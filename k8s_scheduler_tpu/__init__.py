@@ -1,0 +1,24 @@
+"""tpu-scheduler: a TPU-native cluster-scheduling framework.
+
+A from-scratch re-design of the kube-scheduler family that
+`yinwoods/k8s-scheduler` derives from (see SURVEY.md for the blueprint and
+its provenance caveats: the reference mount was empty, so parity targets come
+from the surveyed upstream architecture, tagged [UNVERIFIED] there).
+
+Design in one paragraph: instead of the reference's per-pod `ScheduleOne`
+loop (pop one pod, run Filter plugins over nodes on 16 goroutines, score,
+bind), the whole pending set is scheduled per cycle as ONE batched JAX/XLA
+program. Filter plugins become boolean mask kernels over a pods x nodes
+feasibility matrix, Score plugins become vmapped scoring kernels combined by
+weight, and the reference's sequential state mutation between pods is
+preserved exactly by a greedy commit `lax.scan` over the priority-ordered
+pending set (running allocatable subtraction + running topology-domain
+counts). Preemption is a batched what-if over per-node victim prefixes; gang
+scheduling is group-feasibility + all-or-nothing commit. The host side keeps
+the reference's shape: SchedulerCache (assume/confirm/forget), a
+SchedulingQueue (active/backoff/unschedulable), a plugin registry with the
+upstream extension points, upstream config knobs, and a gRPC shim that takes
+cluster snapshots in and returns the whole queue's bindings in one shot.
+"""
+
+__version__ = "0.1.0"

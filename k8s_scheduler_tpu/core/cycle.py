@@ -1,0 +1,118 @@
+"""The scheduling cycle: one jitted program, pending pods in, bindings out.
+
+This is the TPU-native replacement for the reference's `ScheduleOne` hot
+loop (SURVEY.md §3.2; expected `schedule_one.go` / `core/generic_scheduler.go`
+[UNVERIFIED], mount empty). Where the reference runs, per pod:
+
+    RunPreFilterPlugins -> RunFilterPlugins (16 goroutines over nodes)
+    -> RunScorePlugins -> selectHost -> cache.AssumePod
+
+this program computes, per cycle, for the WHOLE pending set:
+
+    static masks/scores (batched [P, N], everything independent of in-cycle
+    commitments) -> greedy sequential-commit scan (the dynamic residue:
+    resource fit + running-state scores) -> assignment [P]
+
+The minimal slice wires NodeResourcesFit + LeastRequested +
+BalancedAllocation + NodeName/validity masks; further Filter/Score plugins
+contribute additional static masks/scores or dynamic hooks (see
+framework/runtime.py for how the plugin registry assembles them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.encoding import ClusterSnapshot
+from ..ops import commit as commit_ops
+from ..ops import resources as res_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class CycleOptions:
+    """Static knobs baked into the compiled cycle (a change recompiles).
+
+    Score weights follow the upstream default-plugin weights; resources
+    participating in scoring default to cpu+memory like upstream
+    `defaultRequestedRatioResources`."""
+
+    least_requested_weight: float = 1.0
+    balanced_allocation_weight: float = 1.0
+    score_resources: tuple[str, ...] = ("cpu", "memory")
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CycleResult:
+    assignment: jnp.ndarray  # i32 [P] node index or -1
+    node_requested: jnp.ndarray  # f32 [N, R] post-cycle
+    unschedulable: jnp.ndarray  # bool [P] valid pod that found no node
+
+
+def _score_resource_weights(snap: ClusterSnapshot, options: CycleOptions) -> np.ndarray:
+    w = np.zeros(len(snap.resource_names), np.float32)
+    for r in options.score_resources:
+        if r in snap.resource_names:
+            w[snap.resource_names.index(r)] = 1.0
+    return w
+
+
+def static_mask_basic(snap: ClusterSnapshot) -> jnp.ndarray:
+    """Masks independent of both in-cycle commitments and label machinery:
+    node validity (padding), NodeUnschedulable, NodeName pin."""
+    P, N = snap.pod_requested.shape[0], snap.node_allocatable.shape[0]
+    mask = jnp.broadcast_to(
+        snap.node_valid[None, :] & ~snap.node_unschedulable[None, :], (P, N)
+    )
+    # NodeName plugin: a pinned pod may only land on its named node
+    # (pod_node_name -2 = named node unknown -> infeasible everywhere).
+    pinned = snap.pod_node_name[:, None]  # [P, 1]
+    node_ids = jnp.arange(N, dtype=jnp.int32)[None, :]
+    mask = jnp.where(pinned >= 0, mask & (node_ids == pinned), mask)
+    mask = jnp.where(pinned == -2, False, mask)
+    return mask
+
+
+def build_cycle_fn(
+    options: CycleOptions = CycleOptions(),
+) -> Callable[[ClusterSnapshot], CycleResult]:
+    """Compile the minimal-slice cycle. The returned callable is jitted;
+    snapshots with identical padded shapes reuse the compiled program."""
+
+    @jax.jit
+    def cycle(snap: ClusterSnapshot) -> CycleResult:
+        res_w = jnp.asarray(_score_resource_weights(snap, options))
+        smask = static_mask_basic(snap)
+        sscore = jnp.zeros_like(smask, jnp.float32)
+
+        def dyn_fn(p, node_req, _extra):
+            req = snap.pod_requested[p]
+            m = res_ops.fit_mask_single(req, snap.node_allocatable, node_req)
+            s = options.least_requested_weight * res_ops.least_requested_score(
+                req, snap.node_allocatable, node_req, res_w
+            ) + options.balanced_allocation_weight * res_ops.balanced_allocation_score(
+                req, snap.node_allocatable, node_req, res_w
+            )
+            return m, s
+
+        order = jnp.argsort(snap.pod_order)
+        result = commit_ops.greedy_commit(
+            order=order,
+            static_mask=smask,
+            static_score=sscore,
+            pod_requested=snap.pod_requested,
+            pod_valid=snap.pod_valid,
+            pod_nominated=snap.pod_nominated,
+            node_allocatable=snap.node_allocatable,
+            node_requested=snap.node_requested,
+            dyn_fn=dyn_fn,
+        )
+        unsched = snap.pod_valid & (result.assignment < 0)
+        return CycleResult(result.assignment, result.node_requested, unsched)
+
+    return cycle

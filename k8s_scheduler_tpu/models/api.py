@@ -1,0 +1,469 @@
+"""Typed object model for the scheduler's API surface.
+
+This is the subset of the Kubernetes Pod/Node API that the scheduler family
+consumes (the reference's inputs arrive as client-go informer objects; here
+they arrive as these dataclasses, built from dicts/JSON by `from_dict`
+constructors or over the gRPC shim).
+
+Expected upstream shapes (reference mount empty — [UNVERIFIED], SURVEY.md
+§2 C2/C4): `k8s.io/api/core/v1` types consumed by `framework/types.go`.
+
+Conventions:
+- cpu is stored in millicores, memory/storage in bytes (upstream Quantity
+  semantics, normalized at parse time — see utils/quantity.py).
+- `None` everywhere means "field absent", matching k8s optionality.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+from ..utils.quantity import parse_quantity
+
+# Resource names get a fixed axis order in the encoded tensors; cpu/memory
+# first because every workload has them (upstream: v1.ResourceCPU etc.).
+CPU = "cpu"
+MEMORY = "memory"
+PODS = "pods"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+DEFAULT_RESOURCES = (CPU, MEMORY, PODS, EPHEMERAL_STORAGE)
+
+# Taint effects (v1.TaintEffect)
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
+
+# Selector operators (v1.NodeSelectorOperator / metav1.LabelSelectorOperator)
+OP_IN = "In"
+OP_NOT_IN = "NotIn"
+OP_EXISTS = "Exists"
+OP_DOES_NOT_EXIST = "DoesNotExist"
+OP_GT = "Gt"
+OP_LT = "Lt"
+
+# TopologySpreadConstraint.whenUnsatisfiable
+DO_NOT_SCHEDULE = "DoNotSchedule"
+SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+
+def _req_to_internal(requests: Mapping[str, Any]) -> dict[str, float]:
+    out: dict[str, float] = {}
+    for name, q in requests.items():
+        out[name] = parse_quantity(q, as_millis=(name == CPU))
+    return out
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = 0.0
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: tuple[str, ...] = ()
+
+
+@dataclass
+class NodeSelectorTerm:
+    # ANDed requirements; a NodeSelector is an OR over terms.
+    match_expressions: tuple[NodeSelectorRequirement, ...] = ()
+    match_fields: tuple[NodeSelectorRequirement, ...] = ()  # metadata.name only
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+
+@dataclass
+class NodeAffinity:
+    # requiredDuringSchedulingIgnoredDuringExecution
+    required: tuple[NodeSelectorTerm, ...] = ()
+    # preferredDuringSchedulingIgnoredDuringExecution
+    preferred: tuple[PreferredSchedulingTerm, ...] = ()
+
+
+@dataclass
+class LabelSelector:
+    match_labels: dict[str, str] = field(default_factory=dict)
+    match_expressions: tuple[NodeSelectorRequirement, ...] = ()
+
+    def empty(self) -> bool:
+        return not self.match_labels and not self.match_expressions
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: LabelSelector
+    topology_key: str
+    namespaces: tuple[str, ...] = ()  # empty = pod's own namespace
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int
+    term: PodAffinityTerm
+
+
+@dataclass
+class PodAffinity:
+    required: tuple[PodAffinityTerm, ...] = ()
+    preferred: tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass
+class PodAntiAffinity:
+    required: tuple[PodAffinityTerm, ...] = ()
+    preferred: tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass
+class Affinity:
+    node_affinity: NodeAffinity | None = None
+    pod_affinity: PodAffinity | None = None
+    pod_anti_affinity: PodAntiAffinity | None = None
+
+
+@dataclass
+class Toleration:
+    key: str = ""  # empty key + Exists tolerates everything
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+    toleration_seconds: int | None = None
+
+
+@dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = NO_SCHEDULE
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str  # DoNotSchedule | ScheduleAnyway
+    label_selector: LabelSelector = field(default_factory=LabelSelector)
+
+
+@dataclass
+class ContainerPort:
+    container_port: int
+    host_port: int = 0  # 0 = no host port claim
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    image: str = ""
+    requests: dict[str, float] = field(default_factory=dict)  # internal units
+    ports: tuple[ContainerPort, ...] = ()
+
+    @staticmethod
+    def make(name: str, image: str, requests: Mapping[str, Any],
+             ports: tuple[ContainerPort, ...] = ()) -> "Container":
+        return Container(name, image, _req_to_internal(requests), ports)
+
+
+@dataclass
+class PodSpec:
+    containers: tuple[Container, ...] = ()
+    node_name: str = ""  # pre-bound / NodeName plugin target
+    node_selector: dict[str, str] = field(default_factory=dict)
+    affinity: Affinity | None = None
+    tolerations: tuple[Toleration, ...] = ()
+    topology_spread_constraints: tuple[TopologySpreadConstraint, ...] = ()
+    priority: int = 0
+    priority_class_name: str = ""
+    scheduler_name: str = "default-scheduler"
+    overhead: dict[str, float] = field(default_factory=dict)
+    # Gang scheduling (out-of-tree Coscheduling plugin's PodGroup label):
+    pod_group: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta
+    spec: PodSpec
+    # status.nominatedNodeName — set by preemption, honored next cycle
+    nominated_node_name: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    def resource_requests(self) -> dict[str, float]:
+        """Effective pod request = sum over containers (+ overhead), plus the
+        implicit one-"pods"-slot request (upstream computePodResourceRequest;
+        init containers take a max, not modeled yet)."""
+        total: dict[str, float] = {}
+        for c in self.spec.containers:
+            for r, v in c.requests.items():
+                total[r] = total.get(r, 0.0) + v
+        for r, v in self.spec.overhead.items():
+            total[r] = total.get(r, 0.0) + v
+        total[PODS] = total.get(PODS, 0.0) + 1.0
+        return total
+
+    def host_ports(self) -> list[tuple[int, str, str]]:
+        out = []
+        for c in self.spec.containers:
+            for p in c.ports:
+                if p.host_port:
+                    out.append((p.host_port, p.protocol, p.host_ip))
+        return out
+
+    def images(self) -> list[str]:
+        return [c.image for c in self.spec.containers if c.image]
+
+
+@dataclass
+class ContainerImage:
+    names: tuple[str, ...]
+    size_bytes: int = 0
+
+
+@dataclass
+class NodeStatus:
+    allocatable: dict[str, float] = field(default_factory=dict)  # internal units
+    images: tuple[ContainerImage, ...] = ()
+
+
+@dataclass
+class NodeSpec:
+    taints: tuple[Taint, ...] = ()
+    unschedulable: bool = False
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class PodGroup:
+    """Gang-scheduling group (scheduler-plugins Coscheduling PodGroup CRD
+    analogue): schedule min_member members all-or-nothing."""
+
+    name: str
+    min_member: int
+
+
+# ---------------------------------------------------------------------------
+# dict (JSON) constructors — the wire format of the gRPC shim and test
+# fixtures. Accepts the k8s-ish camelCase shapes.
+# ---------------------------------------------------------------------------
+
+
+def _selector_req_from_dict(d: Mapping[str, Any]) -> NodeSelectorRequirement:
+    return NodeSelectorRequirement(
+        key=d["key"], operator=d["operator"], values=tuple(d.get("values", ()))
+    )
+
+
+def _term_from_dict(d: Mapping[str, Any]) -> NodeSelectorTerm:
+    return NodeSelectorTerm(
+        match_expressions=tuple(
+            _selector_req_from_dict(e) for e in d.get("matchExpressions", ())
+        ),
+        match_fields=tuple(
+            _selector_req_from_dict(e) for e in d.get("matchFields", ())
+        ),
+    )
+
+
+def _label_selector_from_dict(d: Mapping[str, Any] | None) -> LabelSelector:
+    if not d:
+        return LabelSelector()
+    return LabelSelector(
+        match_labels=dict(d.get("matchLabels", {})),
+        match_expressions=tuple(
+            _selector_req_from_dict(e) for e in d.get("matchExpressions", ())
+        ),
+    )
+
+
+def _pod_affinity_term_from_dict(d: Mapping[str, Any]) -> PodAffinityTerm:
+    return PodAffinityTerm(
+        label_selector=_label_selector_from_dict(d.get("labelSelector")),
+        topology_key=d.get("topologyKey", ""),
+        namespaces=tuple(d.get("namespaces", ())),
+    )
+
+
+def affinity_from_dict(d: Mapping[str, Any] | None) -> Affinity | None:
+    if not d:
+        return None
+    na = None
+    if "nodeAffinity" in d:
+        nd = d["nodeAffinity"]
+        req = nd.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+        na = NodeAffinity(
+            required=tuple(
+                _term_from_dict(t) for t in req.get("nodeSelectorTerms", ())
+            ),
+            preferred=tuple(
+                PreferredSchedulingTerm(p["weight"], _term_from_dict(p["preference"]))
+                for p in nd.get(
+                    "preferredDuringSchedulingIgnoredDuringExecution", ()
+                )
+            ),
+        )
+    pa = pan = None
+    for key, cls in (("podAffinity", PodAffinity), ("podAntiAffinity", PodAntiAffinity)):
+        if key in d:
+            pd = d[key]
+            obj = cls(
+                required=tuple(
+                    _pod_affinity_term_from_dict(t)
+                    for t in pd.get(
+                        "requiredDuringSchedulingIgnoredDuringExecution", ()
+                    )
+                ),
+                preferred=tuple(
+                    WeightedPodAffinityTerm(
+                        w["weight"],
+                        _pod_affinity_term_from_dict(w["podAffinityTerm"]),
+                    )
+                    for w in pd.get(
+                        "preferredDuringSchedulingIgnoredDuringExecution", ()
+                    )
+                ),
+            )
+            if key == "podAffinity":
+                pa = obj
+            else:
+                pan = obj
+    return Affinity(node_affinity=na, pod_affinity=pa, pod_anti_affinity=pan)
+
+
+def pod_from_dict(d: Mapping[str, Any]) -> Pod:
+    meta = d.get("metadata", {})
+    spec = d.get("spec", {})
+    containers = []
+    for c in spec.get("containers", ()):
+        ports = tuple(
+            ContainerPort(
+                container_port=p.get("containerPort", 0),
+                host_port=p.get("hostPort", 0),
+                protocol=p.get("protocol", "TCP"),
+                host_ip=p.get("hostIP", ""),
+            )
+            for p in c.get("ports", ())
+        )
+        containers.append(
+            Container.make(
+                c.get("name", "main"),
+                c.get("image", ""),
+                (c.get("resources", {}) or {}).get("requests", {}),
+                ports,
+            )
+        )
+    tolerations = tuple(
+        Toleration(
+            key=t.get("key", ""),
+            operator=t.get("operator", "Equal"),
+            value=t.get("value", ""),
+            effect=t.get("effect", ""),
+            toleration_seconds=t.get("tolerationSeconds"),
+        )
+        for t in spec.get("tolerations", ())
+    )
+    tsc = tuple(
+        TopologySpreadConstraint(
+            max_skew=t["maxSkew"],
+            topology_key=t["topologyKey"],
+            when_unsatisfiable=t["whenUnsatisfiable"],
+            label_selector=_label_selector_from_dict(t.get("labelSelector")),
+        )
+        for t in spec.get("topologySpreadConstraints", ())
+    )
+    return Pod(
+        metadata=ObjectMeta(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            uid=meta.get("uid", ""),
+            labels=dict(meta.get("labels", {})),
+            annotations=dict(meta.get("annotations", {})),
+            creation_timestamp=meta.get("creationTimestamp", 0.0),
+        ),
+        spec=PodSpec(
+            containers=tuple(containers),
+            node_name=spec.get("nodeName", ""),
+            node_selector=dict(spec.get("nodeSelector", {})),
+            affinity=affinity_from_dict(spec.get("affinity")),
+            tolerations=tolerations,
+            topology_spread_constraints=tsc,
+            priority=spec.get("priority", 0),
+            priority_class_name=spec.get("priorityClassName", ""),
+            scheduler_name=spec.get("schedulerName", "default-scheduler"),
+            overhead=_req_to_internal(spec.get("overhead", {})),
+            pod_group=spec.get("podGroup", "")
+            or meta.get("labels", {}).get("pod-group.scheduling.sigs.k8s.io", ""),
+        ),
+        nominated_node_name=d.get("status", {}).get("nominatedNodeName", ""),
+    )
+
+
+def node_from_dict(d: Mapping[str, Any]) -> Node:
+    meta = d.get("metadata", {})
+    spec = d.get("spec", {})
+    status = d.get("status", {})
+    return Node(
+        metadata=ObjectMeta(
+            name=meta.get("name", ""),
+            labels=dict(meta.get("labels", {})),
+            creation_timestamp=meta.get("creationTimestamp", 0.0),
+        ),
+        spec=NodeSpec(
+            taints=tuple(
+                Taint(t["key"], t.get("value", ""), t.get("effect", NO_SCHEDULE))
+                for t in spec.get("taints", ())
+            ),
+            unschedulable=bool(spec.get("unschedulable", False)),
+        ),
+        status=NodeStatus(
+            allocatable=_req_to_internal(status.get("allocatable", {})),
+            images=tuple(
+                ContainerImage(tuple(i.get("names", ())), i.get("sizeBytes", 0))
+                for i in status.get("images", ())
+            ),
+        ),
+    )
+
+
+def pod_to_dict(p: Pod) -> dict[str, Any]:
+    """Minimal inverse of pod_from_dict (wire round-trips in tests/shim)."""
+    return dataclasses.asdict(p)

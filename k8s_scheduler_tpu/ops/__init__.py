@@ -1,0 +1,7 @@
+from .resources import (  # noqa: F401
+    MAX_NODE_SCORE,
+    balanced_allocation_score,
+    fit_mask,
+    least_requested_score,
+)
+from .commit import CommitResult, greedy_commit  # noqa: F401

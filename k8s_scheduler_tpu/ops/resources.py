@@ -1,0 +1,107 @@
+"""Resource-fit mask and resource-based score kernels.
+
+TPU-native re-design of the reference's `NodeResourcesFit` Filter plugin and
+`LeastRequested` / `NodeResourcesBalancedAllocation` Score plugins (expected
+upstream locations `framework/plugins/noderesources/*` or
+`algorithm/{predicates,priorities}` — [UNVERIFIED], reference mount empty;
+SURVEY.md §2 C7/C8): instead of a per-pod, per-node Go loop over 16
+goroutines, the whole pods x nodes matrix is computed in one fused XLA
+program (the MXU/VPU does the batching; no Parallelizer needed).
+
+Numerics: quantities are float32 (cpu in millicores, memory in bytes).
+Upstream uses int64; float32 ulp at 16Gi is 1KiB, far below scheduling
+granularity, and all comparisons use a relative epsilon so aggregation
+rounding never flips a feasibility bit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MAX_NODE_SCORE = 100.0
+_REL_EPS = 1e-5
+
+
+def fit_mask(
+    pod_requested: jnp.ndarray,  # f32 [P, R]
+    node_allocatable: jnp.ndarray,  # f32 [N, R]
+    node_requested: jnp.ndarray,  # f32 [N, R]
+) -> jnp.ndarray:  # bool [P, N]
+    """NodeResourcesFit: pod fits iff for every resource
+    requested_pod + requested_node <= allocatable."""
+    free = node_allocatable - node_requested  # [N, R]
+    slack = _REL_EPS * node_allocatable + _REL_EPS
+    return jnp.all(
+        pod_requested[:, None, :] <= free[None, :, :] + slack[None, :, :], axis=-1
+    )
+
+
+def fit_mask_single(
+    pod_requested: jnp.ndarray,  # f32 [R]
+    node_allocatable: jnp.ndarray,  # f32 [N, R]
+    node_requested: jnp.ndarray,  # f32 [N, R]
+) -> jnp.ndarray:  # bool [N]
+    free = node_allocatable - node_requested
+    slack = _REL_EPS * node_allocatable + _REL_EPS
+    return jnp.all(pod_requested[None, :] <= free + slack, axis=-1)
+
+
+def _used_fraction(
+    pod_requested: jnp.ndarray,  # f32 [R] or [P, R] broadcastable
+    node_allocatable: jnp.ndarray,  # f32 [N, R]
+    node_requested: jnp.ndarray,  # f32 [N, R]
+) -> jnp.ndarray:
+    """(node_requested + pod) / allocatable per resource, 1.0 where
+    allocatable is 0 (a zero-capacity resource is fully used)."""
+    after = node_requested + pod_requested
+    return jnp.where(node_allocatable > 0, after / jnp.maximum(node_allocatable, 1e-9), 1.0)
+
+
+def least_requested_score(
+    pod_requested: jnp.ndarray,  # f32 [R] (single pod) or [P, 1, R]
+    node_allocatable: jnp.ndarray,  # f32 [N, R]
+    node_requested: jnp.ndarray,  # f32 [N, R]
+    resource_weights: jnp.ndarray,  # f32 [R] (0 excludes a resource)
+) -> jnp.ndarray:  # f32 [N] or [P, N]
+    """LeastRequested: mean over weighted resources of
+    (allocatable - requested_after) / allocatable * 100.
+
+    Matches upstream leastResourceScorer: per-resource score
+    ((capacity - requested) * MaxNodeScore / capacity), combined as a
+    weight-weighted average. cpu/memory weight 1 by default."""
+    frac = _used_fraction(pod_requested, node_allocatable, node_requested)
+    per_res = (1.0 - jnp.clip(frac, 0.0, 1.0)) * MAX_NODE_SCORE
+    wsum = jnp.maximum(jnp.sum(resource_weights), 1e-9)
+    return jnp.sum(per_res * resource_weights, axis=-1) / wsum
+
+
+def balanced_allocation_score(
+    pod_requested: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    node_requested: jnp.ndarray,
+    resource_weights: jnp.ndarray,  # f32 [R] — which resources participate
+) -> jnp.ndarray:
+    """NodeResourcesBalancedAllocation: (1 - std(fractions)) * 100 over the
+    participating resources (upstream balancedResourceScorer, current era:
+    standard deviation over resource usage fractions)."""
+    frac = jnp.clip(
+        _used_fraction(pod_requested, node_allocatable, node_requested), 0.0, 1.0
+    )
+    w = resource_weights > 0
+    n = jnp.maximum(jnp.sum(w), 1)
+    mean = jnp.sum(jnp.where(w, frac, 0.0), axis=-1, keepdims=True) / n
+    var = jnp.sum(jnp.where(w, (frac - mean) ** 2, 0.0), axis=-1) / n
+    return (1.0 - jnp.sqrt(var)) * MAX_NODE_SCORE
+
+
+def most_requested_score(
+    pod_requested: jnp.ndarray,
+    node_allocatable: jnp.ndarray,
+    node_requested: jnp.ndarray,
+    resource_weights: jnp.ndarray,
+) -> jnp.ndarray:
+    """MostRequested (bin-packing variant of LeastRequested)."""
+    frac = _used_fraction(pod_requested, node_allocatable, node_requested)
+    per_res = jnp.clip(frac, 0.0, 1.0) * MAX_NODE_SCORE
+    wsum = jnp.maximum(jnp.sum(resource_weights), 1e-9)
+    return jnp.sum(per_res * resource_weights, axis=-1) / wsum

@@ -1,0 +1,480 @@
+"""Oracle: a straightforward per-pod Python reimplementation of the
+reference scheduler's semantics, used as the differential-test ground truth
+for the batched JAX kernels (SURVEY.md §4 "build-side additions") and as the
+CPU fallback path when no accelerator is available.
+
+It deliberately mirrors the reference's shape — one pod at a time in
+priority order, Filter plugins then Score plugins then selectHost, state
+updated between pods (SURVEY.md §3.2) — NOT the batched design, so that
+agreement between the two is meaningful evidence of parity.
+
+Tie-breaking: lowest node index on equal score (the deterministic stand-in
+for upstream's random reservoir tie-break; both implementations use it so
+differential tests are exact).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+from .models import api
+from .models.api import (
+    Affinity,
+    LabelSelector,
+    Node,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinityTerm,
+)
+
+MAX_NODE_SCORE = 100.0
+
+
+def _match_expression(labels: dict[str, str], req: NodeSelectorRequirement,
+                      name: str | None = None) -> bool:
+    """labels.Requirement semantics: NotIn/DoesNotExist match on absent key."""
+    op = req.operator
+    if name is not None:  # matchFields metadata.name
+        if op == api.OP_IN:
+            return name in req.values
+        if op == api.OP_NOT_IN:
+            return name not in req.values
+        return False
+    present = req.key in labels
+    val = labels.get(req.key)
+    if op == api.OP_IN:
+        return present and val in req.values
+    if op == api.OP_NOT_IN:
+        return not present or val not in req.values
+    if op == api.OP_EXISTS:
+        return present
+    if op == api.OP_DOES_NOT_EXIST:
+        return not present
+    if op == api.OP_GT:
+        try:
+            return present and float(val) > float(req.values[0])
+        except (ValueError, IndexError):
+            return False
+    if op == api.OP_LT:
+        try:
+            return present and float(val) < float(req.values[0])
+        except (ValueError, IndexError):
+            return False
+    raise ValueError(f"unknown operator {op}")
+
+
+def _match_term(node: Node, term: NodeSelectorTerm) -> bool:
+    labels = _node_labels(node)
+    return all(
+        _match_expression(labels, e) for e in term.match_expressions
+    ) and all(
+        _match_expression({}, e, name=node.name) for e in term.match_fields
+    )
+
+
+def _node_labels(node: Node) -> dict[str, str]:
+    labels = dict(node.metadata.labels)
+    labels.setdefault("kubernetes.io/hostname", node.name)
+    return labels
+
+
+def match_label_selector(sel: LabelSelector, labels: dict[str, str]) -> bool:
+    for k, v in sel.match_labels.items():
+        if labels.get(k) != v:
+            return False
+    return all(_match_expression(labels, e) for e in sel.match_expressions)
+
+
+def tolerates(pod: Pod, taint: api.Taint) -> bool:
+    for t in pod.spec.tolerations:
+        if t.effect and t.effect != taint.effect:
+            continue
+        if t.operator == "Exists":
+            if t.key == "" or t.key == taint.key:
+                return True
+        else:  # Equal
+            if t.key == taint.key and t.value == taint.value:
+                return True
+    return False
+
+
+@dataclasses.dataclass
+class OracleState:
+    """Mutable per-node state mirroring NodeInfo aggregation."""
+
+    nodes: list[Node]
+    requested: list[dict[str, float]]  # per node
+    pods_on_node: list[list[Pod]]  # per node (existing + committed this run)
+
+    @staticmethod
+    def build(nodes: Sequence[Node], existing: Sequence[tuple[Pod, str]]) -> "OracleState":
+        idx = {n.name: i for i, n in enumerate(nodes)}
+        st = OracleState(
+            nodes=list(nodes),
+            requested=[{} for _ in nodes],
+            pods_on_node=[[] for _ in nodes],
+        )
+        for pod, node_name in existing:
+            i = idx.get(node_name)
+            if i is None:
+                continue
+            st.add(i, pod)
+        return st
+
+    def add(self, node_idx: int, pod: Pod) -> None:
+        for r, v in pod.resource_requests().items():
+            self.requested[node_idx][r] = self.requested[node_idx].get(r, 0.0) + v
+        self.pods_on_node[node_idx].append(pod)
+
+    def remove(self, node_idx: int, pod: Pod) -> None:
+        for r, v in pod.resource_requests().items():
+            self.requested[node_idx][r] = self.requested[node_idx].get(r, 0.0) - v
+        self.pods_on_node[node_idx].remove(pod)
+
+    def free(self, node_idx: int) -> dict[str, float]:
+        alloc = self.nodes[node_idx].status.allocatable
+        return {
+            r: alloc.get(r, 0.0) - self.requested[node_idx].get(r, 0.0)
+            for r in set(alloc) | set(self.requested[node_idx])
+        }
+
+
+# --------------------------------------------------------------------------
+# Filter plugins (feasibility predicates)
+# --------------------------------------------------------------------------
+
+
+def filter_node_resources_fit(pod: Pod, state: OracleState, i: int) -> bool:
+    alloc = state.nodes[i].status.allocatable
+    used = state.requested[i]
+    for r, v in pod.resource_requests().items():
+        if used.get(r, 0.0) + v > alloc.get(r, 0.0) * (1 + 1e-5) + 1e-5:
+            return False
+    return True
+
+
+def filter_node_name(pod: Pod, state: OracleState, i: int) -> bool:
+    return not pod.spec.node_name or pod.spec.node_name == state.nodes[i].name
+
+
+def filter_node_unschedulable(pod: Pod, state: OracleState, i: int) -> bool:
+    return not state.nodes[i].spec.unschedulable
+
+
+def filter_node_affinity(pod: Pod, state: OracleState, i: int) -> bool:
+    node = state.nodes[i]
+    labels = _node_labels(node)
+    for k, v in pod.spec.node_selector.items():
+        if labels.get(k) != v:
+            return False
+    aff = pod.spec.affinity
+    if aff and aff.node_affinity and aff.node_affinity.required:
+        if not any(_match_term(node, t) for t in aff.node_affinity.required):
+            return False
+    return True
+
+
+def filter_taint_toleration(pod: Pod, state: OracleState, i: int) -> bool:
+    for taint in state.nodes[i].spec.taints:
+        if taint.effect in (api.NO_SCHEDULE, api.NO_EXECUTE) and not tolerates(pod, taint):
+            return False
+    return True
+
+
+def filter_node_ports(pod: Pod, state: OracleState, i: int) -> bool:
+    wanted = {(p, proto) for (p, proto, _ip) in pod.host_ports()}
+    if not wanted:
+        return True
+    used = set()
+    for other in state.pods_on_node[i]:
+        for (p, proto, _ip) in other.host_ports():
+            used.add((p, proto))
+    return not (wanted & used)
+
+
+def _domain(node: Node, topology_key: str) -> str | None:
+    return _node_labels(node).get(topology_key)
+
+
+def _term_matches_pod(term: PodAffinityTerm, own_ns: str, other: Pod) -> bool:
+    namespaces = term.namespaces or (own_ns,)
+    if other.namespace not in namespaces:
+        return False
+    return match_label_selector(term.label_selector, other.metadata.labels)
+
+
+def filter_inter_pod_affinity(pod: Pod, state: OracleState, i: int) -> bool:
+    node = state.nodes[i]
+    aff = pod.spec.affinity or Affinity()
+    # required pod affinity: each term needs >=1 matching pod in the domain
+    if aff.pod_affinity:
+        for term in aff.pod_affinity.required:
+            dom = _domain(node, term.topology_key)
+            if dom is None:
+                return False
+            found = False
+            for j, nd in enumerate(state.nodes):
+                if _domain(nd, term.topology_key) != dom:
+                    continue
+                for other in state.pods_on_node[j]:
+                    if _term_matches_pod(term, pod.namespace, other):
+                        found = True
+                        break
+                if found:
+                    break
+            if not found:
+                return False
+    # required anti-affinity: no matching pod in the domain
+    if aff.pod_anti_affinity:
+        for term in aff.pod_anti_affinity.required:
+            dom = _domain(node, term.topology_key)
+            if dom is None:
+                continue  # upstream: absent key -> term can't be violated
+            for j, nd in enumerate(state.nodes):
+                if _domain(nd, term.topology_key) != dom:
+                    continue
+                for other in state.pods_on_node[j]:
+                    if _term_matches_pod(term, pod.namespace, other):
+                        return False
+    # symmetry: existing pods' required anti-affinity must not be violated
+    for j, nd in enumerate(state.nodes):
+        for other in state.pods_on_node[j]:
+            oa = other.spec.affinity
+            if not oa or not oa.pod_anti_affinity:
+                continue
+            for term in oa.pod_anti_affinity.required:
+                dom_other = _domain(nd, term.topology_key)
+                dom_new = _domain(node, term.topology_key)
+                if dom_other is None or dom_new != dom_other:
+                    continue
+                if _term_matches_pod(term, other.namespace, pod):
+                    return False
+    return True
+
+
+def filter_topology_spread(pod: Pod, state: OracleState, i: int) -> bool:
+    node = state.nodes[i]
+    for c in pod.spec.topology_spread_constraints:
+        if c.when_unsatisfiable != api.DO_NOT_SCHEDULE:
+            continue
+        dom = _domain(node, c.topology_key)
+        if dom is None:
+            return False
+        counts: dict[str, int] = {}
+        for j, nd in enumerate(state.nodes):
+            d = _domain(nd, c.topology_key)
+            if d is None:
+                continue
+            counts.setdefault(d, 0)
+            for other in state.pods_on_node[j]:
+                if other.namespace == pod.namespace and match_label_selector(
+                    c.label_selector, other.metadata.labels
+                ):
+                    counts[d] += 1
+        if not counts:
+            continue
+        min_count = min(counts.values())
+        if counts.get(dom, 0) + 1 - min_count > c.max_skew:
+            return False
+    return True
+
+
+DEFAULT_FILTERS = (
+    filter_node_unschedulable,
+    filter_node_name,
+    filter_taint_toleration,
+    filter_node_affinity,
+    filter_node_ports,
+    filter_node_resources_fit,
+    filter_inter_pod_affinity,
+    filter_topology_spread,
+)
+
+
+# --------------------------------------------------------------------------
+# Score plugins
+# --------------------------------------------------------------------------
+
+
+def _score_fracs(pod: Pod, state: OracleState, i: int,
+                 resources: Sequence[str]) -> list[float]:
+    alloc = state.nodes[i].status.allocatable
+    req = pod.resource_requests()
+    fracs = []
+    for r in resources:
+        a = alloc.get(r, 0.0)
+        after = state.requested[i].get(r, 0.0) + req.get(r, 0.0)
+        fracs.append(min(max(after / a, 0.0), 1.0) if a > 0 else 1.0)
+    return fracs
+
+
+def score_least_requested(pod: Pod, state: OracleState, i: int,
+                          resources: Sequence[str] = ("cpu", "memory")) -> float:
+    fracs = _score_fracs(pod, state, i, resources)
+    return sum((1.0 - f) * MAX_NODE_SCORE for f in fracs) / len(fracs)
+
+
+def score_balanced_allocation(pod: Pod, state: OracleState, i: int,
+                              resources: Sequence[str] = ("cpu", "memory")) -> float:
+    fracs = _score_fracs(pod, state, i, resources)
+    mean = sum(fracs) / len(fracs)
+    var = sum((f - mean) ** 2 for f in fracs) / len(fracs)
+    return (1.0 - math.sqrt(var)) * MAX_NODE_SCORE
+
+
+def score_node_affinity(pod: Pod, state: OracleState, i: int) -> float:
+    aff = pod.spec.affinity
+    if not aff or not aff.node_affinity or not aff.node_affinity.preferred:
+        return 0.0
+    total = sum(p.weight for p in aff.node_affinity.preferred)
+    if total <= 0:
+        return 0.0
+    got = sum(
+        p.weight
+        for p in aff.node_affinity.preferred
+        if _match_term(state.nodes[i], p.preference)
+    )
+    return got / total * MAX_NODE_SCORE
+
+
+def score_taint_toleration(pod: Pod, state: OracleState, i: int) -> float:
+    """Fewer untolerated PreferNoSchedule taints -> higher score."""
+    taints = [
+        t for t in state.nodes[i].spec.taints if t.effect == api.PREFER_NO_SCHEDULE
+    ]
+    if not taints:
+        return MAX_NODE_SCORE
+    untol = sum(1 for t in taints if not tolerates(pod, t))
+    return (1.0 - untol / len(taints)) * MAX_NODE_SCORE
+
+
+def score_image_locality(pod: Pod, state: OracleState, i: int) -> float:
+    images = {}
+    for img in state.nodes[i].status.images:
+        for nm in img.names:
+            images[nm] = img.size_bytes
+    have = sum(images.get(im, 0) for im in pod.images())
+    # upstream scales by image size between thresholds (23MB..1GB) and by
+    # the spread of the image across nodes; we use the size ramp only.
+    lo, hi = 23 * 2**20, 2**30
+    clipped = min(max(have, lo), hi)
+    return (clipped - lo) / (hi - lo) * MAX_NODE_SCORE
+
+
+def score_inter_pod_affinity(pod: Pod, state: OracleState, i: int) -> float:
+    """Preferred affinity/anti-affinity terms, both directions (incoming
+    pod's preferences against existing pods, and existing pods' preferences
+    against the incoming pod). Raw weighted sum; normalized by caller."""
+    node = state.nodes[i]
+    score = 0.0
+    aff = pod.spec.affinity or Affinity()
+    prefs = []
+    if aff.pod_affinity:
+        prefs += [(w.weight, w.term) for w in aff.pod_affinity.preferred]
+    if aff.pod_anti_affinity:
+        prefs += [(-w.weight, w.term) for w in aff.pod_anti_affinity.preferred]
+    for weight, term in prefs:
+        dom = _domain(node, term.topology_key)
+        if dom is None:
+            continue
+        for j, nd in enumerate(state.nodes):
+            if _domain(nd, term.topology_key) != dom:
+                continue
+            for other in state.pods_on_node[j]:
+                if _term_matches_pod(term, pod.namespace, other):
+                    score += weight
+    # symmetric: existing pods' preferred terms matching the incoming pod
+    for j, nd in enumerate(state.nodes):
+        for other in state.pods_on_node[j]:
+            oa = other.spec.affinity or Affinity()
+            oprefs = []
+            if oa.pod_affinity:
+                oprefs += [(w.weight, w.term) for w in oa.pod_affinity.preferred]
+            if oa.pod_anti_affinity:
+                oprefs += [(-w.weight, w.term) for w in oa.pod_anti_affinity.preferred]
+            for weight, term in oprefs:
+                dom_other = _domain(nd, term.topology_key)
+                if dom_other is None or _domain(node, term.topology_key) != dom_other:
+                    continue
+                if _term_matches_pod(term, other.namespace, pod):
+                    score += weight
+    return score
+
+
+# --------------------------------------------------------------------------
+# The sequential scheduler
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class OracleDecision:
+    pod: Pod
+    node_index: int  # -1 = unschedulable
+
+
+@dataclasses.dataclass(frozen=True)
+class OracleWeights:
+    least_requested: float = 1.0
+    balanced_allocation: float = 1.0
+    node_affinity: float = 0.0
+    taint_toleration: float = 0.0
+    image_locality: float = 0.0
+    inter_pod_affinity: float = 0.0
+
+
+def schedule(
+    nodes: Sequence[Node],
+    pending: Sequence[Pod],
+    existing: Sequence[tuple[Pod, str]] = (),
+    weights: OracleWeights = OracleWeights(),
+    filters=DEFAULT_FILTERS,
+) -> list[OracleDecision]:
+    """Sequential greedy scheduling in (priority desc, creation asc) order —
+    the reference's queue order (PrioritySort QueueSort plugin)."""
+    state = OracleState.build(nodes, existing)
+    order = sorted(
+        range(len(pending)),
+        key=lambda i: (-pending[i].spec.priority,
+                       pending[i].metadata.creation_timestamp, i),
+    )
+    decisions: dict[int, int] = {}
+    for pi in order:
+        pod = pending[pi]
+        feasible = [
+            i
+            for i in range(len(nodes))
+            if all(f(pod, state, i) for f in filters)
+        ]
+        # nominated node honored first when feasible
+        if pod.nominated_node_name:
+            for i in feasible:
+                if nodes[i].name == pod.nominated_node_name:
+                    feasible = [i]
+                    break
+        if not feasible:
+            decisions[pi] = -1
+            continue
+        best, best_score = -1, -float("inf")
+        raw_ipa = {}
+        if weights.inter_pod_affinity:
+            raw_ipa = {i: score_inter_pod_affinity(pod, state, i) for i in feasible}
+            hi = max(map(abs, raw_ipa.values()), default=0.0)
+        for i in feasible:
+            s = (
+                weights.least_requested * score_least_requested(pod, state, i)
+                + weights.balanced_allocation * score_balanced_allocation(pod, state, i)
+                + weights.node_affinity * score_node_affinity(pod, state, i)
+                + weights.taint_toleration * score_taint_toleration(pod, state, i)
+                + weights.image_locality * score_image_locality(pod, state, i)
+            )
+            if weights.inter_pod_affinity and hi > 0:
+                s += weights.inter_pod_affinity * (raw_ipa[i] / hi) * MAX_NODE_SCORE
+            if s > best_score:
+                best, best_score = i, s
+        decisions[pi] = best
+        if best >= 0:
+            state.add(best, pod)
+    return [OracleDecision(pending[i], decisions[i]) for i in range(len(pending))]

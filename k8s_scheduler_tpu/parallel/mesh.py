@@ -1,0 +1,70 @@
+"""Device mesh + sharding layout for the scheduling program.
+
+The reference's intra-process parallelism is a 16-goroutine `Parallelizer`
+fanning Filter/Score over nodes (SURVEY.md §2 C6 — [UNVERIFIED], mount
+empty); its distributed story is HTTPS to the API server. The TPU-native
+equivalents (SURVEY.md §2 parallelism checklist, §5.8): the batched static
+phase shards the **pods axis** across mesh devices (data-parallel masks and
+scores; XLA inserts ICI collectives where the commit scan needs the full
+row), and at 5k-node scale the **nodes axis** can shard on a second mesh
+dimension. No NCCL/MPI — `jax.sharding` + XLA collectives only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+def make_mesh(devices=None, nodes_axis: int = 1):
+    """1-D ('pods',) mesh by default; pass nodes_axis>1 for a 2-D
+    ('pods','nodes') mesh at large node counts."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if nodes_axis > 1:
+        assert n % nodes_axis == 0
+        arr = np.array(devices).reshape(n // nodes_axis, nodes_axis)
+        return Mesh(arr, ("pods", "nodes"))
+    return Mesh(np.array(devices), ("pods",))
+
+
+def shard_snapshot(snap, mesh):
+    """Lay out a ClusterSnapshot over the mesh: pod-axis arrays sharded on
+    'pods' (and node-axis arrays on 'nodes' when the mesh has that axis);
+    everything else replicated. Arrays whose leading dim doesn't divide the
+    mesh axis stay replicated (tiny dedup tables are cheaper replicated
+    than gathered)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    pods_size = mesh.shape["pods"]
+    nodes_size = mesh.shape.get("nodes", 1)
+
+    out = {}
+    for f in dataclasses.fields(snap):
+        v = getattr(snap, f.name)
+        if not isinstance(v, (np.ndarray, jax.Array)):
+            out[f.name] = v
+            continue
+        spec = [None] * v.ndim
+        if (
+            f.name.startswith("pod_")
+            and v.ndim >= 1
+            and v.shape[0] % pods_size == 0
+        ):
+            spec[0] = "pods"
+        elif (
+            f.name.startswith("node_")
+            and nodes_size > 1
+            and v.ndim >= 1
+            and v.shape[0] % nodes_size == 0
+        ):
+            spec[0] = "nodes"
+        out[f.name] = jax.device_put(
+            v, NamedSharding(mesh, PartitionSpec(*spec))
+        )
+    return dataclasses.replace(snap, **{k: v for k, v in out.items()})

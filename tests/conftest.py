@@ -1,0 +1,22 @@
+"""Test environment: force an 8-device virtual CPU mesh.
+
+This environment's sitecustomize imports jax and registers the axon TPU
+PJRT plugin at interpreter start, so env vars are already baked into
+jax.config by the time pytest runs — `jax.config.update` (not os.environ)
+is the only switch that still works here. Tests must never touch the real
+TPU tunnel (single chip, slow first-compile); multi-chip sharding is
+exercised on the virtual CPU mesh instead, as the driver does via
+`__graft_entry__.dryrun_multichip`.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

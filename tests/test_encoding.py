@@ -1,0 +1,153 @@
+import numpy as np
+
+from k8s_scheduler_tpu.models import MakeNode, MakePod, SnapshotEncoder
+from k8s_scheduler_tpu.models import api
+from k8s_scheduler_tpu.utils import parse_quantity
+
+
+def test_parse_quantity():
+    assert parse_quantity("100m", as_millis=True) == 100.0
+    assert parse_quantity("2", as_millis=True) == 2000.0
+    assert parse_quantity("1Gi") == 2**30
+    assert parse_quantity("512Mi") == 512 * 2**20
+    assert parse_quantity("1500m", as_millis=True) == 1500.0
+    assert parse_quantity("1k") == 1000.0
+    assert parse_quantity("2e3") == 2000.0
+    assert parse_quantity(2, as_millis=True) == 2000.0
+
+
+def test_pod_resource_requests():
+    p = MakePod("a").req({"cpu": "500m", "memory": "1Gi"}).obj()
+    r = p.resource_requests()
+    assert r["cpu"] == 500.0
+    assert r["memory"] == 2**30
+    assert r["pods"] == 1.0  # implicit pod-slot request
+
+
+def test_encode_basic_shapes():
+    nodes = [
+        MakeNode(f"n{i}").capacity({"cpu": "4", "memory": "8Gi"}).obj()
+        for i in range(3)
+    ]
+    pods = [MakePod(f"p{i}").req({"cpu": "1"}).obj() for i in range(5)]
+    snap = SnapshotEncoder().encode(nodes, pods)
+    assert snap.num_nodes == 3 and snap.num_pending == 5
+    assert snap.N >= 3 and snap.P >= 5  # padded
+    assert snap.node_valid[:3].all() and not snap.node_valid[3:].any()
+    cpu = snap.resource_names.index("cpu")
+    assert np.allclose(snap.node_allocatable[:3, cpu], 4000.0)
+    assert np.allclose(snap.pod_requested[:5, cpu], 1000.0)
+
+
+def test_encode_existing_pods_aggregate():
+    nodes = [MakeNode("n0").capacity({"cpu": "4"}).obj(),
+             MakeNode("n1").capacity({"cpu": "4"}).obj()]
+    existing = [
+        (MakePod("e0").req({"cpu": "1"}).obj(), "n0"),
+        (MakePod("e1").req({"cpu": "2"}).obj(), "n0"),
+    ]
+    snap = SnapshotEncoder().encode(nodes, [], existing)
+    cpu = snap.resource_names.index("cpu")
+    assert snap.node_requested[0, cpu] == 3000.0
+    assert snap.node_requested[1, cpu] == 0.0
+    # preemption table: sorted ascending by priority
+    assert set(snap.node_pods[0][snap.node_pods[0] >= 0].tolist()) == {0, 1}
+
+
+def test_encode_priority_order():
+    nodes = [MakeNode("n0").capacity({"cpu": "4"}).obj()]
+    pods = [
+        MakePod("low").priority(1).created(5).obj(),
+        MakePod("high").priority(10).created(9).obj(),
+        MakePod("mid-old").priority(5).created(1).obj(),
+        MakePod("mid-new").priority(5).created(2).obj(),
+    ]
+    snap = SnapshotEncoder().encode(nodes, pods)
+    # rank: high(0), mid-old(1), mid-new(2), low(3)
+    assert snap.pod_order[:4].tolist() == [3, 0, 1, 2]
+
+
+def test_encode_taints_tolerations_dedup():
+    nodes = [
+        MakeNode("n0").capacity({"cpu": "1"}).taint("gpu", "true").obj(),
+        MakeNode("n1").capacity({"cpu": "1"}).taint("gpu", "true").obj(),
+        MakeNode("n2").capacity({"cpu": "1"}).obj(),
+    ]
+    pods = [
+        MakePod("p0").toleration("gpu", "true", api.NO_SCHEDULE).obj(),
+        MakePod("p1").toleration("gpu", "true", api.NO_SCHEDULE).obj(),
+        MakePod("p2").obj(),
+    ]
+    snap = SnapshotEncoder().encode(nodes, pods)
+    # dedup: both tainted nodes share a taint-set id
+    assert snap.node_taintset[0] == snap.node_taintset[1]
+    assert snap.node_taintset[0] != snap.node_taintset[2]
+    assert snap.pod_tolset[0] == snap.pod_tolset[1]
+    assert snap.pod_tolset[0] != snap.pod_tolset[2]
+
+
+def test_encode_node_affinity_dedup():
+    nodes = [MakeNode("n0").capacity({"cpu": "1"}).labels({"zone": "a"}).obj()]
+    pods = [
+        MakePod("p0").node_affinity_in("zone", ["a", "b"]).obj(),
+        MakePod("p1").node_affinity_in("zone", ["a", "b"]).obj(),
+        MakePod("p2").node_affinity_in("zone", ["c"]).obj(),
+        MakePod("p3").obj(),
+    ]
+    snap = SnapshotEncoder().encode(nodes, pods)
+    assert snap.pod_req_id[0] == snap.pod_req_id[1]
+    assert snap.pod_req_id[0] != snap.pod_req_id[2]
+    assert snap.pod_req_id[3] == -1
+
+
+def test_encode_topology_domains():
+    nodes = [
+        MakeNode("n0").capacity({"cpu": "1"}).labels({"zone": "a"}).obj(),
+        MakeNode("n1").capacity({"cpu": "1"}).labels({"zone": "a"}).obj(),
+        MakeNode("n2").capacity({"cpu": "1"}).labels({"zone": "b"}).obj(),
+    ]
+    pods = [MakePod("p0").pod_affinity("zone", {"app": "web"}).obj()]
+    snap = SnapshotEncoder().encode(nodes, pods)
+    assert "zone" in snap.topology_keys
+    k = snap.topology_keys.index("zone")
+    # n0,n1 same zone-domain; n2 different; hostname domains all distinct
+    assert snap.node_domains[0, k] == snap.node_domains[1, k]
+    assert snap.node_domains[0, k] != snap.node_domains[2, k]
+    # hostname is always topology key 0; its domains are all distinct
+    assert len({int(snap.node_domains[i, 0]) for i in range(3)}) == 3
+
+
+def test_snapshot_is_pytree():
+    import jax
+
+    nodes = [MakeNode("n0").capacity({"cpu": "1"}).obj()]
+    pods = [MakePod("p0").req({"cpu": "1"}).obj()]
+    snap = SnapshotEncoder().encode(nodes, pods)
+    leaves = jax.tree_util.tree_leaves(snap)
+    assert all(isinstance(x, np.ndarray) for x in leaves)
+    # round-trips through flatten/unflatten with static meta preserved
+    flat, treedef = jax.tree_util.tree_flatten(snap)
+    snap2 = jax.tree_util.tree_unflatten(treedef, flat)
+    assert snap2.resource_names == snap.resource_names
+    assert snap2.num_nodes == 1
+
+
+def test_encode_malformed_gt_and_matchfields_no_crash():
+    from k8s_scheduler_tpu.models.api import (
+        NodeSelectorRequirement, NodeSelectorTerm,
+    )
+    from k8s_scheduler_tpu.models import encoding as enc_mod
+
+    nodes = [MakeNode("n0").capacity({"cpu": "1"}).obj()]
+    bad_gt = MakePod("bad-gt").node_affinity_required(
+        NodeSelectorTerm((NodeSelectorRequirement("size", "Gt", ("abc",)),))
+    ).obj()
+    bad_field = MakePod("bad-field").node_affinity_required(
+        NodeSelectorTerm(match_fields=(
+            NodeSelectorRequirement("metadata.name", "Exists", ()),
+        ))
+    ).obj()
+    snap = SnapshotEncoder().encode(nodes, [bad_gt, bad_field])
+    # both malformed requirements compile to the never-matching expression
+    assert (snap.ex_op == enc_mod.OP_IMPOSSIBLE).any()
+    assert snap.pod_req_id[0] >= 0 and snap.pod_req_id[1] >= 0

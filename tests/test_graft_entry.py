@@ -1,0 +1,25 @@
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as ge  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    import jax
+
+    fn, args = ge.entry()
+    result = jax.jit(fn)(*args)
+    a = np.asarray(result.assignment)
+    assert (a[:8] >= 0).all()  # tiny cluster has room for all 8 pods
+
+
+def test_dryrun_multichip_8():
+    ge.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_2():
+    ge.dryrun_multichip(2)

@@ -1,0 +1,127 @@
+"""Differential tests: batched JAX cycle vs the sequential Python oracle
+(benchmark config #1 territory: resource fit + least-requested/balanced)."""
+
+import numpy as np
+import pytest
+
+from k8s_scheduler_tpu import oracle
+from k8s_scheduler_tpu.core import CycleOptions, build_cycle_fn
+from k8s_scheduler_tpu.models import MakeNode, MakePod, SnapshotEncoder
+
+
+def run_both(nodes, pods, existing=(), options=CycleOptions()):
+    snap = SnapshotEncoder().encode(nodes, pods, existing)
+    result = build_cycle_fn(options)(snap)
+    got = np.asarray(result.assignment)[: len(pods)]
+    want = [
+        d.node_index
+        for d in oracle.schedule(nodes, pods, existing,
+                                 weights=oracle.OracleWeights())
+    ]
+    return got.tolist(), want, result
+
+
+def test_single_pod_picks_least_loaded():
+    nodes = [
+        MakeNode("n0").capacity({"cpu": "4", "memory": "8Gi"}).obj(),
+        MakeNode("n1").capacity({"cpu": "4", "memory": "8Gi"}).obj(),
+    ]
+    existing = [(MakePod("e0").req({"cpu": "2", "memory": "4Gi"}).obj(), "n0")]
+    pods = [MakePod("p0").req({"cpu": "1", "memory": "1Gi"}).obj()]
+    got, want, _ = run_both(nodes, pods, existing)
+    assert got == want == [1]
+
+
+def test_capacity_exhaustion_sequential_commit():
+    # one node fits only two of the three pods: the third must go elsewhere
+    nodes = [
+        MakeNode("n0").capacity({"cpu": "2", "memory": "4Gi"}).obj(),
+        MakeNode("n1").capacity({"cpu": "8", "memory": "16Gi"}).obj(),
+    ]
+    pods = [MakePod(f"p{i}").req({"cpu": "900m", "memory": "1Gi"}).obj()
+            for i in range(6)]
+    got, want, _ = run_both(nodes, pods)
+    assert got == want
+
+
+def test_unschedulable_when_full():
+    nodes = [MakeNode("n0").capacity({"cpu": "1", "memory": "1Gi"}).obj()]
+    pods = [MakePod(f"p{i}").req({"cpu": "800m"}).obj() for i in range(3)]
+    got, want, result = run_both(nodes, pods)
+    assert got == want
+    assert got.count(-1) == 2
+    assert np.asarray(result.unschedulable)[:3].sum() == 2
+
+
+def test_priority_order_respected():
+    # high-priority pod gets the only slot even though it's later in the list
+    nodes = [MakeNode("n0").capacity({"cpu": "1"}).obj()]
+    pods = [
+        MakePod("low").req({"cpu": "800m"}).priority(0).obj(),
+        MakePod("high").req({"cpu": "800m"}).priority(100).obj(),
+    ]
+    got, want, _ = run_both(nodes, pods)
+    assert got == want == [-1, 0]
+
+
+def test_node_name_pin():
+    nodes = [MakeNode("n0").capacity({"cpu": "4"}).obj(),
+             MakeNode("n1").capacity({"cpu": "4"}).obj()]
+    pods = [MakePod("p0").req({"cpu": "1"}).node("n1").obj(),
+            MakePod("p1").req({"cpu": "1"}).node("missing").obj()]
+    got, want, _ = run_both(nodes, pods)
+    assert got[0] == want[0] == 1
+    assert got[1] == -1  # unknown node: infeasible (oracle agrees)
+    assert want[1] == -1
+
+
+def test_unschedulable_node_excluded():
+    nodes = [MakeNode("n0").capacity({"cpu": "4"}).unschedulable().obj(),
+             MakeNode("n1").capacity({"cpu": "4"}).obj()]
+    pods = [MakePod("p0").req({"cpu": "1"}).obj()]
+    got, want, _ = run_both(nodes, pods)
+    assert got == want == [1]
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_randomized_differential(seed):
+    rng = np.random.default_rng(seed)
+    n_nodes, n_pods = int(rng.integers(3, 12)), int(rng.integers(5, 40))
+    nodes = [
+        MakeNode(f"n{i}").capacity(
+            {"cpu": f"{rng.integers(2, 16)}", "memory": f"{rng.integers(4, 32)}Gi"}
+        ).obj()
+        for i in range(n_nodes)
+    ]
+    pods = [
+        MakePod(f"p{i}")
+        .req({"cpu": f"{rng.integers(100, 3000)}m",
+              "memory": f"{rng.integers(256, 4096)}Mi"})
+        .priority(int(rng.integers(0, 5)))
+        .created(float(rng.integers(0, 100)))
+        .obj()
+        for i in range(n_pods)
+    ]
+    existing = []
+    for i in range(int(rng.integers(0, 10))):
+        existing.append(
+            (MakePod(f"e{i}").req({"cpu": f"{rng.integers(100, 2000)}m"}).obj(),
+             f"n{rng.integers(0, n_nodes)}")
+        )
+    got, want, _ = run_both(nodes, pods, existing)
+    assert got == want
+
+
+def test_jit_cache_reuse_across_cycles():
+    # same padded shapes -> no recompile (pad buckets keep shapes stable)
+    enc = SnapshotEncoder(pad_pods=16, pad_nodes=8)
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "4"}).obj() for i in range(3)]
+    cycle = build_cycle_fn()
+    s1 = enc.encode(nodes, [MakePod("a").req({"cpu": "1"}).obj()])
+    s2 = enc.encode(nodes, [MakePod("b").req({"cpu": "2"}).obj(),
+                            MakePod("c").req({"cpu": "1"}).obj()])
+    r1 = cycle(s1)
+    assert cycle._cache_size() == 1
+    r2 = cycle(s2)
+    assert cycle._cache_size() == 1  # second cycle hit the compiled program
+    assert np.asarray(r1.assignment).shape == np.asarray(r2.assignment).shape
