@@ -1,0 +1,169 @@
+"""Config API: the KubeSchedulerConfiguration analogue.
+
+Mirrors the reference's versioned config (`apis/config/` — [UNVERIFIED],
+mount empty; SURVEY.md §2 C12): profiles keyed by schedulerName, per-
+extension-point plugin enable/disable lists, per-plugin args, and the
+`percentageOfNodesToScore` knob, loadable from the same YAML field names.
+No multi-version conversion machinery (SURVEY.md §5.6)."""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class PluginEntry:
+    name: str
+    weight: int = 1
+
+
+@dataclass
+class PluginSet:
+    enabled: list[PluginEntry] = field(default_factory=list)
+    disabled: list[str] = field(default_factory=list)  # ["*"] = all defaults
+
+    def resolve(self, defaults: list[PluginEntry]) -> list[PluginEntry]:
+        """Upstream merge semantics: defaults minus disabled, plus enabled
+        (enabled entries replace same-named defaults to carry new weights)."""
+        if "*" in self.disabled:
+            base: list[PluginEntry] = []
+        else:
+            base = [d for d in defaults if d.name not in self.disabled]
+        out = {e.name: e for e in base}
+        for e in self.enabled:
+            out[e.name] = e
+        return list(out.values())
+
+
+@dataclass
+class Plugins:
+    queue_sort: PluginSet = field(default_factory=PluginSet)
+    pre_filter: PluginSet = field(default_factory=PluginSet)
+    filter: PluginSet = field(default_factory=PluginSet)
+    post_filter: PluginSet = field(default_factory=PluginSet)
+    pre_score: PluginSet = field(default_factory=PluginSet)
+    score: PluginSet = field(default_factory=PluginSet)
+    reserve: PluginSet = field(default_factory=PluginSet)
+    permit: PluginSet = field(default_factory=PluginSet)
+    bind: PluginSet = field(default_factory=PluginSet)
+
+
+@dataclass
+class Profile:
+    scheduler_name: str = "default-scheduler"
+    plugins: Plugins = field(default_factory=Plugins)
+    plugin_config: dict[str, dict[str, Any]] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulerConfiguration:
+    profiles: list[Profile] = field(default_factory=lambda: [Profile()])
+    percentage_of_nodes_to_score: int = 0  # 0 = adaptive/all (upstream default)
+    pod_initial_backoff_seconds: float = 1.0
+    pod_max_backoff_seconds: float = 10.0
+    # gang scheduling (Coscheduling PodGroup CRD analogue, SURVEY.md C14)
+    gang_scheduling: bool = True
+
+    def profile(self, scheduler_name: str = "default-scheduler") -> Profile:
+        for p in self.profiles:
+            if p.scheduler_name == scheduler_name:
+                return p
+        return self.profiles[0]
+
+
+# Upstream default plugin sets (getDefaultPlugins — [UNVERIFIED] weights
+# follow the widely-documented defaults: PodTopologySpread 2,
+# TaintToleration 3, others 1).
+_DEFAULT_FILTERS = [
+    "NodeUnschedulable",
+    "NodeName",
+    "TaintToleration",
+    "NodeAffinity",
+    "NodePorts",
+    "NodeResourcesFit",
+    "InterPodAffinity",
+    "PodTopologySpread",
+]
+_DEFAULT_SCORES = [
+    ("NodeResourcesBalancedAllocation", 1),
+    ("ImageLocality", 1),
+    ("InterPodAffinity", 1),
+    ("NodeResourcesFit", 1),
+    ("NodeAffinity", 1),
+    ("PodTopologySpread", 2),
+    ("TaintToleration", 3),
+]
+_DEFAULT_POST_FILTERS = ["DefaultPreemption"]
+
+
+def default_plugins() -> dict[str, list[PluginEntry]]:
+    return {
+        "filter": [PluginEntry(n) for n in _DEFAULT_FILTERS],
+        "score": [PluginEntry(n, w) for n, w in _DEFAULT_SCORES],
+        "post_filter": [PluginEntry(n) for n in _DEFAULT_POST_FILTERS],
+    }
+
+
+def _plugin_set_from_dict(d: dict) -> PluginSet:
+    return PluginSet(
+        enabled=[
+            PluginEntry(e["name"], e.get("weight", 1)) for e in d.get("enabled", [])
+        ],
+        disabled=[e["name"] if isinstance(e, dict) else e
+                  for e in d.get("disabled", [])],
+    )
+
+
+def load_config(source: "str | dict") -> SchedulerConfiguration:
+    """Load from a YAML string/path or a dict with upstream field names."""
+    if isinstance(source, str):
+        import yaml
+
+        if "\n" not in source and source.endswith((".yaml", ".yml", ".json")):
+            with open(source) as f:
+                data = yaml.safe_load(f)
+        else:
+            data = yaml.safe_load(source)
+    else:
+        data = source
+    data = data or {}
+
+    profiles = []
+    for pd in data.get("profiles", [{}]):
+        plugins = Plugins()
+        for point, attr in [
+            ("queueSort", "queue_sort"),
+            ("preFilter", "pre_filter"),
+            ("filter", "filter"),
+            ("postFilter", "post_filter"),
+            ("preScore", "pre_score"),
+            ("score", "score"),
+            ("reserve", "reserve"),
+            ("permit", "permit"),
+            ("bind", "bind"),
+        ]:
+            if point in pd.get("plugins", {}):
+                setattr(plugins, attr, _plugin_set_from_dict(pd["plugins"][point]))
+        plugin_config = {
+            e["name"]: e.get("args", {}) for e in pd.get("pluginConfig", [])
+        }
+        profiles.append(
+            Profile(
+                scheduler_name=pd.get("schedulerName", "default-scheduler"),
+                plugins=plugins,
+                plugin_config=plugin_config,
+            )
+        )
+    return SchedulerConfiguration(
+        profiles=profiles or [Profile()],
+        percentage_of_nodes_to_score=data.get("percentageOfNodesToScore", 0),
+        pod_initial_backoff_seconds=data.get("podInitialBackoffSeconds", 1.0),
+        pod_max_backoff_seconds=data.get("podMaxBackoffSeconds", 10.0),
+        gang_scheduling=data.get("gangScheduling", True),
+    )
+
+
+def to_dict(cfg: SchedulerConfiguration) -> dict:
+    return dataclasses.asdict(cfg)

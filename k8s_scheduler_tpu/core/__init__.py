@@ -1,1 +1,1 @@
-from .cycle import CycleOptions, CycleResult, build_cycle_fn  # noqa: F401
+from .cycle import CycleResult, build_cycle_fn  # noqa: F401

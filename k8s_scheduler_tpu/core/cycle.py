@@ -1,7 +1,7 @@
 """The scheduling cycle: one jitted program, pending pods in, bindings out.
 
-This is the TPU-native replacement for the reference's `ScheduleOne` hot
-loop (SURVEY.md §3.2; expected `schedule_one.go` / `core/generic_scheduler.go`
+TPU-native replacement for the reference's `ScheduleOne` hot loop
+(SURVEY.md §3.2; expected `schedule_one.go` / `core/generic_scheduler.go`
 [UNVERIFIED], mount empty). Where the reference runs, per pod:
 
     RunPreFilterPlugins -> RunFilterPlugins (16 goroutines over nodes)
@@ -9,15 +9,13 @@ loop (SURVEY.md §3.2; expected `schedule_one.go` / `core/generic_scheduler.go`
 
 this program computes, per cycle, for the WHOLE pending set:
 
-    static masks/scores (batched [P, N], everything independent of in-cycle
-    commitments) -> greedy sequential-commit scan (the dynamic residue:
-    resource fit + running-state scores) -> assignment [P]
+    CycleContext precomputes (PreFilter analogue, batched)
+    -> framework static masks/scores ([P, N], commitment-independent)
+    -> greedy sequential-commit scan (dynamic residue: resource fit,
+       running domain counts) -> assignment [P]
 
-The minimal slice wires NodeResourcesFit + LeastRequested +
-BalancedAllocation + NodeName/validity masks; further Filter/Score plugins
-contribute additional static masks/scores or dynamic hooks (see
-framework/runtime.py for how the plugin registry assembles them).
-"""
+The framework (framework/runtime.py) decides which plugins contribute;
+`build_cycle_fn` bakes one Framework into one compiled program."""
 
 from __future__ import annotations
 
@@ -26,24 +24,11 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
+from ..framework.interfaces import CycleContext
+from ..framework.runtime import Framework
 from ..models.encoding import ClusterSnapshot
 from ..ops import commit as commit_ops
-from ..ops import resources as res_ops
-
-
-@dataclasses.dataclass(frozen=True)
-class CycleOptions:
-    """Static knobs baked into the compiled cycle (a change recompiles).
-
-    Score weights follow the upstream default-plugin weights; resources
-    participating in scoring default to cpu+memory like upstream
-    `defaultRequestedRatioResources`."""
-
-    least_requested_weight: float = 1.0
-    balanced_allocation_weight: float = 1.0
-    score_resources: tuple[str, ...] = ("cpu", "memory")
 
 
 @jax.tree_util.register_dataclass
@@ -54,51 +39,25 @@ class CycleResult:
     unschedulable: jnp.ndarray  # bool [P] valid pod that found no node
 
 
-def _score_resource_weights(snap: ClusterSnapshot, options: CycleOptions) -> np.ndarray:
-    w = np.zeros(len(snap.resource_names), np.float32)
-    for r in options.score_resources:
-        if r in snap.resource_names:
-            w[snap.resource_names.index(r)] = 1.0
-    return w
-
-
-def static_mask_basic(snap: ClusterSnapshot) -> jnp.ndarray:
-    """Masks independent of both in-cycle commitments and label machinery:
-    node validity (padding), NodeUnschedulable, NodeName pin."""
-    P, N = snap.pod_requested.shape[0], snap.node_allocatable.shape[0]
-    mask = jnp.broadcast_to(
-        snap.node_valid[None, :] & ~snap.node_unschedulable[None, :], (P, N)
-    )
-    # NodeName plugin: a pinned pod may only land on its named node
-    # (pod_node_name -2 = named node unknown -> infeasible everywhere).
-    pinned = snap.pod_node_name[:, None]  # [P, 1]
-    node_ids = jnp.arange(N, dtype=jnp.int32)[None, :]
-    mask = jnp.where(pinned >= 0, mask & (node_ids == pinned), mask)
-    mask = jnp.where(pinned == -2, False, mask)
-    return mask
-
-
 def build_cycle_fn(
-    options: CycleOptions = CycleOptions(),
+    framework: Framework | None = None,
 ) -> Callable[[ClusterSnapshot], CycleResult]:
-    """Compile the minimal-slice cycle. The returned callable is jitted;
-    snapshots with identical padded shapes reuse the compiled program."""
+    """Compile the cycle for a framework (default: the default plugin set).
+    The returned callable is jitted; snapshots with identical padded shapes
+    reuse the compiled program."""
+    fw = framework or Framework.from_config()
 
     @jax.jit
     def cycle(snap: ClusterSnapshot) -> CycleResult:
-        res_w = jnp.asarray(_score_resource_weights(snap, options))
-        smask = static_mask_basic(snap)
-        sscore = jnp.zeros_like(smask, jnp.float32)
+        ctx = CycleContext(snap)
+        smask, sscore = fw.static(ctx)
+        extra = fw.extra_init(ctx)
 
-        def dyn_fn(p, node_req, _extra):
-            req = snap.pod_requested[p]
-            m = res_ops.fit_mask_single(req, snap.node_allocatable, node_req)
-            s = options.least_requested_weight * res_ops.least_requested_score(
-                req, snap.node_allocatable, node_req, res_w
-            ) + options.balanced_allocation_weight * res_ops.balanced_allocation_score(
-                req, snap.node_allocatable, node_req, res_w
-            )
-            return m, s
+        def dyn_fn(p, node_req, ext):
+            return fw.dyn(ctx, p, node_req, ext)
+
+        def update_fn(ext, p, node, ok):
+            return fw.extra_update(ctx, ext, p, node, ok)
 
         order = jnp.argsort(snap.pod_order)
         result = commit_ops.greedy_commit(
@@ -111,6 +70,8 @@ def build_cycle_fn(
             node_allocatable=snap.node_allocatable,
             node_requested=snap.node_requested,
             dyn_fn=dyn_fn,
+            extra=extra,
+            update_fn=update_fn,
         )
         unsched = snap.pod_valid & (result.assignment < 0)
         return CycleResult(result.assignment, result.node_requested, unsched)

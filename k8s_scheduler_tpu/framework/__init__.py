@@ -1,0 +1,3 @@
+from .interfaces import CycleContext, Plugin  # noqa: F401
+from .registry import Registry, default_registry  # noqa: F401
+from .runtime import Framework  # noqa: F401

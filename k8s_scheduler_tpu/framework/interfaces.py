@@ -1,0 +1,95 @@
+"""Scheduler-framework extension points, TPU-native shape.
+
+The reference's framework (`framework/runtime/framework.go` — [UNVERIFIED],
+mount empty; SURVEY.md §2 C6) runs plugin callbacks per pod per extension
+point: PreEnqueue, QueueSort, PreFilter, Filter, PostFilter, PreScore,
+Score+NormalizeScore, Reserve, Permit, PreBind, Bind, PostBind.
+
+The TPU-native mapping, per extension point:
+
+- QueueSort        -> the priority-ordered `pod_order` rank (encoder) used
+                      by the commit scan; PrioritySort semantics built in.
+- PreFilter        -> `CycleContext` precomputes shared across plugins
+                      (expression-table node masks etc.), computed ONCE per
+                      cycle, batched — the analogue of PreFilter state.
+- Filter           -> `static_mask` (batched [P, N], independent of
+                      in-cycle commitments) and/or `dyn_mask` ([N] inside
+                      the commit scan, sees running state).
+- PostFilter       -> `post_filter` (batched preemption, ops/preemption.py).
+- PreScore/Score   -> `static_score` / `dyn_score`, each 0..100 per the
+                      upstream NormalizeScore contract; the runtime applies
+                      the configured integer plugin weight.
+- Reserve..PostBind-> host-side (core/scheduler.py, service/): assume,
+                      gang Permit, binding. Not device code.
+
+A plugin implements any subset; `None` means "not implemented at this
+point". All array-returning hooks are traced inside ONE jit, so plugins
+compose into a single fused XLA program — the registry is a program
+assembler, not a callback dispatcher.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, runtime_checkable
+
+import jax.numpy as jnp
+
+from ..models.encoding import ClusterSnapshot
+
+
+class CycleContext:
+    """Shared per-cycle precomputes (the PreFilter-state analogue).
+
+    Lazily computed, cached: plugins ask for what they need; anything no
+    enabled plugin asks for is never computed (and XLA dead-code-eliminates
+    anything unused)."""
+
+    def __init__(self, snap: ClusterSnapshot):
+        self.snap = snap
+        self._cache: dict[str, Any] = {}
+
+    def get(self, key: str, compute) -> Any:
+        if key not in self._cache:
+            self._cache[key] = compute(self.snap)
+        return self._cache[key]
+
+    @property
+    def expr_node_mask(self) -> jnp.ndarray:  # bool [Ex, N]
+        from ..ops import labels
+
+        return self.get("expr_node_mask", labels.expr_node_mask)
+
+
+@runtime_checkable
+class Plugin(Protocol):
+    """Base protocol. Concrete plugins subclass `PluginBase`."""
+
+    name: str
+
+
+class PluginBase:
+    name: str = ""
+
+    def __init__(self, args: dict | None = None):
+        self.args = args or {}
+
+    # --- Filter ---
+    def static_mask(self, ctx: CycleContext) -> jnp.ndarray | None:
+        return None
+
+    def dyn_mask(self, ctx: CycleContext, p, node_requested, extra) -> jnp.ndarray | None:
+        return None
+
+    # --- Score (0..100; runtime applies weight) ---
+    def static_score(self, ctx: CycleContext) -> jnp.ndarray | None:
+        return None
+
+    def dyn_score(self, ctx: CycleContext, p, node_requested, extra) -> jnp.ndarray | None:
+        return None
+
+    # --- scan-carried state (running domain counts etc.) ---
+    def extra_init(self, ctx: CycleContext) -> Any | None:
+        return None
+
+    def extra_update(self, ctx: CycleContext, extra, p, node, committed):
+        return extra

@@ -1,0 +1,158 @@
+"""The default plugin set, mirroring the reference's plugin names
+(SURVEY.md §2 C7/C8: NodeUnschedulable, NodeName, NodePorts,
+NodeResourcesFit, NodeAffinity, TaintToleration, ImageLocality,
+NodeResourcesBalancedAllocation, InterPodAffinity, PodTopologySpread,
+DefaultPreemption; expected upstream `framework/plugins/<name>/` —
+[UNVERIFIED], mount empty).
+
+Each plugin contributes mask/score fragments to the single fused cycle
+program (see interfaces.py for the extension-point mapping)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import images as images_ops
+from ..ops import labels as labels_ops
+from ..ops import ports as ports_ops
+from ..ops import resources as res_ops
+from ..ops import taints as taints_ops
+from .interfaces import CycleContext, PluginBase
+
+
+def _score_resource_weights(snap, args: dict) -> jnp.ndarray:
+    """score_resources arg -> one-hot f32 [R] weight vector (cpu+memory by
+    default, matching upstream defaultRequestedRatioResources). Shared by
+    every resource-scoring plugin so the semantics can't drift."""
+    score_resources = args.get("score_resources", ("cpu", "memory"))
+    w = np.zeros(len(snap.resource_names), np.float32)
+    for r in score_resources:
+        if r in snap.resource_names:
+            w[snap.resource_names.index(r)] = 1.0
+    return jnp.asarray(w)
+
+
+class NodeUnschedulable(PluginBase):
+    """Excludes cordoned nodes (`spec.unschedulable`). Upstream admits pods
+    tolerating the node.kubernetes.io/unschedulable taint; that refinement
+    rides on the toleration tables once the taint is synthesized — for now
+    cordoned nodes are excluded unconditionally (oracle matches)."""
+
+    name = "NodeUnschedulable"
+
+    def static_mask(self, ctx: CycleContext):
+        snap = ctx.snap
+        P = snap.P
+        return jnp.broadcast_to(~snap.node_unschedulable[None, :], (P, snap.N))
+
+
+class NodeName(PluginBase):
+    name = "NodeName"
+
+    def static_mask(self, ctx: CycleContext):
+        snap = ctx.snap
+        pinned = snap.pod_node_name[:, None]  # [P, 1]
+        node_ids = jnp.arange(snap.N, dtype=jnp.int32)[None, :]
+        mask = jnp.ones((snap.P, snap.N), bool)
+        mask = jnp.where(pinned >= 0, node_ids == pinned, mask)
+        return jnp.where(pinned == -2, False, mask)  # named node unknown
+
+
+class NodePorts(PluginBase):
+    """hostPort conflicts: against EXISTING pods via the static mask,
+    against pods committed earlier in this cycle via a [N, Q] port-claim
+    bitmap carried through the commit scan (Q = distinct pending ports) —
+    so intra-batch conflicts resolve exactly like the reference's
+    sequential NodeInfo updates."""
+
+    name = "NodePorts"
+
+    def static_mask(self, ctx: CycleContext):
+        snap = ctx.snap
+        return ~ports_ops.ports_conflict_mask(snap.pod_ports, snap.node_used_ports)
+
+    def extra_init(self, ctx: CycleContext):
+        snap = ctx.snap
+        return jnp.zeros((snap.N, snap.num_distinct_ports), bool)
+
+    def dyn_mask(self, ctx: CycleContext, p, node_requested, extra):
+        snap = ctx.snap
+        claimed = extra[self.name]  # [N, Q]
+        ids = snap.pod_port_ids[p]  # [MPorts]
+        want = claimed[:, jnp.clip(ids, 0, claimed.shape[1] - 1)]  # [N, MPorts]
+        return ~jnp.any(want & (ids >= 0)[None, :], axis=1)
+
+    def extra_update(self, ctx: CycleContext, extra, p, node, committed):
+        snap = ctx.snap
+        ids = snap.pod_port_ids[p]
+        safe = jnp.clip(ids, 0, extra.shape[1] - 1)
+        add = committed & (ids >= 0)
+        return extra.at[node, safe].max(add)
+
+
+class NodeResourcesFit(PluginBase):
+    """Filter: resource fit against the RUNNING allocatable (in-scan).
+    Score: the configured scoring strategy (LeastAllocated default,
+    MostAllocated for bin-packing), also in-scan."""
+
+    name = "NodeResourcesFit"
+
+    def dyn_mask(self, ctx: CycleContext, p, node_requested, extra):
+        snap = ctx.snap
+        return res_ops.fit_mask_single(
+            snap.pod_requested[p], snap.node_allocatable, node_requested
+        )
+
+    def dyn_score(self, ctx: CycleContext, p, node_requested, extra):
+        snap = ctx.snap
+        strategy = self.args.get("scoring_strategy", "LeastAllocated")
+        fn = (
+            res_ops.most_requested_score
+            if strategy == "MostAllocated"
+            else res_ops.least_requested_score
+        )
+        return fn(
+            snap.pod_requested[p],
+            snap.node_allocatable,
+            node_requested,
+            _score_resource_weights(snap, self.args),
+        )
+
+
+class NodeResourcesBalancedAllocation(PluginBase):
+    name = "NodeResourcesBalancedAllocation"
+
+    def dyn_score(self, ctx: CycleContext, p, node_requested, extra):
+        snap = ctx.snap
+        return res_ops.balanced_allocation_score(
+            snap.pod_requested[p], snap.node_allocatable, node_requested,
+            _score_resource_weights(snap, self.args),
+        )
+
+
+class NodeAffinity(PluginBase):
+    name = "NodeAffinity"
+
+    def static_mask(self, ctx: CycleContext):
+        return labels_ops.pod_requirement_mask(ctx.snap, ctx.expr_node_mask)
+
+    def static_score(self, ctx: CycleContext):
+        return labels_ops.preferred_score(ctx.snap, ctx.expr_node_mask)
+
+
+class TaintToleration(PluginBase):
+    name = "TaintToleration"
+
+    def static_mask(self, ctx: CycleContext):
+        return taints_ops.taint_filter_mask(ctx.snap)
+
+    def static_score(self, ctx: CycleContext):
+        return taints_ops.taint_score(ctx.snap)
+
+
+class ImageLocality(PluginBase):
+    name = "ImageLocality"
+
+    def static_score(self, ctx: CycleContext):
+        return images_ops.image_locality_score(ctx.snap)

@@ -1,0 +1,127 @@
+"""Framework runtime: assembles enabled plugins into the fused cycle
+program (the analogue of `framework/runtime/framework.go`'s
+RunFilterPlugins/RunScorePlugins — [UNVERIFIED], mount empty; SURVEY.md §2
+C6). Where the reference dispatches plugin callbacks per pod on 16
+goroutines, this runtime asks each enabled plugin for its batched mask/
+score fragments once per cycle and AND/weighted-sums them inside one jit —
+plugin composition happens at trace time, parallelism comes from XLA."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..config import SchedulerConfiguration, default_plugins
+from .interfaces import CycleContext, PluginBase
+from .registry import Registry, default_registry
+
+# Default-enabled plugins whose TPU kernels are scheduled but not landed:
+# silently skipped when missing from the registry (unlike unknown names,
+# which raise). Shrinks as kernels land.
+PLANNED_PLUGINS = frozenset({
+    "InterPodAffinity",
+    "PodTopologySpread",
+    "DefaultPreemption",
+    "VolumeBinding",
+})
+
+
+class Framework:
+    def __init__(
+        self,
+        filters: list[PluginBase],
+        scores: list[tuple[PluginBase, float]],
+        post_filters: list[PluginBase] = (),
+    ):
+        self.filters = list(filters)
+        self.scores = list(scores)
+        self.post_filters = list(post_filters)
+
+    @staticmethod
+    def from_config(
+        config: SchedulerConfiguration | None = None,
+        scheduler_name: str = "default-scheduler",
+        registry: Registry | None = None,
+    ) -> "Framework":
+        config = config or SchedulerConfiguration()
+        registry = registry or default_registry()
+        profile = config.profile(scheduler_name)
+        defaults = default_plugins()
+        args = profile.plugin_config
+
+        def make(entries):
+            out = []
+            for e in entries:
+                if e.name in registry.names():
+                    out.append((registry.make(e.name, args.get(e.name)), e.weight))
+                elif e.name in PLANNED_PLUGINS:
+                    continue  # default-enabled, kernel not landed yet
+                else:
+                    # unknown names fail loudly (a typo must not silently
+                    # change scheduling semantics) — same error Registry.make
+                    # raises, reachable from the config path
+                    registry.make(e.name)
+            return out
+
+        filters = [p for p, _ in make(profile.plugins.filter.resolve(defaults["filter"]))]
+        scores = [
+            (p, float(w)) for p, w in make(profile.plugins.score.resolve(defaults["score"]))
+        ]
+        post_filters = [
+            p for p, _ in make(profile.plugins.post_filter.resolve(defaults["post_filter"]))
+        ]
+        return Framework(filters, scores, post_filters)
+
+    # ---- trace-time assembly (called inside jit) ----
+
+    def static(self, ctx: CycleContext) -> tuple[jnp.ndarray, jnp.ndarray]:
+        snap = ctx.snap
+        mask = jnp.broadcast_to(snap.node_valid[None, :], (snap.P, snap.N))
+        for f in self.filters:
+            m = f.static_mask(ctx)
+            if m is not None:
+                mask = mask & m
+        score = jnp.zeros((snap.P, snap.N), jnp.float32)
+        for s, w in self.scores:
+            v = s.static_score(ctx)
+            if v is not None:
+                score = score + w * v
+        return mask, score
+
+    def _stateful_plugins(self) -> list[PluginBase]:
+        # a plugin enabled at several points (e.g. InterPodAffinity filter +
+        # score) owns ONE extra-state slot, keyed by name
+        seen: dict[str, PluginBase] = {}
+        for p in self.filters + [s for s, _ in self.scores]:
+            seen.setdefault(p.name, p)
+        return list(seen.values())
+
+    def extra_init(self, ctx: CycleContext) -> dict[str, Any]:
+        extra = {}
+        for p in self._stateful_plugins():
+            e = p.extra_init(ctx)
+            if e is not None:
+                extra[p.name] = e
+        return extra
+
+    def dyn(self, ctx: CycleContext, p, node_requested, extra):
+        snap = ctx.snap
+        mask = jnp.ones((snap.N,), bool)
+        for f in self.filters:
+            m = f.dyn_mask(ctx, p, node_requested, extra)
+            if m is not None:
+                mask = mask & m
+        score = jnp.zeros((snap.N,), jnp.float32)
+        for s, w in self.scores:
+            v = s.dyn_score(ctx, p, node_requested, extra)
+            if v is not None:
+                score = score + w * v
+        return mask, score
+
+    def extra_update(self, ctx: CycleContext, extra, p, node, committed):
+        out = dict(extra)
+        for pl in self._stateful_plugins():
+            if pl.name in out:
+                out[pl.name] = pl.extra_update(ctx, out[pl.name], p, node, committed)
+        return out

@@ -168,6 +168,9 @@ class ClusterSnapshot:
     # --- names (static aux data, baked into the compiled program) ---
     resource_names: tuple[str, ...]
     topology_keys: tuple[str, ...]  # interned topology key strings, order = K axis
+    # padded count of distinct pending host ports (Q axis of the scan's
+    # port-claim bitmap; static because it is a shape, bucketed by 4)
+    num_distinct_ports: int
 
     # --- real (unpadded) counts: 0-d arrays, NOT static — a changed pod
     # count must not recompile the cycle (only padded shapes are static) ---
@@ -228,7 +231,10 @@ class ClusterSnapshot:
     pod_tolset: np.ndarray  # i32 [P] -> Tl
     pod_label_keys: np.ndarray  # i32 [P, MPL]
     pod_label_vals: np.ndarray  # i32 [P, MPL]
-    pod_ports: np.ndarray  # i32 [P, MPorts] (-1 pad)
+    pod_ports: np.ndarray  # i32 [P, MPorts] encoded host ports (-1 pad)
+    # same ports as indices into the distinct pending-port axis Q — the
+    # commit scan tracks intra-batch port claims as a [N, Q] bitmap
+    pod_port_ids: np.ndarray  # i32 [P, MPorts] -> Q (-1 pad)
     pod_aff_terms: np.ndarray  # i32 [P, MA, 2] (sel, topo-key idx) (-1 pad)
     pod_anti_terms: np.ndarray  # i32 [P, MA, 2]
     pod_pref_aff: np.ndarray  # i32 [P, MA, 2] preferred affinity terms
@@ -567,6 +573,8 @@ class SnapshotEncoder:
             4,
         )
         pod_ports = np.full((P, MPorts), -1, np.int32)
+        pod_port_ids = np.full((P, MPorts), -1, np.int32)
+        port_ids_t = _InternTable()  # distinct (port, proto) among pending
 
         MA = _pad_dim(
             max(
@@ -645,7 +653,9 @@ class SnapshotEncoder:
             pod_tolset[i] = compile_tolerations(p.spec.tolerations)
             encode_pod_labels(p, pl_keys, pl_vals, i)
             for j, (port, proto, _) in enumerate(p.host_ports()):
-                pod_ports[i, j] = port * 4 + {"TCP": 0, "UDP": 1, "SCTP": 2}.get(proto, 3)
+                enc_port = port * 4 + {"TCP": 0, "UDP": 1, "SCTP": 2}.get(proto, 3)
+                pod_ports[i, j] = enc_port
+                pod_port_ids[i, j] = port_ids_t.intern(enc_port)
             encode_aff(p, i, pod_aff_terms, pod_anti_terms, pod_pref_aff, pod_pref_aff_w)
             for j, c in enumerate(p.spec.topology_spread_constraints):
                 when = (
@@ -875,6 +885,8 @@ class SnapshotEncoder:
             pod_label_keys=pl_keys,
             pod_label_vals=pl_vals,
             pod_ports=pod_ports,
+            pod_port_ids=pod_port_ids,
+            num_distinct_ports=_pad_dim(len(port_ids_t), 4),
             pod_aff_terms=pod_aff_terms,
             pod_anti_terms=pod_anti_terms,
             pod_pref_aff=pod_pref_aff,

@@ -1,0 +1,37 @@
+"""ImageLocality score kernel.
+
+Reference: `framework/plugins/imagelocality/` ([UNVERIFIED], mount empty) —
+nodes already holding a pod's container images score higher, scaled by image
+size (ramp between 23MB and 1GB) and by how widely the image is spread
+across nodes.
+
+TPU-native design: pods' image sets are deduplicated ([Is] distinct sets);
+the per-(imageset, node) total-present-bytes matrix is ONE matmul
+node_images[N, I] @ weighted_sizes[Is, I]^T — an MXU op — followed by the
+ramp and a per-pod gather.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_MIN_IMG = 23.0 * 2**20  # minThreshold: images below this don't move the score
+_MAX_IMG = 1.0 * 2**30  # maxThreshold: cap per upstream maxContainerThreshold
+
+
+def image_locality_score(snap) -> jnp.ndarray:  # f32 [P, N] in [0, 100]
+    node_imgs = snap.node_images.astype(jnp.float32)  # [N, I]
+    # spread factor: fraction of (real) nodes having each image — an image
+    # everywhere contributes fully, a rare image is discounted (upstream
+    # scaledImageScore), preventing stampedes onto one warm node.
+    n_real = jnp.maximum(
+        jnp.sum(snap.node_valid.astype(jnp.float32)), 1.0
+    )
+    spread = jnp.sum(
+        node_imgs * snap.node_valid[:, None].astype(jnp.float32), axis=0
+    ) / n_real  # [I]
+    weighted = snap.imgset_sizes * spread[None, :]  # [Is, I]
+    have = node_imgs @ weighted.T  # [N, Is]  (MXU)
+    clipped = jnp.clip(have, _MIN_IMG, _MAX_IMG)
+    table = (clipped - _MIN_IMG) / (_MAX_IMG - _MIN_IMG) * 100.0  # [N, Is]
+    return table.T[snap.pod_imageset]  # [P, N]

@@ -1,0 +1,163 @@
+"""Label-expression kernels: the device-side selector/affinity machinery.
+
+The reference evaluates label selectors per pod per node in Go
+(`NodeAffinity`/`nodeaffinity.Filter`, upstream
+`component-helpers/scheduling/corev1/nodeaffinity` — [UNVERIFIED], mount
+empty; SURVEY.md §2 C7). Here, every distinct match expression in the
+cluster is one row of a deduplicated expression table (models/encoding.py),
+and ONE kernel evaluates the whole table against every node (or every pod)
+at once:
+
+    expr_node_mask: [Ex] exprs x [N] nodes  -> bool [Ex, N]
+    requirement_mask: OR-of-terms(AND-of-exprs) gather -> bool [Rq, N]
+    per-pod masks are then a single int gather: mask[pod_req_id[p]]
+
+so the per-cycle cost is O(Ex*N*ML*MV) elementwise (tiny: Ex is the number
+of DISTINCT expressions, not pods) plus O(P) gathers, instead of the
+reference's O(P*N*terms) interpreted walk.
+
+Semantics parity (labels.Requirement): NotIn and DoesNotExist match when
+the key is absent; Gt/Lt require a numerically-parsable label value.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..models import encoding as enc
+
+
+def expr_match(
+    ex_key: jnp.ndarray,  # i32 [Ex]
+    ex_op: jnp.ndarray,  # i32 [Ex]
+    ex_vals: jnp.ndarray,  # i32 [Ex, MV] (-1 pad)
+    ex_num: jnp.ndarray,  # f32 [Ex]
+    label_keys: jnp.ndarray,  # i32 [X, ML] (-1 pad)
+    label_vals: jnp.ndarray,  # i32 [X, ML]
+    label_num: jnp.ndarray | None = None,  # f32 [X, ML] (nan if not numeric)
+    subject_index: jnp.ndarray | None = None,  # i32 [X] for FIELD_IN
+) -> jnp.ndarray:  # bool [Ex, X]
+    """Evaluate every expression against every labeled subject (node or
+    pod). X is the subject axis."""
+    key_eq = label_keys[None, :, :] == ex_key[:, None, None]  # [Ex, X, ML]
+    key_eq &= label_keys[None, :, :] >= 0
+    has_key = key_eq.any(-1)  # [Ex, X]
+    # value-in-set per label slot: [Ex, X, ML, MV] -> [Ex, X, ML]
+    val_in = (
+        (label_vals[None, :, :, None] == ex_vals[:, None, None, :])
+        & (ex_vals >= 0)[:, None, None, :]
+    ).any(-1)
+    key_and_val = (key_eq & val_in).any(-1)  # [Ex, X]
+
+    if label_num is not None:
+        # nan compares False, so non-numeric labels never satisfy Gt/Lt
+        gt = (key_eq & (label_num[None, :, :] > ex_num[:, None, None])).any(-1)
+        lt = (key_eq & (label_num[None, :, :] < ex_num[:, None, None])).any(-1)
+    else:
+        gt = lt = jnp.zeros_like(has_key)
+
+    if subject_index is not None:
+        field_in = (
+            (subject_index[None, :, None] == ex_vals[:, None, :])
+            & (ex_vals >= 0)[:, None, :]
+        ).any(-1)
+    else:
+        field_in = jnp.zeros_like(has_key)
+
+    op = ex_op[:, None]
+    return jnp.select(
+        [
+            op == enc.OP_IN,
+            op == enc.OP_NOT_IN,
+            op == enc.OP_EXISTS,
+            op == enc.OP_DOES_NOT_EXIST,
+            op == enc.OP_GT,
+            op == enc.OP_LT,
+            op == enc.OP_FIELD_IN,
+        ],
+        [
+            key_and_val,
+            ~key_and_val,  # absent key matches NotIn
+            has_key,
+            ~has_key,
+            gt,
+            lt,
+            field_in,
+        ],
+        default=jnp.zeros_like(has_key),  # OP_IMPOSSIBLE / padding
+    )
+
+
+def expr_node_mask(snap) -> jnp.ndarray:  # bool [Ex, N]
+    return expr_match(
+        snap.ex_key,
+        snap.ex_op,
+        snap.ex_vals,
+        snap.ex_num,
+        snap.node_label_keys,
+        snap.node_label_vals,
+        snap.node_label_num,
+        subject_index=jnp.arange(snap.N, dtype=jnp.int32),
+    )
+
+
+def expr_pod_mask(snap, label_keys, label_vals) -> jnp.ndarray:  # [Ex, X]
+    """Expressions against pod labels (selectors). Gt/Lt on pod labels is
+    legal in k8s only for node selectors, so no numeric axis here."""
+    return expr_match(
+        snap.ex_key, snap.ex_op, snap.ex_vals, snap.ex_num,
+        label_keys, label_vals,
+    )
+
+
+def _gather_expr(expr_mask: jnp.ndarray, ids: jnp.ndarray,
+                 fill: bool) -> jnp.ndarray:
+    """expr_mask [Ex, X] gathered by ids [...] with -1 -> `fill`."""
+    safe = jnp.clip(ids, 0, expr_mask.shape[0] - 1)
+    out = expr_mask[safe]  # [..., X]
+    return jnp.where((ids >= 0)[..., None], out, fill)
+
+
+def requirement_mask(rq_exprs: jnp.ndarray, expr_mask: jnp.ndarray) -> jnp.ndarray:
+    """[Rq, MT, ME] requirement table -> bool [Rq, X]: OR over terms of
+    AND over expressions (nodeSelectorTerms semantics; an all-padding term
+    is ignored)."""
+    g = _gather_expr(expr_mask, rq_exprs, fill=True)  # [Rq, MT, ME, X]
+    term_ok = g.all(axis=2)  # [Rq, MT, X]
+    term_valid = (rq_exprs >= 0).any(axis=2)  # [Rq, MT]
+    return (term_ok & term_valid[:, :, None]).any(axis=1)
+
+
+def pod_requirement_mask(snap, expr_mask: jnp.ndarray) -> jnp.ndarray:
+    """Per-pod node-affinity + nodeSelector feasibility: bool [P, N].
+    (NodeAffinity Filter + the separate nodeSelector field are ANDed,
+    matching upstream.)"""
+    req = requirement_mask(snap.rq_exprs, expr_mask)  # [Rq, N]
+
+    def per_pod(ids):
+        safe = jnp.clip(ids, 0, req.shape[0] - 1)
+        return jnp.where((ids >= 0)[:, None], req[safe], True)
+
+    return per_pod(snap.pod_req_id) & per_pod(snap.pod_sel_req_id)
+
+
+def preferred_score(snap, expr_mask: jnp.ndarray) -> jnp.ndarray:
+    """NodeAffinity preferred terms -> score [P, N] in [0, 100].
+
+    Deviation from upstream (documented): upstream NormalizeScore divides
+    by the max score across *feasible* nodes, which couples a pod's score
+    on one node to the whole node set; we normalize by the pod's total
+    preferred weight instead (score = matched_weight / total_weight * 100),
+    which is node-local and identical in ranking for a single pod. The
+    oracle uses the same rule, so differential tests are exact."""
+    g = _gather_expr(expr_mask, snap.pf_exprs, fill=True)  # [Pf, MPT, ME, N]
+    term_ok = g.all(axis=2)  # [Pf, MPT, N]
+    term_valid = (snap.pf_exprs >= 0).any(axis=2)  # [Pf, MPT]
+    w = snap.pf_weight * term_valid  # [Pf, MPT]
+    matched = jnp.sum(w[:, :, None] * term_ok, axis=1)  # [Pf, N]
+    total = jnp.maximum(jnp.sum(w, axis=1), 1e-9)[:, None]  # [Pf, 1]
+    table = matched / total * 100.0  # [Pf, N]
+
+    ids = snap.pod_pref_id
+    safe = jnp.clip(ids, 0, table.shape[0] - 1)
+    return jnp.where((ids >= 0)[:, None], table[safe], 0.0)  # [P, N]

@@ -1,0 +1,45 @@
+"""NodePorts filter kernel.
+
+Reference: `framework/plugins/nodeports/` ([UNVERIFIED], mount empty) —
+a pod requesting hostPorts is infeasible on nodes where any requested
+(port, protocol) is already in use. Ports are encoded as port*4+protocol
+ints (models/encoding.py), so the check is set-disjointness of small padded
+int lists, evaluated blockwise over the pods axis to bound the [P, N,
+MPp, MUP] intermediate.
+
+This mask covers ports used by EXISTING pods; pods claiming the same host
+port within one pending batch are handled exactly by the commit scan's
+[N, Q] port-claim bitmap (framework/plugins.py NodePorts.extra_*), matching
+the reference's sequential NodeInfo updates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ports_conflict_mask(
+    pod_ports: jnp.ndarray,  # i32 [P, MPp] (-1 pad)
+    node_used_ports: jnp.ndarray,  # i32 [N, MUP] (-1 pad)
+    block: int = 512,
+) -> jnp.ndarray:  # bool [P, N] — True = conflict (infeasible)
+    P = pod_ports.shape[0]
+    nblocks = max(P // block, 1)
+    if P % block != 0:
+        # padded P is a power of two / 128-multiple; fall back to one block
+        nblocks, block_ = 1, P
+    else:
+        block_ = block
+
+    blocks = pod_ports.reshape(nblocks, block_, -1)
+
+    def one(pp):  # [B, MPp]
+        eq = (
+            (pp[:, None, :, None] == node_used_ports[None, :, None, :])
+            & (pp >= 0)[:, None, :, None]
+            & (node_used_ports >= 0)[None, :, None, :]
+        )
+        return eq.any((2, 3))  # [B, N]
+
+    return jax.lax.map(one, blocks).reshape(P, -1)

@@ -1,0 +1,76 @@
+"""Taint/toleration kernels.
+
+The reference's `TaintToleration` Filter/Score plugin walks each node's
+taints per pod (`framework/plugins/tainttoleration/` — [UNVERIFIED], mount
+empty; SURVEY.md §2 C7/C8). TPU-native design: taint sets and toleration
+sets are deduplicated at encode time (clusters have FEW distinct taint/
+toleration combinations), one small kernel computes the [Tl, Ts]
+set-compatibility tables, and the per-(pod, node) masks are a 2-D int
+gather — O(Tl*Ts*slots) + O(P*N) gather instead of O(P*N*taints*tols).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..models import encoding as enc
+
+
+def toleration_tables(snap) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (schedulable [Tl, Ts] bool, prefer_untolerated [Tl, Ts] f32).
+
+    schedulable: every NoSchedule/NoExecute taint in set Ts is tolerated by
+    set Tl (v1helper.TolerationsTolerateTaint semantics: effect matches or
+    toleration effect empty; key matches or toleration key empty with
+    Exists; value matches unless operator Exists).
+    prefer_untolerated: count of PreferNoSchedule taints not tolerated
+    (the TaintToleration score input)."""
+    # toleration axes: [Tl, MTl]; taint axes: [Ts, MTt]
+    tl_key = snap.tl_key[:, None, :, None]  # [Tl, 1, MTl, 1]
+    tl_op = snap.tl_op[:, None, :, None]
+    tl_val = snap.tl_val[:, None, :, None]
+    tl_eff = snap.tl_effect[:, None, :, None]
+    tl_ok = snap.tl_valid[:, None, :, None]
+    ts_key = snap.ts_key[None, :, None, :]  # [1, Ts, 1, MTt]
+    ts_val = snap.ts_val[None, :, None, :]
+    ts_eff = snap.ts_effect[None, :, None, :]
+    ts_ok = snap.ts_valid[None, :, None, :]
+
+    effect_match = (tl_eff == -1) | (tl_eff == ts_eff)
+    key_match = jnp.where(
+        tl_key == -1,
+        tl_op == enc.TOL_OP_EXISTS,  # empty key requires Exists, matches all
+        tl_key == ts_key,
+    )
+    value_match = (tl_op == enc.TOL_OP_EXISTS) | (tl_val == ts_val)
+    tolerates = tl_ok & effect_match & key_match & value_match
+    # taint t tolerated by ANY toleration slot: reduce over MTl
+    tolerated = tolerates.any(axis=2)  # [Tl, Ts, MTt]
+
+    hard = ts_ok[:, :, 0, :] & (
+        (ts_eff[:, :, 0, :] == enc.EFFECT_NO_SCHEDULE)
+        | (ts_eff[:, :, 0, :] == enc.EFFECT_NO_EXECUTE)
+    )  # [1, Ts, MTt]
+    schedulable = (~hard | tolerated).all(axis=-1)  # [Tl, Ts]
+
+    prefer = ts_ok[:, :, 0, :] & (ts_eff[:, :, 0, :] == enc.EFFECT_PREFER_NO_SCHEDULE)
+    prefer_untolerated = jnp.sum(prefer & ~tolerated, axis=-1).astype(jnp.float32)
+    return schedulable, prefer_untolerated
+
+
+def taint_filter_mask(snap) -> jnp.ndarray:  # bool [P, N]
+    schedulable, _ = toleration_tables(snap)
+    return schedulable[snap.pod_tolset[:, None], snap.node_taintset[None, :]]
+
+
+def taint_score(snap) -> jnp.ndarray:  # f32 [P, N] in [0, 100]
+    """TaintToleration score: fewer untolerated PreferNoSchedule taints is
+    better, normalized like upstream DefaultNormalizeScore(reverse=true):
+    score = (1 - count / max_count_over_nodes) * 100, with 100 when no node
+    has such taints. Deviation (documented): the max is over ALL nodes, not
+    just filter-feasible ones (the oracle does the same)."""
+    _, prefer = toleration_tables(snap)
+    counts = prefer[snap.pod_tolset[:, None], snap.node_taintset[None, :]]  # [P, N]
+    counts = jnp.where(snap.node_valid[None, :], counts, 0.0)
+    mx = jnp.max(counts, axis=1, keepdims=True)  # [P, 1]
+    return jnp.where(mx > 0, (1.0 - counts / jnp.maximum(mx, 1e-9)) * 100.0, 100.0)

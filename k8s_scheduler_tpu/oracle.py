@@ -109,6 +109,11 @@ class OracleState:
     requested: list[dict[str, float]]  # per node
     pods_on_node: list[list[Pod]]  # per node (existing + committed this run)
 
+    # memoized per-pod / per-image quantities that scoring would otherwise
+    # recompute once per candidate node (O(P*N^2) without these)
+    _taint_max: dict[str, int] = dataclasses.field(default_factory=dict)
+    _image_spread: dict[str, float] = dataclasses.field(default_factory=dict)
+
     @staticmethod
     def build(nodes: Sequence[Node], existing: Sequence[tuple[Pod, str]]) -> "OracleState":
         idx = {n.name: i for i, n in enumerate(nodes)}
@@ -340,15 +345,44 @@ def score_node_affinity(pod: Pod, state: OracleState, i: int) -> float:
     return got / total * MAX_NODE_SCORE
 
 
+def _untolerated_prefer_count(pod: Pod, state: OracleState, i: int) -> int:
+    return sum(
+        1
+        for t in state.nodes[i].spec.taints
+        if t.effect == api.PREFER_NO_SCHEDULE and not tolerates(pod, t)
+    )
+
+
 def score_taint_toleration(pod: Pod, state: OracleState, i: int) -> float:
-    """Fewer untolerated PreferNoSchedule taints -> higher score."""
-    taints = [
-        t for t in state.nodes[i].spec.taints if t.effect == api.PREFER_NO_SCHEDULE
-    ]
-    if not taints:
+    """Fewer untolerated PreferNoSchedule taints -> higher score, normalized
+    by the max count over ALL nodes (DefaultNormalizeScore(reverse=true)
+    analogue; same documented deviation as ops/taints.py: the max is over
+    all nodes, not just feasible ones). The per-pod max is memoized on the
+    state (taints don't change during a run)."""
+    mx = state._taint_max.get(pod.uid)
+    if mx is None:
+        mx = max(
+            (_untolerated_prefer_count(pod, state, j) for j in range(len(state.nodes))),
+            default=0,
+        )
+        state._taint_max[pod.uid] = mx
+    if mx == 0:
         return MAX_NODE_SCORE
-    untol = sum(1 for t in taints if not tolerates(pod, t))
-    return (1.0 - untol / len(taints)) * MAX_NODE_SCORE
+    return (1.0 - _untolerated_prefer_count(pod, state, i) / mx) * MAX_NODE_SCORE
+
+
+def _spread(state: OracleState, name: str) -> float:
+    """Fraction of nodes holding an image; memoized (images are static)."""
+    s = state._image_spread.get(name)
+    if s is None:
+        n = sum(
+            1
+            for nd in state.nodes
+            if any(name in im.names for im in nd.status.images)
+        )
+        s = n / max(len(state.nodes), 1)
+        state._image_spread[name] = s
+    return s
 
 
 def score_image_locality(pod: Pod, state: OracleState, i: int) -> float:
@@ -356,9 +390,11 @@ def score_image_locality(pod: Pod, state: OracleState, i: int) -> float:
     for img in state.nodes[i].status.images:
         for nm in img.names:
             images[nm] = img.size_bytes
-    have = sum(images.get(im, 0) for im in pod.images())
-    # upstream scales by image size between thresholds (23MB..1GB) and by
-    # the spread of the image across nodes; we use the size ramp only.
+    # image size scaled by spread (upstream scaledImageScore), then the
+    # 23MB..1GB ramp (upstream calculatePriority thresholds)
+    have = sum(
+        images.get(im, 0) * _spread(state, im) for im in pod.images() if im in images
+    )
     lo, hi = 23 * 2**20, 2**30
     clipped = min(max(have, lo), hi)
     return (clipped - lo) / (hi - lo) * MAX_NODE_SCORE
@@ -417,12 +453,104 @@ class OracleDecision:
 
 @dataclasses.dataclass(frozen=True)
 class OracleWeights:
+    """Defaults mirror the default-plugin score weights in config/types.py
+    (TaintToleration 3, others 1; InterPodAffinity joins when its kernel
+    lands so both sides stay in lockstep)."""
+
     least_requested: float = 1.0
     balanced_allocation: float = 1.0
-    node_affinity: float = 0.0
-    taint_toleration: float = 0.0
-    image_locality: float = 0.0
+    node_affinity: float = 1.0
+    taint_toleration: float = 3.0
+    image_locality: float = 1.0
     inter_pod_affinity: float = 0.0
+
+
+def queue_order(pending: Sequence[Pod]) -> list[int]:
+    """The queue's pop order: priority desc, creation asc, index (the
+    PrioritySort QueueSort plugin; same key as the encoder's pod_order)."""
+    return sorted(
+        range(len(pending)),
+        key=lambda i: (-pending[i].spec.priority,
+                       pending[i].metadata.creation_timestamp, i),
+    )
+
+
+def feasible_nodes(pod: Pod, state: OracleState, filters) -> list[int]:
+    """Filter pass + nominated-node narrowing (upstream evaluates the
+    nominated node first and keeps it when it passes filters)."""
+    feasible = [
+        i for i in range(len(state.nodes))
+        if all(f(pod, state, i) for f in filters)
+    ]
+    if pod.nominated_node_name:
+        for i in feasible:
+            if state.nodes[i].name == pod.nominated_node_name:
+                return [i]
+    return feasible
+
+
+def _score_pod(pod: Pod, state: OracleState, i: int, weights: OracleWeights,
+               raw_ipa: dict | None = None, ipa_hi: float = 0.0) -> float:
+    s = (
+        weights.least_requested * score_least_requested(pod, state, i)
+        + weights.balanced_allocation * score_balanced_allocation(pod, state, i)
+        + weights.node_affinity * score_node_affinity(pod, state, i)
+        + weights.taint_toleration * score_taint_toleration(pod, state, i)
+        + weights.image_locality * score_image_locality(pod, state, i)
+    )
+    if weights.inter_pod_affinity and raw_ipa and ipa_hi > 0:
+        s += weights.inter_pod_affinity * (raw_ipa[i] / ipa_hi) * MAX_NODE_SCORE
+    return s
+
+
+def validate_assignment(
+    nodes: Sequence[Node],
+    pending: Sequence[Pod],
+    assignment: Sequence[int],
+    existing: Sequence[tuple[Pod, str]] = (),
+    weights: OracleWeights = OracleWeights(),
+    filters=DEFAULT_FILTERS,
+    tol: float = 0.05,
+) -> list[str]:
+    """Semantic differential check that is robust to f32-vs-f64 score ties.
+
+    Replays the kernel's assignment through the oracle's sequential state:
+    each chosen node must be oracle-feasible at that point and its oracle
+    score within `tol` of the oracle's best feasible score (the batched
+    kernel computes scores in float32, so two nodes whose f64 scores differ
+    by ~1e-4 are legitimately interchangeable); -1 requires that NO node be
+    feasible. Returns a list of human-readable violations (empty = valid)."""
+    state = OracleState.build(nodes, existing)
+    errors = []
+    for pi in queue_order(pending):
+        pod = pending[pi]
+        node = assignment[pi]
+        feasible = feasible_nodes(pod, state, filters)
+        if node < 0:
+            if feasible:
+                errors.append(
+                    f"{pod.name}: kernel says unschedulable but oracle finds "
+                    f"feasible nodes {feasible}"
+                )
+            continue
+        if node not in feasible:
+            errors.append(f"{pod.name}: node {node} infeasible per oracle "
+                          f"(feasible: {feasible})")
+            continue
+        raw_ipa = {}
+        hi = 0.0
+        if weights.inter_pod_affinity:
+            raw_ipa = {i: score_inter_pod_affinity(pod, state, i) for i in feasible}
+            hi = max(map(abs, raw_ipa.values()), default=0.0)
+        scores = {i: _score_pod(pod, state, i, weights, raw_ipa, hi) for i in feasible}
+        best = max(scores.values())
+        if scores[node] < best - tol:
+            errors.append(
+                f"{pod.name}: node {node} scores {scores[node]:.4f}, "
+                f"{best - scores[node]:.4f} below best {best:.4f}"
+            )
+        state.add(node, pod)
+    return errors
 
 
 def schedule(
@@ -435,43 +563,21 @@ def schedule(
     """Sequential greedy scheduling in (priority desc, creation asc) order —
     the reference's queue order (PrioritySort QueueSort plugin)."""
     state = OracleState.build(nodes, existing)
-    order = sorted(
-        range(len(pending)),
-        key=lambda i: (-pending[i].spec.priority,
-                       pending[i].metadata.creation_timestamp, i),
-    )
     decisions: dict[int, int] = {}
-    for pi in order:
+    for pi in queue_order(pending):
         pod = pending[pi]
-        feasible = [
-            i
-            for i in range(len(nodes))
-            if all(f(pod, state, i) for f in filters)
-        ]
-        # nominated node honored first when feasible
-        if pod.nominated_node_name:
-            for i in feasible:
-                if nodes[i].name == pod.nominated_node_name:
-                    feasible = [i]
-                    break
+        feasible = feasible_nodes(pod, state, filters)
         if not feasible:
             decisions[pi] = -1
             continue
         best, best_score = -1, -float("inf")
         raw_ipa = {}
+        hi = 0.0
         if weights.inter_pod_affinity:
             raw_ipa = {i: score_inter_pod_affinity(pod, state, i) for i in feasible}
             hi = max(map(abs, raw_ipa.values()), default=0.0)
         for i in feasible:
-            s = (
-                weights.least_requested * score_least_requested(pod, state, i)
-                + weights.balanced_allocation * score_balanced_allocation(pod, state, i)
-                + weights.node_affinity * score_node_affinity(pod, state, i)
-                + weights.taint_toleration * score_taint_toleration(pod, state, i)
-                + weights.image_locality * score_image_locality(pod, state, i)
-            )
-            if weights.inter_pod_affinity and hi > 0:
-                s += weights.inter_pod_affinity * (raw_ipa[i] / hi) * MAX_NODE_SCORE
+            s = _score_pod(pod, state, i, weights, raw_ipa, hi)
             if s > best_score:
                 best, best_score = i, s
         decisions[pi] = best

@@ -1,0 +1,251 @@
+"""Differential tests for the label/taint/port/image plugin kernels vs the
+oracle (benchmark config #2 territory: node-affinity + taints/tolerations)."""
+
+import numpy as np
+import pytest
+
+from k8s_scheduler_tpu import oracle
+from k8s_scheduler_tpu.core import build_cycle_fn
+from k8s_scheduler_tpu.models import MakeNode, MakePod, SnapshotEncoder
+from k8s_scheduler_tpu.models import api
+
+
+def run_both(nodes, pods, existing=()):
+    snap = SnapshotEncoder().encode(nodes, pods, existing)
+    result = build_cycle_fn()(snap)
+    got = np.asarray(result.assignment)[: len(pods)].tolist()
+    want = [d.node_index for d in oracle.schedule(nodes, pods, existing)]
+    return got, want
+
+
+def test_node_selector():
+    nodes = [
+        MakeNode("gen").capacity({"cpu": "4"}).labels({"type": "general"}).obj(),
+        MakeNode("cmp").capacity({"cpu": "4"}).labels({"type": "compute"}).obj(),
+    ]
+    pods = [
+        MakePod("p0").req({"cpu": "1"}).node_selector({"type": "compute"}).obj(),
+        MakePod("p1").req({"cpu": "1"}).node_selector({"type": "general"}).obj(),
+        MakePod("p2").req({"cpu": "1"}).node_selector({"type": "gpu"}).obj(),
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want == [1, 0, -1]
+
+
+def test_node_affinity_required_in_notin():
+    nodes = [
+        MakeNode("a").capacity({"cpu": "4"}).labels({"zone": "east"}).obj(),
+        MakeNode("b").capacity({"cpu": "4"}).labels({"zone": "west"}).obj(),
+        MakeNode("c").capacity({"cpu": "4"}).obj(),  # no zone label
+    ]
+    from k8s_scheduler_tpu.models.api import NodeSelectorRequirement, NodeSelectorTerm
+
+    pods = [
+        MakePod("in-east").req({"cpu": "1"}).node_affinity_in("zone", ["east"]).obj(),
+        # NotIn matches absent keys too: feasible on b and c
+        MakePod("not-east").req({"cpu": "1"}).node_affinity_required(
+            NodeSelectorTerm((NodeSelectorRequirement("zone", api.OP_NOT_IN, ("east",)),))
+        ).obj(),
+        MakePod("exists").req({"cpu": "1"}).node_affinity_required(
+            NodeSelectorTerm((NodeSelectorRequirement("zone", api.OP_EXISTS),))
+        ).obj(),
+        MakePod("not-exists").req({"cpu": "1"}).node_affinity_required(
+            NodeSelectorTerm((NodeSelectorRequirement("zone", api.OP_DOES_NOT_EXIST),))
+        ).obj(),
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    assert got[0] == 0  # only east
+    assert got[3] == 2  # only unlabeled
+
+
+def test_node_affinity_gt_lt():
+    from k8s_scheduler_tpu.models.api import NodeSelectorRequirement, NodeSelectorTerm
+
+    nodes = [
+        MakeNode("small").capacity({"cpu": "4"}).labels({"size": "10"}).obj(),
+        MakeNode("big").capacity({"cpu": "4"}).labels({"size": "100"}).obj(),
+        MakeNode("odd").capacity({"cpu": "4"}).labels({"size": "huge"}).obj(),
+    ]
+    pods = [
+        MakePod("gt50").req({"cpu": "1"}).node_affinity_required(
+            NodeSelectorTerm((NodeSelectorRequirement("size", api.OP_GT, ("50",)),))
+        ).obj(),
+        MakePod("lt50").req({"cpu": "1"}).node_affinity_required(
+            NodeSelectorTerm((NodeSelectorRequirement("size", api.OP_LT, ("50",)),))
+        ).obj(),
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want == [1, 0]
+
+
+def test_node_affinity_or_of_terms():
+    nodes = [
+        MakeNode("a").capacity({"cpu": "4"}).labels({"zone": "east"}).obj(),
+        MakeNode("b").capacity({"cpu": "4"}).labels({"tier": "gold"}).obj(),
+        MakeNode("c").capacity({"cpu": "4"}).obj(),
+    ]
+    from k8s_scheduler_tpu.models.api import NodeSelectorRequirement, NodeSelectorTerm
+
+    # two terms = OR: zone=east OR tier=gold
+    pods = [
+        MakePod("p").req({"cpu": "1"}).node_affinity_required(
+            NodeSelectorTerm((NodeSelectorRequirement("zone", api.OP_IN, ("east",)),)),
+            NodeSelectorTerm((NodeSelectorRequirement("tier", api.OP_IN, ("gold",)),)),
+        ).obj()
+        for _ in range(3)
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    assert -1 not in got[:2] and got[2] in (0, 1)
+
+
+def test_node_affinity_preferred_steers():
+    nodes = [
+        MakeNode("plain").capacity({"cpu": "8"}).obj(),
+        MakeNode("ssd").capacity({"cpu": "8"}).labels({"disk": "ssd"}).obj(),
+    ]
+    pods = [
+        MakePod("p").req({"cpu": "1"})
+        .node_affinity_preferred(100, "disk", ["ssd"]).obj()
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want == [1]
+
+
+def test_taints_block_and_tolerations_admit():
+    nodes = [
+        MakeNode("tainted").capacity({"cpu": "8"}).taint("gpu", "yes").obj(),
+        MakeNode("open").capacity({"cpu": "2"}).obj(),
+    ]
+    pods = [
+        MakePod("tolerant").req({"cpu": "1"})
+        .toleration("gpu", "yes", api.NO_SCHEDULE).obj(),
+        MakePod("plain-1").req({"cpu": "1"}).obj(),
+        MakePod("plain-2").req({"cpu": "1"}).obj(),
+        MakePod("plain-3").req({"cpu": "1"}).obj(),  # open node full -> -1
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    assert want[0] == 0  # tolerant pod prefers the empty tainted node
+    assert want[3] == -1
+
+
+def test_toleration_exists_and_wildcard():
+    nodes = [
+        MakeNode("t1").capacity({"cpu": "4"}).taint("a", "1").obj(),
+        MakeNode("t2").capacity({"cpu": "4"}).taint("b", "2", api.NO_EXECUTE).obj(),
+    ]
+    pods = [
+        # operator Exists on key a: tolerates any value of a
+        MakePod("ex").req({"cpu": "1"}).toleration("a", op="Exists").obj(),
+        # empty key + Exists: tolerates everything
+        MakePod("wild").req({"cpu": "1"}).toleration("", op="Exists").obj(),
+        MakePod("none").req({"cpu": "1"}).obj(),
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    assert got[0] == 0 and got[1] in (0, 1) and got[2] == -1
+
+
+def test_prefer_no_schedule_scoring():
+    nodes = [
+        MakeNode("soft").capacity({"cpu": "8"})
+        .taint("maint", "true", api.PREFER_NO_SCHEDULE).obj(),
+        MakeNode("clean").capacity({"cpu": "8"}).obj(),
+    ]
+    pods = [MakePod("p").req({"cpu": "1"}).obj()]
+    got, want = run_both(nodes, pods)
+    assert got == want == [1]  # PreferNoSchedule steers away, doesn't block
+
+
+def test_host_ports_conflict_with_existing():
+    nodes = [MakeNode("n0").capacity({"cpu": "8"}).obj(),
+             MakeNode("n1").capacity({"cpu": "8"}).obj()]
+    existing = [(MakePod("web").req({"cpu": "1"}).host_port(80).obj(), "n0")]
+    pods = [MakePod("also-web").req({"cpu": "1"}).host_port(80).obj()]
+    got, want = run_both(nodes, pods, existing)
+    assert got == want == [1]
+
+
+def test_image_locality_steers():
+    img = "registry/model-server:v1"
+    nodes = [
+        MakeNode("cold").capacity({"cpu": "8"}).obj(),
+        MakeNode("warm").capacity({"cpu": "8"}).image(img, 800 * 2**20).obj(),
+    ]
+    pods = [MakePod("p").req({"cpu": "1"}).image(img).obj()]
+    got, want = run_both(nodes, pods)
+    assert got == want == [1]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_differential_with_labels(seed):
+    rng = np.random.default_rng(100 + seed)
+    n_nodes = int(rng.integers(3, 10))
+    zones = ["za", "zb", "zc"]
+    nodes = []
+    for i in range(n_nodes):
+        b = MakeNode(f"n{i}").capacity(
+            {"cpu": f"{rng.integers(2, 16)}", "memory": f"{rng.integers(4, 32)}Gi"}
+        ).labels({"zone": zones[i % 3], "idx": str(i)})
+        if rng.random() < 0.3:
+            b.taint("dedicated", "x")
+        if rng.random() < 0.2:
+            b.unschedulable()
+        nodes.append(b.obj())
+    pods = []
+    for i in range(int(rng.integers(5, 25))):
+        b = MakePod(f"p{i}").req(
+            {"cpu": f"{rng.integers(100, 3000)}m",
+             "memory": f"{rng.integers(256, 2048)}Mi"}
+        ).priority(int(rng.integers(0, 3))).created(float(i))
+        r = rng.random()
+        if r < 0.3:
+            b.node_affinity_in("zone", [zones[int(rng.integers(0, 3))]])
+        elif r < 0.5:
+            b.node_selector({"zone": zones[int(rng.integers(0, 3))]})
+        if rng.random() < 0.4:
+            b.toleration("dedicated", "x", api.NO_SCHEDULE)
+        if rng.random() < 0.3:
+            b.node_affinity_preferred(
+                int(rng.integers(1, 100)), "zone", [zones[int(rng.integers(0, 3))]]
+            )
+        pods.append(b.obj())
+    got, _ = run_both(nodes, pods)
+    # trajectory validation, not exact equality: f32 kernel scores can tie
+    # where the f64 oracle differs in the 7th digit (see validate_assignment)
+    errors = oracle.validate_assignment(nodes, pods, got)
+    assert not errors, errors
+
+
+def test_host_ports_conflict_within_batch():
+    # two pending pods want the same host port; one node is strongly
+    # preferred — the scan's port-claim bitmap must push the second pod to
+    # the other node, exactly like the oracle's sequential NodeInfo update
+    nodes = [MakeNode("n0").capacity({"cpu": "8"}).obj(),
+             MakeNode("n1").capacity({"cpu": "8"}).obj()]
+    pods = [
+        MakePod("web-a").req({"cpu": "1"}).host_port(80).created(0).obj(),
+        MakePod("web-b").req({"cpu": "1"}).host_port(80).created(1).obj(),
+        MakePod("web-c").req({"cpu": "1"}).host_port(80).created(2).obj(),
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    assert sorted(got[:2]) == [0, 1] and got[2] == -1
+
+
+def test_unknown_plugin_in_config_raises():
+    import pytest as _pytest
+
+    from k8s_scheduler_tpu.config import load_config
+    from k8s_scheduler_tpu.framework.runtime import Framework
+
+    cfg = load_config("""
+profiles:
+- plugins:
+    score:
+      enabled: [{name: NodePort, weight: 5}]
+""")
+    with _pytest.raises(KeyError, match="NodePort"):
+        Framework.from_config(cfg)
