@@ -53,8 +53,8 @@ def build_cycle_fn(
         smask, sscore = fw.static(ctx)
         extra = fw.extra_init(ctx)
 
-        def dyn_fn(p, node_req, ext):
-            return fw.dyn(ctx, p, node_req, ext)
+        def dyn_fn(p, node_req, ext, static_row):
+            return fw.dyn(ctx, p, node_req, ext, static_row)
 
         def update_fn(ext, p, node, ok):
             return fw.extra_update(ctx, ext, p, node, ok)

@@ -59,6 +59,26 @@ class CycleContext:
 
         return self.get("expr_node_mask", labels.expr_node_mask)
 
+    @property
+    def matched_pending(self) -> jnp.ndarray:  # bool [S, P]
+        from ..ops import interpod
+
+        return self.get("matched_pending", interpod.matched_pending)
+
+    @property
+    def matched_existing(self) -> jnp.ndarray:  # bool [S, E]
+        from ..ops import interpod
+
+        return self.get("matched_existing", interpod.matched_existing)
+
+    def initial_affinity_state(self):
+        from ..ops import interpod
+
+        return self.get(
+            "initial_affinity_state",
+            lambda s: interpod.initial_state(s, self.matched_existing),
+        )
+
 
 @runtime_checkable
 class Plugin(Protocol):
@@ -84,7 +104,11 @@ class PluginBase:
     def static_score(self, ctx: CycleContext) -> jnp.ndarray | None:
         return None
 
-    def dyn_score(self, ctx: CycleContext, p, node_requested, extra) -> jnp.ndarray | None:
+    def dyn_score(self, ctx: CycleContext, p, node_requested, extra,
+                  feasible) -> jnp.ndarray | None:
+        """`feasible` is the pod's full feasibility row [N] (static &
+        dynamic masks combined) for upstream-style normalize-over-feasible
+        scoring."""
         return None
 
     # --- scan-carried state (running domain counts etc.) ---

@@ -104,7 +104,7 @@ class NodeResourcesFit(PluginBase):
             snap.pod_requested[p], snap.node_allocatable, node_requested
         )
 
-    def dyn_score(self, ctx: CycleContext, p, node_requested, extra):
+    def dyn_score(self, ctx: CycleContext, p, node_requested, extra, feasible):
         snap = ctx.snap
         strategy = self.args.get("scoring_strategy", "LeastAllocated")
         fn = (
@@ -123,7 +123,7 @@ class NodeResourcesFit(PluginBase):
 class NodeResourcesBalancedAllocation(PluginBase):
     name = "NodeResourcesBalancedAllocation"
 
-    def dyn_score(self, ctx: CycleContext, p, node_requested, extra):
+    def dyn_score(self, ctx: CycleContext, p, node_requested, extra, feasible):
         snap = ctx.snap
         return res_ops.balanced_allocation_score(
             snap.pod_requested[p], snap.node_allocatable, node_requested,
@@ -156,3 +156,95 @@ class ImageLocality(PluginBase):
 
     def static_score(self, ctx: CycleContext):
         return images_ops.image_locality_score(ctx.snap)
+
+
+# --- shared affinity-state plumbing -----------------------------------------
+# InterPodAffinity and PodTopologySpread both consume the per-(selector,
+# domain) count state; whichever is initialized FIRST (filter order) owns
+# the scan-carried slot and maintains it, the other reads it.
+
+_AFFINITY_OWNER_KEY = "__affinity_state_owner__"
+
+
+def _claim_affinity_state(ctx: CycleContext, name: str):
+    snap = ctx.snap
+    if not (snap.has_inter_pod_affinity or snap.has_topology_spread):
+        return None
+    owner = ctx._cache.get(_AFFINITY_OWNER_KEY)
+    if owner is not None and owner != name:
+        return None  # someone else owns the slot
+    ctx._cache[_AFFINITY_OWNER_KEY] = name
+    return ctx.initial_affinity_state()
+
+
+def _affinity_state(ctx: CycleContext, extra):
+    return extra[ctx._cache[_AFFINITY_OWNER_KEY]]
+
+
+def _update_affinity_state(ctx: CycleContext, name, state, p, node, committed):
+    from ..ops import interpod as interpod_ops
+
+    if ctx._cache.get(_AFFINITY_OWNER_KEY) != name:
+        return state
+    return interpod_ops.affinity_update(
+        ctx.snap, state, ctx.matched_pending, p, node, committed
+    )
+
+
+class InterPodAffinity(PluginBase):
+    """The quadratic hot path, as counts over (selector, topology-domain)
+    instead of pairwise pod comparisons — see ops/interpod.py."""
+
+    name = "InterPodAffinity"
+
+    def extra_init(self, ctx: CycleContext):
+        return _claim_affinity_state(ctx, self.name)
+
+    def dyn_mask(self, ctx: CycleContext, p, node_requested, extra):
+        from ..ops import interpod as interpod_ops
+
+        if not ctx.snap.has_inter_pod_affinity:
+            return None
+        return interpod_ops.affinity_dyn_mask(
+            ctx.snap, _affinity_state(ctx, extra), ctx.matched_pending, p
+        )
+
+    def dyn_score(self, ctx: CycleContext, p, node_requested, extra, feasible):
+        from ..ops import interpod as interpod_ops
+
+        if not ctx.snap.has_inter_pod_affinity:
+            return None
+        return interpod_ops.affinity_dyn_score(
+            ctx.snap, _affinity_state(ctx, extra), ctx.matched_pending, p, feasible
+        )
+
+    def extra_update(self, ctx: CycleContext, extra, p, node, committed):
+        return _update_affinity_state(ctx, self.name, extra, p, node, committed)
+
+
+class PodTopologySpread(PluginBase):
+    name = "PodTopologySpread"
+
+    def extra_init(self, ctx: CycleContext):
+        return _claim_affinity_state(ctx, self.name)
+
+    def dyn_mask(self, ctx: CycleContext, p, node_requested, extra):
+        from ..ops import interpod as interpod_ops
+
+        if not ctx.snap.has_topology_spread:
+            return None
+        return interpod_ops.spread_dyn_mask(
+            ctx.snap, _affinity_state(ctx, extra), p
+        )
+
+    def dyn_score(self, ctx: CycleContext, p, node_requested, extra, feasible):
+        from ..ops import interpod as interpod_ops
+
+        if not ctx.snap.has_topology_spread:
+            return None
+        return interpod_ops.spread_dyn_score(
+            ctx.snap, _affinity_state(ctx, extra), p, feasible
+        )
+
+    def extra_update(self, ctx: CycleContext, extra, p, node, committed):
+        return _update_affinity_state(ctx, self.name, extra, p, node, committed)

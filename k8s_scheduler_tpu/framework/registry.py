@@ -43,6 +43,8 @@ def default_registry() -> Registry:
         p.NodeAffinity,
         p.TaintToleration,
         p.ImageLocality,
+        p.InterPodAffinity,
+        p.PodTopologySpread,
     ):
         r.register(cls.name, lambda args, _cls=cls: _cls(args))
     return r

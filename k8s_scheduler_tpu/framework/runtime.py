@@ -20,8 +20,6 @@ from .registry import Registry, default_registry
 # silently skipped when missing from the registry (unlike unknown names,
 # which raise). Shrinks as kernels land.
 PLANNED_PLUGINS = frozenset({
-    "InterPodAffinity",
-    "PodTopologySpread",
     "DefaultPreemption",
     "VolumeBinding",
 })
@@ -105,16 +103,19 @@ class Framework:
                 extra[p.name] = e
         return extra
 
-    def dyn(self, ctx: CycleContext, p, node_requested, extra):
+    def dyn(self, ctx: CycleContext, p, node_requested, extra, static_row):
         snap = ctx.snap
-        mask = jnp.ones((snap.N,), bool)
+        mask = static_row
         for f in self.filters:
             m = f.dyn_mask(ctx, p, node_requested, extra)
             if m is not None:
                 mask = mask & m
         score = jnp.zeros((snap.N,), jnp.float32)
         for s, w in self.scores:
-            v = s.dyn_score(ctx, p, node_requested, extra)
+            # dyn_score sees the FULL feasibility row (static & dynamic) so
+            # cross-node normalization covers feasible nodes only, like
+            # upstream NormalizeScore running after Filter
+            v = s.dyn_score(ctx, p, node_requested, extra, mask)
             if v is not None:
                 score = score + w * v
         return mask, score

@@ -171,6 +171,11 @@ class ClusterSnapshot:
     # padded count of distinct pending host ports (Q axis of the scan's
     # port-claim bitmap; static because it is a shape, bucketed by 4)
     num_distinct_ports: int
+    # capability flags (static): when False, the corresponding plugin
+    # contributes nothing and its whole kernel is never traced — a cluster
+    # without affinity pays zero for the affinity machinery
+    has_inter_pod_affinity: bool
+    has_topology_spread: bool
 
     # --- real (unpadded) counts: 0-d arrays, NOT static — a changed pod
     # count must not recompile the cycle (only padded shapes are static) ---
@@ -887,6 +892,14 @@ class SnapshotEncoder:
             pod_ports=pod_ports,
             pod_port_ids=pod_port_ids,
             num_distinct_ports=_pad_dim(len(port_ids_t), 4),
+            has_inter_pod_affinity=bool(
+                (pod_aff_terms >= 0).any()
+                or (pod_anti_terms >= 0).any()
+                or (pod_pref_aff >= 0).any()
+                or (exist_anti >= 0).any()
+                or (exist_pref >= 0).any()
+            ),
+            has_topology_spread=bool((pod_tsc >= 0).any()),
             pod_aff_terms=pod_aff_terms,
             pod_anti_terms=pod_anti_terms,
             pod_pref_aff=pod_pref_aff,

@@ -33,8 +33,15 @@ import jax.numpy as jnp
 
 NEG_INF = -1e9
 
-# dyn_fn(pod_idx, node_requested [N,R], extra) -> (mask [N] bool, score [N] f32)
-DynFn = Callable[[jnp.ndarray, jnp.ndarray, Any], tuple[jnp.ndarray, jnp.ndarray]]
+# dyn_fn(pod_idx, node_requested [N,R], extra, static_row [N] bool)
+#   -> (full feasibility mask [N] bool, score [N] f32)
+# The static row is passed IN so score hooks that normalize across nodes
+# (inter-pod affinity, topology spread) can normalize over feasible nodes
+# only, like upstream NormalizeScore running after Filter.
+DynFn = Callable[
+    [jnp.ndarray, jnp.ndarray, Any, jnp.ndarray],
+    tuple[jnp.ndarray, jnp.ndarray],
+]
 # update_fn(extra, pod_idx, node_idx, committed) -> extra
 UpdateFn = Callable[[Any, jnp.ndarray, jnp.ndarray, jnp.ndarray], Any]
 
@@ -66,8 +73,11 @@ def greedy_commit(
     def step(carry, rank):
         node_req, ext = carry
         p = order[rank]
-        dyn_mask, dyn_score = dyn_fn(p, node_req, ext)
-        feasible = static_mask[p] & dyn_mask
+        feasible, dyn_score = dyn_fn(p, node_req, ext, static_mask[p])
+        # dyn_fn is expected to fold the static row in (it needs it for
+        # normalize-over-feasible scoring); AND it again here so a dyn_fn
+        # that ignores its 4th arg can never bypass static filters
+        feasible = feasible & static_mask[p]
         score = jnp.where(feasible, static_score[p] + dyn_score, NEG_INF)
         # A nominated node (set by a previous preemption) is honored when
         # feasible, regardless of score — upstream evaluates the nominated

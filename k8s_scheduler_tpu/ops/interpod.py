@@ -1,0 +1,293 @@
+"""Inter-pod affinity / topology-spread kernels — the quadratic hot path.
+
+The reference's InterPodAffinity plugin is its worst-case cost center:
+O(nodes x existing-pods-with-affinity) per pod (SURVEY.md §3.5, benchmark
+config #3; expected `framework/plugins/interpodaffinity/` — [UNVERIFIED],
+mount empty). The TPU-native design never materializes pods x nodes x pods:
+
+1. Label selectors are deduplicated ([S] distinct selectors, each an AND of
+   expression-table rows incl. an implicit namespace expression).
+2. ONE batched pass computes matched_pending [S, P] and matched_existing
+   [S, E] via the shared expression kernel.
+3. Affinity state collapses to per-(selector, topology-domain) COUNTS
+   [S, D] (plus per-selector node tables [S, N] for the symmetric checks) —
+   segment-sums over existing pods, not pairwise comparisons.
+4. The commit scan carries these counts and updates them as pods place, so
+   in-cycle affinity among pending pods resolves exactly like the
+   reference's sequential NodeInfo mutation. Per-step cost is O(S*N + MA*N).
+
+Semantics parity notes:
+- Required affinity: >=1 matching pod in the node's domain, with the
+  upstream bootstrap rule (a pod matching its own selector may place when
+  NO pod in the cluster matches it — the first pod of a self-affine group).
+- Required anti-affinity: zero matching pods in the domain; symmetric
+  anti-affinity of existing AND in-cycle pods is enforced via the [S, N]
+  presence table.
+- Preferred terms score both directions (incoming pod's preferences against
+  placed pods, placed pods' preferences against the incoming pod),
+  normalized by max |raw| over feasible nodes like the oracle.
+- A node missing the topology key cannot satisfy required affinity, cannot
+  violate anti-affinity, and fails DoNotSchedule spread constraints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..models import encoding as enc
+from . import labels as labels_ops
+
+
+def selector_match(snap, label_keys, label_vals) -> jnp.ndarray:  # [S, X]
+    """Every deduplicated selector against every labeled subject."""
+    em = labels_ops.expr_pod_mask(snap, label_keys, label_vals)  # [Ex, X]
+    g = labels_ops._gather_expr(em, snap.sel_exprs, fill=True)  # [S, MSE, X]
+    return g.all(axis=1)
+
+
+def matched_pending(snap) -> jnp.ndarray:  # bool [S, P]
+    return selector_match(snap, snap.pod_label_keys, snap.pod_label_vals) & (
+        snap.pod_valid[None, :]
+    )
+
+
+def matched_existing(snap) -> jnp.ndarray:  # bool [S, E]
+    return selector_match(snap, snap.exist_label_keys, snap.exist_label_vals) & (
+        snap.exist_valid[None, :]
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class AffinityState:
+    """Scan-carried affinity state (see module docstring)."""
+
+    counts: jnp.ndarray  # f32 [S, D] matching pods per (selector, domain)
+    total: jnp.ndarray  # f32 [S] matching pods anywhere (bootstrap rule)
+    anti_presence: jnp.ndarray  # bool [S, N] node blocked-by-anti(sel) table
+    pref_sym: jnp.ndarray  # f32 [S, N] symmetric preferred-term weights
+
+
+def _exist_domains(snap) -> jnp.ndarray:  # i32 [E, K]
+    safe_node = jnp.clip(snap.exist_node, 0, snap.N - 1)
+    dom = snap.node_domains[safe_node]  # [E, K]
+    return jnp.where((snap.exist_node >= 0)[:, None], dom, -1)
+
+
+def initial_state(snap, m_exist: jnp.ndarray) -> AffinityState:
+    """Aggregate existing pods into the four state tables."""
+    S, E = m_exist.shape
+    D = snap.domain_key.shape[0]
+    K = snap.node_domains.shape[1]
+    dom = _exist_domains(snap)  # [E, K]
+
+    # counts[s, d] = number of existing pods matching s whose node is in d
+    counts = jnp.zeros((S, D), jnp.float32)
+    mf = m_exist.astype(jnp.float32)
+    for k in range(K):  # K is tiny (distinct topology keys)
+        ids = jnp.clip(dom[:, k], 0, D - 1)
+        w = jnp.where(dom[:, k] >= 0, mf, 0.0)  # [S, E]
+        # segment-add per selector row over the domain axis
+        counts = counts + jax.vmap(
+            lambda row: jnp.zeros(D, jnp.float32).at[ids].add(row)
+        )(w)
+    total = jnp.sum(mf, axis=1)  # [S]
+
+    # anti_presence[s, n] = some placed pod with required anti-term (s, k)
+    # shares node n's k-domain. Built as ONE scatter into a flat [S, D]
+    # table (flat domain ids are globally unique, so no key collisions),
+    # then expanded to nodes with K gathers.
+    anti = _flat_to_node(
+        snap, _flat_table(snap.exist_anti_terms, None, dom, S, D), True
+    )
+    pref = _flat_to_node(
+        snap,
+        _flat_table(snap.exist_pref_aff, snap.exist_pref_aff_w, dom, S, D),
+        False,
+    )
+    return AffinityState(counts, total, anti, pref)
+
+
+def _flat_table(terms, weights, owner_dom, S, D):
+    """Scatter every term (sel, k) of every owner into [S, D] at the
+    owner's k-domain. terms [X, MA, 2], owner_dom [X, K]; weights None ->
+    bool OR table, else f32 sum table."""
+    X, MA, _ = terms.shape
+    K = owner_dom.shape[1]
+    sel = terms[..., 0].reshape(-1)  # [X*MA]
+    k = jnp.clip(terms[..., 1].reshape(-1), 0, K - 1)
+    xi = jnp.repeat(jnp.arange(X), MA)
+    d = owner_dom[xi, k]
+    valid = (sel >= 0) & (d >= 0)
+    si = jnp.clip(sel, 0, S - 1)
+    di = jnp.clip(d, 0, D - 1)
+    if weights is None:
+        return jnp.zeros((S, D), bool).at[si, di].max(valid)
+    w = jnp.where(valid, weights.reshape(-1), 0.0)
+    return jnp.zeros((S, D), jnp.float32).at[si, di].add(w)
+
+
+def _flat_to_node(snap, flat, bool_mode: bool):
+    """[S, D] per-domain table -> [S, N] per-node table (a node is in one
+    domain per topology key; flat ids are unique across keys)."""
+    out = jnp.zeros((flat.shape[0], snap.N), bool if bool_mode else jnp.float32)
+    for k in range(snap.node_domains.shape[1]):
+        nd = snap.node_domains[:, k]  # [N]
+        g = flat[:, jnp.clip(nd, 0, flat.shape[1] - 1)]  # [S, N]
+        m = (nd >= 0)[None, :]
+        out = (out | (g & m)) if bool_mode else (out + jnp.where(m, g, 0.0))
+    return out
+
+
+def _node_domain_match(snap, k, d):  # bool [N]: nodes whose k-domain == d
+    nd = jnp.take(snap.node_domains, jnp.clip(k, 0, snap.node_domains.shape[1] - 1),
+                  axis=1)  # [N]
+    return (nd == d) & (d >= 0)
+
+
+# --------------------------------------------------------------------------
+# per-step (inside the commit scan)
+# --------------------------------------------------------------------------
+
+
+def _counts_at_nodes(snap, state: AffinityState, sel, k) -> jnp.ndarray:
+    """counts[sel, domain(n, k)] for all nodes n; -1 domains -> -1."""
+    D = state.counts.shape[1]
+    nd = jnp.take(
+        snap.node_domains, jnp.clip(k, 0, snap.node_domains.shape[1] - 1), axis=1
+    )  # [N]
+    row = state.counts[jnp.clip(sel, 0, state.counts.shape[0] - 1)]  # [D]
+    c = row[jnp.clip(nd, 0, D - 1)]
+    return jnp.where(nd >= 0, c, -1.0)  # -1 marks "no such domain"
+
+
+def affinity_dyn_mask(snap, state: AffinityState, m_pending, p) -> jnp.ndarray:
+    """Required affinity + anti-affinity + symmetric anti for pod p: [N]."""
+    N = snap.N
+    ok = jnp.ones((N,), bool)
+    MA = snap.pod_aff_terms.shape[1]
+    aff = snap.pod_aff_terms[p]  # [MA, 2]
+    anti = snap.pod_anti_terms[p]
+    for a in range(MA):
+        sel, k = aff[a, 0], aff[a, 1]
+        c = _counts_at_nodes(snap, state, sel, k)
+        # bootstrap: nothing matches the selector anywhere AND the pod
+        # matches its own selector -> term ignored
+        boot = (state.total[jnp.clip(sel, 0, state.total.shape[0] - 1)] == 0) & (
+            m_pending[jnp.clip(sel, 0, m_pending.shape[0] - 1), p]
+        )
+        term_ok = jnp.where(sel >= 0, boot | (c > 0), True)
+        ok &= term_ok
+    for a in range(MA):
+        sel, k = anti[a, 0], anti[a, 1]
+        c = _counts_at_nodes(snap, state, sel, k)
+        # c == -1 (key absent) cannot be violated; c == 0 is fine
+        term_ok = jnp.where(sel >= 0, c <= 0, True)
+        ok &= term_ok
+    # symmetric: placed pods' anti terms whose selector matches p
+    mp = m_pending[:, p]  # [S]
+    viol = jnp.any(mp[:, None] & state.anti_presence, axis=0)  # [N]
+    return ok & ~viol
+
+
+def affinity_dyn_score(snap, state: AffinityState, m_pending, p,
+                       feasible) -> jnp.ndarray:
+    """Preferred-term score for pod p, normalized to [-100, 100] by the max
+    |raw| over feasible nodes (both sides of the symmetry)."""
+    N = snap.N
+    raw = jnp.zeros((N,), jnp.float32)
+    MA = snap.pod_pref_aff.shape[1]
+    pref = snap.pod_pref_aff[p]
+    w = snap.pod_pref_aff_w[p]
+    for a in range(MA):
+        sel, k = pref[a, 0], pref[a, 1]
+        c = _counts_at_nodes(snap, state, sel, k)
+        raw += jnp.where((sel >= 0) & (c > 0), w[a] * jnp.maximum(c, 0.0), 0.0)
+    mp = m_pending[:, p].astype(jnp.float32)  # [S]
+    raw += mp @ state.pref_sym  # symmetric direction, [S]x[S,N]
+    hi = jnp.max(jnp.where(feasible, jnp.abs(raw), 0.0))
+    return jnp.where(hi > 0, raw / hi * 100.0, 0.0)
+
+
+def affinity_update(snap, state: AffinityState, m_pending, p, node,
+                    committed) -> AffinityState:
+    """Pod p committed to `node`: fold it into counts/total/anti/pref."""
+    K = snap.node_domains.shape[1]
+    S, D = state.counts.shape
+    mp = jnp.where(committed, m_pending[:, p].astype(jnp.float32), 0.0)  # [S]
+    counts = state.counts
+    node_dom = snap.node_domains[node]  # [K]
+    for k in range(K):
+        d = node_dom[k]
+        add = jnp.where(d >= 0, mp, 0.0)
+        counts = counts.at[:, jnp.clip(d, 0, D - 1)].add(add)
+    total = state.total + mp
+
+    # fold p's own anti/preferred terms into the node tables (unrolled over
+    # the tiny MA axis; each slot is one [N]-row mask + scatter)
+    anti = state.anti_presence
+    pref = state.pref_sym
+    MA = snap.pod_anti_terms.shape[1]
+    anti_terms = snap.pod_anti_terms[p]
+    pref_terms = snap.pod_pref_aff[p]
+    pref_w = snap.pod_pref_aff_w[p]
+    for a in range(MA):
+        sel, k = anti_terms[a, 0], anti_terms[a, 1]
+        d = node_dom[jnp.clip(k, 0, K - 1)]
+        row = _node_domain_match(snap, k, d) & (sel >= 0) & committed
+        anti = anti.at[jnp.clip(sel, 0, S - 1)].max(row)
+
+        sel2, k2 = pref_terms[a, 0], pref_terms[a, 1]
+        d2 = node_dom[jnp.clip(k2, 0, K - 1)]
+        row2 = _node_domain_match(snap, k2, d2) & (sel2 >= 0) & committed
+        pref = pref.at[jnp.clip(sel2, 0, S - 1)].add(
+            jnp.where(row2, pref_w[a], 0.0)
+        )
+    return AffinityState(counts, total, anti, pref)
+
+
+# --------------------------------------------------------------------------
+# topology spread
+# --------------------------------------------------------------------------
+
+
+def spread_dyn_mask(snap, state: AffinityState, p) -> jnp.ndarray:
+    """DoNotSchedule constraints: count(dom) + 1 - min(dom counts of the
+    key) <= maxSkew; nodes missing the key fail."""
+    N = snap.N
+    ok = jnp.ones((N,), bool)
+    MC = snap.pod_tsc.shape[1]
+    tsc = snap.pod_tsc[p]  # [MC, 3]
+    skews = snap.pod_tsc_skew[p]
+    D = state.counts.shape[1]
+    for c in range(MC):
+        k, sel, when = tsc[c, 0], tsc[c, 1], tsc[c, 2]
+        cnt = _counts_at_nodes(snap, state, sel, k)  # [N], -1 = no key
+        row = state.counts[jnp.clip(sel, 0, state.counts.shape[0] - 1)]  # [D]
+        eligible = (snap.domain_key == k) & (snap.domain_node_count > 0)
+        minc = jnp.min(jnp.where(eligible, row, jnp.inf))
+        minc = jnp.where(jnp.isfinite(minc), minc, 0.0)
+        viol = (cnt + 1.0 - minc > skews[c].astype(jnp.float32)) | (cnt < 0)
+        hard = (k >= 0) & (when == enc.WHEN_DO_NOT_SCHEDULE)
+        ok &= jnp.where(hard, ~viol, True)
+    return ok
+
+
+def spread_dyn_score(snap, state: AffinityState, p, feasible) -> jnp.ndarray:
+    """ScheduleAnyway constraints: fewer matching pods in the node's domain
+    is better; raw = sum of counts, normalized reverse over feasible nodes
+    (both sides use this simplified form of upstream's two-pass score)."""
+    N = snap.N
+    raw = jnp.zeros((N,), jnp.float32)
+    MC = snap.pod_tsc.shape[1]
+    tsc = snap.pod_tsc[p]
+    for c in range(MC):
+        k, sel, when = tsc[c, 0], tsc[c, 1], tsc[c, 2]
+        cnt = _counts_at_nodes(snap, state, sel, k)
+        soft = (k >= 0) & (when == enc.WHEN_SCHEDULE_ANYWAY)
+        raw += jnp.where(soft, jnp.maximum(cnt, 0.0), 0.0)
+    hi = jnp.max(jnp.where(feasible, raw, 0.0))
+    return jnp.where(hi > 0, (1.0 - raw / hi) * 100.0, 100.0)

@@ -113,6 +113,10 @@ class OracleState:
     # recompute once per candidate node (O(P*N^2) without these)
     _taint_max: dict[str, int] = dataclasses.field(default_factory=dict)
     _image_spread: dict[str, float] = dataclasses.field(default_factory=dict)
+    # bootstrap any_match is node-independent; cache per (pod, term) and
+    # invalidate via a version bumped on every add/remove
+    _version: int = 0
+    _bootstrap: dict = dataclasses.field(default_factory=dict)
 
     @staticmethod
     def build(nodes: Sequence[Node], existing: Sequence[tuple[Pod, str]]) -> "OracleState":
@@ -133,11 +137,25 @@ class OracleState:
         for r, v in pod.resource_requests().items():
             self.requested[node_idx][r] = self.requested[node_idx].get(r, 0.0) + v
         self.pods_on_node[node_idx].append(pod)
+        self._version += 1
 
     def remove(self, node_idx: int, pod: Pod) -> None:
         for r, v in pod.resource_requests().items():
             self.requested[node_idx][r] = self.requested[node_idx].get(r, 0.0) - v
         self.pods_on_node[node_idx].remove(pod)
+        self._version += 1
+
+    def any_pod_matches(self, term: PodAffinityTerm, own_ns: str) -> bool:
+        key = (self._version, id(term), own_ns)
+        hit = self._bootstrap.get(key)
+        if hit is None:
+            hit = any(
+                _term_matches_pod(term, own_ns, other)
+                for pods in self.pods_on_node
+                for other in pods
+            )
+            self._bootstrap[key] = hit
+        return hit
 
     def free(self, node_idx: int) -> dict[str, float]:
         alloc = self.nodes[node_idx].status.allocatable
@@ -217,6 +235,13 @@ def filter_inter_pod_affinity(pod: Pod, state: OracleState, i: int) -> bool:
     # required pod affinity: each term needs >=1 matching pod in the domain
     if aff.pod_affinity:
         for term in aff.pod_affinity.required:
+            # upstream bootstrap rule: when NO pod anywhere matches the
+            # selector and the incoming pod matches its own selector, the
+            # term is ignored (lets the first pod of a self-affine group in)
+            if not state.any_pod_matches(term, pod.namespace) and _term_matches_pod(
+                term, pod.namespace, pod
+            ):
+                continue
             dom = _domain(node, term.topology_key)
             if dom is None:
                 return False
@@ -440,6 +465,29 @@ def score_inter_pod_affinity(pod: Pod, state: OracleState, i: int) -> float:
     return score
 
 
+def score_topology_spread_raw(pod: Pod, state: OracleState, i: int) -> float:
+    """ScheduleAnyway constraints: matching-pod count in the node's domain
+    (summed over constraints); the caller reverse-normalizes over feasible
+    nodes — identical to ops/interpod.spread_dyn_score."""
+    node = state.nodes[i]
+    raw = 0.0
+    for c in pod.spec.topology_spread_constraints:
+        if c.when_unsatisfiable != api.SCHEDULE_ANYWAY:
+            continue
+        dom = _domain(node, c.topology_key)
+        if dom is None:
+            continue
+        for j, nd in enumerate(state.nodes):
+            if _domain(nd, c.topology_key) != dom:
+                continue
+            for other in state.pods_on_node[j]:
+                if other.namespace == pod.namespace and match_label_selector(
+                    c.label_selector, other.metadata.labels
+                ):
+                    raw += 1.0
+    return raw
+
+
 # --------------------------------------------------------------------------
 # The sequential scheduler
 # --------------------------------------------------------------------------
@@ -462,7 +510,8 @@ class OracleWeights:
     node_affinity: float = 1.0
     taint_toleration: float = 3.0
     image_locality: float = 1.0
-    inter_pod_affinity: float = 0.0
+    inter_pod_affinity: float = 1.0
+    topology_spread: float = 2.0
 
 
 def queue_order(pending: Sequence[Pod]) -> list[int]:
@@ -489,8 +538,32 @@ def feasible_nodes(pod: Pod, state: OracleState, filters) -> list[int]:
     return feasible
 
 
+@dataclasses.dataclass
+class _CrossNodeRaws:
+    """Raw scores needing cross-node normalization over the feasible set
+    (upstream NormalizeScore runs after Filter)."""
+
+    ipa: dict
+    ipa_hi: float
+    spread: dict
+    spread_hi: float
+
+    @staticmethod
+    def compute(pod: Pod, state: OracleState, feasible: list[int],
+                weights: "OracleWeights") -> "_CrossNodeRaws":
+        ipa, spread = {}, {}
+        if weights.inter_pod_affinity:
+            ipa = {i: score_inter_pod_affinity(pod, state, i) for i in feasible}
+        if weights.topology_spread and pod.spec.topology_spread_constraints:
+            spread = {i: score_topology_spread_raw(pod, state, i) for i in feasible}
+        return _CrossNodeRaws(
+            ipa, max(map(abs, ipa.values()), default=0.0),
+            spread, max(spread.values(), default=0.0),
+        )
+
+
 def _score_pod(pod: Pod, state: OracleState, i: int, weights: OracleWeights,
-               raw_ipa: dict | None = None, ipa_hi: float = 0.0) -> float:
+               cn: "_CrossNodeRaws | None" = None) -> float:
     s = (
         weights.least_requested * score_least_requested(pod, state, i)
         + weights.balanced_allocation * score_balanced_allocation(pod, state, i)
@@ -498,8 +571,16 @@ def _score_pod(pod: Pod, state: OracleState, i: int, weights: OracleWeights,
         + weights.taint_toleration * score_taint_toleration(pod, state, i)
         + weights.image_locality * score_image_locality(pod, state, i)
     )
-    if weights.inter_pod_affinity and raw_ipa and ipa_hi > 0:
-        s += weights.inter_pod_affinity * (raw_ipa[i] / ipa_hi) * MAX_NODE_SCORE
+    if cn is not None:
+        if weights.inter_pod_affinity and cn.ipa_hi > 0:
+            s += weights.inter_pod_affinity * (cn.ipa[i] / cn.ipa_hi) * MAX_NODE_SCORE
+        if weights.topology_spread and pod.spec.topology_spread_constraints:
+            if cn.spread_hi > 0:
+                s += weights.topology_spread * (
+                    1.0 - cn.spread[i] / cn.spread_hi
+                ) * MAX_NODE_SCORE
+            else:
+                s += weights.topology_spread * MAX_NODE_SCORE
     return s
 
 
@@ -537,12 +618,8 @@ def validate_assignment(
             errors.append(f"{pod.name}: node {node} infeasible per oracle "
                           f"(feasible: {feasible})")
             continue
-        raw_ipa = {}
-        hi = 0.0
-        if weights.inter_pod_affinity:
-            raw_ipa = {i: score_inter_pod_affinity(pod, state, i) for i in feasible}
-            hi = max(map(abs, raw_ipa.values()), default=0.0)
-        scores = {i: _score_pod(pod, state, i, weights, raw_ipa, hi) for i in feasible}
+        cn = _CrossNodeRaws.compute(pod, state, feasible, weights)
+        scores = {i: _score_pod(pod, state, i, weights, cn) for i in feasible}
         best = max(scores.values())
         if scores[node] < best - tol:
             errors.append(
@@ -571,13 +648,9 @@ def schedule(
             decisions[pi] = -1
             continue
         best, best_score = -1, -float("inf")
-        raw_ipa = {}
-        hi = 0.0
-        if weights.inter_pod_affinity:
-            raw_ipa = {i: score_inter_pod_affinity(pod, state, i) for i in feasible}
-            hi = max(map(abs, raw_ipa.values()), default=0.0)
+        cn = _CrossNodeRaws.compute(pod, state, feasible, weights)
         for i in feasible:
-            s = _score_pod(pod, state, i, weights, raw_ipa, hi)
+            s = _score_pod(pod, state, i, weights, cn)
             if s > best_score:
                 best, best_score = i, s
         decisions[pi] = best

@@ -52,6 +52,7 @@ def make_pods(
     anti_affinity_fraction: float = 0.0,
     selector_fraction: float = 0.0,
     toleration_fraction: float = 0.0,
+    spread_fraction: float = 0.0,
     priorities: tuple[int, ...] = (0,),
 ) -> list[Pod]:
     rng = np.random.default_rng(seed)
@@ -78,6 +79,8 @@ def make_pods(
             b.pod_affinity("topology.kubernetes.io/zone", {"app": app})
         if anti_affinity_fraction and rng.random() < anti_affinity_fraction:
             b.pod_affinity("kubernetes.io/hostname", {"app": app}, anti=True)
+        if spread_fraction and rng.random() < spread_fraction:
+            b.spread(2, "topology.kubernetes.io/zone", {"app": app})
         pods.append(b.obj())
     return pods
 

@@ -23,3 +23,32 @@ def test_dryrun_multichip_8():
 
 def test_dryrun_multichip_2():
     ge.dryrun_multichip(2)
+
+
+def test_2d_mesh_sharded_cycle_with_affinity():
+    """Full feature set (affinity + spread + taints) compiled and executed
+    over a 2-D ('pods','nodes') mesh."""
+    import jax
+    import numpy as np
+
+    from k8s_scheduler_tpu.core import build_cycle_fn
+    from k8s_scheduler_tpu.models import SnapshotEncoder
+    from k8s_scheduler_tpu.parallel import make_mesh, shard_snapshot
+    from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+    mesh = make_mesh(jax.devices()[:8], nodes_axis=2)
+    nodes = make_cluster(8, with_labels=True, taint_fraction=0.2)
+    pods = make_pods(
+        16, affinity_fraction=0.3, anti_affinity_fraction=0.3,
+        toleration_fraction=0.5, selector_fraction=0.3, spread_fraction=0.4,
+    )
+    existing = [(p, nodes[i % 8].name) for i, p in enumerate(
+        make_pods(6, seed=9, name_prefix="exist", anti_affinity_fraction=0.5)
+    )]
+    snap = SnapshotEncoder(pad_pods=16, pad_nodes=8).encode(nodes, pods, existing)
+    assert snap.has_topology_spread and snap.has_inter_pod_affinity
+    snap = shard_snapshot(snap, mesh)
+    r = build_cycle_fn()(snap)
+    a = np.asarray(r.assignment)
+    assert a.shape == (16,)
+    assert (a >= -1).all()

@@ -1,0 +1,248 @@
+"""Differential tests for InterPodAffinity + PodTopologySpread (benchmark
+config #3 territory: the quadratic hot path)."""
+
+import numpy as np
+import pytest
+
+from k8s_scheduler_tpu import oracle
+from k8s_scheduler_tpu.core import build_cycle_fn
+from k8s_scheduler_tpu.models import MakeNode, MakePod, SnapshotEncoder, api
+
+
+def run_both(nodes, pods, existing=()):
+    snap = SnapshotEncoder().encode(nodes, pods, existing)
+    result = build_cycle_fn()(snap)
+    got = np.asarray(result.assignment)[: len(pods)].tolist()
+    want = [d.node_index for d in oracle.schedule(nodes, pods, existing)]
+    return got, want
+
+
+def zone_nodes(per_zone=2, zones=("za", "zb"), cpu="8"):
+    nodes = []
+    for z in zones:
+        for i in range(per_zone):
+            nodes.append(
+                MakeNode(f"{z}-n{i}").capacity({"cpu": cpu, "memory": "16Gi"})
+                .labels({"zone": z}).obj()
+            )
+    return nodes
+
+
+def test_required_affinity_follows_existing():
+    nodes = zone_nodes()
+    existing = [
+        (MakePod("db").labels({"app": "db"}).req({"cpu": "1"}).obj(), "zb-n0")
+    ]
+    pods = [
+        MakePod("web").req({"cpu": "1"})
+        .pod_affinity("zone", {"app": "db"}).obj()
+    ]
+    got, want = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[0] in (2, 3)  # zb zone
+
+
+def test_required_affinity_no_match_infeasible():
+    nodes = zone_nodes()
+    pods = [
+        MakePod("web").req({"cpu": "1"})
+        .pod_affinity("zone", {"app": "db"}).obj()
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want == [-1]
+
+
+def test_affinity_bootstrap_first_pod_of_group():
+    # pod matches its OWN selector and nothing else matches: allowed anywhere
+    nodes = zone_nodes()
+    pods = [
+        MakePod("web").labels({"app": "web"}).req({"cpu": "1"})
+        .pod_affinity("zone", {"app": "web"}).obj()
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    assert got[0] >= 0
+
+
+def test_intra_batch_affinity_chain():
+    # second pod's required affinity satisfied by the FIRST pod committed in
+    # the same cycle (running domain counts inside the scan)
+    nodes = zone_nodes()
+    pods = [
+        MakePod("leader").labels({"app": "grp"}).req({"cpu": "1"})
+        .priority(10).created(0).obj(),
+        MakePod("follower").req({"cpu": "1"}).created(1)
+        .pod_affinity("zone", {"app": "grp"}).obj(),
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    lead_zone = got[0] // 2
+    assert got[1] // 2 == lead_zone
+
+
+def test_anti_affinity_spreads_by_hostname():
+    nodes = zone_nodes(per_zone=2)
+    pods = [
+        MakePod(f"r{i}").labels({"app": "api"}).req({"cpu": "1"}).created(i)
+        .pod_affinity("kubernetes.io/hostname", {"app": "api"}, anti=True)
+        .obj()
+        for i in range(5)
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    placed = [g for g in got if g >= 0]
+    assert len(placed) == 4 and len(set(placed)) == 4  # one per node
+    assert got.count(-1) == 1
+
+
+def test_symmetric_anti_affinity_of_existing_pod():
+    # existing pod has anti-affinity against app=web: incoming web pod must
+    # avoid that pod's domain even though the INCOMING pod has no affinity
+    nodes = zone_nodes()
+    existing = [
+        (
+            MakePod("loner").labels({"app": "loner"}).req({"cpu": "1"})
+            .pod_affinity("zone", {"app": "web"}, anti=True).obj(),
+            "za-n0",
+        )
+    ]
+    pods = [MakePod("web").labels({"app": "web"}).req({"cpu": "1"}).obj()]
+    got, want = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[0] in (2, 3)  # pushed out of za
+
+
+def test_symmetric_anti_affinity_intra_batch():
+    # the anti-affine pod is committed FIRST (higher priority) in the same
+    # cycle; the later pod must respect it
+    nodes = zone_nodes()
+    pods = [
+        MakePod("loner").labels({"app": "loner"}).req({"cpu": "1"})
+        .priority(10)
+        .pod_affinity("zone", {"app": "web"}, anti=True).obj(),
+        MakePod("web").labels({"app": "web"}).req({"cpu": "1"}).obj(),
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    assert got[0] >= 0 and got[1] >= 0
+    assert got[1] // 2 != got[0] // 2  # different zones
+
+
+def test_preferred_affinity_steers_together():
+    nodes = zone_nodes()
+    existing = [
+        (MakePod("cache").labels({"app": "cache"}).req({"cpu": "1"}).obj(), "zb-n1")
+    ]
+    pods = [
+        MakePod("web").req({"cpu": "1"})
+        .pod_affinity("zone", {"app": "cache"}, weight=80).obj()
+    ]
+    got, want = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[0] in (2, 3)
+
+
+def test_preferred_anti_affinity_steers_apart():
+    nodes = zone_nodes()
+    existing = [
+        (MakePod("noisy").labels({"app": "noisy"}).req({"cpu": "1"}).obj(), "za-n0")
+    ]
+    pods = [
+        MakePod("quiet").req({"cpu": "1"})
+        .pod_affinity("zone", {"app": "noisy"}, anti=True, weight=80).obj()
+    ]
+    got, want = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[0] in (2, 3)
+
+
+def test_topology_spread_do_not_schedule():
+    nodes = zone_nodes()
+    pods = [
+        MakePod(f"w{i}").labels({"app": "spread"}).req({"cpu": "1"}).created(i)
+        .spread(1, "zone", {"app": "spread"})
+        .obj()
+        for i in range(4)
+    ]
+    got, want = run_both(nodes, pods)
+    assert got == want
+    zones = [g // 2 for g in got if g >= 0]
+    assert abs(zones.count(0) - zones.count(1)) <= 1
+
+
+def test_topology_spread_schedule_anyway_scores():
+    nodes = zone_nodes()
+    existing = [
+        (MakePod(f"e{i}").labels({"app": "s"}).req({"cpu": "1"}).obj(), "za-n0")
+        for i in range(3)
+    ]
+    pods = [
+        MakePod("w").labels({"app": "s"}).req({"cpu": "1"})
+        .spread(1, "zone", {"app": "s"}, when_unsatisfiable=api.SCHEDULE_ANYWAY)
+        .obj()
+    ]
+    got, want = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[0] in (2, 3)  # steered to the empty zone, not blocked
+
+
+def test_namespace_scoping_of_selectors():
+    nodes = zone_nodes()
+    existing = [
+        (
+            MakePod("db-other", namespace="other").labels({"app": "db"})
+            .req({"cpu": "1"}).obj(),
+            "za-n0",
+        )
+    ]
+    # pod in default ns: the other-ns db must NOT satisfy its affinity
+    pods = [
+        MakePod("web").req({"cpu": "1"}).pod_affinity("zone", {"app": "db"}).obj()
+    ]
+    got, want = run_both(nodes, pods, existing)
+    assert got == want == [-1]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_randomized_differential_affinity(seed):
+    rng = np.random.default_rng(200 + seed)
+    zones = ["za", "zb", "zc"]
+    n_nodes = int(rng.integers(4, 10))
+    nodes = [
+        MakeNode(f"n{i}").capacity(
+            {"cpu": f"{rng.integers(4, 16)}", "memory": f"{rng.integers(8, 32)}Gi"}
+        ).labels({"zone": zones[i % 3]}).obj()
+        for i in range(n_nodes)
+    ]
+    apps = [f"app-{j}" for j in range(4)]
+    existing = []
+    for i in range(int(rng.integers(0, 8))):
+        existing.append(
+            (
+                MakePod(f"e{i}").labels({"app": apps[int(rng.integers(0, 4))]})
+                .req({"cpu": "500m"}).obj(),
+                f"n{int(rng.integers(0, n_nodes))}",
+            )
+        )
+    pods = []
+    for i in range(int(rng.integers(4, 16))):
+        app = apps[int(rng.integers(0, 4))]
+        b = (
+            MakePod(f"p{i}").labels({"app": app})
+            .req({"cpu": f"{rng.integers(200, 2000)}m"})
+            .priority(int(rng.integers(0, 3))).created(float(i))
+        )
+        r = rng.random()
+        target = apps[int(rng.integers(0, 4))]
+        if r < 0.25:
+            b.pod_affinity("zone", {"app": target})
+        elif r < 0.5:
+            b.pod_affinity("kubernetes.io/hostname", {"app": target}, anti=True)
+        elif r < 0.65:
+            b.pod_affinity("zone", {"app": target}, weight=int(rng.integers(10, 90)))
+        elif r < 0.8:
+            b.spread(int(rng.integers(1, 3)), "zone", {"app": app})
+        pods.append(b.obj())
+    got, _ = run_both(nodes, pods, existing)
+    errors = oracle.validate_assignment(nodes, pods, got, existing)
+    assert not errors, errors
