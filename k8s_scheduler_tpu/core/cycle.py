@@ -37,14 +37,26 @@ class CycleResult:
     assignment: jnp.ndarray  # i32 [P] node index or -1
     node_requested: jnp.ndarray  # f32 [N, R] post-cycle
     unschedulable: jnp.ndarray  # bool [P] valid pod that found no node
+    gang_dropped: jnp.ndarray  # bool [P] placed, then unwound (group failed)
+    static_mask: jnp.ndarray  # bool [P, N] framework static feasibility —
+    # returned so the PostFilter pass reuses it instead of re-running the
+    # whole static filter pipeline
 
 
 def build_cycle_fn(
     framework: Framework | None = None,
+    gang_scheduling: bool = True,
 ) -> Callable[[ClusterSnapshot], CycleResult]:
     """Compile the cycle for a framework (default: the default plugin set).
     The returned callable is jitted; snapshots with identical padded shapes
-    reuse the compiled program."""
+    reuse the compiled program.
+
+    With `gang_scheduling` (the Coscheduling plugin analogue, SURVEY.md §2
+    C14), pods carrying a pod-group whose placed-member count stays below
+    the group's minMember are rolled back after the commit scan — the
+    all-or-nothing semantics upstream gets from Permit-and-wait, here a
+    single batched unwind. minMember counts pods placed THIS cycle;
+    already-running members are bound facts, not waiters."""
     fw = framework or Framework.from_config()
 
     @jax.jit
@@ -73,7 +85,51 @@ def build_cycle_fn(
             extra=extra,
             update_fn=update_fn,
         )
+        dropped = jnp.zeros_like(snap.pod_valid)
+        if gang_scheduling:
+            placed = snap.pod_valid & (result.assignment >= 0)
+            G = snap.group_min_member.shape[0]
+            gid = jnp.clip(snap.pod_group, 0, G - 1)
+            in_group = snap.pod_group >= 0
+            # minMember counts this cycle's placements PLUS members already
+            # running (a gang member retried alone after a bind error must
+            # not be unwound while its siblings run)
+            counts = snap.group_existing_count + jnp.zeros(G, jnp.int32).at[
+                gid
+            ].add(jnp.where(in_group & placed, 1, 0))
+            # minMember defaults to 0 for undeclared groups -> never fails
+            fail = counts < snap.group_min_member
+            dropped = in_group & fail[gid] & placed
+            result = commit_ops.unwind_assignments(
+                result, dropped, snap.pod_requested
+            )
         unsched = snap.pod_valid & (result.assignment < 0)
-        return CycleResult(result.assignment, result.node_requested, unsched)
+        return CycleResult(
+            result.assignment, result.node_requested, unsched, dropped, smask
+        )
 
     return cycle
+
+
+def build_preemption_fn(framework: Framework | None = None):
+    """Compile the PostFilter (preemption) pass: called with the cycle's
+    output when unschedulable pods remain. Kept as a separate jitted
+    program so the hot cycle pays nothing when every pod places —
+    the analogue of RunPostFilterPlugins only running on failure
+    (SURVEY.md §3.4). Returns None when no PostFilter plugin is enabled."""
+    fw = framework or Framework.from_config()
+    if not fw.post_filters:
+        return None
+
+    @jax.jit
+    def post_filter(snap: ClusterSnapshot, result: CycleResult):
+        ctx = CycleContext(snap)
+        return fw.post_filter(
+            ctx,
+            result.assignment,
+            result.node_requested,
+            result.static_mask,
+            excluded=result.gang_dropped,
+        )
+
+    return post_filter

@@ -117,3 +117,10 @@ class PluginBase:
 
     def extra_update(self, ctx: CycleContext, extra, p, node, committed):
         return extra
+
+    # --- PostFilter (preemption): runs after the commit scan over the
+    # pods that found no node; returns a PreemptionResult or None.
+    # `excluded` [P] marks pods that must not preempt (gang-dropped) ---
+    def post_filter(self, ctx: CycleContext, assignment, node_requested,
+                    static_mask, excluded=None):
+        return None

@@ -222,6 +222,24 @@ class InterPodAffinity(PluginBase):
         return _update_affinity_state(ctx, self.name, extra, p, node, committed)
 
 
+class DefaultPreemption(PluginBase):
+    """PostFilter: batched what-if preemption (ops/preemption.py)."""
+
+    name = "DefaultPreemption"
+
+    def post_filter(self, ctx: CycleContext, assignment, node_requested,
+                    static_mask, excluded=None):
+        from ..ops import preemption as preemption_ops
+
+        return preemption_ops.run_preemption(
+            ctx.snap,
+            assignment=assignment,
+            node_requested=node_requested,
+            static_mask=static_mask,
+            excluded=excluded,
+        )
+
+
 class PodTopologySpread(PluginBase):
     name = "PodTopologySpread"
 

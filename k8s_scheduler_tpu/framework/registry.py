@@ -45,6 +45,7 @@ def default_registry() -> Registry:
         p.ImageLocality,
         p.InterPodAffinity,
         p.PodTopologySpread,
+        p.DefaultPreemption,
     ):
         r.register(cls.name, lambda args, _cls=cls: _cls(args))
     return r

@@ -20,7 +20,6 @@ from .registry import Registry, default_registry
 # silently skipped when missing from the registry (unlike unknown names,
 # which raise). Shrinks as kernels land.
 PLANNED_PLUGINS = frozenset({
-    "DefaultPreemption",
     "VolumeBinding",
 })
 
@@ -126,3 +125,14 @@ class Framework:
             if pl.name in out:
                 out[pl.name] = pl.extra_update(ctx, out[pl.name], p, node, committed)
         return out
+
+    def post_filter(self, ctx: CycleContext, assignment, node_requested,
+                    static_mask, excluded=None):
+        """Run PostFilter plugins in order; first non-None result wins
+        (upstream RunPostFilterPlugins stops at the first nomination)."""
+        for p in self.post_filters:
+            r = p.post_filter(ctx, assignment, node_requested, static_mask,
+                              excluded)
+            if r is not None:
+                return r
+        return None

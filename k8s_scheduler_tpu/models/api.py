@@ -193,6 +193,8 @@ class PodSpec:
     topology_spread_constraints: tuple[TopologySpreadConstraint, ...] = ()
     priority: int = 0
     priority_class_name: str = ""
+    # "PreemptLowerPriority" (default) or "Never"
+    preemption_policy: str = "PreemptLowerPriority"
     scheduler_name: str = "default-scheduler"
     overhead: dict[str, float] = field(default_factory=dict)
     # Gang scheduling (out-of-tree Coscheduling plugin's PodGroup label):
@@ -428,6 +430,7 @@ def pod_from_dict(d: Mapping[str, Any]) -> Pod:
             topology_spread_constraints=tsc,
             priority=spec.get("priority", 0),
             priority_class_name=spec.get("priorityClassName", ""),
+            preemption_policy=spec.get("preemptionPolicy", "PreemptLowerPriority"),
             scheduler_name=spec.get("schedulerName", "default-scheduler"),
             overhead=_req_to_internal(spec.get("overhead", {})),
             pod_group=spec.get("podGroup", "")

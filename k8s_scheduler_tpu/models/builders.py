@@ -71,6 +71,10 @@ class MakePod:
         self._pod.spec.priority = p
         return self
 
+    def preemption_policy(self, policy: str) -> "MakePod":
+        self._pod.spec.preemption_policy = policy
+        return self
+
     def created(self, ts: float) -> "MakePod":
         self._pod.metadata.creation_timestamp = ts
         return self

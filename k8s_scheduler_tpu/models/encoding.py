@@ -248,10 +248,12 @@ class ClusterSnapshot:
     pod_tsc_skew: np.ndarray  # i32 [P, MC] max_skew (0 pad)
     pod_group: np.ndarray  # i32 [P] -> G (-1 none)
     pod_imageset: np.ndarray  # i32 [P] -> Is
+    pod_can_preempt: np.ndarray  # bool [P] (preemptionPolicy != Never)
     pod_valid: np.ndarray  # bool [P]
 
     # --- pod groups [G] ---
     group_min_member: np.ndarray  # i32 [G]
+    group_existing_count: np.ndarray  # i32 [G] members already running
 
     # --- image sets ---
     imgset_sizes: np.ndarray  # f32 [Is, I] size in bytes of image i if in set
@@ -556,6 +558,7 @@ class SnapshotEncoder:
         pod_tolset = np.zeros(P, np.int32)
         pod_group_arr = np.full(P, -1, np.int32)
         pod_imageset = np.zeros(P, np.int32)
+        pod_can_preempt = np.zeros(P, bool)
         pod_valid = np.zeros(P, bool)
         pod_valid[:p_real] = True
 
@@ -676,6 +679,7 @@ class SnapshotEncoder:
                 pod_tsc_skew[i, j] = c.max_skew
             pod_group_arr[i] = group_id(p.spec.pod_group)
             pod_imageset[i] = compile_imageset(p.images())
+            pod_can_preempt[i] = p.spec.preemption_policy != "Never"
 
         # ---- walk existing pods ----
         exist_node = np.full(E, -1, np.int32)
@@ -696,10 +700,12 @@ class SnapshotEncoder:
         # and preferred terms only), so those terms go to a scratch array
         scratch_aff = np.full((E, MA, 2), -1, np.int32)
 
+        exist_group = np.full(E, -1, np.int32)
         for i, (p, node_name) in enumerate(existing):
             ni = node_index.get(node_name, -1)
             exist_node[i] = ni
             exist_prio[i] = p.spec.priority
+            exist_group[i] = group_id(p.spec.pod_group)
             exist_req[i] = vec(reqs_exist[i])
             encode_pod_labels(p, el_keys, el_vals, i)
             encode_aff(p, i, scratch_aff, exist_anti,
@@ -832,6 +838,10 @@ class SnapshotEncoder:
         group_min_member = np.zeros(G, np.int32)
         for name, gi in group_ids.items():
             group_min_member[gi] = declared.get(name, 0)
+        group_existing_count = np.zeros(G, np.int32)
+        for g in exist_group[:e_real]:
+            if g >= 0:
+                group_existing_count[g] += 1
 
         # Pod ordering rank: priority desc, then creation ts asc, then index.
         order_key = sorted(
@@ -908,8 +918,10 @@ class SnapshotEncoder:
             pod_tsc_skew=pod_tsc_skew,
             pod_group=pod_group_arr,
             pod_imageset=pod_imageset,
+            pod_can_preempt=pod_can_preempt,
             pod_valid=pod_valid,
             group_min_member=group_min_member,
+            group_existing_count=group_existing_count,
             imgset_sizes=imgset_sizes,
             exist_node=exist_node,
             exist_priority=exist_prio,

@@ -99,15 +99,20 @@ def initial_state(snap, m_exist: jnp.ndarray) -> AffinityState:
     # anti_presence[s, n] = some placed pod with required anti-term (s, k)
     # shares node n's k-domain. Built as ONE scatter into a flat [S, D]
     # table (flat domain ids are globally unique, so no key collisions),
-    # then expanded to nodes with K gathers.
-    anti = _flat_to_node(
-        snap, _flat_table(snap.exist_anti_terms, None, dom, S, D), True
-    )
-    pref = _flat_to_node(
-        snap,
-        _flat_table(snap.exist_pref_aff, snap.exist_pref_aff_w, dom, S, D),
-        False,
-    )
+    # then expanded to nodes with K gathers. Gated on the static capability
+    # flag: a spread-only cluster never traces the affinity tables.
+    if snap.has_inter_pod_affinity:
+        anti = _flat_to_node(
+            snap, _flat_table(snap.exist_anti_terms, None, dom, S, D), True
+        )
+        pref = _flat_to_node(
+            snap,
+            _flat_table(snap.exist_pref_aff, snap.exist_pref_aff_w, dom, S, D),
+            False,
+        )
+    else:
+        anti = jnp.zeros((S, snap.N), bool)
+        pref = jnp.zeros((S, snap.N), jnp.float32)
     return AffinityState(counts, total, anti, pref)
 
 
@@ -227,9 +232,12 @@ def affinity_update(snap, state: AffinityState, m_pending, p, node,
     total = state.total + mp
 
     # fold p's own anti/preferred terms into the node tables (unrolled over
-    # the tiny MA axis; each slot is one [N]-row mask + scatter)
+    # the tiny MA axis; each slot is one [N]-row mask + scatter); statically
+    # skipped when the cluster has no affinity terms at all
     anti = state.anti_presence
     pref = state.pref_sym
+    if not snap.has_inter_pod_affinity:
+        return AffinityState(counts, total, anti, pref)
     MA = snap.pod_anti_terms.shape[1]
     anti_terms = snap.pod_anti_terms[p]
     pref_terms = snap.pod_pref_aff[p]

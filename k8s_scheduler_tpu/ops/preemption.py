@@ -1,0 +1,169 @@
+"""Preemption as a batched what-if program (SURVEY.md §2 C9, §3.4).
+
+The reference's `DefaultPreemption` PostFilter (expected
+`framework/plugins/defaultpreemption/` or `generic_scheduler.go (preempt)`
+— [UNVERIFIED], mount empty) runs, per unschedulable pod:
+
+    findCandidates: for each node (parallel goroutines):
+        SelectVictimsOnNode: dry-run remove lower-priority pods, re-run
+        Filter until the pod fits; re-add highest-priority victims back
+        while it still fits (minimize victims)
+    pickOneNodeForPreemption: min highest-victim-priority, then min
+        priority sum, then fewest victims, then node order
+    evict victims, set pod.Status.NominatedNodeName
+
+The TPU-native design exploits the encoder's `node_pods` table: per node,
+existing-pod indices sorted ascending by priority, so every candidate
+victim set is a PREFIX of that list and the whole
+remove/re-add-highest-first minimization collapses to "find the smallest
+prefix k whose freed resources make the pod fit" — one cumulative sum plus
+a first-true search, vectorized over all nodes at once. A `lax.scan` over
+the priority-ordered pending set serializes preemptor claims the way the
+reference's one-pod-per-ScheduleOne loop does: a carry tracks, per node,
+how many victims are already claimed (`k_claimed`) and the resources
+nominated pods will consume (`nominated_req`), so two preemptors never
+count the same freed capacity.
+
+Documented deviation from upstream: victim removal only relaxes RESOURCE
+constraints here. Upstream re-runs all filters with victims removed, so a
+pod blocked by (say) anti-affinity toward a victim can preempt it; this
+kernel requires the static mask (labels/taints/ports/...) to pass with the
+victims still present — strictly conservative (never evicts where upstream
+would not). PDBs and victim start-time tie-breaks are not modeled (no such
+state in the snapshot); the final tie-break is lowest node index.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..models.encoding import ClusterSnapshot
+
+_REL_EPS = 1e-5
+_BIG_I32 = jnp.int32(2**31 - 1)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PreemptionResult:
+    nominated: jnp.ndarray  # i32 [P] node nominated by preemption (-1 none)
+    victims: jnp.ndarray  # bool [E] existing pods to evict
+    num_preemptors: jnp.ndarray  # i32 [] pods that got a nomination
+
+
+def run_preemption(
+    snap: ClusterSnapshot,
+    *,
+    assignment: jnp.ndarray,  # i32 [P] from the commit scan (-1 = unsched)
+    node_requested: jnp.ndarray,  # f32 [N, R] post-cycle running requests
+    static_mask: jnp.ndarray,  # bool [P, N] framework static feasibility
+    excluded: jnp.ndarray | None = None,  # bool [P] never preempt (e.g.
+    # gang-dropped members: they fit without eviction, their group is what
+    # failed — upstream never runs PostFilter for Permit rejections)
+) -> PreemptionResult:
+    P, N = static_mask.shape
+    E = snap.E
+    MPN = snap.node_pods.shape[1]
+
+    # ---- per-node victim tables (shared across all preemptors) ----
+    vict_valid = snap.node_pods >= 0  # [N, MPN]
+    safe_idx = jnp.clip(snap.node_pods, 0, E - 1)
+    vict_prio = jnp.where(
+        vict_valid, snap.exist_priority[safe_idx], _BIG_I32
+    )  # [N, MPN]
+    vict_req = jnp.where(
+        vict_valid[:, :, None], snap.exist_requested[safe_idx], 0.0
+    )  # [N, MPN, R]
+    # prefix_freed[:, k] = resources freed by evicting the first k victims
+    prefix_freed = jnp.concatenate(
+        [jnp.zeros_like(vict_req[:, :1]), jnp.cumsum(vict_req, axis=1)], axis=1
+    )  # [N, MPN+1, R]
+    prio_for_sum = jnp.where(vict_valid, vict_prio, 0)
+    prefix_prio = jnp.concatenate(
+        [jnp.zeros_like(prio_for_sum[:, :1]), jnp.cumsum(prio_for_sum, axis=1)],
+        axis=1,
+    )  # [N, MPN+1]
+    ks = jnp.arange(MPN + 1, dtype=jnp.int32)[None, :]  # [1, MPN+1]
+    slack = _REL_EPS * snap.node_allocatable + _REL_EPS  # [N, R]
+
+    unschedulable = snap.pod_valid & (assignment < 0) & snap.pod_can_preempt
+    if excluded is not None:
+        unschedulable = unschedulable & ~excluded
+    order = jnp.argsort(snap.pod_order)
+
+    def step(carry, rank):
+        k_claimed, nominated_req, victim_mask = carry
+        p = order[rank]
+        prio = snap.pod_priority[p]
+
+        # eligible victims: strictly lower priority than the preemptor
+        elig = jnp.sum(vict_valid & (vict_prio < prio), axis=1).astype(jnp.int32)
+        free_base = (
+            snap.node_allocatable - node_requested - nominated_req + slack
+        )  # [N, R]
+        fits = jnp.all(
+            snap.pod_requested[p][None, None, :]
+            <= free_base[:, None, :] + prefix_freed,
+            axis=-1,
+        )  # [N, MPN+1]
+        allowed = fits & (ks >= k_claimed[:, None]) & (ks <= elig[:, None])
+        exists = jnp.any(allowed, axis=1)
+        k_min = jnp.argmax(allowed, axis=1).astype(jnp.int32)  # first True
+        # preemption must actually help: new victims >= 1 (a node feasible
+        # with zero evictions would have been chosen by the main cycle)
+        candidate = (
+            static_mask[p] & snap.node_valid & exists & (k_min > k_claimed)
+        )
+
+        # ---- pickOneNodeForPreemption: lexicographic minimization ----
+        last = jnp.clip(k_min - 1, 0, MPN - 1)
+        max_vict_prio = jnp.take_along_axis(
+            vict_prio, last[:, None], axis=1
+        )[:, 0]  # priority of the highest (last-in-prefix) victim
+        sum_vict_prio = (
+            jnp.take_along_axis(prefix_prio, k_min[:, None], axis=1)[:, 0]
+            - jnp.take_along_axis(prefix_prio, k_claimed[:, None], axis=1)[:, 0]
+        )
+        n_vict = k_min - k_claimed
+
+        def lexmin(cand, key):
+            key = jnp.where(cand, key, _BIG_I32)
+            return cand & (key == jnp.min(key))
+
+        best = lexmin(candidate, max_vict_prio)
+        best = lexmin(best, sum_vict_prio)
+        best = lexmin(best, n_vict)
+        b = jnp.argmax(best).astype(jnp.int32)  # lowest node index among ties
+
+        do = unschedulable[p] & jnp.any(candidate)
+        nominated_p = jnp.where(do, b, jnp.int32(-1))
+
+        # claim victims node_pods[b, k_claimed[b]:k_min[b]]
+        pos = jnp.arange(MPN, dtype=jnp.int32)
+        newly = do & (pos >= k_claimed[b]) & (pos < k_min[b]) & vict_valid[b]
+        victim_mask = victim_mask.at[safe_idx[b]].max(newly)
+        k_claimed = k_claimed.at[b].set(
+            jnp.where(do, k_min[b], k_claimed[b])
+        )
+        nominated_req = nominated_req.at[b].add(
+            jnp.where(do, snap.pod_requested[p], 0.0)
+        )
+        return (k_claimed, nominated_req, victim_mask), (p, nominated_p)
+
+    init = (
+        jnp.zeros(N, jnp.int32),
+        jnp.zeros_like(node_requested),
+        jnp.zeros(E, bool),
+    )
+    (_, _, victims), (pods, noms) = jax.lax.scan(
+        step, init, jnp.arange(P, dtype=jnp.int32)
+    )
+    nominated = jnp.full(P, -1, jnp.int32).at[pods].set(noms)
+    return PreemptionResult(
+        nominated=nominated,
+        victims=victims & snap.exist_valid,
+        num_preemptors=jnp.sum(nominated >= 0).astype(jnp.int32),
+    )

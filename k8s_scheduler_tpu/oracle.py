@@ -138,12 +138,14 @@ class OracleState:
             self.requested[node_idx][r] = self.requested[node_idx].get(r, 0.0) + v
         self.pods_on_node[node_idx].append(pod)
         self._version += 1
+        self._bootstrap.clear()  # keys embed _version; old entries are dead
 
     def remove(self, node_idx: int, pod: Pod) -> None:
         for r, v in pod.resource_requests().items():
             self.requested[node_idx][r] = self.requested[node_idx].get(r, 0.0) - v
         self.pods_on_node[node_idx].remove(pod)
         self._version += 1
+        self._bootstrap.clear()
 
     def any_pod_matches(self, term: PodAffinityTerm, own_ns: str) -> bool:
         key = (self._version, id(term), own_ns)
@@ -465,26 +467,41 @@ def score_inter_pod_affinity(pod: Pod, state: OracleState, i: int) -> float:
     return score
 
 
-def score_topology_spread_raw(pod: Pod, state: OracleState, i: int) -> float:
+def _spread_domain_counts(pod: Pod, state: OracleState,
+                          c: api.TopologySpreadConstraint) -> dict[str, float]:
+    """Matching-pod count per domain for one constraint — computed ONCE per
+    (pod, constraint) instead of rescanning all nodes per candidate node."""
+    counts: dict[str, float] = {}
+    for j, nd in enumerate(state.nodes):
+        d = _domain(nd, c.topology_key)
+        if d is None:
+            continue
+        counts.setdefault(d, 0.0)
+        for other in state.pods_on_node[j]:
+            if other.namespace == pod.namespace and match_label_selector(
+                c.label_selector, other.metadata.labels
+            ):
+                counts[d] += 1.0
+    return counts
+
+
+def score_topology_spread_raw(pod: Pod, state: OracleState, i: int,
+                              _counts=None) -> float:
     """ScheduleAnyway constraints: matching-pod count in the node's domain
     (summed over constraints); the caller reverse-normalizes over feasible
-    nodes — identical to ops/interpod.spread_dyn_score."""
+    nodes — identical to ops/interpod.spread_dyn_score. `_counts` is the
+    precomputed per-constraint domain-count list (see _spread_domain_counts);
+    omitted, it is computed here."""
     node = state.nodes[i]
+    constraints = [c for c in pod.spec.topology_spread_constraints
+                   if c.when_unsatisfiable == api.SCHEDULE_ANYWAY]
+    if _counts is None:
+        _counts = [_spread_domain_counts(pod, state, c) for c in constraints]
     raw = 0.0
-    for c in pod.spec.topology_spread_constraints:
-        if c.when_unsatisfiable != api.SCHEDULE_ANYWAY:
-            continue
+    for c, counts in zip(constraints, _counts):
         dom = _domain(node, c.topology_key)
-        if dom is None:
-            continue
-        for j, nd in enumerate(state.nodes):
-            if _domain(nd, c.topology_key) != dom:
-                continue
-            for other in state.pods_on_node[j]:
-                if other.namespace == pod.namespace and match_label_selector(
-                    c.label_selector, other.metadata.labels
-                ):
-                    raw += 1.0
+        if dom is not None:
+            raw += counts.get(dom, 0.0)
     return raw
 
 
@@ -555,7 +572,13 @@ class _CrossNodeRaws:
         if weights.inter_pod_affinity:
             ipa = {i: score_inter_pod_affinity(pod, state, i) for i in feasible}
         if weights.topology_spread and pod.spec.topology_spread_constraints:
-            spread = {i: score_topology_spread_raw(pod, state, i) for i in feasible}
+            constraints = [c for c in pod.spec.topology_spread_constraints
+                           if c.when_unsatisfiable == api.SCHEDULE_ANYWAY]
+            counts = [_spread_domain_counts(pod, state, c) for c in constraints]
+            spread = {
+                i: score_topology_spread_raw(pod, state, i, counts)
+                for i in feasible
+            }
         return _CrossNodeRaws(
             ipa, max(map(abs, ipa.values()), default=0.0),
             spread, max(spread.values(), default=0.0),
@@ -628,6 +651,171 @@ def validate_assignment(
             )
         state.add(node, pod)
     return errors
+
+
+# --------------------------------------------------------------------------
+# Preemption (DefaultPreemption PostFilter analogue)
+# --------------------------------------------------------------------------
+
+# The static (commitment-independent) filters the preemption candidate check
+# uses — mirrors the kernel exactly: victim removal only relaxes RESOURCE
+# constraints; everything else must pass with victims still present (see
+# ops/preemption.py's documented deviation from upstream).
+PREEMPTION_STATIC_FILTERS = (
+    filter_node_unschedulable,
+    filter_node_name,
+    filter_taint_toleration,
+    filter_node_affinity,
+    filter_node_ports,
+)
+
+
+@dataclasses.dataclass
+class OraclePreemption:
+    pod_index: int
+    node_index: int
+    victims: list[int]  # indices into the `existing` sequence
+
+
+def schedule_with_gangs(
+    nodes: Sequence[Node],
+    pending: Sequence[Pod],
+    existing: Sequence[tuple[Pod, str]] = (),
+    pod_groups: Sequence[api.PodGroup] = (),
+    weights: "OracleWeights | None" = None,
+    filters=None,
+) -> tuple[list[OracleDecision], list[int]]:
+    """schedule() then the all-or-nothing gang unwind (Coscheduling
+    analogue, core/cycle.py gang_scheduling): groups whose placed-member
+    count is below minMember have all members rolled back. Returns
+    (decisions, dropped pod indices)."""
+    weights = weights or OracleWeights()
+    filters = filters or DEFAULT_FILTERS
+    decisions = schedule(nodes, pending, existing, weights, filters)
+    min_member = {g.name: g.min_member for g in pod_groups}
+    placed_count: dict[str, int] = {}
+    for p, _node in existing:  # running members count toward minMember
+        g = p.spec.pod_group
+        if g:
+            placed_count[g] = placed_count.get(g, 0) + 1
+    for d in decisions:
+        g = d.pod.spec.pod_group
+        if g and d.node_index >= 0:
+            placed_count[g] = placed_count.get(g, 0) + 1
+    dropped = []
+    for pi, d in enumerate(decisions):
+        g = d.pod.spec.pod_group
+        if g and d.node_index >= 0 and placed_count.get(g, 0) < min_member.get(g, 0):
+            decisions[pi] = OracleDecision(d.pod, -1)
+            dropped.append(pi)
+    return decisions, dropped
+
+
+def schedule_with_preemption(
+    nodes: Sequence[Node],
+    pending: Sequence[Pod],
+    existing: Sequence[tuple[Pod, str]] = (),
+    weights: "OracleWeights | None" = None,
+    filters=None,
+) -> tuple[list[OracleDecision], list["OraclePreemption"]]:
+    """schedule() then the preemption pass on whatever stayed pending."""
+    weights = weights or OracleWeights()
+    filters = filters or DEFAULT_FILTERS
+    decisions = schedule(nodes, pending, existing, weights, filters)
+    post_state = OracleState.build(nodes, existing)
+    for d in decisions:
+        if d.node_index >= 0:
+            post_state.add(d.node_index, d.pod)
+    return decisions, preempt(nodes, pending, existing, decisions, post_state)
+
+
+def preempt(
+    nodes: Sequence[Node],
+    pending: Sequence[Pod],
+    existing: Sequence[tuple[Pod, str]],
+    decisions: Sequence[OracleDecision],
+    post_state: OracleState,
+) -> list[OraclePreemption]:
+    """Sequential preemption over the unschedulable pods in queue order,
+    mirroring ops/preemption.py's semantics: per node, victims are a prefix
+    of the existing pods sorted ascending by priority; the minimal prefix
+    that frees enough resources wins; node choice minimizes (highest victim
+    priority, victim priority sum, victim count, node index). `post_state`
+    is the oracle state AFTER the scheduling pass (committed pods consume
+    capacity); the static filters run against the pre-cycle state."""
+    idx = {n.name: i for i, n in enumerate(nodes)}
+    static_state = OracleState.build(nodes, existing)
+    # per-node victim lists: (priority asc, -existing_index) — same order as
+    # the encoder's node_pods table
+    per_node: list[list[int]] = [[] for _ in nodes]
+    for e, (p, node_name) in enumerate(existing):
+        i = idx.get(node_name)
+        if i is not None:
+            per_node[i].append(e)
+    for lst in per_node:
+        lst.sort(key=lambda e: (existing[e][0].spec.priority, -e))
+
+    k_claimed = [0] * len(nodes)
+    nominated_req: list[dict[str, float]] = [{} for _ in nodes]
+    out: list[OraclePreemption] = []
+
+    unsched = [pi for pi in queue_order(pending)
+               if decisions[pi].node_index < 0
+               and pending[pi].spec.preemption_policy != "Never"]
+    for pi in unsched:
+        pod = pending[pi]
+        req = pod.resource_requests()
+        candidates = []  # (max_prio, sum_prio, n_vict, node, k_min)
+        for i in range(len(nodes)):
+            if not all(f(pod, static_state, i) for f in PREEMPTION_STATIC_FILTERS):
+                continue
+            victs = per_node[i]
+            elig = sum(
+                1 for e in victs
+                if existing[e][0].spec.priority < pod.spec.priority
+            )
+
+            def fits(k: int) -> bool:
+                alloc = nodes[i].status.allocatable
+                freed: dict[str, float] = {}
+                for e in victs[:k]:
+                    for r, v in existing[e][0].resource_requests().items():
+                        freed[r] = freed.get(r, 0.0) + v
+                for r, v in req.items():
+                    used = (
+                        post_state.requested[i].get(r, 0.0)
+                        + nominated_req[i].get(r, 0.0)
+                        - freed.get(r, 0.0)
+                    )
+                    a = alloc.get(r, 0.0)
+                    if used + v > a * (1 + 1e-5) + 1e-5:
+                        return False
+                return True
+
+            k_min = None
+            for k in range(k_claimed[i], elig + 1):
+                if fits(k):
+                    k_min = k
+                    break
+            if k_min is None or k_min <= k_claimed[i]:
+                continue  # no help, or helps without evictions (not preemption)
+            new = victs[k_claimed[i]:k_min]
+            candidates.append((
+                max(existing[e][0].spec.priority for e in new),
+                sum(existing[e][0].spec.priority for e in new),
+                len(new),
+                i,
+                k_min,
+            ))
+        if not candidates:
+            continue
+        max_p, sum_p, n_v, node, k_min = min(candidates)
+        victims = per_node[node][k_claimed[node]:k_min]
+        k_claimed[node] = k_min
+        for r, v in req.items():
+            nominated_req[node][r] = nominated_req[node].get(r, 0.0) + v
+        out.append(OraclePreemption(pi, node, victims))
+    return out
 
 
 def schedule(

@@ -1,0 +1,155 @@
+"""Gang scheduling (benchmark config #5): all-or-nothing group commit."""
+
+import numpy as np
+
+from k8s_scheduler_tpu import oracle
+from k8s_scheduler_tpu.core import build_cycle_fn
+from k8s_scheduler_tpu.models import MakeNode, MakePod, SnapshotEncoder
+from k8s_scheduler_tpu.models.api import PodGroup
+from k8s_scheduler_tpu.utils.synth import make_gang_pods
+
+
+def run_both(nodes, pods, groups, existing=()):
+    snap = SnapshotEncoder().encode(nodes, pods, existing, pod_groups=groups)
+    result = build_cycle_fn()(snap)
+    got = np.asarray(result.assignment)[: len(pods)].tolist()
+    want, dropped = oracle.schedule_with_gangs(
+        nodes, pods, existing, groups
+    )
+    return got, [d.node_index for d in want], result, dropped
+
+
+def test_gang_fits_all_members_placed():
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "4"}).obj() for i in range(4)]
+    pods = [MakePod(f"g-{i}").req({"cpu": "2"}).group("job").created(i).obj()
+            for i in range(4)]
+    got, want, result, _ = run_both(nodes, pods, [PodGroup("job", 4)])
+    assert got == want
+    assert all(n >= 0 for n in got)
+    assert not np.asarray(result.gang_dropped)[:4].any()
+
+
+def test_gang_unwound_when_min_member_unmet():
+    # capacity for only 2 members, minMember=3: everything rolls back
+    nodes = [MakeNode("n0").capacity({"cpu": "4"}).obj()]
+    pods = [MakePod(f"g-{i}").req({"cpu": "2"}).group("job").created(i).obj()
+            for i in range(3)]
+    got, want, result, dropped = run_both(nodes, pods, [PodGroup("job", 3)])
+    assert got == want == [-1, -1, -1]
+    assert np.asarray(result.gang_dropped)[:3].sum() == 2
+    assert len(dropped) == 2
+    # capacity released: the running node_requested is back to zero
+    np.testing.assert_allclose(
+        np.asarray(result.node_requested)[0],
+        np.asarray(SnapshotEncoder().encode(nodes, pods,
+                                            pod_groups=[PodGroup("job", 3)]
+                                            ).node_requested)[0],
+    )
+
+
+def test_gang_failure_releases_capacity_for_later_cycle():
+    # after the unwind, a non-gang pod can take the freed capacity in the
+    # NEXT cycle (the host requeues; in-cycle order already passed it)
+    nodes = [MakeNode("n0").capacity({"cpu": "4"}).obj()]
+    gang = [MakePod(f"g-{i}").req({"cpu": "2"}).group("job")
+            .priority(10).created(i).obj() for i in range(3)]
+    snap = SnapshotEncoder().encode(nodes, gang, pod_groups=[PodGroup("job", 3)])
+    result = build_cycle_fn()(snap)
+    assert (np.asarray(result.assignment)[:3] == -1).all()
+    solo = [MakePod("solo").req({"cpu": "4"}).obj()]
+    snap2 = SnapshotEncoder().encode(nodes, solo)
+    r2 = build_cycle_fn()(snap2)
+    assert np.asarray(r2.assignment)[0] == 0
+
+
+def test_partial_group_min_member_lower_than_size():
+    # minMember=2 of 3: two members placing is enough, third stays pending
+    nodes = [MakeNode("n0").capacity({"cpu": "4"}).obj()]
+    pods = [MakePod(f"g-{i}").req({"cpu": "2"}).group("job").created(i).obj()
+            for i in range(3)]
+    got, want, result, _ = run_both(nodes, pods, [PodGroup("job", 2)])
+    assert got == want
+    assert sum(1 for n in got if n >= 0) == 2
+
+
+def test_undeclared_group_never_gates():
+    nodes = [MakeNode("n0").capacity({"cpu": "2"}).obj()]
+    pods = [MakePod(f"g-{i}").req({"cpu": "2"}).group("mystery").created(i).obj()
+            for i in range(2)]
+    got, want, result, _ = run_both(nodes, pods, [])
+    assert got == want == [0, -1]
+    assert not np.asarray(result.gang_dropped)[:2].any()
+
+
+def test_two_gangs_contending():
+    # both gangs want 2x2cpu; only one node fits both members of one gang.
+    # higher-priority gang wins, the other unwinds fully.
+    nodes = [MakeNode("n0").capacity({"cpu": "4"}).obj()]
+    a = [MakePod(f"a-{i}").req({"cpu": "2"}).group("a").priority(5)
+         .created(i).obj() for i in range(2)]
+    b = [MakePod(f"b-{i}").req({"cpu": "2"}).group("b").priority(1)
+         .created(10 + i).obj() for i in range(2)]
+    groups = [PodGroup("a", 2), PodGroup("b", 2)]
+    got, want, result, _ = run_both(nodes, a + b, groups)
+    assert got == want
+    assert got[0] >= 0 and got[1] >= 0
+    assert got[2] == -1 and got[3] == -1
+
+
+def test_gang_disabled_keeps_partial_placement():
+    nodes = [MakeNode("n0").capacity({"cpu": "4"}).obj()]
+    pods = [MakePod(f"g-{i}").req({"cpu": "2"}).group("job").created(i).obj()
+            for i in range(3)]
+    snap = SnapshotEncoder().encode(nodes, pods, pod_groups=[PodGroup("job", 3)])
+    result = build_cycle_fn(gang_scheduling=False)(snap)
+    assert (np.asarray(result.assignment)[:3] >= 0).sum() == 2
+
+
+def test_synth_gang_workload_differential():
+    pods, groups = make_gang_pods(4, replicas=4)
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "8", "memory": "16Gi"}).obj()
+             for i in range(6)]
+    got, want, _, _ = run_both(nodes, pods, groups)
+    assert got == want
+
+
+def test_gang_dropped_members_do_not_preempt():
+    # gang of 2 can't meet minMember=2; another node holds a low-priority
+    # pod. The dropped members must NOT nominate/evict anything (upstream
+    # never runs PostFilter for Permit/coscheduling rejections).
+    from k8s_scheduler_tpu.core import build_preemption_fn
+
+    nodes = [
+        MakeNode("n0").capacity({"cpu": "2"}).obj(),
+        MakeNode("n1").capacity({"cpu": "2"}).obj(),
+    ]
+    existing = [
+        (MakePod("low").req({"cpu": "2"}).priority(0).obj(), "n1"),
+    ]
+    pods = [MakePod(f"g-{i}").req({"cpu": "2"}).group("job").priority(10)
+            .created(i).obj() for i in range(2)]
+    snap = SnapshotEncoder().encode(nodes, pods, existing,
+                                    pod_groups=[PodGroup("job", 2)])
+    result = build_cycle_fn()(snap)
+    assert (np.asarray(result.assignment)[:2] == -1).all()
+    pre = build_preemption_fn()(snap, result)
+    # g-1 genuinely lacked a node (not gang-dropped) -> may preempt;
+    # g-0 was gang-dropped -> must not
+    dropped = np.asarray(result.gang_dropped)[:2]
+    noms = np.asarray(pre.nominated)[:2]
+    assert noms[np.flatnonzero(dropped)].max(initial=-1) == -1
+
+
+def test_gang_counts_running_members():
+    # 2 of 3 members already run; the third retried alone must place
+    nodes = [MakeNode("n0").capacity({"cpu": "8"}).obj()]
+    existing = [
+        (MakePod(f"g-{i}").req({"cpu": "2"}).group("job").created(i).obj(),
+         "n0")
+        for i in range(2)
+    ]
+    pods = [MakePod("g-2").req({"cpu": "2"}).group("job").created(2).obj()]
+    got, want, result, _ = run_both(nodes, pods, [PodGroup("job", 3)],
+                                    existing)
+    assert got == want == [0]
+    assert not np.asarray(result.gang_dropped)[:1].any()

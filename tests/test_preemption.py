@@ -1,0 +1,197 @@
+"""Differential tests for the batched preemption pass (benchmark config #4
+territory): ops/preemption.py vs oracle.preempt."""
+
+import numpy as np
+
+from k8s_scheduler_tpu import oracle
+from k8s_scheduler_tpu.core import build_cycle_fn, build_preemption_fn
+from k8s_scheduler_tpu.models import MakeNode, MakePod, SnapshotEncoder
+
+
+def run_both(nodes, pods, existing=()):
+    snap = SnapshotEncoder().encode(nodes, pods, existing)
+    cycle = build_cycle_fn()
+    result = cycle(snap)
+    pre = build_preemption_fn()(snap, result)
+    got_nom = np.asarray(pre.nominated)[: len(pods)].tolist()
+    got_victims = sorted(np.flatnonzero(np.asarray(pre.victims)).tolist())
+
+    decisions, preemptions = oracle.schedule_with_preemption(
+        nodes, pods, existing
+    )
+    want_nom = [-1] * len(pods)
+    want_victims = []
+    for pr in preemptions:
+        want_nom[pr.pod_index] = pr.node_index
+        want_victims.extend(pr.victims)
+    return (got_nom, got_victims), (want_nom, sorted(want_victims)), (
+        result, pre, decisions)
+
+
+def test_basic_preemption_evicts_lowest_priority():
+    nodes = [MakeNode("n0").capacity({"cpu": "2"}).obj()]
+    existing = [
+        (MakePod("victim-lo").req({"cpu": "1"}).priority(1).obj(), "n0"),
+        (MakePod("bystander").req({"cpu": "900m"}).priority(5).obj(), "n0"),
+    ]
+    pods = [MakePod("urgent").req({"cpu": "1"}).priority(10).obj()]
+    got, want, (_, pre, _) = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[0] == [0]  # nominated n0
+    assert got[1] == [0]  # evicts victim-lo only (index 0 in existing)
+    assert int(pre.num_preemptors) == 1
+
+
+def test_no_preemption_when_higher_priority():
+    nodes = [MakeNode("n0").capacity({"cpu": "2"}).obj()]
+    existing = [
+        (MakePod("e0").req({"cpu": "1800m"}).priority(100).obj(), "n0"),
+    ]
+    pods = [MakePod("p0").req({"cpu": "1"}).priority(10).obj()]
+    got, want, _ = run_both(nodes, pods, existing)
+    assert got == want == ([-1], [])
+
+
+def test_preemption_policy_never():
+    nodes = [MakeNode("n0").capacity({"cpu": "2"}).obj()]
+    existing = [
+        (MakePod("e0").req({"cpu": "1800m"}).priority(1).obj(), "n0"),
+    ]
+    pods = [
+        MakePod("p0").req({"cpu": "1"}).priority(10)
+        .preemption_policy("Never").obj()
+    ]
+    got, want, _ = run_both(nodes, pods, existing)
+    assert got == want == ([-1], [])
+
+
+def test_minimal_victim_set():
+    # evicting one 1-cpu victim suffices; the other stays
+    nodes = [MakeNode("n0").capacity({"cpu": "3"}).obj()]
+    existing = [
+        (MakePod("v0").req({"cpu": "1"}).priority(1).obj(), "n0"),
+        (MakePod("v1").req({"cpu": "1"}).priority(2).obj(), "n0"),
+        (MakePod("v2").req({"cpu": "900m"}).priority(8).obj(), "n0"),
+    ]
+    pods = [MakePod("p0").req({"cpu": "1"}).priority(10).obj()]
+    got, want, _ = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[1] == [0]  # only the lowest-priority victim
+
+
+def test_picks_node_with_cheapest_victims():
+    # n0's victims are priority 5; n1's victim is priority 1 -> prefer n1
+    nodes = [
+        MakeNode("n0").capacity({"cpu": "1"}).obj(),
+        MakeNode("n1").capacity({"cpu": "1"}).obj(),
+    ]
+    existing = [
+        (MakePod("a").req({"cpu": "1"}).priority(5).obj(), "n0"),
+        (MakePod("b").req({"cpu": "1"}).priority(1).obj(), "n1"),
+    ]
+    pods = [MakePod("p0").req({"cpu": "1"}).priority(10).obj()]
+    got, want, _ = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[0] == [1]
+    assert got[1] == [1]
+
+
+def test_two_preemptors_do_not_share_victims():
+    # two urgent pods, one node with two evictable 1-cpu victims: each
+    # preemptor must claim a DIFFERENT victim's capacity
+    nodes = [MakeNode("n0").capacity({"cpu": "2"}).obj()]
+    existing = [
+        (MakePod("v0").req({"cpu": "1"}).priority(1).obj(), "n0"),
+        (MakePod("v1").req({"cpu": "1"}).priority(2).obj(), "n0"),
+    ]
+    pods = [
+        MakePod("p0").req({"cpu": "1"}).priority(10).created(1).obj(),
+        MakePod("p1").req({"cpu": "1"}).priority(9).created(2).obj(),
+    ]
+    got, want, _ = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[0] == [0, 0]
+    assert got[1] == [0, 1]  # both victims evicted, one per preemptor
+
+
+def test_second_preemptor_runs_out_of_victims():
+    nodes = [MakeNode("n0").capacity({"cpu": "2"}).obj()]
+    existing = [
+        (MakePod("v0").req({"cpu": "2"}).priority(1).obj(), "n0"),
+    ]
+    pods = [
+        MakePod("p0").req({"cpu": "2"}).priority(10).created(1).obj(),
+        MakePod("p1").req({"cpu": "2"}).priority(9).created(2).obj(),
+    ]
+    got, want, _ = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[0] == [0, -1]  # only the first preemptor gets a nomination
+
+
+def test_static_filters_gate_candidates():
+    # n1 is tainted: preemption must not nominate it even though evicting
+    # its victim would free capacity
+    nodes = [
+        MakeNode("n0").capacity({"cpu": "1"}).obj(),
+        MakeNode("n1").capacity({"cpu": "4"}).taint("k", "v").obj(),
+    ]
+    existing = [
+        (MakePod("a").req({"cpu": "1"}).priority(1).obj(), "n0"),
+        (MakePod("b").req({"cpu": "4"}).priority(1).obj(), "n1"),
+    ]
+    pods = [MakePod("p0").req({"cpu": "1"}).priority(10).obj()]
+    got, want, _ = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[0] == [0]
+
+
+def test_nominated_node_honored_next_cycle():
+    # feed the nomination back through the encoder: the pod schedules on
+    # the nominated node once the victim is gone
+    nodes = [MakeNode("n0").capacity({"cpu": "2"}).obj()]
+    pods = [MakePod("p0").req({"cpu": "2"}).priority(10)
+            .nominated("n0").obj()]
+    snap = SnapshotEncoder().encode(nodes, pods, existing=())
+    result = build_cycle_fn()(snap)
+    assert np.asarray(result.assignment)[0] == 0
+
+
+def test_schedulable_pods_do_not_preempt():
+    nodes = [
+        MakeNode("n0").capacity({"cpu": "1"}).obj(),
+        MakeNode("n1").capacity({"cpu": "4"}).obj(),
+    ]
+    existing = [(MakePod("e0").req({"cpu": "1"}).priority(0).obj(), "n0")]
+    pods = [MakePod("p0").req({"cpu": "1"}).priority(10).obj()]
+    got, want, (result, pre, _) = run_both(nodes, pods, existing)
+    assert got == want == ([-1], [])
+    assert np.asarray(result.assignment)[0] == 1
+    assert int(pre.num_preemptors) == 0
+
+
+def test_randomized_differential_preemption():
+    rng = np.random.default_rng(7)
+    for trial in range(6):
+        n_nodes = int(rng.integers(2, 6))
+        nodes = [
+            MakeNode(f"n{i}").capacity(
+                {"cpu": f"{int(rng.integers(1, 5))}", "memory": "8Gi"}
+            ).obj()
+            for i in range(n_nodes)
+        ]
+        existing = []
+        for i in range(int(rng.integers(0, 8))):
+            existing.append((
+                MakePod(f"e{i}").req(
+                    {"cpu": f"{int(rng.integers(200, 1500))}m"}
+                ).priority(int(rng.integers(0, 6))).obj(),
+                f"n{int(rng.integers(0, n_nodes))}",
+            ))
+        pods = [
+            MakePod(f"p{i}").req(
+                {"cpu": f"{int(rng.integers(500, 3000))}m"}
+            ).priority(int(rng.integers(0, 12))).created(float(i)).obj()
+            for i in range(int(rng.integers(1, 8)))
+        ]
+        got, want, _ = run_both(nodes, pods, existing)
+        assert got == want, f"trial {trial}: {got} != {want}"
