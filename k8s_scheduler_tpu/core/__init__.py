@@ -1,1 +1,2 @@
-from .cycle import CycleResult, build_cycle_fn  # noqa: F401
+from .cycle import CycleResult, build_cycle_fn, build_preemption_fn  # noqa: F401
+from .scheduler import CycleStats, Scheduler  # noqa: F401

@@ -1,0 +1,2 @@
+from .cache import SchedulerCache  # noqa: F401
+from .queue import SchedulingQueue  # noqa: F401

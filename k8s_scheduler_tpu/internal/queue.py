@@ -1,0 +1,261 @@
+"""SchedulingQueue: active / backoff / unschedulable tiers.
+
+The reference's `PriorityQueue` (`internal/queue/scheduling_queue.go` —
+[UNVERIFIED], mount empty; SURVEY.md §2 C3) is a heap popped one pod at a
+time by 16-way goroutine consumers. The TPU design schedules the WHOLE
+ready set per cycle, so the heap collapses to set bookkeeping:
+
+- `active`: pods ready for the next cycle. `pop_ready()` drains it (the
+  batch analogue of Pop); ordering is re-derived by the encoder's
+  `pod_order` (PrioritySort), so no heap is needed host-side.
+- `backoff`: pods that failed recently, with an expiry deadline
+  (exponential per-pod backoff, initial/max from config — upstream
+  podInitialBackoffSeconds/podMaxBackoffSeconds). `flush_backoff()` moves
+  expired entries back to active (upstream's flushBackoffQCompleted).
+- `unschedulable`: pods that found no node and wait for a cluster event.
+  `move_all_to_active_or_backoff(event)` relocates them (upstream
+  MoveAllToActiveOrBackoffQueue on informer events), honoring the
+  event→plugin queueing-hint table below.
+
+Pods handed out by `pop_ready()` are tracked as in-flight until the cycle
+requeues or drops them; a delete arriving mid-cycle marks the uid so the
+requeue discards it instead of resurrecting a deleted pod. All public
+methods take the queue lock — informer callbacks may run on other threads
+than the scheduling loop (same discipline as SchedulerCache).
+
+Time is injected (`now` callable) so tests drive the clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time as _time
+from typing import Callable, Iterable
+
+from ..models.api import Pod
+
+# Cluster events (the reference's framework.ClusterEvent resource/action
+# pairs, collapsed to the ones that matter for requeueing).
+EVENT_NODE_ADD = "NodeAdd"
+EVENT_NODE_UPDATE = "NodeUpdate"
+EVENT_NODE_DELETE = "NodeDelete"
+EVENT_POD_ADD = "PodAdd"
+EVENT_POD_UPDATE = "PodUpdate"
+EVENT_POD_DELETE = "PodDelete"
+EVENT_UNSCHEDULABLE_TIMEOUT = "UnschedulableTimeout"
+
+# Which failure reasons (plugin names) an event can unstick — the
+# queueing-hint registry (upstream EventsToRegister). A pod rejected by
+# plugin X only requeues on events in HINTS[X]. Unknown reasons requeue on
+# everything (conservative default, matches hintless upstream behavior).
+QUEUEING_HINTS: dict[str, frozenset[str]] = {
+    "NodeResourcesFit": frozenset(
+        {EVENT_NODE_ADD, EVENT_NODE_UPDATE, EVENT_POD_DELETE}
+    ),
+    "NodeAffinity": frozenset({EVENT_NODE_ADD, EVENT_NODE_UPDATE}),
+    "NodeName": frozenset({EVENT_NODE_ADD, EVENT_NODE_UPDATE}),
+    "NodeUnschedulable": frozenset({EVENT_NODE_ADD, EVENT_NODE_UPDATE}),
+    "TaintToleration": frozenset({EVENT_NODE_ADD, EVENT_NODE_UPDATE}),
+    "NodePorts": frozenset({EVENT_NODE_ADD, EVENT_POD_DELETE}),
+    "InterPodAffinity": frozenset(
+        {EVENT_NODE_ADD, EVENT_POD_ADD, EVENT_POD_UPDATE, EVENT_POD_DELETE}
+    ),
+    "PodTopologySpread": frozenset(
+        {EVENT_NODE_ADD, EVENT_POD_ADD, EVENT_POD_UPDATE, EVENT_POD_DELETE}
+    ),
+    "Coscheduling": frozenset({EVENT_POD_ADD, EVENT_POD_DELETE,
+                               EVENT_NODE_ADD, EVENT_NODE_UPDATE}),
+}
+
+
+@dataclasses.dataclass
+class _QueuedPod:
+    pod: Pod
+    attempts: int = 0  # scheduling attempts so far (drives backoff length)
+    backoff_expiry: float = 0.0
+    unschedulable_reason: str = ""  # plugin that rejected it ("" = unknown)
+    enqueued_at: float = 0.0
+
+
+class SchedulingQueue:
+    def __init__(
+        self,
+        initial_backoff_seconds: float = 1.0,
+        max_backoff_seconds: float = 10.0,
+        unschedulable_timeout_seconds: float = 300.0,
+        now: Callable[[], float] = _time.monotonic,
+    ) -> None:
+        self._initial = initial_backoff_seconds
+        self._max = max_backoff_seconds
+        self._timeout = unschedulable_timeout_seconds
+        self._now = now
+        self._lock = threading.RLock()
+        self._active: dict[str, _QueuedPod] = {}
+        self._backoff: dict[str, _QueuedPod] = {}
+        self._unschedulable: dict[str, _QueuedPod] = {}
+        self._in_flight: dict[str, _QueuedPod] = {}
+        self._deleted_in_flight: set[str] = set()
+
+    # ---- intake ----------------------------------------------------------
+
+    def add(self, pod: Pod) -> None:
+        """New pod (informer Add): straight to active."""
+        with self._lock:
+            uid = pod.uid
+            self._backoff.pop(uid, None)
+            self._unschedulable.pop(uid, None)
+            self._active[uid] = _QueuedPod(pod, enqueued_at=self._now())
+
+    def update(self, pod: Pod) -> None:
+        """Spec/labels changed: an update can unstick its own pod."""
+        with self._lock:
+            uid = pod.uid
+            for tier in (self._active, self._backoff, self._unschedulable):
+                if uid in tier:
+                    entry = tier[uid]
+                    entry.pod = pod
+                    if tier is self._unschedulable:
+                        del tier[uid]
+                        self._active[uid] = entry
+                    return
+            if uid in self._in_flight:
+                # being scheduled right now: refresh the in-flight object so
+                # a requeue carries the new spec, but do NOT double-enqueue
+                self._in_flight[uid].pod = pod
+                return
+            self.add(pod)
+
+    def delete(self, pod_uid: str) -> None:
+        with self._lock:
+            for tier in (self._active, self._backoff, self._unschedulable):
+                tier.pop(pod_uid, None)
+            if pod_uid in self._in_flight:
+                # mark so the cycle's requeue discards instead of
+                # resurrecting a deleted pod
+                self._deleted_in_flight.add(pod_uid)
+
+    # ---- cycle boundary --------------------------------------------------
+
+    def pop_ready(self) -> list[Pod]:
+        """Drain the active tier — the whole next cycle's pending set.
+        Flushes expired backoff first so a ready pod is never left behind."""
+        with self._lock:
+            self.flush_backoff()
+            ready = [e.pod for e in self._active.values()]
+            for e in self._active.values():
+                e.attempts += 1
+            self._in_flight = dict(self._active)
+            self._deleted_in_flight.clear()
+            self._active.clear()
+            return ready
+
+    def requeue_unschedulable(self, pod: Pod, reason: str = "") -> None:
+        """Cycle found no node (AddUnschedulableIfNotPresent). Goes to the
+        unschedulable tier to wait for an event; backoff still advances so
+        an event-triggered retry honors it."""
+        with self._lock:
+            uid = pod.uid
+            if uid in self._deleted_in_flight:
+                self._deleted_in_flight.discard(uid)
+                self._in_flight.pop(uid, None)
+                return
+            entry = self._in_flight.pop(uid, None) or _QueuedPod(pod)
+            entry.pod = pod
+            entry.unschedulable_reason = reason
+            entry.enqueued_at = self._now()
+            entry.backoff_expiry = self._now() + self._backoff_for(entry.attempts)
+            self._unschedulable[uid] = entry
+
+    def requeue_backoff(self, pod: Pod) -> None:
+        """Transient failure (e.g. bind error): retry after backoff."""
+        with self._lock:
+            uid = pod.uid
+            if uid in self._deleted_in_flight:
+                self._deleted_in_flight.discard(uid)
+                self._in_flight.pop(uid, None)
+                return
+            entry = self._in_flight.pop(uid, None) or _QueuedPod(pod)
+            entry.pod = pod
+            entry.backoff_expiry = self._now() + self._backoff_for(entry.attempts)
+            self._backoff[uid] = entry
+
+    def _backoff_for(self, attempts: int) -> float:
+        return min(self._initial * (2 ** max(attempts - 1, 0)), self._max)
+
+    # ---- event-driven movement ------------------------------------------
+
+    def flush_backoff(self) -> int:
+        with self._lock:
+            now = self._now()
+            expired = [
+                u for u, e in self._backoff.items() if e.backoff_expiry <= now
+            ]
+            for u in expired:
+                self._active[u] = self._backoff.pop(u)
+            return len(expired)
+
+    def flush_unschedulable_timeout(self) -> int:
+        """Upstream flushUnschedulablePodsLeftover: pods stuck too long
+        retry even without an event."""
+        with self._lock:
+            now = self._now()
+            stuck = [
+                u for u, e in self._unschedulable.items()
+                if now - e.enqueued_at >= self._timeout
+            ]
+            for u in stuck:
+                self._move_out(u)
+            return len(stuck)
+
+    def move_all_to_active_or_backoff(self, event: str) -> int:
+        """Informer event: move unschedulable pods whose failure the event
+        can cure (queueing hints) to backoff (or active if expired)."""
+        with self._lock:
+            moved = 0
+            for u in list(self._unschedulable):
+                reason = self._unschedulable[u].unschedulable_reason
+                hints = QUEUEING_HINTS.get(reason)
+                if reason and hints is not None and event not in hints:
+                    continue
+                self._move_out(u)
+                moved += 1
+            return moved
+
+    def _move_out(self, uid: str) -> None:
+        entry = self._unschedulable.pop(uid, None)
+        if entry is None:
+            return
+        if entry.backoff_expiry > self._now():
+            self._backoff[uid] = entry
+        else:
+            self._active[uid] = entry
+
+    # ---- introspection ---------------------------------------------------
+
+    def pending_counts(self) -> dict[str, int]:
+        """Tier sizes, keyed like the upstream pending_pods{queue=...}
+        metric labels."""
+        with self._lock:
+            return {
+                "active": len(self._active),
+                "backoff": len(self._backoff),
+                "unschedulable": len(self._unschedulable),
+            }
+
+    def all_pending(self) -> Iterable[Pod]:
+        with self._lock:
+            entries = [
+                e.pod
+                for tier in (self._active, self._backoff, self._unschedulable)
+                for e in tier.values()
+            ]
+        return entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return (
+                len(self._active)
+                + len(self._backoff)
+                + len(self._unschedulable)
+            )

@@ -1,0 +1,254 @@
+"""Host-side runtime tests: SchedulingQueue tiers, SchedulerCache
+lifecycle, and the Scheduler driver end-to-end against a fake cluster
+(SURVEY.md §4: fakes + integration-style tests, no real cluster)."""
+
+import numpy as np
+
+from k8s_scheduler_tpu.core import Scheduler
+from k8s_scheduler_tpu.internal.cache import SchedulerCache
+from k8s_scheduler_tpu.internal.queue import (
+    EVENT_NODE_ADD,
+    EVENT_POD_DELETE,
+    SchedulingQueue,
+)
+from k8s_scheduler_tpu.models import MakeNode, MakePod
+from k8s_scheduler_tpu.models.api import PodGroup
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+class FakeCluster:
+    """Stands in for the API server: records binds/evictions and feeds
+    confirmation events back, like the informer would."""
+
+    def __init__(self, sched=None):
+        self.bound = {}
+        self.evicted = []
+        self.fail_next_binds = 0
+        self.sched = sched
+
+    def bind(self, pod, node_name):
+        if self.fail_next_binds > 0:
+            self.fail_next_binds -= 1
+            raise RuntimeError("bind failed")
+        self.bound[pod.name] = node_name
+        if self.sched is not None:  # informer echo: pod now bound
+            self.sched.cache.confirm(pod.uid)
+
+    def evict(self, pod, node_name):
+        self.evicted.append(pod.name)
+        if self.sched is not None:
+            self.sched.on_pod_delete(pod.uid)
+
+
+def make_scheduler(clock=None):
+    clock = clock or FakeClock()
+    cluster = FakeCluster()
+    sched = Scheduler(binder=cluster.bind, evictor=cluster.evict, now=clock,
+                      pad_bucket=8)
+    cluster.sched = sched
+    return sched, cluster, clock
+
+
+# ---- queue unit tests ------------------------------------------------------
+
+
+def test_queue_backoff_grows_and_expires():
+    clock = FakeClock()
+    q = SchedulingQueue(initial_backoff_seconds=1.0, max_backoff_seconds=4.0,
+                        now=clock)
+    pod = MakePod("p").obj()
+    q.add(pod)
+    assert [p.name for p in q.pop_ready()] == ["p"]
+    q.requeue_backoff(pod)
+    assert q.pop_ready() == []  # still backing off
+    clock.tick(1.1)
+    assert [p.name for p in q.pop_ready()] == ["p"]  # attempt 2
+    q.requeue_backoff(pod)
+    clock.tick(1.1)
+    assert q.pop_ready() == []  # backoff doubled to 2s
+    clock.tick(1.0)
+    assert [p.name for p in q.pop_ready()] == ["p"]
+
+
+def test_queue_unschedulable_waits_for_matching_event():
+    clock = FakeClock()
+    q = SchedulingQueue(now=clock)
+    pod = MakePod("p").obj()
+    q.add(pod)
+    q.pop_ready()
+    q.requeue_unschedulable(pod, reason="NodeResourcesFit")
+    # PodDelete can cure NodeResourcesFit; backoff already expired?
+    assert q.pending_counts()["unschedulable"] == 1
+    q.move_all_to_active_or_backoff(EVENT_POD_DELETE)
+    counts = q.pending_counts()
+    assert counts["unschedulable"] == 0
+    assert counts["active"] + counts["backoff"] == 1
+
+
+def test_queue_hint_filters_irrelevant_events():
+    clock = FakeClock()
+    q = SchedulingQueue(now=clock)
+    pod = MakePod("p").obj()
+    q.add(pod)
+    q.pop_ready()
+    q.requeue_unschedulable(pod, reason="NodeAffinity")
+    # PodDelete cannot cure a NodeAffinity rejection
+    q.move_all_to_active_or_backoff(EVENT_POD_DELETE)
+    assert q.pending_counts()["unschedulable"] == 1
+    q.move_all_to_active_or_backoff(EVENT_NODE_ADD)
+    assert q.pending_counts()["unschedulable"] == 0
+
+
+def test_queue_unschedulable_timeout_flush():
+    clock = FakeClock()
+    q = SchedulingQueue(unschedulable_timeout_seconds=300.0, now=clock)
+    pod = MakePod("p").obj()
+    q.add(pod)
+    q.pop_ready()
+    q.requeue_unschedulable(pod, reason="NodeAffinity")
+    clock.tick(301.0)
+    q.flush_unschedulable_timeout()
+    assert q.pending_counts()["unschedulable"] == 0
+
+
+# ---- cache unit tests ------------------------------------------------------
+
+
+def test_cache_assume_confirm_lifecycle():
+    clock = FakeClock()
+    c = SchedulerCache(assumed_pod_ttl_seconds=30.0, now=clock)
+    c.add_node(MakeNode("n0").capacity({"cpu": "4"}).obj())
+    pod = MakePod("p").obj()
+    c.assume(pod, "n0")
+    assert c.is_assumed(pod.uid)
+    assert len(c.existing_pods()) == 1  # assumed counts as existing
+    c.finish_binding(pod.uid)
+    c.confirm(pod.uid)
+    assert not c.is_assumed(pod.uid)
+    assert c.counts()["bound"] == 1
+
+
+def test_cache_assumed_ttl_expiry():
+    clock = FakeClock()
+    c = SchedulerCache(assumed_pod_ttl_seconds=30.0, now=clock)
+    pod = MakePod("p").obj()
+    c.assume(pod, "n0")
+    c.finish_binding(pod.uid)
+    clock.tick(31.0)
+    expired = c.cleanup_expired()
+    assert [p.name for p in expired] == ["p"]
+    assert c.counts()["assumed"] == 0
+
+
+def test_cache_forget_on_bind_failure():
+    c = SchedulerCache()
+    pod = MakePod("p").obj()
+    c.assume(pod, "n0")
+    c.forget(pod.uid)
+    assert c.existing_pods() == []
+
+
+# ---- scheduler end-to-end --------------------------------------------------
+
+
+def test_scheduler_end_to_end_bind():
+    sched, cluster, _ = make_scheduler()
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "4"}).obj())
+    sched.on_node_add(MakeNode("n1").capacity({"cpu": "4"}).obj())
+    for i in range(4):
+        sched.on_pod_add(MakePod(f"p{i}").req({"cpu": "1"}).obj())
+    stats = sched.schedule_cycle()
+    assert stats.attempted == 4
+    assert stats.scheduled == 4
+    assert len(cluster.bound) == 4
+    assert sched.cache.counts()["bound"] == 4  # confirmations arrived
+    # second cycle: nothing pending
+    assert sched.schedule_cycle().attempted == 0
+
+
+def test_scheduler_sequential_cycles_respect_capacity():
+    sched, cluster, _ = make_scheduler()
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "2"}).obj())
+    sched.on_pod_add(MakePod("a").req({"cpu": "2"}).obj())
+    sched.schedule_cycle()
+    sched.on_pod_add(MakePod("b").req({"cpu": "2"}).obj())
+    stats = sched.schedule_cycle()
+    assert stats.unschedulable == 1  # n0 is full with a bound pod
+    assert cluster.bound == {"a": "n0"}
+
+
+def test_scheduler_bind_failure_backs_off_and_retries():
+    sched, cluster, clock = make_scheduler()
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "4"}).obj())
+    sched.on_pod_add(MakePod("p").req({"cpu": "1"}).obj())
+    cluster.fail_next_binds = 1
+    stats = sched.schedule_cycle()
+    assert stats.bind_errors == 1 and stats.scheduled == 0
+    assert not sched.cache.is_assumed("")  # assumption forgotten
+    clock.tick(2.0)  # past initial backoff
+    stats = sched.schedule_cycle()
+    assert stats.scheduled == 1
+    assert cluster.bound == {"p": "n0"}
+
+
+def test_scheduler_preemption_flow():
+    sched, cluster, clock = make_scheduler()
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "2"}).obj())
+    victim = MakePod("victim").req({"cpu": "2"}).priority(1).obj()
+    sched.on_pod_add(victim, node_name="n0")  # already bound
+    sched.on_pod_add(MakePod("urgent").req({"cpu": "2"}).priority(10).obj())
+    stats = sched.schedule_cycle()
+    assert stats.unschedulable == 1
+    assert stats.preemptors == 1
+    assert stats.victims == 1
+    assert cluster.evicted == ["victim"]
+    # eviction event moved the preemptor out of the unschedulable tier;
+    # next cycle it lands on its nominated node
+    clock.tick(2.0)
+    stats = sched.schedule_cycle()
+    assert stats.scheduled == 1
+    assert cluster.bound == {"urgent": "n0"}
+
+
+def test_scheduler_gang_requeue():
+    sched, cluster, clock = make_scheduler()
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "4"}).obj())
+    sched.add_pod_group(PodGroup("job", 3))
+    for i in range(3):
+        sched.on_pod_add(
+            MakePod(f"g{i}").req({"cpu": "2"}).group("job").created(i).obj()
+        )
+    stats = sched.schedule_cycle()
+    assert stats.scheduled == 0
+    assert stats.gang_dropped == 2
+    assert stats.unschedulable == 3
+    assert cluster.bound == {}
+    # more capacity arrives -> the whole gang lands
+    sched.on_node_add(MakeNode("n1").capacity({"cpu": "4"}).obj())
+    clock.tick(2.0)
+    stats = sched.schedule_cycle()
+    assert stats.scheduled == 3
+    assert len(cluster.bound) == 3
+
+
+def test_scheduler_node_delete_requeues():
+    sched, cluster, clock = make_scheduler()
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "1"}).obj())
+    sched.on_pod_add(MakePod("p").req({"cpu": "2"}).obj())
+    stats = sched.schedule_cycle()
+    assert stats.unschedulable == 1
+    sched.on_node_add(MakeNode("big").capacity({"cpu": "8"}).obj())
+    clock.tick(2.0)
+    stats = sched.schedule_cycle()
+    assert stats.scheduled == 1
+    assert cluster.bound == {"p": "big"}
