@@ -27,6 +27,7 @@ import numpy as np
 from ..config import SchedulerConfiguration
 from ..framework.runtime import Framework
 from ..internal.cache import SchedulerCache
+from ..metrics import SchedulerMetrics
 from ..internal.queue import (
     EVENT_NODE_ADD,
     EVENT_NODE_DELETE,
@@ -71,6 +72,7 @@ class Scheduler:
         evictor: Evictor | None = None,
         now: Callable[[], float] = _time.monotonic,
         pad_bucket: int = 64,
+        metrics: SchedulerMetrics | None = None,
     ) -> None:
         self.config = config or SchedulerConfiguration()
         self.framework = Framework.from_config(self.config)
@@ -84,6 +86,8 @@ class Scheduler:
         self.evictor = evictor or (lambda pod, node: None)
         self._now = now
         self._pad_bucket = pad_bucket
+        self.metrics = metrics or SchedulerMetrics()
+        self._profile_name = self.config.profiles[0].scheduler_name
         self._groups: dict[str, PodGroup] = {}
         # ONE encoder for the scheduler's lifetime: interned string ids and
         # the resource-name axis stay stable across cycles (the encoder's
@@ -98,13 +102,21 @@ class Scheduler:
 
     def on_pod_add(self, pod: Pod, node_name: str = "") -> None:
         if node_name:
+            # observed bound: drop any stale queue entry (a late informer
+            # echo after an assumption expired must not leave the pod both
+            # pending and existing, which would double-schedule it)
+            self.queue.delete(pod.uid)
             self.cache.add_pod(pod, node_name)
             self.queue.move_all_to_active_or_backoff(EVENT_POD_ADD)
         else:
             self.queue.add(pod)
+            self.metrics.queue_incoming.labels(
+                queue="active", event=EVENT_POD_ADD
+            ).inc()
 
     def on_pod_update(self, pod: Pod, node_name: str = "") -> None:
         if node_name:
+            self.queue.delete(pod.uid)
             self.cache.add_pod(pod, node_name)
             self.queue.move_all_to_active_or_backoff(EVENT_POD_UPDATE)
         else:
@@ -144,6 +156,7 @@ class Scheduler:
         if not pending:
             return stats
         stats.attempted = len(pending)
+        self.metrics.cycle_pods.observe(len(pending))
 
         nodes = self.cache.nodes()
         existing = self.cache.existing_pods()
@@ -153,32 +166,67 @@ class Scheduler:
         snap = self._encoder.encode(
             nodes, pending, existing, pod_groups=list(self._groups.values())
         )
+        t_encode = self._now()
+        self.metrics.cycle_duration.labels(phase="encode").observe(
+            t_encode - t0
+        )
         result = self._cycle(snap)
         assignment = np.asarray(result.assignment)[: len(pending)]
         gang_dropped = np.asarray(result.gang_dropped)[: len(pending)]
         stats.gang_dropped = int(gang_dropped.sum())
+        t_device = self._now()
+        self.metrics.cycle_duration.labels(phase="device").observe(
+            t_device - t_encode
+        )
+        self.metrics.decisions.inc(len(pending) * len(nodes))
 
         nominated = victims = None
         if self._preempt is not None and (assignment < 0).any():
+            self.metrics.preemption_attempts.inc()
             pre = self._preempt(snap, result)
             nominated = np.asarray(pre.nominated)[: len(pending)]
             victims = np.asarray(pre.victims)[: len(existing)]
 
         # ---- apply: assume + bind winners, requeue losers ----
+        per_pod_s = (self._now() - t0) / max(len(pending), 1)
         for i, pod in enumerate(pending):
             node_idx = int(assignment[i])
             if node_idx >= 0:
                 node_name = nodes[node_idx].name
-                self.cache.assume(pod, node_name)
+                try:
+                    # a per-pod scheduling error (e.g. the uid raced to
+                    # bound via an informer echo mid-cycle) must not kill
+                    # the loop — upstream continues with the next pod
+                    self.cache.assume(pod, node_name)
+                except ValueError:
+                    stats.bind_errors += 1
+                    self.metrics.observe_attempt(
+                        "error", per_pod_s, self._profile_name
+                    )
+                    continue
+                t_bind = self._now()
                 try:
                     self.binder(pod, node_name)
                 except Exception:
                     self.cache.forget(pod.uid)
                     self.queue.requeue_backoff(pod)
                     stats.bind_errors += 1
+                    self.metrics.queue_incoming.labels(
+                        queue="backoff", event="BindError"
+                    ).inc()
+                    self.metrics.observe_attempt(
+                        "error", per_pod_s, self._profile_name
+                    )
                     continue
+                self.metrics.binding_duration.observe(self._now() - t_bind)
                 self.cache.finish_binding(pod.uid)
                 stats.scheduled += 1
+                self.metrics.pod_scheduling_attempts.observe(
+                    self.queue.attempts_of(pod.uid)
+                )
+                self.metrics.observe_attempt(
+                    "scheduled", per_pod_s, self._profile_name
+                )
             else:
                 if nominated is not None and nominated[i] >= 0:
                     pod.nominated_node_name = nodes[int(nominated[i])].name
@@ -186,14 +234,36 @@ class Scheduler:
                 reason = "Coscheduling" if gang_dropped[i] else ""
                 self.queue.requeue_unschedulable(pod, reason=reason)
                 stats.unschedulable += 1
+                self.metrics.queue_incoming.labels(
+                    queue="unschedulable", event="ScheduleAttemptFailure"
+                ).inc()
+                self.metrics.observe_attempt(
+                    "unschedulable", per_pod_s, self._profile_name
+                )
 
         if victims is not None and victims.any():
             for e in np.flatnonzero(victims):
                 vpod, vnode = existing[int(e)]
                 self.evictor(vpod, vnode)
                 stats.victims += 1
+            self.metrics.preemption_victims.observe(stats.victims)
 
         stats.cycle_seconds = self._now() - t0
+        self.metrics.cycle_duration.labels(phase="apply").observe(
+            stats.cycle_seconds - (t_device - t0)
+        )
+        self.metrics.cycle_duration.labels(phase="total").observe(
+            stats.cycle_seconds
+        )
+        self.metrics.set_pending(self.queue.pending_counts())
+        c = self.cache.counts()
+        # upstream cache_size{type="pods"} counts every tracked pod state;
+        # assumed_pods is the subset awaiting bind confirmation
+        self.metrics.set_cache(
+            c.get("nodes", 0),
+            c.get("bound", 0) + c.get("assumed", 0),
+            c.get("assumed", 0),
+        )
         return stats
 
     def run(self, max_cycles: int | None = None,

@@ -116,8 +116,15 @@ class SchedulingQueue:
                     entry = tier[uid]
                     entry.pod = pod
                     if tier is self._unschedulable:
+                        # the update may cure the failure, but the pod's
+                        # backoff window still applies (upstream checks
+                        # isPodBackingOff here) — otherwise a controller
+                        # touching annotations defeats exponential backoff
                         del tier[uid]
-                        self._active[uid] = entry
+                        if entry.backoff_expiry > self._now():
+                            self._backoff[uid] = entry
+                        else:
+                            self._active[uid] = entry
                     return
             if uid in self._in_flight:
                 # being scheduled right now: refresh the in-flight object so
@@ -232,6 +239,12 @@ class SchedulingQueue:
             self._active[uid] = entry
 
     # ---- introspection ---------------------------------------------------
+
+    def attempts_of(self, uid: str) -> int:
+        """Scheduling attempts the in-flight pod has used (1 = first try)."""
+        with self._lock:
+            e = self._in_flight.get(uid)
+            return e.attempts if e else 1
 
     def pending_counts(self) -> dict[str, int]:
         """Tier sizes, keyed like the upstream pending_pods{queue=...}

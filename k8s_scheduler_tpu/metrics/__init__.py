@@ -1,0 +1,3 @@
+from .metrics import SchedulerMetrics, global_metrics
+
+__all__ = ["SchedulerMetrics", "global_metrics"]

@@ -1,0 +1,198 @@
+"""Observability: Prometheus metrics with the upstream metric names.
+
+The reference family registers its metrics in `metrics/metrics.go`
+([UNVERIFIED] location, mount empty; SURVEY.md §2 C13, §5.5) under the
+`scheduler_` subsystem. This module keeps the same names so existing
+dashboards and alerts transfer unchanged:
+
+- scheduler_schedule_attempts_total{result,profile}
+- scheduler_scheduling_attempt_duration_seconds{result,profile}
+- scheduler_e2e_scheduling_duration_seconds{result,profile} (legacy name)
+- scheduler_pending_pods{queue}
+- scheduler_queue_incoming_pods_total{queue,event}
+- scheduler_preemption_attempts_total
+- scheduler_preemption_victims (histogram)
+- scheduler_binding_duration_seconds
+- scheduler_framework_extension_point_duration_seconds{extension_point,status}
+- scheduler_plugin_execution_duration_seconds{plugin,extension_point,status}
+- scheduler_pod_scheduling_attempts (histogram)
+- scheduler_cache_size{type}
+
+Batched-cycle additions (no upstream equivalent — the TPU design schedules
+the whole pending set per cycle):
+
+- scheduler_cycle_duration_seconds{phase} — encode / device / apply / total
+- scheduler_cycle_pods (histogram) — pending-set size per cycle
+- scheduler_pod_node_decisions_total — P*N decisions evaluated (the
+  north-star throughput numerator)
+
+Each `SchedulerMetrics` owns its own `CollectorRegistry` so tests and
+multi-scheduler processes never collide; `global_metrics()` returns a
+process-wide default instance.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+# Buckets tuned for a <10ms-per-cycle target (BASELINE.md north star):
+# upstream uses exponential 1ms..~16s; extend downward for TPU cycles.
+_DURATION_BUCKETS = (
+    0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+_ATTEMPTS_BUCKETS = (1, 2, 3, 5, 8, 13, 21)
+_VICTIM_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+_PODS_BUCKETS = (1, 8, 64, 256, 1024, 4096, 16384, 65536)
+
+RESULT_SCHEDULED = "scheduled"
+RESULT_UNSCHEDULABLE = "unschedulable"
+RESULT_ERROR = "error"
+
+
+class SchedulerMetrics:
+    def __init__(self, registry: CollectorRegistry | None = None) -> None:
+        self.registry = registry or CollectorRegistry()
+        r = self.registry
+        self.schedule_attempts = Counter(
+            "scheduler_schedule_attempts_total",
+            "Number of attempts to schedule pods, by result.",
+            ["result", "profile"],
+            registry=r,
+        )
+        self.attempt_duration = Histogram(
+            "scheduler_scheduling_attempt_duration_seconds",
+            "Scheduling attempt latency (scheduling algorithm + binding).",
+            ["result", "profile"],
+            buckets=_DURATION_BUCKETS,
+            registry=r,
+        )
+        self.e2e_duration = Histogram(
+            "scheduler_e2e_scheduling_duration_seconds",
+            "E2e scheduling latency (legacy name kept for dashboards).",
+            ["result", "profile"],
+            buckets=_DURATION_BUCKETS,
+            registry=r,
+        )
+        self.pending_pods = Gauge(
+            "scheduler_pending_pods",
+            "Pending pods, by queue (active|backoff|unschedulable).",
+            ["queue"],
+            registry=r,
+        )
+        self.queue_incoming = Counter(
+            "scheduler_queue_incoming_pods_total",
+            "Pods added to scheduling queues by queue and event.",
+            ["queue", "event"],
+            registry=r,
+        )
+        self.preemption_attempts = Counter(
+            "scheduler_preemption_attempts_total",
+            "Total preemption attempts in the cluster so far.",
+            registry=r,
+        )
+        self.preemption_victims = Histogram(
+            "scheduler_preemption_victims",
+            "Number of selected preemption victims.",
+            buckets=_VICTIM_BUCKETS,
+            registry=r,
+        )
+        self.binding_duration = Histogram(
+            "scheduler_binding_duration_seconds",
+            "Binding latency.",
+            buckets=_DURATION_BUCKETS,
+            registry=r,
+        )
+        self.extension_point_duration = Histogram(
+            "scheduler_framework_extension_point_duration_seconds",
+            "Latency for running all plugins of a specific extension point.",
+            ["extension_point", "status"],
+            buckets=_DURATION_BUCKETS,
+            registry=r,
+        )
+        self.plugin_duration = Histogram(
+            "scheduler_plugin_execution_duration_seconds",
+            "Duration for running a plugin at a specific extension point.",
+            ["plugin", "extension_point", "status"],
+            buckets=_DURATION_BUCKETS,
+            registry=r,
+        )
+        self.pod_scheduling_attempts = Histogram(
+            "scheduler_pod_scheduling_attempts",
+            "Number of attempts to successfully schedule a pod.",
+            buckets=_ATTEMPTS_BUCKETS,
+            registry=r,
+        )
+        self.cache_size = Gauge(
+            "scheduler_cache_size",
+            "Scheduler cache size, by type (nodes|pods|assumed_pods).",
+            ["type"],
+            registry=r,
+        )
+        # ---- batched-cycle additions ----
+        self.cycle_duration = Histogram(
+            "scheduler_cycle_duration_seconds",
+            "Batched scheduling cycle latency by phase "
+            "(encode|device|apply|total).",
+            ["phase"],
+            buckets=_DURATION_BUCKETS,
+            registry=r,
+        )
+        self.cycle_pods = Histogram(
+            "scheduler_cycle_pods",
+            "Pending-set size per batched cycle.",
+            buckets=_PODS_BUCKETS,
+            registry=r,
+        )
+        self.decisions = Counter(
+            "scheduler_pod_node_decisions_total",
+            "Pod-node feasibility+scoring decisions evaluated (P*N per "
+            "cycle) — the north-star throughput numerator.",
+            registry=r,
+        )
+
+    # ---- convenience recorders ------------------------------------------
+
+    def observe_attempt(
+        self, result: str, seconds: float, profile: str = "default-scheduler"
+    ) -> None:
+        self.schedule_attempts.labels(result=result, profile=profile).inc()
+        self.attempt_duration.labels(result=result, profile=profile).observe(
+            seconds
+        )
+        self.e2e_duration.labels(result=result, profile=profile).observe(
+            seconds
+        )
+
+    def set_pending(self, counts: dict[str, int]) -> None:
+        for queue, n in counts.items():
+            self.pending_pods.labels(queue=queue).set(n)
+
+    def set_cache(self, nodes: int, pods: int, assumed: int) -> None:
+        self.cache_size.labels(type="nodes").set(nodes)
+        self.cache_size.labels(type="pods").set(pods)
+        self.cache_size.labels(type="assumed_pods").set(assumed)
+
+    def expose(self) -> bytes:
+        """Prometheus text exposition (the /metrics payload)."""
+        return generate_latest(self.registry)
+
+
+_global_lock = threading.Lock()
+_global: SchedulerMetrics | None = None
+
+
+def global_metrics() -> SchedulerMetrics:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = SchedulerMetrics()
+        return _global

@@ -1,0 +1,111 @@
+"""Observability (SURVEY.md §2 C13, §5.5): upstream metric names exposed
+via prometheus_client, recorded by the host-side scheduling loop."""
+
+import numpy as np
+
+from k8s_scheduler_tpu.core.scheduler import Scheduler
+from k8s_scheduler_tpu.metrics import SchedulerMetrics
+from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+
+def _sample(metrics, name, labels=None):
+    v = metrics.registry.get_sample_value(name, labels or {})
+    return 0.0 if v is None else v
+
+
+def test_upstream_metric_names_present():
+    m = SchedulerMetrics()
+    text = m.expose().decode()
+    for name in [
+        "scheduler_schedule_attempts_total",
+        "scheduler_scheduling_attempt_duration_seconds",
+        "scheduler_e2e_scheduling_duration_seconds",
+        "scheduler_pending_pods",
+        "scheduler_preemption_attempts_total",
+        "scheduler_preemption_victims",
+        "scheduler_binding_duration_seconds",
+        "scheduler_framework_extension_point_duration_seconds",
+        "scheduler_plugin_execution_duration_seconds",
+        "scheduler_pod_scheduling_attempts",
+        "scheduler_cache_size",
+        "scheduler_cycle_duration_seconds",
+        "scheduler_pod_node_decisions_total",
+    ]:
+        assert name in text, name
+
+
+def test_cycle_records_attempts_and_pending():
+    m = SchedulerMetrics()
+    sched = Scheduler(metrics=m)
+    for nd in make_cluster(4):
+        sched.on_node_add(nd)
+    for p in make_pods(6):
+        sched.on_pod_add(p)
+    stats = sched.schedule_cycle()
+    assert stats.scheduled == 6
+
+    scheduled = _sample(
+        m,
+        "scheduler_schedule_attempts_total",
+        {"result": "scheduled", "profile": "default-scheduler"},
+    )
+    assert scheduled == 6
+    assert _sample(m, "scheduler_pod_node_decisions_total") == 6 * 4
+    assert _sample(m, "scheduler_cache_size", {"type": "nodes"}) == 4
+    assert _sample(m, "scheduler_cache_size", {"type": "pods"}) == 6
+    assert (
+        _sample(
+            m,
+            "scheduler_cycle_duration_seconds_count",
+            {"phase": "total"},
+        )
+        == 1
+    )
+    # everything scheduled -> pending gauges are zero
+    for q in ("active", "backoff", "unschedulable"):
+        assert _sample(m, "scheduler_pending_pods", {"queue": q}) == 0
+
+
+def test_bind_error_and_unschedulable_results():
+    m = SchedulerMetrics()
+    calls = {"n": 0}
+
+    def flaky_binder(pod, node):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("apiserver away")
+
+    sched = Scheduler(metrics=m, binder=flaky_binder)
+    for nd in make_cluster(2):
+        sched.on_node_add(nd)
+    # one pod that fits, one that can't (huge request)
+    pods = make_pods(2)
+    pods[1].spec.containers[0].requests["cpu"] = 10_000_000.0  # 10k cores
+    for p in pods:
+        sched.on_pod_add(p)
+    stats = sched.schedule_cycle()
+    assert stats.bind_errors == 1
+    assert stats.unschedulable == 1
+    assert (
+        _sample(
+            m,
+            "scheduler_schedule_attempts_total",
+            {"result": "error", "profile": "default-scheduler"},
+        )
+        == 1
+    )
+    assert (
+        _sample(
+            m,
+            "scheduler_schedule_attempts_total",
+            {"result": "unschedulable", "profile": "default-scheduler"},
+        )
+        == 1
+    )
+
+
+def test_registries_are_isolated():
+    a, b = SchedulerMetrics(), SchedulerMetrics()
+    a.decisions.inc(5)
+    assert _sample(a, "scheduler_pod_node_decisions_total") == 5
+    assert _sample(b, "scheduler_pod_node_decisions_total") == 0

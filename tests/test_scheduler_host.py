@@ -62,6 +62,33 @@ def make_scheduler(clock=None):
 # ---- queue unit tests ------------------------------------------------------
 
 
+def test_queue_update_honors_backoff_window():
+    clock = FakeClock()
+    q = SchedulingQueue(initial_backoff_seconds=10.0, now=clock)
+    pod = MakePod("p").obj()
+    q.add(pod)
+    q.pop_ready()
+    q.requeue_unschedulable(pod, reason="NodeResourcesFit")
+    # a spec update can cure the failure but must not skip the 10s backoff
+    q.update(pod)
+    assert q.pop_ready() == []
+    clock.tick(10.1)
+    assert [p.name for p in q.pop_ready()] == ["p"]
+
+
+def test_observed_bind_drops_stale_queue_entry():
+    sched, cluster, clock = make_scheduler()
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "4"}).obj())
+    pod = MakePod("p").req({"cpu": "1"}).obj()
+    sched.on_pod_add(pod)
+    # late informer echo: the pod is observed bound before the cycle runs
+    sched.on_pod_add(pod, node_name="n0")
+    stats = sched.schedule_cycle()
+    # must not double-schedule (pod would be both pending and existing)
+    assert stats.attempted == 0
+    assert sched.cache.counts()["bound"] == 1
+
+
 def test_queue_backoff_grows_and_expires():
     clock = FakeClock()
     q = SchedulingQueue(initial_backoff_seconds=1.0, max_backoff_seconds=4.0,
@@ -190,11 +217,12 @@ def test_scheduler_sequential_cycles_respect_capacity():
 def test_scheduler_bind_failure_backs_off_and_retries():
     sched, cluster, clock = make_scheduler()
     sched.on_node_add(MakeNode("n0").capacity({"cpu": "4"}).obj())
-    sched.on_pod_add(MakePod("p").req({"cpu": "1"}).obj())
+    pod = MakePod("p").req({"cpu": "1"}).obj()
+    sched.on_pod_add(pod)
     cluster.fail_next_binds = 1
     stats = sched.schedule_cycle()
     assert stats.bind_errors == 1 and stats.scheduled == 0
-    assert not sched.cache.is_assumed("")  # assumption forgotten
+    assert not sched.cache.is_assumed(pod.uid)  # assumption forgotten
     clock.tick(2.0)  # past initial backoff
     stats = sched.schedule_cycle()
     assert stats.scheduled == 1
