@@ -1,0 +1,3 @@
+from .main import main, new_scheduler_command
+
+__all__ = ["main", "new_scheduler_command"]

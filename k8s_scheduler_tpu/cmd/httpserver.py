@@ -1,0 +1,57 @@
+"""Health + metrics HTTP endpoints (SURVEY.md §2 C1, §5.5).
+
+The reference family serves /healthz and Prometheus /metrics from its
+secure port; dashboards and probes expect those paths. Served here with
+the stdlib http.server on a daemon thread — the payloads are tiny and
+low-rate (scrapes + probes), no framework needed."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from ..metrics import SchedulerMetrics
+
+
+def start_http_server(
+    metrics: SchedulerMetrics,
+    port: int = 10251,
+    host: str = "127.0.0.1",
+    healthz: Callable[[], tuple[bool, dict]] | None = None,
+) -> ThreadingHTTPServer:
+    """Serve /healthz, /readyz, /metrics; returns the running server
+    (bound port at `.server_address[1]`; pass port=0 for ephemeral)."""
+    health_fn = healthz or (lambda: (True, {}))
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802  (stdlib casing)
+            if self.path in ("/healthz", "/readyz", "/livez"):
+                ok, detail = health_fn()
+                body = json.dumps({"ok": ok, **detail}).encode()
+                self.send_response(200 if ok else 503)
+                self.send_header("Content-Type", "application/json")
+            elif self.path == "/metrics":
+                body = metrics.expose()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+            else:
+                body = b"not found"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # probes are noisy
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(
+        target=server.serve_forever, name="http-metrics", daemon=True
+    )
+    thread.start()
+    return server

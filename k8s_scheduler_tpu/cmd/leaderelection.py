@@ -1,0 +1,134 @@
+"""Leader election for active/standby HA (SURVEY.md §5.3).
+
+The reference family elects a leader through apiserver Lease objects
+(`leaderElection` in KubeSchedulerConfiguration): the active scheduler
+renews a lease; standbys watch it and take over when it expires. Without an
+apiserver, the shim's equivalent coordination point is a lease FILE on
+shared storage: fcntl byte-range locks give the atomic acquire, and a
+heartbeat timestamp written under the lock gives standbys the expiry
+signal. The scheduler itself stays stateless either way — a standby that
+takes over rebuilds all state from the agent's re-list (§5.3), so
+correctness never depends on the lease (at worst two actives emit
+conflicting bindings briefly; the cluster store's optimistic concurrency —
+or the agent applying one — arbitrates, as upstream).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import threading
+import time as _time
+from typing import Callable
+
+
+class FileLease:
+    """flock-based lease with heartbeat renewal.
+
+    acquire() blocks until leadership is won (or `timeout` elapses). The
+    holder renews by rewriting the heartbeat every `renew_seconds`; a
+    holder that stops renewing (crash, hang) loses the flock when its
+    process dies, letting a standby in immediately — the heartbeat is
+    advisory metadata for observability, the kernel lock is the truth.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        identity: str = "",
+        renew_seconds: float = 2.0,
+    ) -> None:
+        self.path = path
+        self.identity = identity or f"pid-{os.getpid()}"
+        self.renew_seconds = renew_seconds
+        self._fd: int | None = None
+        self._stop = threading.Event()
+        self._renewer: threading.Thread | None = None
+
+    # ---- acquisition -----------------------------------------------------
+
+    def try_acquire(self) -> bool:
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        self._fd = fd
+        self._write_heartbeat()
+        return True
+
+    def acquire(self, timeout: float | None = None,
+                poll_seconds: float = 0.5) -> bool:
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            if self.try_acquire():
+                return True
+            if deadline is not None and _time.monotonic() >= deadline:
+                return False
+            _time.sleep(poll_seconds)
+
+    def start_renewing(self) -> None:
+        self._renewer = threading.Thread(
+            target=self._renew_loop, name="lease-renewer", daemon=True
+        )
+        self._renewer.start()
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(self.renew_seconds):
+            self._write_heartbeat()
+
+    def _write_heartbeat(self) -> None:
+        if self._fd is None:
+            return
+        payload = json.dumps(
+            {
+                "holderIdentity": self.identity,
+                "renewTime": _time.time(),
+                "leaseDurationSeconds": self.renew_seconds * 3,
+            }
+        ).encode()
+        os.lseek(self._fd, 0, os.SEEK_SET)
+        os.truncate(self._fd, 0)
+        os.write(self._fd, payload)
+
+    # ---- observation (standbys / operators) ------------------------------
+
+    def holder(self) -> dict | None:
+        """Read the advisory heartbeat (None if no lease file/content)."""
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+            return json.loads(data) if data else None
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def is_leader(self) -> bool:
+        return self._fd is not None
+
+    def release(self) -> None:
+        self._stop.set()
+        if self._renewer is not None:
+            self._renewer.join(timeout=5)
+            self._renewer = None
+        if self._fd is not None:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+
+
+def run_with_leader_election(
+    lease: FileLease,
+    run: Callable[[], None],
+    on_started_leading: Callable[[], None] | None = None,
+) -> None:
+    """Block until leadership, then run (upstream leaderElection.Run)."""
+    lease.acquire()
+    lease.start_renewing()
+    if on_started_leading is not None:
+        on_started_leading()
+    try:
+        run()
+    finally:
+        lease.release()

@@ -1,0 +1,116 @@
+"""Process entry: flags, config load, serving, leader election.
+
+The analogue of the reference's `NewSchedulerCommand`/`Run` (SURVEY.md §2
+C1, §3.1): parse flags, load the KubeSchedulerConfiguration-shaped YAML,
+start the health/metrics HTTP endpoints, optionally win a leader lease,
+then run the gRPC shim that the cluster agent talks to.
+
+    python -m k8s_scheduler_tpu \
+        --config scheduler.yaml --address 127.0.0.1:50051 --http-port 10251
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from ..config import SchedulerConfiguration, load_config
+from .httpserver import start_http_server
+from .leaderelection import FileLease
+
+
+def new_scheduler_command() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="k8s-scheduler-tpu",
+        description="TPU-native scheduling service (kube-scheduler-"
+        "compatible semantics; snapshot in, bindings out over gRPC)",
+    )
+    ap.add_argument(
+        "--config", default="", help="KubeSchedulerConfiguration-style YAML"
+    )
+    ap.add_argument(
+        "--address", default="127.0.0.1:50051", help="gRPC bind address"
+    )
+    ap.add_argument(
+        "--http-port", type=int, default=10251,
+        help="/healthz + /metrics port (0 = ephemeral, -1 = disabled)",
+    )
+    ap.add_argument(
+        "--http-host", default="127.0.0.1", help="/healthz + /metrics host"
+    )
+    ap.add_argument(
+        "--leader-elect", action="store_true",
+        help="block on the lease file until elected (active/standby HA)",
+    )
+    ap.add_argument(
+        "--leader-elect-lease-file", default="/tmp/k8s-scheduler-tpu.lease",
+        help="shared lease file used for election",
+    )
+    ap.add_argument(
+        "--profile-every", type=int, default=0,
+        help="every N cycles, run the per-plugin profiling pass (0 = off)",
+    )
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = new_scheduler_command().parse_args(argv)
+    config = (
+        load_config(args.config) if args.config else SchedulerConfiguration()
+    )
+
+    # the shim owns the Scheduler; import deferred so --help stays instant
+    from ..service.server import serve
+
+    lease = None
+    if args.leader_elect:
+        lease = FileLease(args.leader_elect_lease_file)
+        print(
+            f"waiting for leader lease {args.leader_elect_lease_file} ...",
+            flush=True,
+        )
+        lease.acquire()
+        lease.start_renewing()
+        print("became leader", flush=True)
+
+    server, service, port = serve(args.address, config=config)
+    print(f"scheduler shim listening on port {port}", flush=True)
+
+    http_server = None
+    if args.http_port >= 0:
+        http_server = start_http_server(
+            service.scheduler.metrics,
+            port=args.http_port,
+            host=args.http_host,
+            healthz=lambda: (
+                True,
+                {
+                    "bootId": service.boot_id,
+                    "leader": lease.is_leader() if lease else True,
+                    "pending": service.scheduler.queue.pending_counts(),
+                },
+            ),
+        )
+        print(
+            "serving /healthz /metrics on port "
+            f"{http_server.server_address[1]}",
+            flush=True,
+        )
+
+    stop = threading.Event()
+
+    def _shutdown(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    try:
+        stop.wait()
+    finally:
+        server.stop(grace=2.0)
+        if http_server is not None:
+            http_server.shutdown()
+        if lease is not None:
+            lease.release()
+    return 0

@@ -1,0 +1,114 @@
+"""Per-plugin profiling pass (SURVEY.md §5.1 tracing/profiling).
+
+The production cycle fuses every plugin kernel into one XLA program, so
+per-plugin latency is not separable there (upstream can time each plugin
+because it dispatches callbacks eagerly). This pass re-runs each enabled
+plugin's static kernel as its own jitted program, blocked to completion,
+and records the upstream per-plugin histograms:
+
+    scheduler_plugin_execution_duration_seconds{plugin,extension_point,...}
+    scheduler_framework_extension_point_duration_seconds{extension_point,...}
+
+plus a per-plugin decision-log report (feasible fraction per Filter, score
+stats per Score) — the per-plugin mask statistics from SURVEY.md §5.5.
+
+Run it sampled (Scheduler.profile_cycle, or the CLI's --profile-every
+knob), never in the hot loop. For kernel-level detail beyond this, wrap any
+call in `jax.profiler.trace(log_dir)` and read the trace in TensorBoard or
+Perfetto; `trace_cycle` below does that for one full fused cycle.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..framework.interfaces import CycleContext
+from ..framework.runtime import Framework
+from ..metrics import SchedulerMetrics
+from ..models.encoding import ClusterSnapshot
+
+
+def _time_call(fn, snap, repeats: int = 3) -> tuple[float, Any]:
+    """Compile (untimed), then best-of-`repeats` wall time, result blocked."""
+    out = fn(snap)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = _time.perf_counter()
+        out = fn(snap)
+        jax.block_until_ready(out)
+        best = min(best, _time.perf_counter() - t0)
+    return best, out
+
+
+def profile_plugins(
+    framework: Framework,
+    snap: ClusterSnapshot,
+    metrics: SchedulerMetrics | None = None,
+    repeats: int = 3,
+) -> dict[str, dict[str, Any]]:
+    """Time each plugin's static kernel in isolation; returns a report
+    {plugin_name: {extension_point, seconds, ...stats}} and records the
+    per-plugin/per-point histograms when `metrics` is given."""
+    report: dict[str, dict[str, Any]] = {}
+    point_totals = {"Filter": 0.0, "Score": 0.0}
+    valid = (
+        np.asarray(snap.pod_valid)[:, None] & np.asarray(snap.node_valid)[None, :]
+    )
+    n_valid = max(valid.sum(), 1)
+
+    for plugin in framework.filters:
+        fn = jax.jit(lambda s, p=plugin: p.static_mask(CycleContext(s)))
+        if fn(snap) is None:  # dynamic-only plugin (no static kernel)
+            continue
+        secs, mask = _time_call(fn, snap, repeats)
+        feasible = float((np.asarray(mask) & valid).sum() / n_valid)
+        report[f"{plugin.name}/Filter"] = {
+            "extension_point": "Filter",
+            "seconds": secs,
+            "feasible_fraction": feasible,
+        }
+        point_totals["Filter"] += secs
+        if metrics is not None:
+            metrics.plugin_duration.labels(
+                plugin=plugin.name, extension_point="Filter", status="Success"
+            ).observe(secs)
+
+    for plugin, weight in framework.scores:
+        fn = jax.jit(lambda s, p=plugin: p.static_score(CycleContext(s)))
+        if fn(snap) is None:
+            continue
+        secs, score = _time_call(fn, snap, repeats)
+        sc = np.asarray(score)[valid]
+        report[f"{plugin.name}/Score"] = {
+            "extension_point": "Score",
+            "seconds": secs,
+            "weight": weight,
+            "score_mean": float(sc.mean()) if sc.size else 0.0,
+            "score_max": float(sc.max()) if sc.size else 0.0,
+        }
+        point_totals["Score"] += secs
+        if metrics is not None:
+            metrics.plugin_duration.labels(
+                plugin=plugin.name, extension_point="Score", status="Success"
+            ).observe(secs)
+
+    if metrics is not None:
+        for point, total in point_totals.items():
+            if total > 0.0:
+                metrics.extension_point_duration.labels(
+                    extension_point=point, status="Success"
+                ).observe(total)
+    return report
+
+
+def trace_cycle(cycle_fn, snap: ClusterSnapshot, log_dir: str):
+    """One fused cycle under jax.profiler (TensorBoard/Perfetto trace)."""
+    with jax.profiler.trace(log_dir):
+        out = cycle_fn(snap)
+        jax.block_until_ready(out.assignment)
+    return out

@@ -77,18 +77,25 @@ class Scheduler:
         self.config = config or SchedulerConfiguration()
         self.framework = Framework.from_config(self.config)
         self.cache = SchedulerCache(now=now)
+        self.metrics = metrics or SchedulerMetrics()
         self.queue = SchedulingQueue(
             initial_backoff_seconds=self.config.pod_initial_backoff_seconds,
             max_backoff_seconds=self.config.pod_max_backoff_seconds,
             now=now,
+            on_enqueue=lambda queue, event: self.metrics.queue_incoming.labels(
+                queue=queue, event=event
+            ).inc(),
         )
         self.binder = binder or (lambda pod, node: None)
         self.evictor = evictor or (lambda pod, node: None)
         self._now = now
         self._pad_bucket = pad_bucket
-        self.metrics = metrics or SchedulerMetrics()
         self._profile_name = self.config.profiles[0].scheduler_name
         self._groups: dict[str, PodGroup] = {}
+        # per-cycle decision log (consumed by the gRPC shim): what the last
+        # schedule_cycle nominated (preemptors) and evicted (victims)
+        self.last_nominations: list[tuple[Pod, str]] = []
+        self.last_evictions: list[tuple[Pod, str]] = []
         # ONE encoder for the scheduler's lifetime: interned string ids and
         # the resource-name axis stay stable across cycles (the encoder's
         # documented contract); only the pad sizes track the workload
@@ -110,9 +117,6 @@ class Scheduler:
             self.queue.move_all_to_active_or_backoff(EVENT_POD_ADD)
         else:
             self.queue.add(pod)
-            self.metrics.queue_incoming.labels(
-                queue="active", event=EVENT_POD_ADD
-            ).inc()
 
     def on_pod_update(self, pod: Pod, node_name: str = "") -> None:
         if node_name:
@@ -148,12 +152,17 @@ class Scheduler:
         """One batched scheduling cycle over everything ready to run."""
         t0 = self._now()
         stats = CycleStats()
+        self.last_nominations = []
+        self.last_evictions = []
         for pod in self.cache.cleanup_expired():
-            self.queue.requeue_backoff(pod)
+            self.queue.requeue_backoff(pod, event="AssumeExpired")
         self.queue.flush_unschedulable_timeout()
 
         pending = self.queue.pop_ready()
         if not pending:
+            # gauges must track deletions/moves that happen between
+            # non-empty cycles, so update them on the empty path too
+            self._update_gauges()
             return stats
         stats.attempted = len(pending)
         self.metrics.cycle_pods.observe(len(pending))
@@ -186,9 +195,16 @@ class Scheduler:
             pre = self._preempt(snap, result)
             nominated = np.asarray(pre.nominated)[: len(pending)]
             victims = np.asarray(pre.victims)[: len(existing)]
+        t_post = self._now()
+        self.metrics.cycle_duration.labels(phase="postfilter").observe(
+            t_post - t_device
+        )
 
         # ---- apply: assume + bind winners, requeue losers ----
-        per_pod_s = (self._now() - t0) / max(len(pending), 1)
+        # per-attempt latency is sampled at observation time so it includes
+        # binding (upstream attempt duration = algorithm + bind)
+        def per_pod_s() -> float:
+            return (self._now() - t0) / max(len(pending), 1)
         for i, pod in enumerate(pending):
             node_idx = int(assignment[i])
             if node_idx >= 0:
@@ -201,7 +217,7 @@ class Scheduler:
                 except ValueError:
                     stats.bind_errors += 1
                     self.metrics.observe_attempt(
-                        "error", per_pod_s, self._profile_name
+                        "error", per_pod_s(), self._profile_name
                     )
                     continue
                 t_bind = self._now()
@@ -211,11 +227,8 @@ class Scheduler:
                     self.cache.forget(pod.uid)
                     self.queue.requeue_backoff(pod)
                     stats.bind_errors += 1
-                    self.metrics.queue_incoming.labels(
-                        queue="backoff", event="BindError"
-                    ).inc()
                     self.metrics.observe_attempt(
-                        "error", per_pod_s, self._profile_name
+                        "error", per_pod_s(), self._profile_name
                     )
                     continue
                 self.metrics.binding_duration.observe(self._now() - t_bind)
@@ -225,36 +238,41 @@ class Scheduler:
                     self.queue.attempts_of(pod.uid)
                 )
                 self.metrics.observe_attempt(
-                    "scheduled", per_pod_s, self._profile_name
+                    "scheduled", per_pod_s(), self._profile_name
                 )
             else:
                 if nominated is not None and nominated[i] >= 0:
                     pod.nominated_node_name = nodes[int(nominated[i])].name
+                    self.last_nominations.append(
+                        (pod, pod.nominated_node_name)
+                    )
                     stats.preemptors += 1
                 reason = "Coscheduling" if gang_dropped[i] else ""
                 self.queue.requeue_unschedulable(pod, reason=reason)
                 stats.unschedulable += 1
-                self.metrics.queue_incoming.labels(
-                    queue="unschedulable", event="ScheduleAttemptFailure"
-                ).inc()
                 self.metrics.observe_attempt(
-                    "unschedulable", per_pod_s, self._profile_name
+                    "unschedulable", per_pod_s(), self._profile_name
                 )
 
         if victims is not None and victims.any():
             for e in np.flatnonzero(victims):
                 vpod, vnode = existing[int(e)]
                 self.evictor(vpod, vnode)
+                self.last_evictions.append((vpod, vnode))
                 stats.victims += 1
             self.metrics.preemption_victims.observe(stats.victims)
 
         stats.cycle_seconds = self._now() - t0
         self.metrics.cycle_duration.labels(phase="apply").observe(
-            stats.cycle_seconds - (t_device - t0)
+            stats.cycle_seconds - (t_post - t0)
         )
         self.metrics.cycle_duration.labels(phase="total").observe(
             stats.cycle_seconds
         )
+        self._update_gauges()
+        return stats
+
+    def _update_gauges(self) -> None:
         self.metrics.set_pending(self.queue.pending_counts())
         c = self.cache.counts()
         # upstream cache_size{type="pods"} counts every tracked pod state;
@@ -264,7 +282,27 @@ class Scheduler:
             c.get("bound", 0) + c.get("assumed", 0),
             c.get("assumed", 0),
         )
-        return stats
+
+    def profile_cycle(self, repeats: int = 3) -> dict:
+        """Sampled per-plugin observability pass (SURVEY.md §5.1): times
+        each enabled plugin's kernel in isolation over the CURRENT pending
+        set + cluster state (queue is not drained), filling the upstream
+        per-plugin/extension-point histograms. Not the hot path."""
+        from .profiling import profile_plugins
+
+        pending = list(self.queue.all_pending())
+        nodes = self.cache.nodes()
+        if not pending or not nodes:
+            return {}
+        self._encoder.pad_pods = _pad(len(pending), self._pad_bucket)
+        self._encoder.pad_nodes = _pad(len(nodes), self._pad_bucket)
+        snap = self._encoder.encode(
+            nodes,
+            pending,
+            self.cache.existing_pods(),
+            pod_groups=list(self._groups.values()),
+        )
+        return profile_plugins(self.framework, snap, self.metrics, repeats)
 
     def run(self, max_cycles: int | None = None,
             idle_sleep: float = 0.01) -> None:

@@ -85,11 +85,16 @@ class SchedulingQueue:
         max_backoff_seconds: float = 10.0,
         unschedulable_timeout_seconds: float = 300.0,
         now: Callable[[], float] = _time.monotonic,
+        on_enqueue: Callable[[str, str], None] | None = None,
     ) -> None:
         self._initial = initial_backoff_seconds
         self._max = max_backoff_seconds
         self._timeout = unschedulable_timeout_seconds
         self._now = now
+        # (queue_name, event) observer for EVERY tier entry — feeds the
+        # upstream scheduler_queue_incoming_pods_total metric; kept in the
+        # queue so no transition undercounts
+        self._on_enqueue = on_enqueue or (lambda queue, event: None)
         self._lock = threading.RLock()
         self._active: dict[str, _QueuedPod] = {}
         self._backoff: dict[str, _QueuedPod] = {}
@@ -106,6 +111,7 @@ class SchedulingQueue:
             self._backoff.pop(uid, None)
             self._unschedulable.pop(uid, None)
             self._active[uid] = _QueuedPod(pod, enqueued_at=self._now())
+            self._on_enqueue("active", EVENT_POD_ADD)
 
     def update(self, pod: Pod) -> None:
         """Spec/labels changed: an update can unstick its own pod."""
@@ -123,8 +129,10 @@ class SchedulingQueue:
                         del tier[uid]
                         if entry.backoff_expiry > self._now():
                             self._backoff[uid] = entry
+                            self._on_enqueue("backoff", EVENT_POD_UPDATE)
                         else:
                             self._active[uid] = entry
+                            self._on_enqueue("active", EVENT_POD_UPDATE)
                     return
             if uid in self._in_flight:
                 # being scheduled right now: refresh the in-flight object so
@@ -173,8 +181,9 @@ class SchedulingQueue:
             entry.enqueued_at = self._now()
             entry.backoff_expiry = self._now() + self._backoff_for(entry.attempts)
             self._unschedulable[uid] = entry
+            self._on_enqueue("unschedulable", "ScheduleAttemptFailure")
 
-    def requeue_backoff(self, pod: Pod) -> None:
+    def requeue_backoff(self, pod: Pod, event: str = "BindError") -> None:
         """Transient failure (e.g. bind error): retry after backoff."""
         with self._lock:
             uid = pod.uid
@@ -186,6 +195,7 @@ class SchedulingQueue:
             entry.pod = pod
             entry.backoff_expiry = self._now() + self._backoff_for(entry.attempts)
             self._backoff[uid] = entry
+            self._on_enqueue("backoff", event)
 
     def _backoff_for(self, attempts: int) -> float:
         return min(self._initial * (2 ** max(attempts - 1, 0)), self._max)
@@ -200,6 +210,7 @@ class SchedulingQueue:
             ]
             for u in expired:
                 self._active[u] = self._backoff.pop(u)
+                self._on_enqueue("active", "BackoffComplete")
             return len(expired)
 
     def flush_unschedulable_timeout(self) -> int:
@@ -212,7 +223,7 @@ class SchedulingQueue:
                 if now - e.enqueued_at >= self._timeout
             ]
             for u in stuck:
-                self._move_out(u)
+                self._move_out(u, EVENT_UNSCHEDULABLE_TIMEOUT)
             return len(stuck)
 
     def move_all_to_active_or_backoff(self, event: str) -> int:
@@ -225,18 +236,20 @@ class SchedulingQueue:
                 hints = QUEUEING_HINTS.get(reason)
                 if reason and hints is not None and event not in hints:
                     continue
-                self._move_out(u)
+                self._move_out(u, event)
                 moved += 1
             return moved
 
-    def _move_out(self, uid: str) -> None:
+    def _move_out(self, uid: str, event: str) -> None:
         entry = self._unschedulable.pop(uid, None)
         if entry is None:
             return
         if entry.backoff_expiry > self._now():
             self._backoff[uid] = entry
+            self._on_enqueue("backoff", event)
         else:
             self._active[uid] = entry
+            self._on_enqueue("active", event)
 
     # ---- introspection ---------------------------------------------------
 

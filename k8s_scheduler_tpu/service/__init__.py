@@ -1,0 +1,10 @@
+from .client import SchedulerAgent, SchedulerClient
+from .server import SchedulerService, add_to_server, serve
+
+__all__ = [
+    "SchedulerAgent",
+    "SchedulerClient",
+    "SchedulerService",
+    "add_to_server",
+    "serve",
+]

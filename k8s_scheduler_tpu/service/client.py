@@ -1,0 +1,234 @@
+"""Client agent for the gRPC shim.
+
+`SchedulerClient` is the raw stub (hand-written; no grpc_python_plugin in
+the image). `SchedulerAgent` is the cluster-side logic the reference keeps
+in-process: it mirrors the informer stream to the shim, carries bindings
+back, and — because the shim is stateless like upstream's scheduler
+(SURVEY.md §5.3) — recovers from a shim restart by re-listing everything it
+knows. A binding the agent fails to apply is reported as a bind_failure so
+the shim forgets the assumption and backs the pod off.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Iterator
+
+import grpc
+
+from ..models.api import Node, Pod, PodGroup
+from . import convert
+from . import scheduler_pb2 as pb
+from .server import SERVICE_NAME
+
+
+class SchedulerClient:
+    """Thin typed stub over a grpc channel."""
+
+    def __init__(self, target: str, channel: grpc.Channel | None = None) -> None:
+        self.channel = channel or grpc.insecure_channel(target)
+        mk = self.channel.unary_unary
+        self._update = mk(
+            f"/{SERVICE_NAME}/Update",
+            request_serializer=pb.UpdateRequest.SerializeToString,
+            response_deserializer=pb.UpdateResponse.FromString,
+        )
+        self._cycle = mk(
+            f"/{SERVICE_NAME}/Cycle",
+            request_serializer=pb.CycleRequest.SerializeToString,
+            response_deserializer=pb.CycleResponse.FromString,
+        )
+        self._health = mk(
+            f"/{SERVICE_NAME}/Health",
+            request_serializer=pb.HealthRequest.SerializeToString,
+            response_deserializer=pb.HealthResponse.FromString,
+        )
+        self._metrics = mk(
+            f"/{SERVICE_NAME}/Metrics",
+            request_serializer=pb.MetricsRequest.SerializeToString,
+            response_deserializer=pb.MetricsResponse.FromString,
+        )
+
+    def update(self, request: pb.UpdateRequest, timeout: float = 10.0):
+        return self._update(request, timeout=timeout)
+
+    def cycle(self, timeout: float = 120.0) -> pb.CycleResponse:
+        return self._cycle(pb.CycleRequest(), timeout=timeout)
+
+    def health(self, timeout: float = 5.0) -> pb.HealthResponse:
+        return self._health(pb.HealthRequest(), timeout=timeout)
+
+    def metrics_text(self, timeout: float = 10.0) -> bytes:
+        return self._metrics(pb.MetricsRequest(), timeout=timeout).prometheus_text
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+# bind_applier(pod_uid, pod_name, namespace, node_name) -> None; raise = failed
+BindApplier = Callable[[str, str, str, str], None]
+
+
+class SchedulerAgent:
+    """Mirrors cluster objects into the shim and applies its decisions.
+
+    Keeps a local store of every live object so a full re-list can be
+    replayed after the shim restarts (same recovery the reference gets from
+    client-go informers re-listing into a fresh scheduler process)."""
+
+    def __init__(self, client: SchedulerClient, bind_applier: BindApplier,
+                 evict_applier: Callable[[str, str], None] | None = None) -> None:
+        self.client = client
+        self.bind_applier = bind_applier
+        self.evict_applier = evict_applier or (lambda uid, node: None)
+        self._nodes: dict[str, Node] = {}
+        self._pods: dict[str, tuple[Pod, str]] = {}  # uid -> (pod, bound_node)
+        self._groups: dict[str, PodGroup] = {}
+        self._pending_failures: list[str] = []
+        self._boot_id: str | None = None  # shim incarnation last fed state
+        self._batch: pb.UpdateRequest | None = None  # open batched() request
+
+    # ---- informer-side entry points -------------------------------------
+
+    def upsert_node(self, node: Node) -> None:
+        known = node.name in self._nodes
+        self._nodes[node.name] = node
+        self._send(
+            pb.UpdateRequest(
+                **{
+                    ("node_updates" if known else "node_adds"): [
+                        convert.node_to(node)
+                    ]
+                }
+            )
+        )
+
+    def delete_node(self, name: str) -> None:
+        self._nodes.pop(name, None)
+        self._send(pb.UpdateRequest(node_deletes=[name]))
+
+    def upsert_pod(self, pod: Pod, bound_node: str = "") -> None:
+        known = pod.uid in self._pods
+        self._pods[pod.uid] = (pod, bound_node)
+        ev = pb.PodEvent(pod=convert.pod_to(pod), bound_node=bound_node)
+        self._send(
+            pb.UpdateRequest(
+                **{("pod_updates" if known else "pod_adds"): [ev]}
+            )
+        )
+
+    def delete_pod(self, uid: str) -> None:
+        self._pods.pop(uid, None)
+        self._send(pb.UpdateRequest(pod_deletes=[uid]))
+
+    def add_pod_group(self, group: PodGroup) -> None:
+        self._groups[group.name] = group
+        self._send(
+            pb.UpdateRequest(
+                pod_groups=[pb.PodGroup(name=group.name,
+                                        min_member=group.min_member)]
+            )
+        )
+
+    # ---- the cycle -------------------------------------------------------
+
+    def run_cycle(self) -> pb.CycleResponse:
+        """Flush failures, run one cycle, apply bindings/evictions."""
+        if self._pending_failures:
+            self._send(pb.UpdateRequest(bind_failures=self._pending_failures))
+            self._pending_failures = []
+        resp = self._with_recovery(self.client.cycle)
+        if self._boot_changed(resp.boot_id):
+            # the shim restarted since we fed it state and the cycle ran
+            # against an empty cache — replay everything and re-run
+            self.relist()
+            resp = self._with_recovery(self.client.cycle)
+        confirmed = pb.UpdateRequest()
+        for b in resp.bindings:
+            try:
+                self.bind_applier(
+                    b.pod_uid, b.pod_name, b.pod_namespace, b.node_name
+                )
+            except Exception:
+                self._pending_failures.append(b.pod_uid)
+                continue
+            pod, _ = self._pods.get(b.pod_uid, (None, ""))
+            if pod is not None:
+                self._pods[b.pod_uid] = (pod, b.node_name)
+                confirmed.pod_updates.append(
+                    pb.PodEvent(pod=convert.pod_to(pod), bound_node=b.node_name)
+                )
+        for ev in resp.evictions:
+            self.evict_applier(ev.pod_uid, ev.node_name)
+        if confirmed.pod_updates:
+            self._send(confirmed)
+        return resp
+
+    # ---- transport + recovery -------------------------------------------
+
+    def _boot_changed(self, boot_id: str) -> bool:
+        """Track the shim incarnation; True when a restart was detected
+        (a restarted shim at the same address answers RPCs normally but
+        holds empty state — the boot_id is the only tell)."""
+        if self._boot_id == boot_id:
+            return False
+        first = self._boot_id is None
+        self._boot_id = boot_id
+        return not first
+
+    @contextlib.contextmanager
+    def batched(self) -> Iterator[None]:
+        """Coalesce every upsert/delete inside the block into ONE Update
+        RPC — the informer re-list path would otherwise pay one round-trip
+        per object (10k pods = 10k RPCs). Nesting reuses the open batch."""
+        if self._batch is not None:
+            yield
+            return
+        self._batch = pb.UpdateRequest()
+        try:
+            yield
+            batch, self._batch = self._batch, None
+            if batch.SerializeToString():
+                self._send(batch)
+        finally:
+            self._batch = None
+
+    def _send(self, request: pb.UpdateRequest) -> None:
+        if self._batch is not None:
+            self._batch.MergeFrom(request)
+            return
+        resp = self._with_recovery(lambda: self.client.update(request))
+        if self._boot_changed(resp.boot_id):
+            # state before this delta is gone: replay everything (the delta
+            # itself was applied to the fresh shim, and relist re-sends the
+            # full store including it, which is idempotent)
+            self.relist()
+
+    def _with_recovery(self, call):
+        try:
+            return call()
+        except grpc.RpcError as e:
+            if e.code() not in (
+                grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+            ):
+                raise
+            # shim restarted (or hiccuped): replay the full state, retry once
+            self.relist()
+            return call()
+
+    def relist(self) -> None:
+        """Replay everything we know into a (possibly fresh) shim."""
+        req = pb.UpdateRequest()
+        for node in self._nodes.values():
+            req.node_adds.append(convert.node_to(node))
+        for g in self._groups.values():
+            req.pod_groups.append(
+                pb.PodGroup(name=g.name, min_member=g.min_member)
+            )
+        for pod, bound in self._pods.values():
+            req.pod_adds.append(
+                pb.PodEvent(pod=convert.pod_to(pod), bound_node=bound)
+            )
+        resp = self.client.update(req)
+        self._boot_id = resp.boot_id

@@ -1,0 +1,189 @@
+"""gRPC shim server: snapshot deltas in, bindings out.
+
+The cluster-integration boundary from SURVEY.md §7 step 7 / §5.8: where the
+reference talks HTTPS watch/Binding-POST to the API server itself, the TPU
+scheduler runs behind this service and a thin agent (client.py) owns the
+cluster store conversation. Per the north star, one `Cycle` RPC returns
+pod->node bindings for the WHOLE pending set.
+
+Bind dispatch is optimistic (upstream assume-then-bind-async): a binding
+returned from `Cycle` is assumed in the cache; the agent reports failed
+Binding POSTs in its next `Update(bind_failures=[...])`, which forgets the
+assumption and requeues with backoff. If the confirmation never arrives the
+assumed-pod TTL expires and the pod is requeued (no double-bind either way
+— fault tests in tests/test_service.py).
+
+The grpc servicer/stub glue is hand-written (the image has protoc for
+messages but no grpc_python_plugin); method handler wiring mirrors what
+grpc_tools would generate.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from concurrent import futures
+
+import grpc
+
+from ..config import SchedulerConfiguration
+from ..core.scheduler import Scheduler
+from ..models.api import PodGroup
+from . import convert
+from . import scheduler_pb2 as pb
+
+SERVICE_NAME = "k8sschedtpu.Scheduler"
+
+
+class SchedulerService:
+    """Implements the four RPCs against one host-side Scheduler."""
+
+    def __init__(self, config: SchedulerConfiguration | None = None,
+                 scheduler: Scheduler | None = None) -> None:
+        # the injectable binder collects into the in-progress response;
+        # one cycle at a time (serialized by _cycle_lock)
+        self._bindings: list[pb.Binding] = []
+        self.scheduler = scheduler or Scheduler(
+            config=config, binder=self._collect_binding
+        )
+        if scheduler is not None:
+            scheduler.binder = self._collect_binding
+        self._cycle_lock = threading.Lock()
+        self._uid_index: dict[str, object] = {}  # uid -> last seen Pod
+        # incarnation id: a restarted shim at the same address must be
+        # distinguishable from the one the agent fed state to (§5.3)
+        self.boot_id = uuid.uuid4().hex
+
+    def _collect_binding(self, pod, node_name: str) -> None:
+        self._bindings.append(
+            pb.Binding(
+                pod_uid=pod.uid,
+                pod_name=pod.name,
+                pod_namespace=pod.namespace,
+                node_name=node_name,
+            )
+        )
+
+    # ---- RPCs ------------------------------------------------------------
+
+    def Update(self, request: pb.UpdateRequest, context) -> pb.UpdateResponse:
+        s = self.scheduler
+        for n in request.node_adds:
+            s.on_node_add(convert.node_from(n))
+        for n in request.node_updates:
+            s.on_node_update(convert.node_from(n))
+        for name in request.node_deletes:
+            s.on_node_delete(name)
+        for g in request.pod_groups:
+            s.add_pod_group(PodGroup(g.name, g.min_member))
+        for ev in request.pod_adds:
+            pod = convert.pod_from(ev.pod)
+            self._uid_index[pod.uid] = pod
+            s.on_pod_add(pod, node_name=ev.bound_node)
+        for ev in request.pod_updates:
+            pod = convert.pod_from(ev.pod)
+            self._uid_index[pod.uid] = pod
+            s.on_pod_update(pod, node_name=ev.bound_node)
+        for uid in request.pod_deletes:
+            self._uid_index.pop(uid, None)
+            s.on_pod_delete(uid)
+        for uid in request.bind_failures:
+            # agent's Binding POST failed: forget + backoff (upstream
+            # handleBindingCycleError)
+            s.cache.forget(uid)
+            pod = self._uid_index.get(uid)
+            if pod is not None:
+                s.queue.requeue_backoff(pod)
+        return pb.UpdateResponse(boot_id=self.boot_id)
+
+    def Cycle(self, request: pb.CycleRequest, context) -> pb.CycleResponse:
+        with self._cycle_lock:
+            self._bindings = []
+            s = self.scheduler
+            stats = s.schedule_cycle()
+            resp = pb.CycleResponse(
+                boot_id=self.boot_id,
+                bindings=list(self._bindings),
+                stats=pb.CycleStats(
+                    attempted=stats.attempted,
+                    scheduled=stats.scheduled,
+                    unschedulable=stats.unschedulable,
+                    bind_errors=stats.bind_errors,
+                    preemptors=stats.preemptors,
+                    victims=stats.victims,
+                    gang_dropped=stats.gang_dropped,
+                    cycle_seconds=stats.cycle_seconds,
+                ),
+            )
+            # nominations + evictions were applied to host state by the
+            # driver; surface them from its per-cycle decision log
+            for pod, node in s.last_nominations:
+                resp.nominations.append(
+                    pb.Nomination(pod_uid=pod.uid, node_name=node)
+                )
+            for pod, node in s.last_evictions:
+                resp.evictions.append(
+                    pb.Eviction(
+                        pod_uid=pod.uid, pod_name=pod.name, node_name=node
+                    )
+                )
+            return resp
+
+    def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
+        return pb.HealthResponse(ok=True, status="ok", boot_id=self.boot_id)
+
+    def Metrics(self, request: pb.MetricsRequest, context) -> pb.MetricsResponse:
+        return pb.MetricsResponse(
+            prometheus_text=self.scheduler.metrics.expose()
+        )
+
+
+_RPCS = {
+    "Update": (pb.UpdateRequest, pb.UpdateResponse),
+    "Cycle": (pb.CycleRequest, pb.CycleResponse),
+    "Health": (pb.HealthRequest, pb.HealthResponse),
+    "Metrics": (pb.MetricsRequest, pb.MetricsResponse),
+}
+
+
+def add_to_server(servicer: SchedulerService, server: grpc.Server) -> None:
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req.FromString,
+            response_serializer=resp.SerializeToString,
+        )
+        for name, (req, resp) in _RPCS.items()
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+def serve(
+    address: str = "127.0.0.1:50051",
+    config: SchedulerConfiguration | None = None,
+    max_workers: int = 4,
+) -> tuple[grpc.Server, SchedulerService, int]:
+    """Start the shim; returns (server, servicer, bound_port)."""
+    service = SchedulerService(config=config)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    add_to_server(service, server)
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, service, port
+
+
+def main() -> None:  # pragma: no cover - exercised via the CLI
+    import argparse
+
+    ap = argparse.ArgumentParser(description="TPU scheduler gRPC shim")
+    ap.add_argument("--address", default="127.0.0.1:50051")
+    args = ap.parse_args()
+    server, _, port = serve(args.address)
+    print(f"scheduler shim listening on port {port}", flush=True)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,88 @@
+"""Process layer (SURVEY.md §2 C1, §5.3/§5.5): flags, health/metrics
+endpoints, and file-lease leader election."""
+
+import json
+import multiprocessing
+import os
+import time
+import urllib.request
+
+from k8s_scheduler_tpu.cmd import new_scheduler_command
+from k8s_scheduler_tpu.cmd.httpserver import start_http_server
+from k8s_scheduler_tpu.cmd.leaderelection import FileLease
+from k8s_scheduler_tpu.metrics import SchedulerMetrics
+
+
+def test_flag_surface_matches_upstream_names():
+    ap = new_scheduler_command()
+    args = ap.parse_args(
+        ["--config", "x.yaml", "--leader-elect", "--http-port", "0"]
+    )
+    assert args.config == "x.yaml"
+    assert args.leader_elect
+    assert args.http_port == 0
+
+
+def test_http_endpoints_serve_health_and_metrics():
+    m = SchedulerMetrics()
+    m.decisions.inc(42)
+    server = start_http_server(m, port=0, healthz=lambda: (True, {"x": 1}))
+    port = server.server_address[1]
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz") as r:
+            body = json.loads(r.read())
+            assert body["ok"] and body["x"] == 1
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+            text = r.read().decode()
+            assert "scheduler_pod_node_decisions_total 42.0" in text
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope")
+            assert False, "404 expected"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.shutdown()
+
+
+def _hold_lease(path, hold_seconds, acquired):
+    lease = FileLease(path, identity="other")
+    assert lease.try_acquire()
+    acquired.set()
+    time.sleep(hold_seconds)
+    lease.release()
+
+
+def test_file_lease_single_holder(tmp_path):
+    path = str(tmp_path / "lease")
+    acquired = multiprocessing.Event()
+    proc = multiprocessing.Process(
+        target=_hold_lease, args=(path, 1.5, acquired)
+    )
+    proc.start()
+    try:
+        assert acquired.wait(10)
+        mine = FileLease(path, identity="me")
+        # flock is held by the other PROCESS: try_acquire must fail
+        assert not mine.try_acquire()
+        holder = mine.holder()
+        assert holder and holder["holderIdentity"] == "other"
+        # blocks until the holder releases, then wins
+        assert mine.acquire(timeout=10)
+        assert mine.is_leader()
+        mine.release()
+        assert not mine.is_leader()
+    finally:
+        proc.join(timeout=10)
+
+
+def test_lease_heartbeat_renews(tmp_path):
+    path = str(tmp_path / "lease")
+    lease = FileLease(path, identity="hb", renew_seconds=0.05)
+    assert lease.try_acquire()
+    try:
+        first = lease.holder()["renewTime"]
+        lease.start_renewing()
+        time.sleep(0.3)
+        assert lease.holder()["renewTime"] > first
+    finally:
+        lease.release()

@@ -104,6 +104,60 @@ def test_bind_error_and_unschedulable_results():
     )
 
 
+def test_profile_cycle_fills_per_plugin_histograms():
+    m = SchedulerMetrics()
+    sched = Scheduler(metrics=m)
+    for nd in make_cluster(4):
+        sched.on_node_add(nd)
+    for p in make_pods(8, anti_affinity_fraction=0.5):
+        sched.on_pod_add(p)
+    report = sched.profile_cycle(repeats=1)
+    # NodeResourcesFit is dynamic-only (fit runs in the commit scan), so
+    # the static profile covers plugins with standalone kernels
+    assert "NodeName/Filter" in report
+    assert any(k.endswith("/Score") for k in report)
+    for entry in report.values():
+        assert entry["seconds"] >= 0.0
+    nn = report["NodeName/Filter"]
+    assert 0.0 < nn["feasible_fraction"] <= 1.0
+    assert (
+        _sample(
+            m,
+            "scheduler_plugin_execution_duration_seconds_count",
+            {
+                "plugin": "NodeName",
+                "extension_point": "Filter",
+                "status": "Success",
+            },
+        )
+        == 1
+    )
+    assert (
+        _sample(
+            m,
+            "scheduler_framework_extension_point_duration_seconds_count",
+            {"extension_point": "Filter", "status": "Success"},
+        )
+        == 1
+    )
+
+
+def test_gauges_update_on_empty_cycles():
+    m = SchedulerMetrics()
+    sched = Scheduler(metrics=m)
+    for nd in make_cluster(2):
+        sched.on_node_add(nd)
+    huge = make_pods(1)
+    huge[0].spec.containers[0].requests["cpu"] = 10_000_000.0
+    sched.on_pod_add(huge[0])
+    sched.schedule_cycle()
+    assert _sample(m, "scheduler_pending_pods", {"queue": "unschedulable"}) == 1
+    # pod deleted while idle: the next (empty) cycle must clear the gauge
+    sched.on_pod_delete(huge[0].uid)
+    sched.schedule_cycle()
+    assert _sample(m, "scheduler_pending_pods", {"queue": "unschedulable"}) == 0
+
+
 def test_registries_are_isolated():
     a, b = SchedulerMetrics(), SchedulerMetrics()
     a.decisions.inc(5)

@@ -1,0 +1,223 @@
+"""gRPC shim tests (SURVEY.md §7 step 7): end-to-end over a real grpc
+channel on localhost, plus the fault-tolerance contract from §5.3 —
+shim restart recovers via agent re-list, bind failures forget+backoff,
+and no pod is ever double-bound."""
+
+import grpc
+import pytest
+
+from k8s_scheduler_tpu.models import MakeNode, MakePod
+from k8s_scheduler_tpu.models.api import PodGroup
+from k8s_scheduler_tpu.service import (
+    SchedulerAgent,
+    SchedulerClient,
+    serve,
+)
+from k8s_scheduler_tpu.service import convert
+from k8s_scheduler_tpu.service import scheduler_pb2 as pb
+
+
+# ---- conversion round-trips ------------------------------------------------
+
+
+def test_pod_proto_roundtrip_preserves_scheduling_fields():
+    pod = (
+        MakePod("web-1", namespace="prod")
+        .req({"cpu": "500m", "memory": "1Gi"})
+        .labels({"app": "web"})
+        .priority(7)
+        .node_selector({"disk": "ssd"})
+        .toleration("dedicated", "gpu", "NoSchedule")
+        .pod_affinity("topology.kubernetes.io/zone", {"app": "cache"})
+        .pod_affinity("kubernetes.io/hostname", {"app": "web"}, anti=True)
+        .spread(2, "topology.kubernetes.io/zone", {"app": "web"})
+        .host_port(8080)
+        .group("gang-a")
+        .obj()
+    )
+    back = convert.pod_from(convert.pod_to(pod))
+    assert back.uid == pod.uid
+    assert back.resource_requests() == pod.resource_requests()
+    assert back.spec.priority == 7
+    assert back.spec.node_selector == {"disk": "ssd"}
+    assert back.spec.tolerations == pod.spec.tolerations
+    assert back.spec.affinity == pod.spec.affinity
+    assert (
+        back.spec.topology_spread_constraints
+        == pod.spec.topology_spread_constraints
+    )
+    assert back.host_ports() == pod.host_ports()
+    assert back.spec.pod_group == "gang-a"
+
+
+def test_node_proto_roundtrip():
+    node = (
+        MakeNode("n1")
+        .capacity({"cpu": "16", "memory": "32Gi"})
+        .labels({"topology.kubernetes.io/zone": "zone-a"})
+        .taint("dedicated", "gpu")
+        .obj()
+    )
+    back = convert.node_from(convert.node_to(node))
+    assert back.name == "n1"
+    assert back.status.allocatable == node.status.allocatable
+    assert back.spec.taints == node.spec.taints
+    assert back.metadata.labels == node.metadata.labels
+
+
+# ---- end-to-end over localhost ---------------------------------------------
+
+
+class Applier:
+    """Fake cluster-side bind applier."""
+
+    def __init__(self):
+        self.bound = {}
+        self.fail_uids = set()
+        self.evicted = []
+
+    def bind(self, uid, name, namespace, node_name):
+        if uid in self.fail_uids:
+            raise RuntimeError("binding POST failed")
+        assert uid not in self.bound, f"double bind of {uid}"
+        self.bound[uid] = node_name
+
+    def evict(self, uid, node_name):
+        self.evicted.append(uid)
+
+
+@pytest.fixture()
+def shim():
+    server, service, port = serve("127.0.0.1:0")
+    client = SchedulerClient(f"127.0.0.1:{port}")
+    yield server, service, client
+    client.close()
+    server.stop(grace=None)
+
+
+def test_service_schedules_over_the_wire(shim):
+    _, _, client = shim
+    applier = Applier()
+    agent = SchedulerAgent(client, applier.bind, applier.evict)
+    for i in range(3):
+        agent.upsert_node(MakeNode(f"n{i}").capacity({"cpu": "8"}).obj())
+    for i in range(6):
+        agent.upsert_pod(MakePod(f"p{i}").req({"cpu": "1"}).obj())
+    resp = agent.run_cycle()
+    assert resp.stats.scheduled == 6
+    assert len(applier.bound) == 6
+    assert set(applier.bound.values()) <= {"n0", "n1", "n2"}
+    # second cycle: nothing pending
+    assert agent.run_cycle().stats.attempted == 0
+    assert client.health().ok
+    assert b"scheduler_schedule_attempts_total" in client.metrics_text()
+
+
+def test_bind_failure_forgets_and_retries(shim):
+    _, _, client = shim
+    applier = Applier()
+    agent = SchedulerAgent(client, applier.bind, applier.evict)
+    agent.upsert_node(MakeNode("n0").capacity({"cpu": "8"}).obj())
+    pod = MakePod("p").req({"cpu": "1"}).obj()
+    agent.upsert_pod(pod)
+    applier.fail_uids.add(pod.uid)
+    resp = agent.run_cycle()
+    assert len(resp.bindings) == 1 and not applier.bound
+    # the failure report goes out with the next cycle; backoff applies, so
+    # drive cycles until the pod comes back (initial backoff 1s is too long
+    # for a test -> flush by event instead: a node update unsticks nothing
+    # in backoff; wait out via repeated cycles is flaky. Use the queue
+    # directly through the service's scheduler for determinism.)
+    applier.fail_uids.clear()
+    service = shim[1]
+    agent.run_cycle()  # reports the failure; pod now in backoff
+    assert not service.scheduler.cache.is_assumed(pod.uid)
+    # force the backoff to expire deterministically
+    for e in service.scheduler.queue._backoff.values():
+        e.backoff_expiry = 0.0
+    resp = agent.run_cycle()
+    assert resp.stats.scheduled == 1
+    assert applier.bound[pod.uid] == "n0"
+
+
+def test_gang_scheduling_over_the_wire(shim):
+    _, _, client = shim
+    applier = Applier()
+    agent = SchedulerAgent(client, applier.bind, applier.evict)
+    agent.upsert_node(MakeNode("n0").capacity({"cpu": "4", "pods": "110"}).obj())
+    agent.add_pod_group(PodGroup("gang", 3))
+    for i in range(3):
+        agent.upsert_pod(
+            MakePod(f"g{i}").req({"cpu": "2"}).group("gang").obj()
+        )
+    resp = agent.run_cycle()
+    # only 2 of 3 fit -> all-or-nothing unwind, nothing binds
+    assert resp.stats.scheduled == 0
+    assert resp.stats.gang_dropped == 2
+    assert not applier.bound
+
+
+def test_batched_updates_coalesce_into_one_rpc(shim):
+    _, service, client = shim
+    applier = Applier()
+    agent = SchedulerAgent(client, applier.bind, applier.evict)
+    calls = {"n": 0}
+    orig = client.update
+
+    def counting_update(req, timeout=10.0):
+        calls["n"] += 1
+        return orig(req, timeout=timeout)
+
+    client.update = counting_update
+    with agent.batched():
+        for i in range(4):
+            agent.upsert_node(MakeNode(f"n{i}").capacity({"cpu": "8"}).obj())
+        for i in range(20):
+            agent.upsert_pod(MakePod(f"p{i}").req({"cpu": "1"}).obj())
+    assert calls["n"] == 1  # 24 objects, one RPC
+    resp = agent.run_cycle()
+    assert resp.stats.scheduled == 20
+
+
+def test_shim_restart_recovers_without_double_bind(shim):
+    server, _, client = shim
+    applier = Applier()
+    agent = SchedulerAgent(client, applier.bind, applier.evict)
+    agent.upsert_node(MakeNode("n0").capacity({"cpu": "8"}).obj())
+    agent.upsert_pod(MakePod("a").req({"cpu": "1"}).obj())
+    resp = agent.run_cycle()
+    assert resp.stats.scheduled == 1 and len(applier.bound) == 1
+
+    # kill the shim mid-flight and bring up a fresh one (new state)
+    server.stop(grace=None)
+    new_server, new_service, new_port = serve("127.0.0.1:0")
+    try:
+        agent.client = SchedulerClient(f"127.0.0.1:{new_port}")
+        # agent notices the restart on the next call and re-lists; the
+        # bound pod is replayed WITH its binding, the new pod without
+        agent.upsert_pod(MakePod("b").req({"cpu": "1"}).obj())
+        resp = agent.run_cycle()
+        # restart must not re-schedule pod a (it is bound state, not
+        # pending) — only b binds, and the applier asserts no double-bind
+        assert resp.stats.scheduled == 1
+        assert set(applier.bound) == {"default/a", "default/b"}
+        assert new_service.scheduler.cache.counts()["bound"] >= 1
+    finally:
+        agent.client.close()
+        new_server.stop(grace=None)
+
+
+def test_preemption_over_the_wire(shim):
+    _, _, client = shim
+    applier = Applier()
+    agent = SchedulerAgent(client, applier.bind, applier.evict)
+    agent.upsert_node(MakeNode("n0").capacity({"cpu": "2", "pods": "110"}).obj())
+    victim = MakePod("victim").req({"cpu": "2"}).priority(1).obj()
+    agent.upsert_pod(victim, bound_node="n0")
+    urgent = MakePod("urgent").req({"cpu": "2"}).priority(10).obj()
+    agent.upsert_pod(urgent)
+    resp = agent.run_cycle()
+    assert resp.stats.scheduled == 0
+    assert [n.pod_uid for n in resp.nominations] == [urgent.uid]
+    assert [e.pod_uid for e in resp.evictions] == [victim.uid]
+    assert applier.evicted == [victim.uid]
