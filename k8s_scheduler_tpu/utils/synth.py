@@ -54,11 +54,16 @@ def make_pods(
     toleration_fraction: float = 0.0,
     spread_fraction: float = 0.0,
     priorities: tuple[int, ...] = (0,),
+    num_apps: int = 20,
 ) -> list[Pod]:
+    """`num_apps` controls how many distinct `app` labels (and therefore
+    distinct affinity selectors) the workload carries — the S axis of the
+    affinity state; real clusters run one selector per deployment, so
+    realistic scale tests want num_apps in the hundreds."""
     rng = np.random.default_rng(seed)
     pods = []
     for i in range(num_pods):
-        app = f"app-{int(rng.integers(0, 20))}"
+        app = f"app-{int(rng.integers(0, num_apps))}"
         b = (
             MakePod(f"{name_prefix}-{i}")
             .req(

@@ -1,0 +1,110 @@
+"""Diagnose the config-#4 compile blowup: time trace / compile / first-run
+separately for the affinity-enabled cycle at increasing pod counts.
+
+Usage: python scripts/diag_compile.py P N [flags]
+  flags: noaff nospread noanti cpu apps=<num_distinct_apps> exist=<frac>
+  Unknown flags are an error. `cpu` flips to the CPU backend post-import
+  (the documented-safe way; exporting JAX_PLATFORMS=cpu hangs sitecustomize).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from k8s_scheduler_tpu.core import build_cycle_fn
+from k8s_scheduler_tpu.models import SnapshotEncoder
+from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+
+def main() -> None:
+    p_real = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+    n_real = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    flags = set(sys.argv[3:])
+    num_apps, exist_frac = 20, 0.0
+    known = {"noaff", "nointer", "noanti", "nospread", "cpu"}
+    for f in list(flags):
+        if f.startswith("apps="):
+            num_apps = int(f.split("=")[1])
+            flags.discard(f)
+        elif f.startswith("exist="):
+            exist_frac = float(f.split("=")[1])
+            flags.discard(f)
+        elif f not in known:
+            sys.exit(f"unknown flag: {f!r} (known: {sorted(known)}, apps=N, exist=F)")
+    if "cpu" in flags:
+        jax.config.update("jax_platforms", "cpu")
+    aff = 0.0 if ("noaff" in flags or "nointer" in flags) else 0.3
+    anti = 0.0 if ("noaff" in flags or "noanti" in flags) else 0.2
+    spread = 0.0 if ("noaff" in flags or "nospread" in flags) else 0.2
+
+    t0 = time.time()
+    nodes = make_cluster(n_real, with_labels=True, taint_fraction=0.1)
+    pods = make_pods(
+        p_real,
+        affinity_fraction=aff,
+        anti_affinity_fraction=anti,
+        spread_fraction=spread,
+        selector_fraction=0.3,
+        toleration_fraction=0.1,
+        priorities=(0, 0, 0, 100),
+        num_apps=num_apps,
+    )
+    existing = []
+    if exist_frac:
+        rng = np.random.default_rng(7)
+        epods = make_pods(
+            int(p_real * exist_frac),
+            seed=9,
+            name_prefix="run",
+            affinity_fraction=aff,
+            anti_affinity_fraction=anti,
+            spread_fraction=spread,
+            num_apps=num_apps,
+        )
+        existing = [
+            (p, f"node-{int(rng.integers(0, n_real))}") for p in epods
+        ]
+    print(f"synth: {time.time() - t0:.1f}s", flush=True)
+
+    t0 = time.time()
+    snap = SnapshotEncoder().encode(nodes, pods, existing)
+    print(
+        f"encode: {time.time() - t0:.1f}s  P={snap.P} N={snap.N} E={snap.E} "
+        f"S={snap.sel_exprs.shape[0]} D={snap.domain_key.shape[0]} "
+        f"Ex={snap.ex_key.shape[0]} MA={snap.pod_aff_terms.shape[1]} "
+        f"aff={snap.has_inter_pod_affinity} spread={snap.has_topology_spread}",
+        flush=True,
+    )
+
+    cycle = build_cycle_fn()
+    t0 = time.time()
+    lowered = cycle.lower(snap)
+    print(f"trace/lower: {time.time() - t0:.1f}s", flush=True)
+
+    t0 = time.time()
+    compiled = lowered.compile()
+    print(f"compile: {time.time() - t0:.1f}s", flush=True)
+
+    t0 = time.time()
+    res = compiled(snap)
+    jax.block_until_ready(res.assignment)
+    print(f"first run: {time.time() - t0:.2f}s", flush=True)
+
+    for _ in range(3):
+        t0 = time.time()
+        res = compiled(snap)
+        jax.block_until_ready(res.assignment)
+        print(f"steady run: {(time.time() - t0) * 1e3:.1f}ms", flush=True)
+    a = np.asarray(res.assignment)
+    print(f"scheduled {(a >= 0).sum()} / {p_real}")
+
+
+if __name__ == "__main__":
+    main()
