@@ -41,6 +41,9 @@ class CycleResult:
     static_mask: jnp.ndarray  # bool [P, N] framework static feasibility —
     # returned so the PostFilter pass reuses it instead of re-running the
     # whole static filter pipeline
+    reject_counts: jnp.ndarray  # i32 [P, F] nodes first-rejected per filter
+    # (static + dynamic attribution summed; columns = Framework.filter_names)
+    # — feeds FailedScheduling events and requeue queueing hints
 
 
 def build_cycle_fn(
@@ -62,7 +65,7 @@ def build_cycle_fn(
     @jax.jit
     def cycle(snap: ClusterSnapshot) -> CycleResult:
         ctx = CycleContext(snap)
-        smask, sscore = fw.static(ctx)
+        smask, sscore, srejects = fw.static(ctx)
         extra = fw.extra_init(ctx)
 
         def dyn_fn(p, node_req, ext, static_row):
@@ -105,7 +108,8 @@ def build_cycle_fn(
             )
         unsched = snap.pod_valid & (result.assignment < 0)
         return CycleResult(
-            result.assignment, result.node_requested, unsched, dropped, smask
+            result.assignment, result.node_requested, unsched, dropped, smask,
+            srejects + result.dyn_aux,
         )
 
     return cycle

@@ -40,6 +40,7 @@ from ..internal.queue import (
 from ..models.api import Node, Pod, PodGroup
 from ..models.encoding import SnapshotEncoder
 from .cycle import build_cycle_fn, build_preemption_fn
+from .events import EventRecorder, failed_scheduling_message
 
 # binder(pod, node_name) -> None; raise to signal bind failure
 Binder = Callable[[Pod, str], None]
@@ -73,6 +74,7 @@ class Scheduler:
         now: Callable[[], float] = _time.monotonic,
         pad_bucket: int = 64,
         metrics: SchedulerMetrics | None = None,
+        events: EventRecorder | None = None,
     ) -> None:
         self.config = config or SchedulerConfiguration()
         self.framework = Framework.from_config(self.config)
@@ -88,6 +90,7 @@ class Scheduler:
         )
         self.binder = binder or (lambda pod, node: None)
         self.evictor = evictor or (lambda pod, node: None)
+        self.events = events or EventRecorder()
         self._now = now
         self._pad_bucket = pad_bucket
         self._profile_name = self.config.profiles[0].scheduler_name
@@ -182,6 +185,8 @@ class Scheduler:
         result = self._cycle(snap)
         assignment = np.asarray(result.assignment)[: len(pending)]
         gang_dropped = np.asarray(result.gang_dropped)[: len(pending)]
+        reject_counts = np.asarray(result.reject_counts)[: len(pending)]
+        filter_names = self.framework.filter_names
         stats.gang_dropped = int(gang_dropped.sum())
         t_device = self._now()
         self.metrics.cycle_duration.labels(phase="device").observe(
@@ -233,6 +238,7 @@ class Scheduler:
                     continue
                 self.metrics.binding_duration.observe(self._now() - t_bind)
                 self.cache.finish_binding(pod.uid)
+                self.events.scheduled(pod, node_name)
                 stats.scheduled += 1
                 self.metrics.pod_scheduling_attempts.observe(
                     self.queue.attempts_of(pod.uid)
@@ -247,18 +253,43 @@ class Scheduler:
                         (pod, pod.nominated_node_name)
                     )
                     stats.preemptors += 1
-                reason = "Coscheduling" if gang_dropped[i] else ""
-                self.queue.requeue_unschedulable(pod, reason=reason)
+                if gang_dropped[i]:
+                    reasons = ("Coscheduling",)
+                    message = (
+                        f"pod group {pod.spec.pod_group!r} did not reach "
+                        "minMember; all-or-nothing placement rolled back"
+                    )
+                else:
+                    per_plugin = list(zip(filter_names, reject_counts[i]))
+                    reasons = tuple(
+                        name for name, n in per_plugin if n > 0
+                    )
+                    message = failed_scheduling_message(
+                        len(nodes), per_plugin
+                    )
+                for r in reasons:
+                    self.metrics.unschedulable_reasons.labels(
+                        plugin=r, profile=self._profile_name
+                    ).inc()
+                self.events.failed_scheduling(pod, message)
+                self.queue.requeue_unschedulable(pod, reasons=reasons)
                 stats.unschedulable += 1
                 self.metrics.observe_attempt(
                     "unschedulable", per_pod_s(), self._profile_name
                 )
 
         if victims is not None and victims.any():
+            # victims belong to the preemptor nominated onto their node
+            preemptor_by_node = {
+                node: pod.name for pod, node in self.last_nominations
+            }
             for e in np.flatnonzero(victims):
                 vpod, vnode = existing[int(e)]
                 self.evictor(vpod, vnode)
                 self.last_evictions.append((vpod, vnode))
+                self.events.preempted(
+                    vpod, preemptor_by_node.get(vnode, "<pending>")
+                )
                 stats.victims += 1
             self.metrics.preemption_victims.observe(stats.victims)
 

@@ -72,19 +72,38 @@ class Framework:
 
     # ---- trace-time assembly (called inside jit) ----
 
-    def static(self, ctx: CycleContext) -> tuple[jnp.ndarray, jnp.ndarray]:
+    @property
+    def filter_names(self) -> list[str]:
+        """Column names of the per-pod reject-count tables (filter order =
+        upstream Filter execution order = first-rejector attribution)."""
+        return [f.name for f in self.filters]
+
+    def static(
+        self, ctx: CycleContext
+    ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Batched static masks/scores plus per-pod reject attribution.
+
+        Returns (mask [P,N], score [P,N], rejects i32 [P,F]) where
+        rejects[p,i] counts the nodes FIRST rejected for pod p by filter i —
+        the batched analogue of upstream's per-node "first failing plugin"
+        Status that feeds FailedScheduling events and queueing hints."""
         snap = ctx.snap
         mask = jnp.broadcast_to(snap.node_valid[None, :], (snap.P, snap.N))
+        rejects = []
         for f in self.filters:
             m = f.static_mask(ctx)
-            if m is not None:
+            if m is None:
+                rejects.append(jnp.zeros((snap.P,), jnp.int32))
+            else:
+                newly = mask & ~m
+                rejects.append(jnp.sum(newly, axis=1, dtype=jnp.int32))
                 mask = mask & m
         score = jnp.zeros((snap.P, snap.N), jnp.float32)
         for s, w in self.scores:
             v = s.static_score(ctx)
             if v is not None:
                 score = score + w * v
-        return mask, score
+        return mask, score, jnp.stack(rejects, axis=1)
 
     def _stateful_plugins(self) -> list[PluginBase]:
         # a plugin enabled at several points (e.g. InterPodAffinity filter +
@@ -103,11 +122,20 @@ class Framework:
         return extra
 
     def dyn(self, ctx: CycleContext, p, node_requested, extra, static_row):
+        """Returns (mask [N], score [N], rejects i32 [F]) — `rejects[i]`
+        counts nodes first rejected by filter i's DYNAMIC mask at this scan
+        step (nodes already statically rejected are attributed by
+        `static`; the two tables add up per filter name)."""
         snap = ctx.snap
         mask = static_row
+        rejects = []
         for f in self.filters:
             m = f.dyn_mask(ctx, p, node_requested, extra)
-            if m is not None:
+            if m is None:
+                rejects.append(jnp.int32(0))
+            else:
+                newly = mask & ~m
+                rejects.append(jnp.sum(newly, dtype=jnp.int32))
                 mask = mask & m
         score = jnp.zeros((snap.N,), jnp.float32)
         for s, w in self.scores:
@@ -117,7 +145,7 @@ class Framework:
             v = s.dyn_score(ctx, p, node_requested, extra, mask)
             if v is not None:
                 score = score + w * v
-        return mask, score
+        return mask, score, jnp.stack(rejects)
 
     def extra_update(self, ctx: CycleContext, extra, p, node, committed):
         out = dict(extra)

@@ -31,7 +31,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time as _time
-from typing import Callable, Iterable
+from typing import Callable, Iterable, Sequence
 
 from ..models.api import Pod
 
@@ -74,7 +74,10 @@ class _QueuedPod:
     pod: Pod
     attempts: int = 0  # scheduling attempts so far (drives backoff length)
     backoff_expiry: float = 0.0
-    unschedulable_reason: str = ""  # plugin that rejected it ("" = unknown)
+    # plugins that rejected it (() = unknown -> requeue on any event). A pod
+    # requeues when the event can cure ANY of its reasons (upstream: the
+    # union of the failed plugins' EventsToRegister hints).
+    unschedulable_reasons: tuple[str, ...] = ()
     enqueued_at: float = 0.0
 
 
@@ -165,19 +168,26 @@ class SchedulingQueue:
             self._active.clear()
             return ready
 
-    def requeue_unschedulable(self, pod: Pod, reason: str = "") -> None:
+    def requeue_unschedulable(
+        self, pod: Pod, reasons: Sequence[str] | str = ()
+    ) -> None:
         """Cycle found no node (AddUnschedulableIfNotPresent). Goes to the
         unschedulable tier to wait for an event; backoff still advances so
-        an event-triggered retry honors it."""
+        an event-triggered retry honors it. `reasons` names the rejecting
+        plugins (drives the queueing-hint check on later events)."""
+        if isinstance(reasons, str):
+            reasons = (reasons,) if reasons else ()
         with self._lock:
             uid = pod.uid
             if uid in self._deleted_in_flight:
                 self._deleted_in_flight.discard(uid)
                 self._in_flight.pop(uid, None)
                 return
+            self._active.pop(uid, None)
+            self._backoff.pop(uid, None)
             entry = self._in_flight.pop(uid, None) or _QueuedPod(pod)
             entry.pod = pod
-            entry.unschedulable_reason = reason
+            entry.unschedulable_reasons = tuple(reasons)
             entry.enqueued_at = self._now()
             entry.backoff_expiry = self._now() + self._backoff_for(entry.attempts)
             self._unschedulable[uid] = entry
@@ -191,6 +201,8 @@ class SchedulingQueue:
                 self._deleted_in_flight.discard(uid)
                 self._in_flight.pop(uid, None)
                 return
+            self._active.pop(uid, None)
+            self._unschedulable.pop(uid, None)
             entry = self._in_flight.pop(uid, None) or _QueuedPod(pod)
             entry.pod = pod
             entry.backoff_expiry = self._now() + self._backoff_for(entry.attempts)
@@ -232,9 +244,11 @@ class SchedulingQueue:
         with self._lock:
             moved = 0
             for u in list(self._unschedulable):
-                reason = self._unschedulable[u].unschedulable_reason
-                hints = QUEUEING_HINTS.get(reason)
-                if reason and hints is not None and event not in hints:
+                reasons = self._unschedulable[u].unschedulable_reasons
+                if reasons and not any(
+                    event in QUEUEING_HINTS.get(r, frozenset({event}))
+                    for r in reasons
+                ):
                     continue
                 self._move_out(u, event)
                 moved += 1

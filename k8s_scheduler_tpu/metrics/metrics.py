@@ -158,6 +158,13 @@ class SchedulerMetrics:
             "cycle) — the north-star throughput numerator.",
             registry=r,
         )
+        self.unschedulable_reasons = Counter(
+            "scheduler_unschedulable_reasons_total",
+            "Unschedulable attempts by first-rejecting plugin (per-pod "
+            "failure attribution from the batched cycle).",
+            ["plugin", "profile"],
+            registry=r,
+        )
 
     # ---- convenience recorders ------------------------------------------
 

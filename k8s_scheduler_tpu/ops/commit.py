@@ -35,6 +35,9 @@ NEG_INF = -1e9
 
 # dyn_fn(pod_idx, node_requested [N,R], extra, static_row [N] bool)
 #   -> (full feasibility mask [N] bool, score [N] f32)
+#   or (mask, score, aux) — aux is any pytree emitted per step (e.g.
+#   per-filter reject counts for failure attribution); stacked over the
+#   pod axis into CommitResult.dyn_aux
 # The static row is passed IN so score hooks that normalize across nodes
 # (inter-pod affinity, topology spread) can normalize over feasible nodes
 # only, like upstream NormalizeScore running after Filter.
@@ -52,6 +55,7 @@ class CommitResult:
     assignment: jnp.ndarray  # i32 [P] node index or -1
     node_requested: jnp.ndarray  # f32 [N, R] post-commit
     extra: Any  # final hook state (e.g. running domain counts)
+    dyn_aux: Any = None  # per-pod stacked dyn_fn aux (None w/ 2-tuple dyn_fn)
 
 
 def greedy_commit(
@@ -73,7 +77,9 @@ def greedy_commit(
     def step(carry, rank):
         node_req, ext = carry
         p = order[rank]
-        feasible, dyn_score = dyn_fn(p, node_req, ext, static_mask[p])
+        out = dyn_fn(p, node_req, ext, static_mask[p])
+        feasible, dyn_score = out[0], out[1]
+        aux = out[2] if len(out) > 2 else jnp.int32(0)
         # dyn_fn is expected to fold the static row in (it needs it for
         # normalize-over-feasible scoring); AND it again here so a dyn_fn
         # that ignores its 4th arg can never bypass static filters
@@ -92,13 +98,17 @@ def greedy_commit(
         )
         if update_fn is not None:
             ext = update_fn(ext, p, best, ok)
-        return (node_req, ext), (p, node)
+        return (node_req, ext), (p, node, aux)
 
-    (node_req_final, extra_final), (pods, assigned) = jax.lax.scan(
+    (node_req_final, extra_final), (pods, assigned, auxs) = jax.lax.scan(
         step, (node_requested, extra), jnp.arange(P, dtype=jnp.int32)
     )
     assignment = jnp.zeros(P, jnp.int32).at[pods].set(assigned)
-    return CommitResult(assignment, node_req_final, extra_final)
+    # ys arrive in rank order; re-scatter to pod order like `assignment`
+    dyn_aux = jax.tree_util.tree_map(
+        lambda a: jnp.zeros_like(a).at[pods].set(a), auxs
+    )
+    return CommitResult(assignment, node_req_final, extra_final, dyn_aux)
 
 
 def unwind_assignments(
@@ -119,4 +129,4 @@ def unwind_assignments(
         jnp.where(undo[:, None], -pod_requested, 0.0)
     )
     assignment = jnp.where(undo, -1, result.assignment)
-    return CommitResult(assignment, node_req, result.extra)
+    return CommitResult(assignment, node_req, result.extra, result.dyn_aux)

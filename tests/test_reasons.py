@@ -1,0 +1,120 @@
+"""Per-pod failure reasons, wired end-to-end (SURVEY.md §3.3, §5.5): the
+cycle attributes every rejected node to the first rejecting filter plugin
+(upstream's per-node Status), the scheduler turns the counts into
+FailedScheduling events + queueing-hint reasons, and the queue only
+requeues on events that can cure one of the pod's reasons."""
+
+import numpy as np
+
+from k8s_scheduler_tpu.core import Scheduler
+from k8s_scheduler_tpu.core.events import FAILED_SCHEDULING, SCHEDULED
+from k8s_scheduler_tpu.internal.queue import (
+    EVENT_NODE_UPDATE,
+    EVENT_POD_DELETE,
+)
+from k8s_scheduler_tpu.models import MakeNode, MakePod
+
+from test_scheduler_host import FakeClock, make_scheduler
+
+
+def test_reject_counts_attribute_first_rejecting_plugin():
+    """Three nodes, three distinct rejections: a cordoned node
+    (NodeUnschedulable), a label mismatch (NodeAffinity), and a full node
+    (NodeResourcesFit) — each attributed to its plugin, filter order
+    deciding ties like upstream's first failing Status."""
+    sched, cluster, clock = make_scheduler()
+    sched.on_node_add(
+        MakeNode("cordoned").capacity({"cpu": "8"}).labels({"disk": "ssd"})
+        .unschedulable().obj()
+    )
+    sched.on_node_add(
+        MakeNode("wrong-label").capacity({"cpu": "8"}).obj()
+    )
+    sched.on_node_add(
+        MakeNode("full").capacity({"cpu": "1"}).labels({"disk": "ssd"}).obj()
+    )
+    pod = (
+        MakePod("p").req({"cpu": "4"}).node_selector({"disk": "ssd"}).obj()
+    )
+    sched.on_pod_add(pod)
+    stats = sched.schedule_cycle()
+    assert stats.unschedulable == 1
+
+    events = [e for e in sched.events.events() if e.reason == FAILED_SCHEDULING]
+    assert len(events) == 1
+    msg = events[0].message
+    assert msg.startswith("0/3 nodes are available:")
+    assert "1 NodeUnschedulable" in msg
+    assert "1 NodeAffinity" in msg
+    assert "1 NodeResourcesFit" in msg
+
+
+def test_node_affinity_reject_ignores_pod_delete_event():
+    """The QUEUEING_HINTS table must actually filter: a NodeAffinity-
+    rejected pod stays unschedulable on PodDelete but moves on NodeUpdate
+    (VERDICT r1 item 5 — previously every event requeued everything)."""
+    sched, cluster, clock = make_scheduler()
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "8"}).obj())
+    pod = (
+        MakePod("p").req({"cpu": "1"}).node_selector({"disk": "ssd"}).obj()
+    )
+    sched.on_pod_add(pod)
+    sched.schedule_cycle()
+    assert sched.queue.pending_counts()["unschedulable"] == 1
+
+    # PodDelete cannot cure a node-affinity failure -> stays put
+    moved = sched.queue.move_all_to_active_or_backoff(EVENT_POD_DELETE)
+    assert moved == 0
+    assert sched.queue.pending_counts()["unschedulable"] == 1
+
+    # NodeUpdate can -> moves (into backoff: window still running)
+    moved = sched.queue.move_all_to_active_or_backoff(EVENT_NODE_UPDATE)
+    assert moved == 1
+    assert sched.queue.pending_counts()["unschedulable"] == 0
+
+
+def test_resources_reject_requeues_on_pod_delete():
+    """The complementary case: a resources-rejected pod DOES move on
+    PodDelete (freed capacity can cure it)."""
+    sched, cluster, clock = make_scheduler()
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "1"}).obj())
+    pod = MakePod("p").req({"cpu": "4"}).obj()
+    sched.on_pod_add(pod)
+    sched.schedule_cycle()
+    assert sched.queue.pending_counts()["unschedulable"] == 1
+    assert sched.queue.move_all_to_active_or_backoff(EVENT_POD_DELETE) == 1
+
+
+def test_scheduled_event_and_reason_metric():
+    sched, cluster, clock = make_scheduler()
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "8"}).obj())
+    sched.on_pod_add(MakePod("ok").req({"cpu": "1"}).obj())
+    sched.on_pod_add(MakePod("too-big").req({"cpu": "64"}).obj())
+    sched.schedule_cycle()
+
+    reasons = {e.reason for e in sched.events.events()}
+    assert {SCHEDULED, FAILED_SCHEDULING} <= reasons
+    # the per-plugin unschedulable counter ticked for NodeResourcesFit
+    v = sched.metrics.registry.get_sample_value(
+        "scheduler_unschedulable_reasons_total",
+        {"plugin": "NodeResourcesFit", "profile": "default-scheduler"},
+    )
+    assert v == 1.0
+
+
+def test_gang_drop_reason_is_coscheduling():
+    from k8s_scheduler_tpu.models.api import PodGroup
+
+    sched, cluster, clock = make_scheduler()
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "2"}).obj())
+    sched.add_pod_group(PodGroup("job", 3))
+    for i in range(3):
+        sched.on_pod_add(
+            MakePod(f"j-{i}").req({"cpu": "1"}).group("job").obj()
+        )
+    stats = sched.schedule_cycle()
+    assert stats.gang_dropped >= 1
+    # gang members wait for events Coscheduling's hints accept; PodDelete
+    # is one of them (freed capacity can let the whole group place)
+    assert sched.queue.pending_counts()["unschedulable"] >= 1
+    assert sched.queue.move_all_to_active_or_backoff(EVENT_POD_DELETE) >= 1

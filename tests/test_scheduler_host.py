@@ -68,7 +68,7 @@ def test_queue_update_honors_backoff_window():
     pod = MakePod("p").obj()
     q.add(pod)
     q.pop_ready()
-    q.requeue_unschedulable(pod, reason="NodeResourcesFit")
+    q.requeue_unschedulable(pod, reasons="NodeResourcesFit")
     # a spec update can cure the failure but must not skip the 10s backoff
     q.update(pod)
     assert q.pop_ready() == []
@@ -113,7 +113,7 @@ def test_queue_unschedulable_waits_for_matching_event():
     pod = MakePod("p").obj()
     q.add(pod)
     q.pop_ready()
-    q.requeue_unschedulable(pod, reason="NodeResourcesFit")
+    q.requeue_unschedulable(pod, reasons="NodeResourcesFit")
     # PodDelete can cure NodeResourcesFit; backoff already expired?
     assert q.pending_counts()["unschedulable"] == 1
     q.move_all_to_active_or_backoff(EVENT_POD_DELETE)
@@ -128,7 +128,7 @@ def test_queue_hint_filters_irrelevant_events():
     pod = MakePod("p").obj()
     q.add(pod)
     q.pop_ready()
-    q.requeue_unschedulable(pod, reason="NodeAffinity")
+    q.requeue_unschedulable(pod, reasons="NodeAffinity")
     # PodDelete cannot cure a NodeAffinity rejection
     q.move_all_to_active_or_backoff(EVENT_POD_DELETE)
     assert q.pending_counts()["unschedulable"] == 1
@@ -142,7 +142,7 @@ def test_queue_unschedulable_timeout_flush():
     pod = MakePod("p").obj()
     q.add(pod)
     q.pop_ready()
-    q.requeue_unschedulable(pod, reason="NodeAffinity")
+    q.requeue_unschedulable(pod, reasons="NodeAffinity")
     clock.tick(301.0)
     q.flush_unschedulable_timeout()
     assert q.pending_counts()["unschedulable"] == 0
