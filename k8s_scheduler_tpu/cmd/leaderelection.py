@@ -33,6 +33,15 @@ class FileLease:
     advisory metadata for observability, the kernel lock is the truth.
     """
 
+    # POSIX record locks are per-process (two locks in one process never
+    # conflict) and are dropped when the process closes ANY fd for the
+    # file. This registry restores flock-like semantics inside a process:
+    # try_acquire of an already-held path fails, and holder() reads through
+    # the holder's own fd instead of open()+close()-ing a second one (which
+    # would silently release the lock).
+    _held_lock = threading.Lock()
+    _held: dict[str, "FileLease"] = {}
+
     def __init__(
         self,
         path: str,
@@ -46,16 +55,26 @@ class FileLease:
         self._stop = threading.Event()
         self._renewer: threading.Thread | None = None
 
+    def _key(self) -> str:
+        return os.path.realpath(self.path)
+
     # ---- acquisition -----------------------------------------------------
 
     def try_acquire(self) -> bool:
-        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
-        try:
-            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-        except OSError:
-            os.close(fd)
-            return False
-        self._fd = fd
+        with FileLease._held_lock:
+            if self._key() in FileLease._held:
+                return False
+            fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+            try:
+                # POSIX byte-range lock (not flock): NFS and other shared
+                # filesystems propagate these, so the election holds
+                # across hosts — the deployment the module exists for
+                fcntl.lockf(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(fd)
+                return False
+            self._fd = fd
+            FileLease._held[self._key()] = self
         self._write_heartbeat()
         return True
 
@@ -98,6 +117,14 @@ class FileLease:
     def holder(self) -> dict | None:
         """Read the advisory heartbeat (None if no lease file/content)."""
         try:
+            with FileLease._held_lock:
+                held = FileLease._held.get(self._key())
+                if held is not None and held._fd is not None:
+                    # this process holds the lock: read through the
+                    # holder's fd — opening+closing another fd for the
+                    # file would drop the POSIX lock
+                    data = os.pread(self._fd or held._fd, 65536, 0)
+                    return json.loads(data) if data else None
             with open(self.path, "rb") as f:
                 data = f.read()
             return json.loads(data) if data else None
@@ -112,10 +139,13 @@ class FileLease:
         if self._renewer is not None:
             self._renewer.join(timeout=5)
             self._renewer = None
-        if self._fd is not None:
-            fcntl.flock(self._fd, fcntl.LOCK_UN)
-            os.close(self._fd)
-            self._fd = None
+        with FileLease._held_lock:
+            if self._fd is not None:
+                fcntl.lockf(self._fd, fcntl.LOCK_UN)
+                os.close(self._fd)
+                self._fd = None
+                if FileLease._held.get(self._key()) is self:
+                    del FileLease._held[self._key()]
 
 
 def run_with_leader_election(

@@ -74,7 +74,9 @@ def main(argv: list[str] | None = None) -> int:
         lease.start_renewing()
         print("became leader", flush=True)
 
-    server, service, port = serve(args.address, config=config)
+    server, service, port = serve(
+        args.address, config=config, profile_every=args.profile_every
+    )
     print(f"scheduler shim listening on port {port}", flush=True)
 
     http_server = None

@@ -4,10 +4,10 @@
 The reference family posts Kubernetes Events per pod with per-plugin
 failure reasons ("0/5 nodes are available: 3 Insufficient cpu, ..." —
 SURVEY.md §5.5; expected upstream `EventBroadcaster` usage, [UNVERIFIED],
-mount empty). There is no API server here to post to, so the recorder is a
-callable the embedder can point anywhere (the gRPC shim forwards them; the
-default records to a bounded in-memory ring + structured logging, which
-doubles as the per-cycle decision log the batched design needs).
+mount empty). There is no API server here to post to, so the recorder
+keeps a bounded in-memory ring + structured logging, which doubles as the
+per-cycle decision log the batched design needs; the gRPC shim drains the
+ring into each CycleResponse so the cluster agent can post real Events.
 """
 
 from __future__ import annotations
@@ -51,8 +51,8 @@ def failed_scheduling_message(
 class EventRecorder:
     """Bounded in-memory event ring + structured log line per event.
 
-    Thread-safe; `events()` snapshots for tests/endpoints. The gRPC shim
-    drains it into the agent's Update stream."""
+    Thread-safe; `events()` snapshots for tests/endpoints; the gRPC shim
+    calls `drain()` per Cycle so events ride the CycleResponse."""
 
     def __init__(self, capacity: int = 4096):
         self._lock = threading.Lock()
@@ -87,6 +87,14 @@ class EventRecorder:
     def events(self) -> list[Event]:
         with self._lock:
             return list(self._ring)
+
+    def drain(self) -> list[Event]:
+        """Pop everything recorded so far (the gRPC shim calls this per
+        Cycle response so the agent can post real Kubernetes Events)."""
+        with self._lock:
+            out = list(self._ring)
+            self._ring.clear()
+        return out
 
     def clear(self) -> None:
         with self._lock:

@@ -77,10 +77,13 @@ class SchedulerAgent:
     client-go informers re-listing into a fresh scheduler process)."""
 
     def __init__(self, client: SchedulerClient, bind_applier: BindApplier,
-                 evict_applier: Callable[[str, str], None] | None = None) -> None:
+                 evict_applier: Callable[[str, str], None] | None = None,
+                 event_applier: Callable[["pb.Event"], None] | None = None) -> None:
         self.client = client
         self.bind_applier = bind_applier
         self.evict_applier = evict_applier or (lambda uid, node: None)
+        # posts each drained scheduler event as a Kubernetes Event
+        self.event_applier = event_applier or (lambda ev: None)
         self._nodes: dict[str, Node] = {}
         self._pods: dict[str, tuple[Pod, str]] = {}  # uid -> (pod, bound_node)
         self._groups: dict[str, PodGroup] = {}
@@ -160,6 +163,8 @@ class SchedulerAgent:
                 )
         for ev in resp.evictions:
             self.evict_applier(ev.pod_uid, ev.node_name)
+        for ev in resp.events:
+            self.event_applier(ev)
         if confirmed.pod_updates:
             self._send(confirmed)
         return resp

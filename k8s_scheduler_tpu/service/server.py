@@ -39,7 +39,8 @@ class SchedulerService:
     """Implements the four RPCs against one host-side Scheduler."""
 
     def __init__(self, config: SchedulerConfiguration | None = None,
-                 scheduler: Scheduler | None = None) -> None:
+                 scheduler: Scheduler | None = None,
+                 profile_every: int = 0) -> None:
         # the injectable binder collects into the in-progress response;
         # one cycle at a time (serialized by _cycle_lock)
         self._bindings: list[pb.Binding] = []
@@ -53,6 +54,10 @@ class SchedulerService:
         # incarnation id: a restarted shim at the same address must be
         # distinguishable from the one the agent fed state to (§5.3)
         self.boot_id = uuid.uuid4().hex
+        # every N Cycle RPCs, run the per-plugin profiling pass so the
+        # plugin-latency histograms stay populated in steady serving
+        self.profile_every = int(profile_every)
+        self._cycle_count = 0
 
     def _collect_binding(self, pod, node_name: str) -> None:
         self._bindings.append(
@@ -101,6 +106,9 @@ class SchedulerService:
             self._bindings = []
             s = self.scheduler
             stats = s.schedule_cycle()
+            self._cycle_count += 1
+            if self.profile_every and self._cycle_count % self.profile_every == 0:
+                s.profile_cycle()
             resp = pb.CycleResponse(
                 boot_id=self.boot_id,
                 bindings=list(self._bindings),
@@ -125,6 +133,18 @@ class SchedulerService:
                 resp.evictions.append(
                     pb.Eviction(
                         pod_uid=pod.uid, pod_name=pod.name, node_name=node
+                    )
+                )
+            # drain Scheduled/FailedScheduling/Preempted events so the
+            # agent can post them as real Kubernetes Events
+            for ev in s.events.drain():
+                resp.events.append(
+                    pb.Event(
+                        type=ev.type,
+                        reason=ev.reason,
+                        pod_uid=ev.pod_uid,
+                        pod_name=ev.pod_name,
+                        message=ev.message,
                     )
                 )
             return resp
@@ -164,12 +184,23 @@ def serve(
     address: str = "127.0.0.1:50051",
     config: SchedulerConfiguration | None = None,
     max_workers: int = 4,
+    profile_every: int = 0,
 ) -> tuple[grpc.Server, SchedulerService, int]:
     """Start the shim; returns (server, servicer, bound_port)."""
-    service = SchedulerService(config=config)
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    service = SchedulerService(config=config, profile_every=profile_every)
+    # no SO_REUSEPORT: a second shim on the same address must fail loudly,
+    # not silently split the accept queue with the first
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=(("grpc.so_reuseport", 0),),
+    )
     add_to_server(service, server)
     port = server.add_insecure_port(address)
+    if port == 0 and not address.rstrip().endswith(":0"):
+        # grpc signals bind failure by returning port 0; only an explicit
+        # ":0" (ephemeral) request may legitimately come back remapped
+        server.stop(grace=0)
+        raise OSError(f"failed to bind gRPC address {address!r}")
     server.start()
     return server, service, port
 

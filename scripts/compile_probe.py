@@ -1,0 +1,97 @@
+"""Bisect the config-#4 compile blowup: time encode/lower/compile of the
+affinity-enabled cycle at increasing pod counts.
+
+Usage: JAX_PLATFORMS=cpu python scripts/compile_probe.py [P ...]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+
+from k8s_scheduler_tpu.core.cycle import build_cycle_fn
+from k8s_scheduler_tpu.models.encoding import SnapshotEncoder
+from k8s_scheduler_tpu.utils import synth
+
+
+def probe(P: int, N: int) -> None:
+    import os
+
+    nodes = synth.make_cluster(N, taint_fraction=0.1)
+    pods = synth.make_pods(
+        P,
+        affinity_fraction=0.3,
+        anti_affinity_fraction=0.2,
+        spread_fraction=0.2,
+        selector_fraction=0.3,
+        toleration_fraction=0.1,
+        priorities=(0, 0, 10, 100),
+        num_apps=int(os.environ.get("NUM_APPS", "200")),
+    )
+    existing = []
+    n_exist = int(os.environ.get("EXISTING", "0"))
+    if n_exist:
+        epods = synth.make_pods(
+            n_exist,
+            seed=7,
+            name_prefix="run",
+            affinity_fraction=0.3,
+            anti_affinity_fraction=0.2,
+            spread_fraction=0.2,
+            num_apps=int(os.environ.get("NUM_APPS", "200")),
+        )
+        existing = [(p, f"node-{i % N}") for i, p in enumerate(epods)]
+    enc = SnapshotEncoder()
+    t0 = time.perf_counter()
+    snap = enc.encode(nodes, pods, existing)
+    t1 = time.perf_counter()
+    shapes = {
+        "P": snap.P, "N": snap.N, "E": snap.E,
+        "S": snap.sel_exprs.shape[0],
+        "MSE": snap.sel_exprs.shape[1],
+        "D": snap.domain_key.shape[0],
+        "Ex": snap.ex_key.shape[0],
+        "MA": snap.pod_aff_terms.shape[1],
+    }
+    print(f"P={P} N={N} encode={t1-t0:.2f}s shapes={shapes}", flush=True)
+    fn = build_cycle_fn()
+    t2 = time.perf_counter()
+    lowered = fn.lower(snap)
+    t3 = time.perf_counter()
+    compiled = lowered.compile()
+    t4 = time.perf_counter()
+    print(f"  lower={t3-t2:.2f}s compile={t4-t3:.2f}s", flush=True)
+    t5 = time.perf_counter()
+    out = compiled(snap)
+    jax.block_until_ready(out.assignment)
+    t6 = time.perf_counter()
+    t7 = time.perf_counter()
+    out = compiled(snap)
+    jax.block_until_ready(out.assignment)
+    t8 = time.perf_counter()
+    print(f"  first_run={t6-t5:.3f}s second_run={t8-t7:.3f}s", flush=True)
+    if os.environ.get("PREEMPT"):
+        from k8s_scheduler_tpu.core.cycle import build_preemption_fn
+
+        pf = build_preemption_fn()
+        t9 = time.perf_counter()
+        pl = pf.lower(snap, out)
+        t10 = time.perf_counter()
+        pc = pl.compile()
+        t11 = time.perf_counter()
+        pr = pc(snap, out)
+        jax.block_until_ready(jax.tree_util.tree_leaves(pr))
+        t12 = time.perf_counter()
+        print(
+            f"  preempt: lower={t10-t9:.2f}s compile={t11-t10:.2f}s "
+            f"first_run={t12-t11:.3f}s", flush=True,
+        )
+
+
+if __name__ == "__main__":
+    ps = [int(a) for a in sys.argv[1:]] or [1000, 2000]
+    n = int(ps[-1] // 2) if len(ps) > 1 else 1000
+    for p in ps:
+        probe(p, N=int(sys.argv[-1]) if False else max(256, p // 2))

@@ -75,6 +75,27 @@ def test_file_lease_single_holder(tmp_path):
         proc.join(timeout=10)
 
 
+def test_lease_intra_process_exclusion_and_holder_keeps_lock(tmp_path):
+    # POSIX record locks never conflict within a process and are dropped
+    # when ANY fd for the file closes — the FileLease registry must paper
+    # over both (a leader reading its own heartbeat must not lose the lease)
+    path = str(tmp_path / "lease")
+    leader = FileLease(path, identity="leader")
+    standby = FileLease(path, identity="standby")
+    assert leader.try_acquire()
+    try:
+        assert not standby.try_acquire()  # same-process exclusion
+        # holder() reads must not release the kernel lock
+        assert leader.holder()["holderIdentity"] == "leader"
+        assert standby.holder()["holderIdentity"] == "leader"
+        assert not standby.try_acquire()
+        assert leader.is_leader()
+    finally:
+        leader.release()
+    assert standby.try_acquire()
+    standby.release()
+
+
 def test_lease_heartbeat_renews(tmp_path):
     path = str(tmp_path / "lease")
     lease = FileLease(path, identity="hb", renew_seconds=0.05)

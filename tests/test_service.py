@@ -107,10 +107,26 @@ def test_service_schedules_over_the_wire(shim):
     assert resp.stats.scheduled == 6
     assert len(applier.bound) == 6
     assert set(applier.bound.values()) <= {"n0", "n1", "n2"}
+    # Scheduled events ride the response, drained per cycle
+    assert sum(1 for ev in resp.events if ev.reason == "Scheduled") == 6
     # second cycle: nothing pending
-    assert agent.run_cycle().stats.attempted == 0
+    resp2 = agent.run_cycle()
+    assert resp2.stats.attempted == 0
+    assert len(resp2.events) == 0
     assert client.health().ok
     assert b"scheduler_schedule_attempts_total" in client.metrics_text()
+
+
+def test_serve_raises_on_unbindable_address():
+    server, _, port = serve("127.0.0.1:0")
+    try:
+        # grpc raises RuntimeError itself when SO_REUSEPORT is off; the
+        # serve() OSError is the belt-and-braces path for versions that
+        # signal failure by returning port 0 instead
+        with pytest.raises((OSError, RuntimeError)):
+            serve(f"127.0.0.1:{port}")  # already taken
+    finally:
+        server.stop(grace=None)
 
 
 def test_bind_failure_forgets_and_retries(shim):
