@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""scheduler_perf-style benchmark suite: the five BASELINE configs with
+feature-realistic synthetic workloads and latency percentiles.
+
+The model is upstream's `test/integration/scheduler_perf/` (SURVEY.md §4,
+§7 step 8): drive thousands of synthetic pods/nodes through the scheduler
+and record throughput plus latency percentiles. Each config here runs
+`BENCH_SNAPSHOTS` DISTINCT snapshots (pending pods re-drawn per cycle, so
+jit-cache behaviour is what steady serving sees) through the fused cycle —
+plus, for config #4, the PostFilter/preemption pass whenever pods are left
+unschedulable, and for config #5, gang all-or-nothing unwinds.
+
+Emits one JSON line per config:
+    {"config": 4, "name": "full_default_preemption", "decisions_per_sec":…,
+     "p50_ms":…, "p99_ms":…, "scheduled":…, "preemptors":…, …}
+
+Used by bench.py (which reports the driver's single headline line) and
+runnable standalone:  BENCH_SNAPSHOTS=10 python bench_suite.py 1 4
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _percentile(xs: list[float], q: float) -> float:
+    ys = sorted(xs)
+    if not ys:
+        return 0.0
+    k = min(len(ys) - 1, max(0, int(round(q / 100.0 * (len(ys) - 1)))))
+    return ys[k]
+
+
+def _pad(n: int, b: int = 128) -> int:
+    return ((n + b - 1) // b) * b
+
+
+def make_config_workload(cfg: int, seed: int):
+    """(nodes, pending, existing, groups) for BASELINE config `cfg`; `seed`
+    re-draws the pending set so every snapshot is distinct."""
+    from k8s_scheduler_tpu.utils.synth import (
+        make_cluster,
+        make_gang_pods,
+        make_pods,
+    )
+
+    if cfg == 1:  # 100 pods x 10 nodes, CPU/mem requests only
+        return make_cluster(10, with_labels=False), make_pods(100, seed=seed), [], []
+    if cfg == 2:  # 1k pods x 100 nodes, node-affinity + taints/tolerations
+        nodes = make_cluster(100, taint_fraction=0.3)
+        pods = make_pods(
+            1000, seed=seed, selector_fraction=0.5, toleration_fraction=0.4
+        )
+        return nodes, pods, [], []
+    if cfg == 3:  # 5k pods x 1k nodes, inter-pod (anti-)affinity
+        nodes = make_cluster(1000)
+        pods = make_pods(
+            5000,
+            seed=seed,
+            affinity_fraction=0.3,
+            anti_affinity_fraction=0.2,
+            spread_fraction=0.2,
+            num_apps=500,
+        )
+        return nodes, pods, [], []
+    if cfg == 4:  # 10k pods x 5k nodes, full default plugin set + preemption
+        # small nodes + a low-priority existing workload occupying most
+        # capacity: high-priority pending pods must preempt, low-priority
+        # ones go unschedulable — the PostFilter pass has real work
+        nodes = make_cluster(5000, taint_fraction=0.1, cpu_choices=(4, 8, 16))
+        existing_pods = make_pods(
+            12000,
+            seed=991,  # fixed: the running cluster is stable across cycles
+            name_prefix="run",
+            affinity_fraction=0.1,
+            spread_fraction=0.1,
+            num_apps=500,
+        )
+        existing = [
+            (p, f"node-{i % 5000}") for i, p in enumerate(existing_pods)
+        ]
+        pods = make_pods(
+            10000,
+            seed=seed,
+            affinity_fraction=0.3,
+            anti_affinity_fraction=0.2,
+            spread_fraction=0.2,
+            selector_fraction=0.3,
+            toleration_fraction=0.1,
+            priorities=(0, 0, 10, 100),
+            num_apps=500,
+        )
+        return nodes, pods, existing, []
+    if cfg == 5:  # gang-schedule 1k 8-replica jobs on 2k nodes
+        # capacity below aggregate demand: the tail of the priority order
+        # cannot fully place, so all-or-nothing unwinds really fire
+        nodes = make_cluster(2000, cpu_choices=(8,))
+        pods, groups = make_gang_pods(1000, replicas=8, seed=seed)
+        return nodes, pods, [], groups
+    raise ValueError(f"unknown config {cfg}")
+
+
+CONFIG_NAMES = {
+    1: "resources_only",
+    2: "labels_taints",
+    3: "interpod_affinity",
+    4: "full_default_preemption",
+    5: "gang_coscheduling",
+}
+CONFIG_SHAPES = {1: (100, 10), 2: (1000, 100), 3: (5000, 1000),
+                 4: (10000, 5000), 5: (8000, 2000)}
+
+
+def run_config(cfg: int, snapshots: int = 50) -> dict:
+    import jax
+    import numpy as np
+
+    from k8s_scheduler_tpu.core import build_cycle_fn, build_preemption_fn
+    from k8s_scheduler_tpu.models import SnapshotEncoder
+
+    P_real, N_real = CONFIG_SHAPES[cfg]
+    cycle = build_cycle_fn()
+    preempt = build_preemption_fn() if cfg == 4 else None
+
+    # one encoder across snapshots keeps the string/selector dictionaries
+    # stable (what a long-lived serving process sees)
+    enc = SnapshotEncoder(pad_pods=_pad(P_real), pad_nodes=_pad(N_real))
+
+    # Timing methodology: on this rig the TPU sits behind a tunnel with a
+    # measured ~90ms fixed dispatch+read round-trip, and async dispatch
+    # reports readiness optimistically — block_until_ready alone massively
+    # under-reports. Every timed region therefore ends with a FORCING
+    # device->host read (np.asarray of a small output), and the fixed
+    # read round-trip (measured on an already-ready buffer) is subtracted.
+    times: list[float] = []
+    encode_times: list[float] = []
+    compile_s = 0.0
+    d2h_s = 0.0
+    shape_keys: set = set()
+    totals = {"scheduled": 0, "unschedulable": 0, "gang_dropped": 0,
+              "preemptors": 0, "victims": 0}
+    for i in range(snapshots):
+        nodes, pods, existing, groups = make_config_workload(cfg, seed=1000 + i)
+        t0 = time.perf_counter()
+        snap = enc.encode(nodes, pods, existing, groups)
+        encode_times.append(time.perf_counter() - t0)
+        key = tuple(
+            (k, v.shape) for k, v in sorted(snap.array_fields().items())
+        )
+        if key not in shape_keys:
+            # first sight of this padded shape: compile + sync (warmup,
+            # untimed as cycle latency — reported separately)
+            shape_keys.add(key)
+            t0 = time.perf_counter()
+            out = cycle(snap)
+            np.asarray(out.assignment)
+            if preempt is not None:
+                pre = preempt(snap, out)
+                np.asarray(pre.nominated)
+            compile_s += time.perf_counter() - t0
+            # fixed D2H round-trip on a ready buffer (subtracted below)
+            t0 = time.perf_counter()
+            np.asarray(out.assignment)
+            d2h_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = cycle(snap)
+        pre = None
+        if preempt is not None:
+            # preemption chains on the cycle output device-side; one
+            # forcing read at the end times the whole attempt
+            pre = preempt(snap, out)
+            np.asarray(pre.nominated)
+        a = np.asarray(out.assignment)
+        times.append(max(time.perf_counter() - t0 - d2h_s, 0.0))
+        if os.environ.get("BENCH_DEBUG"):
+            print(f"  iter={i} cycle={times[-1]:.4f}s", flush=True)
+
+        valid = np.asarray(snap.pod_valid)
+        totals["scheduled"] += int(((a >= 0) & valid).sum())
+        totals["unschedulable"] += int(np.asarray(out.unschedulable).sum())
+        totals["gang_dropped"] += int(np.asarray(out.gang_dropped).sum())
+        if pre is not None and totals["unschedulable"]:
+            totals["preemptors"] += int(np.asarray(pre.num_preemptors))
+            totals["victims"] += int(np.asarray(pre.victims).sum())
+
+    p50 = _percentile(times, 50)
+    p99 = _percentile(times, 99)
+    return {
+        "config": cfg,
+        "name": CONFIG_NAMES[cfg],
+        "pods": P_real,
+        "nodes": N_real,
+        "snapshots": snapshots,
+        "decisions_per_sec": round(P_real * N_real / max(p50, 1e-9), 1),
+        "p50_ms": round(p50 * 1e3, 3),
+        "p99_ms": round(p99 * 1e3, 3),
+        "d2h_roundtrip_ms": round(d2h_s * 1e3, 3),
+        "encode_p50_ms": round(_percentile(encode_times, 50) * 1e3, 3),
+        "compile_seconds": round(compile_s, 2),
+        "distinct_shapes": len(shape_keys),
+        **{k: v // max(snapshots, 1) for k, v in totals.items()},
+    }
+
+
+def run_suite(configs=(1, 2, 3, 4, 5), snapshots: int = 50) -> list[dict]:
+    return [run_config(c, snapshots=snapshots) for c in configs]
+
+
+def main() -> None:
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    configs = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 4, 5]
+    snapshots = int(os.environ.get("BENCH_SNAPSHOTS", 50))
+    for c in configs:
+        print(json.dumps(run_config(c, snapshots=snapshots)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,14 +19,18 @@ def make_cluster(
     seed: int = 0,
     with_labels: bool = True,
     taint_fraction: float = 0.0,
+    cpu_choices: tuple[int, ...] = (8, 16, 32, 64),
+    memory_choices: tuple[int, ...] = (16, 32, 64, 128),
 ) -> list[Node]:
+    """`cpu_choices`/`memory_choices` set the per-node capacity draw —
+    scarcity knobs for preemption-heavy benchmark configs."""
     rng = np.random.default_rng(seed)
     nodes = []
     for i in range(num_nodes):
         b = MakeNode(f"node-{i}").capacity(
             {
-                "cpu": f"{int(rng.choice([8, 16, 32, 64]))}",
-                "memory": f"{int(rng.choice([16, 32, 64, 128]))}Gi",
+                "cpu": f"{int(rng.choice(cpu_choices))}",
+                "memory": f"{int(rng.choice(memory_choices))}Gi",
                 "pods": 110,
             }
         )

@@ -1,0 +1,40 @@
+"""Bisect the bench_suite vs compile_probe 1000x runtime gap at config #3."""
+
+import time
+
+import jax
+
+from k8s_scheduler_tpu.core import build_cycle_fn
+from k8s_scheduler_tpu.models import SnapshotEncoder
+from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+
+def timeit(label, enc_kwargs, pod_kwargs):
+    nodes = make_cluster(1000)
+    pods = make_pods(5000, seed=1000, **pod_kwargs)
+    enc = SnapshotEncoder(**enc_kwargs)
+    snap = enc.encode(nodes, pods)
+    cycle = build_cycle_fn()
+    out = cycle(snap)
+    jax.block_until_ready(out.assignment)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = cycle(snap)
+        jax.block_until_ready(out.assignment)
+        ts.append(time.perf_counter() - t0)
+    print(f"{label}: P={snap.P} N={snap.N} times={[round(t,4) for t in ts]}",
+          flush=True)
+
+
+AFF = dict(affinity_fraction=0.3, anti_affinity_fraction=0.2,
+           spread_fraction=0.2, num_apps=500)
+
+timeit("bench-pad(128) bench-pods", dict(pad_pods=5120, pad_nodes=1024), AFF)
+timeit("pow2-pad bench-pods", {}, AFF)
+timeit(
+    "pow2-pad probe-pods",
+    {},
+    dict(**AFF, selector_fraction=0.3, toleration_fraction=0.1,
+         priorities=(0, 0, 10, 100)),
+)

@@ -1,0 +1,36 @@
+"""Is it the per-snapshot re-encode that makes bench_suite 1000x slower?"""
+
+import time
+
+import jax
+
+from k8s_scheduler_tpu.core import build_cycle_fn
+from k8s_scheduler_tpu.models import SnapshotEncoder
+from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+AFF = dict(affinity_fraction=0.3, anti_affinity_fraction=0.2,
+           spread_fraction=0.2, num_apps=500)
+
+enc = SnapshotEncoder(pad_pods=5120, pad_nodes=1024)
+cycle = build_cycle_fn()
+nodes = make_cluster(1000)
+
+for i in range(4):
+    pods = make_pods(5000, seed=1000 + i, **AFF)
+    t0 = time.perf_counter()
+    snap = enc.encode(nodes, pods)
+    t1 = time.perf_counter()
+    out = cycle(snap)
+    jax.block_until_ready(out.assignment)
+    t2 = time.perf_counter()
+    out = cycle(snap)
+    jax.block_until_ready(out.assignment)
+    t3 = time.perf_counter()
+    print(
+        f"seed={1000+i} encode={t1-t0:.3f}s first={t2-t1:.3f}s "
+        f"second={t3-t2:.4f}s shapes "
+        f"S={snap.sel_exprs.shape} Ex={snap.ex_key.shape} "
+        f"D={snap.domain_key.shape} ports={snap.num_distinct_ports} "
+        f"caps=({snap.has_inter_pod_affinity},{snap.has_topology_spread})",
+        flush=True,
+    )

@@ -1,0 +1,53 @@
+"""Mirror bench_suite.run_config(3) exactly; strip pieces via env flags.
+
+SKIP_STATS=1   drop the np.asarray stats reads between iterations
+SKIP_NODES=1   hoist make_cluster out of the loop
+SKIP_KEY=1     drop the shape-key computation
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+
+from k8s_scheduler_tpu.core import build_cycle_fn
+from k8s_scheduler_tpu.models import SnapshotEncoder
+from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+AFF = dict(affinity_fraction=0.3, anti_affinity_fraction=0.2,
+           spread_fraction=0.2, num_apps=500)
+
+enc = SnapshotEncoder(pad_pods=5120, pad_nodes=1024)
+cycle = build_cycle_fn()
+shape_keys = set()
+nodes_outer = make_cluster(1000) if os.environ.get("SKIP_NODES") else None
+
+for i in range(3):
+    nodes = nodes_outer if nodes_outer is not None else make_cluster(1000)
+    pods = make_pods(5000, seed=1000 + i, **AFF)
+    snap = enc.encode(nodes, pods)
+    if not os.environ.get("SKIP_KEY"):
+        key = tuple((k, v.shape) for k, v in sorted(snap.array_fields().items()))
+    else:
+        key = 0
+    if key not in shape_keys:
+        shape_keys.add(key)
+        t0 = time.perf_counter()
+        out = cycle(snap)
+        jax.block_until_ready(out.assignment)
+        print(f"  warmup {time.perf_counter()-t0:.2f}s", flush=True)
+    t0 = time.perf_counter()
+    out = cycle(snap)
+    jax.block_until_ready(out.assignment)
+    t_cycle = time.perf_counter() - t0
+    if not os.environ.get("SKIP_STATS"):
+        a = np.asarray(out.assignment)
+        valid = np.asarray(snap.pod_valid)
+        sched = int(((a >= 0) & valid).sum())
+        unsched = int(np.asarray(out.unschedulable).sum())
+        gd = int(np.asarray(out.gang_dropped).sum())
+    else:
+        sched = unsched = gd = -1
+    print(f"iter={i} cycle={t_cycle:.4f}s sched={sched} unsched={unsched}",
+          flush=True)
