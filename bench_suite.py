@@ -122,7 +122,10 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     from k8s_scheduler_tpu.models import SnapshotEncoder
 
     P_real, N_real = CONFIG_SHAPES[cfg]
-    cycle = build_cycle_fn()
+    # the round-based batched commit is the production engine; the strict
+    # sequential scan is available for comparison via BENCH_COMMIT_MODE
+    mode = os.environ.get("BENCH_COMMIT_MODE", "rounds")
+    cycle = build_cycle_fn(commit_mode=mode)
     preempt = build_preemption_fn() if cfg == 4 else None
 
     # one encoder across snapshots keeps the string/selector dictionaries
@@ -190,6 +193,7 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     p99 = _percentile(times, 99)
     return {
         "config": cfg,
+        "commit_mode": mode,
         "name": CONFIG_NAMES[cfg],
         "pods": P_real,
         "nodes": N_real,

@@ -65,6 +65,10 @@ class SchedulerConfiguration:
     pod_max_backoff_seconds: float = 10.0
     # gang scheduling (Coscheduling PodGroup CRD analogue, SURVEY.md C14)
     gang_scheduling: bool = True
+    # in-cycle commitment engine (TPU-native extension, see ops/rounds.py):
+    # "rounds" = batched round commit (production default at scale),
+    # "scan" = strict sequential per-pod scan (exact ScheduleOne order)
+    commit_mode: str = "rounds"
 
     def profile(self, scheduler_name: str = "default-scheduler") -> Profile:
         for p in self.profiles:
@@ -162,6 +166,7 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         pod_initial_backoff_seconds=data.get("podInitialBackoffSeconds", 1.0),
         pod_max_backoff_seconds=data.get("podMaxBackoffSeconds", 10.0),
         gang_scheduling=data.get("gangScheduling", True),
+        commit_mode=data.get("commitMode", "rounds"),
     )
 
 

@@ -29,6 +29,7 @@ from ..framework.interfaces import CycleContext
 from ..framework.runtime import Framework
 from ..models.encoding import ClusterSnapshot
 from ..ops import commit as commit_ops
+from ..ops import rounds as rounds_ops
 
 
 @jax.tree_util.register_dataclass
@@ -44,15 +45,27 @@ class CycleResult:
     reject_counts: jnp.ndarray  # i32 [P, F] nodes first-rejected per filter
     # (static + dynamic attribution summed; columns = Framework.filter_names)
     # — feeds FailedScheduling events and requeue queueing hints
+    rounds_used: jnp.ndarray  # i32 [] commit rounds consumed (0 in scan mode)
 
 
 def build_cycle_fn(
     framework: Framework | None = None,
     gang_scheduling: bool = True,
+    commit_mode: str = "scan",
+    max_rounds: int = 64,
 ) -> Callable[[ClusterSnapshot], CycleResult]:
     """Compile the cycle for a framework (default: the default plugin set).
     The returned callable is jitted; snapshots with identical padded shapes
     reuse the compiled program.
+
+    `commit_mode` selects the in-cycle commitment engine:
+      - "scan": the strict sequential scan (ops/commit.py) — exact
+        one-pod-at-a-time ScheduleOne semantics, one lax.scan step per
+        pod. Best for small pending sets and for differential parity.
+      - "rounds": the round-based batched commit (ops/rounds.py) — a few
+        MXU-wide rounds instead of P sequential steps; the production
+        mode at 10k-pod scale (~1000x faster on TPU; see ops/rounds.py
+        for the documented semantics contract).
 
     With `gang_scheduling` (the Coscheduling plugin analogue, SURVEY.md §2
     C14), pods carrying a pod-group whose placed-member count stays below
@@ -61,6 +74,10 @@ def build_cycle_fn(
     single batched unwind. minMember counts pods placed THIS cycle;
     already-running members are bound facts, not waiters."""
     fw = framework or Framework.from_config()
+    if commit_mode not in ("scan", "rounds"):
+        raise ValueError(f"unknown commit_mode {commit_mode!r}")
+    if commit_mode == "rounds":
+        fw.check_batched_parity()
 
     @jax.jit
     def cycle(snap: ClusterSnapshot) -> CycleResult:
@@ -68,26 +85,70 @@ def build_cycle_fn(
         smask, sscore, srejects = fw.static(ctx)
         extra = fw.extra_init(ctx)
 
-        def dyn_fn(p, node_req, ext, static_row):
-            return fw.dyn(ctx, p, node_req, ext, static_row)
+        if commit_mode == "rounds":
+            # the rounds engine re-invokes the plugin kernels on COMPACTED
+            # pod views (a ClusterSnapshot gathered at the active ids); a
+            # view context shares the full context's node-side precomputes
+            # and swaps in the view's matched-pending columns
+            def view_ctx(vsnap, vmp):
+                vctx = CycleContext(vsnap)
+                vctx._cache.update(ctx._cache)
+                vctx._cache["matched_pending"] = vmp
+                return vctx
 
-        def update_fn(ext, p, node, ok):
-            return fw.extra_update(ctx, ext, p, node, ok)
+            def dyn_batched_view_fn(vsnap, vmp, node_req, ext, vsmask):
+                return fw.dyn_batched(view_ctx(vsnap, vmp), node_req, ext,
+                                      vsmask)
 
-        order = jnp.argsort(snap.pod_order)
-        result = commit_ops.greedy_commit(
-            order=order,
-            static_mask=smask,
-            static_score=sscore,
-            pod_requested=snap.pod_requested,
-            pod_valid=snap.pod_valid,
-            pod_nominated=snap.pod_nominated,
-            node_allocatable=snap.node_allocatable,
-            node_requested=snap.node_requested,
-            dyn_fn=dyn_fn,
-            extra=extra,
-            update_fn=update_fn,
-        )
+            def update_batched_view_fn(vsnap, vmp, ext, accepted, node_of):
+                return fw.extra_update_batched(
+                    view_ctx(vsnap, vmp), ext, accepted, node_of
+                )
+
+            rres = rounds_ops.rounds_commit(
+                snap=snap,
+                static_mask=smask,
+                static_score=sscore,
+                m_pending=ctx.matched_pending,
+                dyn_batched_view_fn=dyn_batched_view_fn,
+                update_batched_view_fn=update_batched_view_fn,
+                extra=extra,
+                max_rounds=max_rounds,
+            )
+            # dynamic reject attribution vs the FINAL state, for the pods
+            # that never placed (same column convention as fw.static)
+            unplaced = snap.pod_valid & (rres.assignment < 0)
+            result = commit_ops.CommitResult(
+                assignment=rres.assignment,
+                node_requested=rres.node_requested,
+                extra=rres.extra,
+                dyn_aux=fw.attribute_rejects(
+                    smask, rres.final_per_filter, rows=unplaced
+                ),
+            )
+            rounds_used = rres.rounds_used
+        else:
+            def dyn_fn(p, node_req, ext, static_row):
+                return fw.dyn(ctx, p, node_req, ext, static_row)
+
+            def update_fn(ext, p, node, ok):
+                return fw.extra_update(ctx, ext, p, node, ok)
+
+            rounds_used = jnp.int32(0)
+            order = jnp.argsort(snap.pod_order)
+            result = commit_ops.greedy_commit(
+                order=order,
+                static_mask=smask,
+                static_score=sscore,
+                pod_requested=snap.pod_requested,
+                pod_valid=snap.pod_valid,
+                pod_nominated=snap.pod_nominated,
+                node_allocatable=snap.node_allocatable,
+                node_requested=snap.node_requested,
+                dyn_fn=dyn_fn,
+                extra=extra,
+                update_fn=update_fn,
+            )
         dropped = jnp.zeros_like(snap.pod_valid)
         if gang_scheduling:
             placed = snap.pod_valid & (result.assignment >= 0)
@@ -109,7 +170,7 @@ def build_cycle_fn(
         unsched = snap.pod_valid & (result.assignment < 0)
         return CycleResult(
             result.assignment, result.node_requested, unsched, dropped, smask,
-            srejects + result.dyn_aux,
+            srejects + result.dyn_aux, rounds_used,
         )
 
     return cycle

@@ -104,7 +104,9 @@ class Scheduler:
         # documented contract); only the pad sizes track the workload
         self._encoder = SnapshotEncoder()
         self._cycle = build_cycle_fn(
-            self.framework, gang_scheduling=self.config.gang_scheduling
+            self.framework,
+            gang_scheduling=self.config.gang_scheduling,
+            commit_mode=self.config.commit_mode,
         )
         self._preempt = build_preemption_fn(self.framework)
 

@@ -118,6 +118,32 @@ class PluginBase:
     def extra_update(self, ctx: CycleContext, extra, p, node, committed):
         return extra
 
+    # --- batched dynamic path (round-based commit, ops/rounds.py):
+    # whole-pending-set [P, N] evaluation against the current running
+    # state, plus a whole-round state fold. A plugin that implements a
+    # per-pod dyn hook MUST implement the batched counterpart too —
+    # Framework.check_batched_parity() (run when a rounds-mode cycle is
+    # built) raises otherwise, because the rounds engine only calls the
+    # batched path. ---
+    def dyn_mask_batched(self, ctx: CycleContext, node_requested, extra,
+                         shared: dict) -> jnp.ndarray | None:
+        """`shared` is a per-round trace-time scratch dict: plugins stash
+        precomputes derived from the round state there (e.g. the
+        counts-by-node table) so co-enabled plugins don't recompute them."""
+        return None
+
+    def dyn_score_batched(self, ctx: CycleContext, node_requested, extra,
+                          feasible, shared: dict) -> jnp.ndarray | None:
+        """`feasible` is the full [P, N] feasibility (static & dynamic)
+        for normalize-over-feasible scoring."""
+        return None
+
+    def extra_update_batched(self, ctx: CycleContext, extra, accepted,
+                             node_of):
+        """Fold a round's placements (accepted bool [P], node_of i32 [P])
+        into this plugin's extra state."""
+        return extra
+
     # --- PostFilter (preemption): runs after the commit scan over the
     # pods that found no node; returns a PreemptionResult or None.
     # `excluded` [P] marks pods that must not preempt (gang-dropped) ---

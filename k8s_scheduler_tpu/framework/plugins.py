@@ -90,6 +90,39 @@ class NodePorts(PluginBase):
         add = committed & (ids >= 0)
         return extra.at[node, safe].max(add)
 
+    # --- batched (rounds) path ---
+
+    @staticmethod
+    def _port_onehot(snap):  # bool [P, Q]
+        Q = snap.num_distinct_ports
+        P = snap.P
+        ids = snap.pod_port_ids  # [P, MPorts]
+        oh = jnp.zeros((P, Q), bool)
+        pid = jnp.broadcast_to(
+            jnp.arange(P, dtype=jnp.int32)[:, None], ids.shape
+        )
+        return oh.at[pid, jnp.clip(ids, 0, Q - 1)].max(ids >= 0)
+
+    def dyn_mask_batched(self, ctx: CycleContext, node_requested, extra,
+                         shared):
+        snap = ctx.snap
+        claimed = extra[self.name]  # [N, Q]
+        oh = shared.setdefault("port_onehot", self._port_onehot(snap))
+        conflict = (
+            oh.astype(jnp.float32) @ claimed.T.astype(jnp.float32)
+        ) > 0.0  # [P, N]
+        return ~conflict
+
+    def extra_update_batched(self, ctx: CycleContext, extra, accepted,
+                             node_of):
+        snap = ctx.snap
+        ids = snap.pod_port_ids  # [P, MPorts]
+        Q = extra.shape[1]
+        nsafe = jnp.clip(node_of, 0, extra.shape[0] - 1)
+        nidx = jnp.broadcast_to(nsafe[:, None], ids.shape)
+        add = accepted[:, None] & (ids >= 0)
+        return extra.at[nidx, jnp.clip(ids, 0, Q - 1)].max(add)
+
 
 class NodeResourcesFit(PluginBase):
     """Filter: resource fit against the RUNNING allocatable (in-scan).
@@ -119,6 +152,29 @@ class NodeResourcesFit(PluginBase):
             _score_resource_weights(snap, self.args),
         )
 
+    def dyn_mask_batched(self, ctx: CycleContext, node_requested, extra,
+                         shared):
+        snap = ctx.snap
+        return res_ops.fit_mask(
+            snap.pod_requested, snap.node_allocatable, node_requested
+        )
+
+    def dyn_score_batched(self, ctx: CycleContext, node_requested, extra,
+                          feasible, shared):
+        snap = ctx.snap
+        strategy = self.args.get("scoring_strategy", "LeastAllocated")
+        fn = (
+            res_ops.most_requested_score
+            if strategy == "MostAllocated"
+            else res_ops.least_requested_score
+        )
+        return fn(
+            snap.pod_requested[:, None, :],
+            snap.node_allocatable,
+            node_requested,
+            _score_resource_weights(snap, self.args),
+        )
+
 
 class NodeResourcesBalancedAllocation(PluginBase):
     name = "NodeResourcesBalancedAllocation"
@@ -128,6 +184,14 @@ class NodeResourcesBalancedAllocation(PluginBase):
         return res_ops.balanced_allocation_score(
             snap.pod_requested[p], snap.node_allocatable, node_requested,
             _score_resource_weights(snap, self.args),
+        )
+
+    def dyn_score_batched(self, ctx: CycleContext, node_requested, extra,
+                          feasible, shared):
+        snap = ctx.snap
+        return res_ops.balanced_allocation_score(
+            snap.pod_requested[:, None, :], snap.node_allocatable,
+            node_requested, _score_resource_weights(snap, self.args),
         )
 
 
@@ -191,6 +255,27 @@ def _update_affinity_state(ctx: CycleContext, name, state, p, node, committed):
     )
 
 
+def _update_affinity_state_batched(ctx: CycleContext, name, state, accepted,
+                                   node_of):
+    from ..ops import interpod as interpod_ops
+
+    if ctx._cache.get(_AFFINITY_OWNER_KEY) != name:
+        return state
+    return interpod_ops.affinity_update_batched(
+        ctx.snap, state, ctx.matched_pending, accepted, node_of
+    )
+
+
+def _shared_cbn(ctx: CycleContext, state, shared):
+    """counts-by-node [K*S, N] for the current round, computed once and
+    shared between InterPodAffinity and PodTopologySpread."""
+    from ..ops import interpod as interpod_ops
+
+    if "cbn" not in shared:
+        shared["cbn"] = interpod_ops.counts_by_node(ctx.snap, state)
+    return shared["cbn"]
+
+
 class InterPodAffinity(PluginBase):
     """The quadratic hot path, as counts over (selector, topology-domain)
     instead of pairwise pod comparisons — see ops/interpod.py."""
@@ -220,6 +305,36 @@ class InterPodAffinity(PluginBase):
 
     def extra_update(self, ctx: CycleContext, extra, p, node, committed):
         return _update_affinity_state(ctx, self.name, extra, p, node, committed)
+
+    def dyn_mask_batched(self, ctx: CycleContext, node_requested, extra,
+                         shared):
+        from ..ops import interpod as interpod_ops
+
+        if not ctx.snap.has_inter_pod_affinity:
+            return None
+        state = _affinity_state(ctx, extra)
+        cbn = _shared_cbn(ctx, state, shared)
+        return interpod_ops.affinity_mask_batched(
+            ctx.snap, state, ctx.matched_pending, cbn
+        )
+
+    def dyn_score_batched(self, ctx: CycleContext, node_requested, extra,
+                          feasible, shared):
+        from ..ops import interpod as interpod_ops
+
+        if not ctx.snap.has_inter_pod_affinity:
+            return None
+        state = _affinity_state(ctx, extra)
+        cbn = _shared_cbn(ctx, state, shared)
+        return interpod_ops.affinity_score_batched(
+            ctx.snap, state, ctx.matched_pending, cbn, feasible
+        )
+
+    def extra_update_batched(self, ctx: CycleContext, extra, accepted,
+                             node_of):
+        return _update_affinity_state_batched(
+            ctx, self.name, extra, accepted, node_of
+        )
 
 
 class DefaultPreemption(PluginBase):
@@ -266,3 +381,35 @@ class PodTopologySpread(PluginBase):
 
     def extra_update(self, ctx: CycleContext, extra, p, node, committed):
         return _update_affinity_state(ctx, self.name, extra, p, node, committed)
+
+    def dyn_mask_batched(self, ctx: CycleContext, node_requested, extra,
+                         shared):
+        from ..ops import interpod as interpod_ops
+
+        if not ctx.snap.has_topology_spread:
+            return None
+        state = _affinity_state(ctx, extra)
+        cbn = _shared_cbn(ctx, state, shared)
+        if "spread_minc" not in shared:
+            shared["spread_minc"] = interpod_ops.spread_minc(ctx.snap, state)
+        return interpod_ops.spread_mask_batched(
+            ctx.snap, state, cbn, shared["spread_minc"]
+        )
+
+    def dyn_score_batched(self, ctx: CycleContext, node_requested, extra,
+                          feasible, shared):
+        from ..ops import interpod as interpod_ops
+
+        if not ctx.snap.has_topology_spread:
+            return None
+        state = _affinity_state(ctx, extra)
+        cbn = _shared_cbn(ctx, state, shared)
+        return interpod_ops.spread_score_batched(
+            ctx.snap, state, cbn, feasible
+        )
+
+    def extra_update_batched(self, ctx: CycleContext, extra, accepted,
+                             node_of):
+        return _update_affinity_state_batched(
+            ctx, self.name, extra, accepted, node_of
+        )

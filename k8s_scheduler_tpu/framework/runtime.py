@@ -88,22 +88,19 @@ class Framework:
         the batched analogue of upstream's per-node "first failing plugin"
         Status that feeds FailedScheduling events and queueing hints."""
         snap = ctx.snap
-        mask = jnp.broadcast_to(snap.node_valid[None, :], (snap.P, snap.N))
-        rejects = []
-        for f in self.filters:
-            m = f.static_mask(ctx)
-            if m is None:
-                rejects.append(jnp.zeros((snap.P,), jnp.int32))
-            else:
-                newly = mask & ~m
-                rejects.append(jnp.sum(newly, axis=1, dtype=jnp.int32))
+        base = jnp.broadcast_to(snap.node_valid[None, :], (snap.P, snap.N))
+        per_filter = [f.static_mask(ctx) for f in self.filters]
+        rejects = self.attribute_rejects(base, per_filter)
+        mask = base
+        for m in per_filter:
+            if m is not None:
                 mask = mask & m
         score = jnp.zeros((snap.P, snap.N), jnp.float32)
         for s, w in self.scores:
             v = s.static_score(ctx)
             if v is not None:
                 score = score + w * v
-        return mask, score, jnp.stack(rejects, axis=1)
+        return mask, score, rejects
 
     def _stateful_plugins(self) -> list[PluginBase]:
         # a plugin enabled at several points (e.g. InterPodAffinity filter +
@@ -152,6 +149,86 @@ class Framework:
         for pl in self._stateful_plugins():
             if pl.name in out:
                 out[pl.name] = pl.extra_update(ctx, out[pl.name], p, node, committed)
+        return out
+
+    # ---- batched dynamic path (round-based commit) ----
+
+    def check_batched_parity(self) -> None:
+        """Fail fast when a plugin implements a per-pod dynamic hook but
+        not its batched counterpart: in rounds mode the batched path is
+        the only one that runs, and a silently-skipped constraint would
+        produce invalid placements with no error."""
+        from .interfaces import PluginBase
+
+        pairs = [
+            ("dyn_mask", "dyn_mask_batched"),
+            ("dyn_score", "dyn_score_batched"),
+            ("extra_update", "extra_update_batched"),
+        ]
+        for p in self.filters + [s for s, _ in self.scores]:
+            for single, batched in pairs:
+                overrides_single = getattr(type(p), single) is not getattr(
+                    PluginBase, single
+                )
+                overrides_batched = getattr(type(p), batched) is not getattr(
+                    PluginBase, batched
+                )
+                if overrides_single and not overrides_batched:
+                    raise TypeError(
+                        f"plugin {p.name!r} implements {single} but not "
+                        f"{batched}: its constraint would be silently "
+                        f"dropped by the rounds commit engine. Implement "
+                        f"{batched} or run with commit_mode='scan'."
+                    )
+
+    def dyn_batched(self, ctx: CycleContext, node_requested, extra,
+                    static_mask):
+        """Whole-pending-set analogue of `dyn`: returns (mask [P,N],
+        score [P,N], per_filter list of [P,N] masks or None in filter
+        order — the latter feeds reject attribution)."""
+        snap = ctx.snap
+        shared: dict = {}
+        mask = static_mask
+        per_filter = []
+        for f in self.filters:
+            m = f.dyn_mask_batched(ctx, node_requested, extra, shared)
+            per_filter.append(m)
+            if m is not None:
+                mask = mask & m
+        score = jnp.zeros((snap.P, snap.N), jnp.float32)
+        for s, w in self.scores:
+            v = s.dyn_score_batched(ctx, node_requested, extra, mask, shared)
+            if v is not None:
+                score = score + w * v
+        return mask, score, per_filter
+
+    def attribute_rejects(self, base_mask, per_filter, rows=None):
+        """First-rejector attribution over a filter-mask chain: returns
+        i32 [P, F] where column i counts the nodes newly rejected by
+        filter i (None entries contribute zeros). `rows` (bool [P])
+        restricts attribution to those pods. The single owner of the
+        chain/column convention used by static(), dyn() and the rounds
+        engine's final pass."""
+        mask = base_mask
+        cols = []
+        for m in per_filter:
+            if m is None:
+                cols.append(jnp.zeros((base_mask.shape[0],), jnp.int32))
+            else:
+                newly = mask & ~m
+                c = jnp.sum(newly, axis=1, dtype=jnp.int32)
+                cols.append(c if rows is None else jnp.where(rows, c, 0))
+                mask = mask & m
+        return jnp.stack(cols, axis=1)
+
+    def extra_update_batched(self, ctx: CycleContext, extra, accepted,
+                             node_of):
+        out = dict(extra)
+        for pl in self._stateful_plugins():
+            if pl.name in out:
+                out[pl.name] = pl.extra_update_batched(
+                    ctx, out[pl.name], accepted, node_of
+                )
         return out
 
     def post_filter(self, ctx: CycleContext, assignment, node_requested,

@@ -299,3 +299,206 @@ def spread_dyn_score(snap, state: AffinityState, p, feasible) -> jnp.ndarray:
         raw += jnp.where(soft, jnp.maximum(cnt, 0.0), 0.0)
     hi = jnp.max(jnp.where(feasible, raw, 0.0))
     return jnp.where(hi > 0, (1.0 - raw / hi) * 100.0, 100.0)
+
+
+# ==========================================================================
+# Batched (whole-pending-set) variants — the round-based commit's kernels.
+#
+# The per-pod functions above run inside the sequential commit scan: one
+# [N]-row at a time, P scan steps. On TPU that is latency-bound (~100us+
+# per scan step through the sequencer), so the round-based commit
+# (ops/rounds.py) evaluates ALL pods against the current state at once:
+# count lookups become row-gathers from a [K*S, N] table and the symmetric
+# terms become [P,S]x[S,N] matmuls on the MXU.
+# ==========================================================================
+
+
+def counts_by_node(snap, state: AffinityState) -> jnp.ndarray:
+    """[K*S, N] table: counts[s, domain(n, k)] for every (k, s, n); -1
+    where node n has no domain for key k."""
+    K = snap.node_domains.shape[1]
+    S, D = state.counts.shape
+    rows = []
+    for k in range(K):
+        nd = snap.node_domains[:, k]  # [N]
+        g = state.counts[:, jnp.clip(nd, 0, D - 1)]  # [S, N]
+        rows.append(jnp.where((nd >= 0)[None, :], g, -1.0))
+    return jnp.concatenate(rows, axis=0)  # [K*S, N]
+
+
+def _term_counts(snap, cbn, sel, k):  # sel,k: i32 [P] -> f32 [P, N]
+    """Row-gather of counts-at-node for per-pod terms."""
+    S = snap.sel_exprs.shape[0]
+    K = snap.node_domains.shape[1]
+    row = jnp.clip(k, 0, K - 1) * S + jnp.clip(sel, 0, S - 1)
+    return cbn[row]  # [P, N]
+
+
+def affinity_mask_batched(snap, state: AffinityState, m_pending,
+                          cbn) -> jnp.ndarray:  # bool [P, N]
+    """Required affinity + anti-affinity + symmetric anti for ALL pods."""
+    P, N = m_pending.shape[1], snap.N
+    ok = jnp.ones((P, N), bool)
+    MA = snap.pod_aff_terms.shape[1]
+    S = state.total.shape[0]
+    pid = jnp.arange(P, dtype=jnp.int32)
+    for a in range(MA):
+        sel = snap.pod_aff_terms[:, a, 0]  # [P]
+        k = snap.pod_aff_terms[:, a, 1]
+        c = _term_counts(snap, cbn, sel, k)  # [P, N]
+        scl = jnp.clip(sel, 0, S - 1)
+        boot = (state.total[scl] == 0) & m_pending[scl, pid]  # [P]
+        ok &= jnp.where((sel >= 0)[:, None], boot[:, None] | (c > 0), True)
+    for a in range(MA):
+        sel = snap.pod_anti_terms[:, a, 0]
+        k = snap.pod_anti_terms[:, a, 1]
+        c = _term_counts(snap, cbn, sel, k)
+        ok &= jnp.where((sel >= 0)[:, None], c <= 0, True)
+    # symmetric: any placed pod's anti term whose selector matches p —
+    # [P,S]x[S,N] matmul on the MXU instead of a per-pod [S,N] reduction
+    viol = (
+        m_pending.T.astype(jnp.float32) @ state.anti_presence.astype(jnp.float32)
+    ) > 0.0
+    return ok & ~viol
+
+
+def affinity_score_batched(snap, state: AffinityState, m_pending, cbn,
+                           feasible) -> jnp.ndarray:  # f32 [P, N]
+    """Preferred-term score for ALL pods, normalized per pod to
+    [-100, 100] by max |raw| over that pod's feasible nodes."""
+    P, N = m_pending.shape[1], snap.N
+    raw = jnp.zeros((P, N), jnp.float32)
+    MA = snap.pod_pref_aff.shape[1]
+    for a in range(MA):
+        sel = snap.pod_pref_aff[:, a, 0]
+        k = snap.pod_pref_aff[:, a, 1]
+        c = _term_counts(snap, cbn, sel, k)
+        w = snap.pod_pref_aff_w[:, a]  # [P]
+        raw += jnp.where((sel >= 0)[:, None] & (c > 0),
+                         w[:, None] * jnp.maximum(c, 0.0), 0.0)
+    raw += m_pending.T.astype(jnp.float32) @ state.pref_sym  # [P, N]
+    hi = jnp.max(jnp.where(feasible, jnp.abs(raw), 0.0), axis=1, keepdims=True)
+    return jnp.where(hi > 0, raw / hi * 100.0, 0.0)
+
+
+def spread_minc(snap, state: AffinityState) -> jnp.ndarray:  # f32 [K*S]
+    """min matching-pod count over eligible domains, per (key, selector) —
+    the `minc` of the spread rule, shared by all pods."""
+    K = snap.node_domains.shape[1]
+    S, D = state.counts.shape
+    outs = []
+    for k in range(K):
+        eligible = (snap.domain_key == k) & (snap.domain_node_count > 0)  # [D]
+        m = jnp.min(
+            jnp.where(eligible[None, :], state.counts, jnp.inf), axis=1
+        )  # [S]
+        outs.append(jnp.where(jnp.isfinite(m), m, 0.0))
+    return jnp.concatenate(outs, axis=0)
+
+
+def spread_mask_batched(snap, state: AffinityState, cbn,
+                        minc) -> jnp.ndarray:  # bool [P, N]
+    P, N = snap.P, snap.N
+    ok = jnp.ones((P, N), bool)
+    MC = snap.pod_tsc.shape[1]
+    S = state.counts.shape[0]
+    K = snap.node_domains.shape[1]
+    for c in range(MC):
+        k = snap.pod_tsc[:, c, 0]
+        sel = snap.pod_tsc[:, c, 1]
+        when = snap.pod_tsc[:, c, 2]
+        cnt = _term_counts(snap, cbn, sel, k)  # [P, N]
+        row = jnp.clip(k, 0, K - 1) * S + jnp.clip(sel, 0, S - 1)
+        mc = minc[row]  # [P]
+        skew = snap.pod_tsc_skew[:, c].astype(jnp.float32)
+        viol = (cnt + 1.0 - mc[:, None] > skew[:, None]) | (cnt < 0)
+        hard = (k >= 0) & (when == enc.WHEN_DO_NOT_SCHEDULE)
+        ok &= jnp.where(hard[:, None], ~viol, True)
+    return ok
+
+
+def spread_score_batched(snap, state: AffinityState, cbn,
+                         feasible) -> jnp.ndarray:  # f32 [P, N]
+    P, N = snap.P, snap.N
+    raw = jnp.zeros((P, N), jnp.float32)
+    MC = snap.pod_tsc.shape[1]
+    for c in range(MC):
+        k = snap.pod_tsc[:, c, 0]
+        sel = snap.pod_tsc[:, c, 1]
+        when = snap.pod_tsc[:, c, 2]
+        cnt = _term_counts(snap, cbn, sel, k)
+        soft = (k >= 0) & (when == enc.WHEN_SCHEDULE_ANYWAY)
+        raw += jnp.where(soft[:, None], jnp.maximum(cnt, 0.0), 0.0)
+    hi = jnp.max(jnp.where(feasible, raw, 0.0), axis=1, keepdims=True)
+    return jnp.where(hi > 0, (1.0 - raw / hi) * 100.0, 100.0)
+
+
+def affinity_update_batched(snap, state: AffinityState, m_pending,
+                            accepted, node_of) -> AffinityState:
+    """Fold a whole round's accepted placements (accepted bool [P],
+    node_of i32 [P]) into the state tables in one batched pass."""
+    K = snap.node_domains.shape[1]
+    S, D = state.counts.shape
+    N = snap.N
+    P = accepted.shape[0]
+    acc_f = accepted.astype(jnp.float32)
+    mp_acc = m_pending.astype(jnp.float32) * acc_f[None, :]  # [S, P]
+    nsafe = jnp.clip(node_of, 0, N - 1)
+    node_dom = snap.node_domains[nsafe]  # [P, K]
+
+    counts = state.counts
+    for k in range(K):
+        d = jnp.where(accepted, node_dom[:, k], -1)  # [P]
+        w = jnp.where((d >= 0)[None, :], mp_acc, 0.0)  # [S, P]
+        counts = counts.at[:, jnp.clip(d, 0, D - 1)].add(w)
+    total = state.total + jnp.sum(mp_acc, axis=1)
+
+    anti = state.anti_presence
+    pref = state.pref_sym
+    if not snap.has_inter_pod_affinity:
+        return AffinityState(counts, total, anti, pref)
+    MA = snap.pod_anti_terms.shape[1]
+    for a in range(MA):
+        sel = snap.pod_anti_terms[:, a, 0]  # [P]
+        k = jnp.clip(snap.pod_anti_terms[:, a, 1], 0, K - 1)
+        d = jnp.take_along_axis(node_dom, k[:, None], axis=1)[:, 0]  # [P]
+        nd_k = snap.node_domains.T[k]  # [P, N] domain of every node under k
+        row = (nd_k == d[:, None]) & (d >= 0)[:, None] & (
+            sel >= 0
+        )[:, None] & accepted[:, None]  # [P, N]
+        anti = anti.at[jnp.clip(sel, 0, S - 1)].max(row)
+
+        sel2 = snap.pod_pref_aff[:, a, 0]
+        k2 = jnp.clip(snap.pod_pref_aff[:, a, 1], 0, K - 1)
+        d2 = jnp.take_along_axis(node_dom, k2[:, None], axis=1)[:, 0]
+        nd_k2 = snap.node_domains.T[k2]  # [P, N]
+        row2 = (nd_k2 == d2[:, None]) & (d2 >= 0)[:, None] & (
+            sel2 >= 0
+        )[:, None] & accepted[:, None]
+        w2 = snap.pod_pref_aff_w[:, a]  # [P]
+        pref = pref.at[jnp.clip(sel2, 0, S - 1)].add(
+            jnp.where(row2, w2[:, None], 0.0)
+        )
+    return AffinityState(counts, total, anti, pref)
+
+
+def selector_activity(snap) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(anti_active [S], spread_active [S]): selectors referenced by any
+    required anti-affinity term (pending or existing pods) / any topology
+    spread constraint — the selectors whose MATCHERS matter for the
+    round-commit interaction guards."""
+    S = snap.sel_exprs.shape[0]
+
+    def mark(terms_sel):  # i32 [..] selector ids (-1 pad) -> bool [S]
+        flat = terms_sel.reshape(-1)
+        return (
+            jnp.zeros((S,), bool)
+            .at[jnp.clip(flat, 0, S - 1)]
+            .max(flat >= 0)
+        )
+
+    anti_active = mark(snap.pod_anti_terms[..., 0]) | mark(
+        snap.exist_anti_terms[..., 0]
+    )
+    spread_active = mark(snap.pod_tsc[..., 1])
+    return anti_active, spread_active

@@ -1,0 +1,431 @@
+"""Round-based batched commit: the TPU-first ScheduleOne batching.
+
+The sequential commit scan (ops/commit.py) preserves exact one-pod-at-a-
+time semantics but costs one `lax.scan` step per pod — and a TPU scan step
+is latency-bound (~100us+ through the sequencer), so 10k pods cost seconds
+regardless of how little work each step does. This module replaces the
+per-pod loop with a small number of ROUNDS; each round is a handful of
+large batched ops (matmuls, row-gathers, sorts, segmented scans) that use
+the MXU/VPU at full width:
+
+  1. CLAIM   — every still-pending pod evaluates all plugin masks/scores
+               against the current state (exactly: the same kernels the
+               scan uses, batched over [B, N]) and claims its best node
+               (nominated node first, then argmax with a deterministic
+               hash tie-break — the analogue of upstream selectHost's
+               random tie-break, which also prevents herding).
+  2. ACCEPT  — claims are resolved in `pod_order` rank without any
+               sequential host loop:
+               a. per-node capacity: sort claims by (node, rank), then a
+                  segmented exclusive prefix-sum of requests admits each
+                  claimant iff it still fits (earlier-rank claimants of
+                  the same node are charged first);
+               b. interaction guards: claims that could invalidate one
+                  another within the round (required anti-affinity,
+                  DoNotSchedule spread skew, affinity bootstrap, hostPort
+                  exclusivity) are resolved by a participant table — one
+                  row per (claimant, constraint-role) — sorted by
+                  (group, rank) and swept with segmented exclusive scans.
+                  Rank order within a group decides, exactly like the
+                  sequential scan would have.
+  3. UPDATE  — accepted placements fold into the running state in one
+               batched pass (segment-adds into domain counts, scatter
+               rows into the symmetric tables, port-bitmap scatter).
+
+Rounds repeat (lax.while_loop) until no claim is accepted or `max_rounds`
+is hit; leftover pods are unschedulable this cycle. Round 1 runs over the
+full pending set; subsequent rounds run over a COMPACTED view — the
+lowest-rank `P/compact` still-active pods, re-gathered each round — since
+round 1 typically places the large majority, and [B, N] work shrinks
+proportionally. The compacted view is a real ClusterSnapshot whose
+pod-axis arrays are gathered at the active ids, so every plugin kernel
+runs unchanged.
+
+Semantics contract (documented deviation from the strict scan):
+  - Every accepted placement satisfies every filter against the state at
+    the start of its round, and the guards make same-round acceptances
+    mutually consistent, so the FINAL assignment is valid under the final
+    state — same validity invariant the sequential scan provides
+    (oracle.validate_rounds_assignment checks it).
+  - Guards count REJECTED claimants too (conservative): a claim that lost
+    capacity can still hold an anti-affinity slot for its round; the loser
+    simply retries next round against the true state. This only delays
+    placements, never invalidates them.
+  - Outcomes can differ from the strict scan where in-cycle contention
+    exists (scores against a slightly older state, hash tie-break); the
+    strict scan remains available as commit_mode="scan".
+
+A pod that matches more than MS_MATCH guard-active selectors overflows the
+matcher table; overflow claimants are deferred while any normal claimant
+exists and then accepted one per round (exact, since they run alone).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..models import encoding as enc
+from . import interpod as interpod_ops
+
+NEG_INF = -1e9
+_REL_EPS = 1e-5  # mirrors ops/resources.py fit slack
+MS_MATCH = 4  # guard-active selectors tracked per pod (overflow = defer)
+TIE_EPS = 1e-3
+_PR1 = jnp.uint32(2654435761)
+_PR2 = jnp.uint32(40503)
+_BIG = jnp.int32(2**31 - 1)
+
+# participant role bits (packed into one sort operand)
+_RB_MATCH = 1
+_RB_ANTI = 2
+_RB_BOOT = 4
+_RB_GMATCH = 8
+_RB_SPREAD = 16
+_RB_PORT = 32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class RoundsResult:
+    assignment: jnp.ndarray  # i32 [P] node index or -1
+    node_requested: jnp.ndarray  # f32 [N, R] post-commit
+    extra: Any  # final plugin state
+    rounds_used: jnp.ndarray  # i32 []
+    final_mask: jnp.ndarray  # bool [P, N] dyn&static mask vs FINAL state
+    final_per_filter: Any  # list of [P,N] masks (None for maskless), final
+
+
+def _tie_break(gid: jnp.ndarray, N: int) -> jnp.ndarray:
+    """f32 [B, N] in [0, TIE_EPS), keyed on GLOBAL pod id so compaction
+    does not change a pod's tie-break row."""
+    p = gid.astype(jnp.uint32)[:, None]
+    n = jax.lax.broadcasted_iota(jnp.uint32, (1, N), 1)
+    h = (p * _PR1 + n * _PR2) & jnp.uint32(0xFFFF)
+    return h.astype(jnp.float32) * (TIE_EPS / 65536.0)
+
+
+def _matched_active(m_pending, active_sel, ms: int):
+    """Per-pod list of up to `ms` guard-active selectors it matches.
+
+    Returns (sels i32 [P, ms] (-1 pad), overflow bool [P]). Selector ids
+    ascending (deterministic)."""
+    S, P = m_pending.shape
+    m = m_pending & active_sel[:, None]  # [S, P]
+    vals = jnp.where(m, (S - jnp.arange(S, dtype=jnp.int32))[:, None], 0)
+    top, idx = jax.lax.top_k(vals.T, ms)  # [P, ms]
+    sels = jnp.where(top > 0, idx, -1)
+    overflow = jnp.sum(m, axis=0) > ms
+    return sels.astype(jnp.int32), overflow
+
+
+def _pod_view(snap, gid: jnp.ndarray):
+    """A ClusterSnapshot whose pod-axis arrays are gathered at `gid` —
+    plugin kernels run on it unchanged with P = len(gid)."""
+    updates = {
+        f.name: getattr(snap, f.name)[gid]
+        for f in dataclasses.fields(snap)
+        if f.name.startswith("pod_")
+    }
+    return dataclasses.replace(snap, **updates)
+
+
+def _seg_scan_tables(keys, pods, counts):
+    """Entries sorted by (key, rank): for each 0/1 indicator column,
+    return the in-segment count strictly before each entry's POD (one
+    pod's own entries never block each other)."""
+    L = keys.shape[0]
+    i = jnp.arange(L, dtype=jnp.int32)
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), keys[1:] != keys[:-1]]
+    )
+    run_start = seg_start | jnp.concatenate(
+        [jnp.ones((1,), bool), pods[1:] != pods[:-1]]
+    )
+    seg_first = jax.lax.cummax(jnp.where(seg_start, i, -1))
+    run_first = jax.lax.cummax(jnp.where(run_start, i, -1))
+    out = {}
+    for name, x in counts.items():
+        c = jnp.cumsum(x)
+        before = c - x  # strictly before index j
+        out[name] = before[run_first] - before[seg_first]
+    return out
+
+
+def _owner_state(ext_state):
+    for v in ext_state.values():
+        if isinstance(v, interpod_ops.AffinityState):
+            return v
+    return None
+
+
+def rounds_commit(
+    *,
+    snap,
+    static_mask: jnp.ndarray,  # bool [P, N]
+    static_score: jnp.ndarray,  # f32 [P, N]
+    m_pending: jnp.ndarray,  # bool [S, P]
+    dyn_batched_view_fn: Callable,  # (vsnap, vmp, node_req, ext, vsmask)
+    #   -> (mask [B,N], score [B,N], per_filter)
+    update_batched_view_fn: Callable,  # (vsnap, vmp, ext, accepted, node_of)
+    extra: Any,
+    max_rounds: int = 64,
+    compact: int = 8,
+) -> RoundsResult:
+    P, N = static_mask.shape
+    S = m_pending.shape[0]
+    D = snap.domain_key.shape[0]
+    K = snap.node_domains.shape[1]
+    MA = snap.pod_anti_terms.shape[1]
+    MC = snap.pod_tsc.shape[1]
+    Q = snap.num_distinct_ports
+    MPorts = snap.pod_port_ids.shape[1]
+
+    rank_g = snap.pod_order.astype(jnp.int32)  # [P] lower = earlier
+
+    # guard-active selectors (static per cycle)
+    anti_active, spread_active = interpod_ops.selector_activity(snap)
+    aff_used = (
+        jnp.zeros((S,), bool)
+        .at[jnp.clip(snap.pod_aff_terms[..., 0].reshape(-1), 0, S - 1)]
+        .max(snap.pod_aff_terms[..., 0].reshape(-1) >= 0)
+    )
+    active_sel = anti_active | spread_active | aff_used
+    matched_sels_g, overflow_g = _matched_active(
+        m_pending, active_sel, MS_MATCH
+    )
+
+    has_guards = bool(snap.has_inter_pod_affinity or snap.has_topology_spread)
+    has_port_guards = bool(Q > 0)
+
+    # group-key space: domain groups, per-selector global groups,
+    # (node, port) groups, invalid
+    GK_GLOBAL = S * (D + 1)
+    GK_PORT = GK_GLOBAL + S
+    GK_INVALID = GK_PORT + N * Q + 1
+
+    slack = _REL_EPS * snap.node_allocatable + _REL_EPS  # [N, R]
+
+    def guards_ok(vsnap, vrank, vsels, choice, live, ext_state):
+        """Participant-table sweep; ok bool [B] for live claims."""
+        B = vrank.shape[0]
+        state = _owner_state(ext_state) if has_guards else None
+        if state is None and not has_port_guards:
+            return jnp.ones((B,), bool)
+        nsafe = jnp.clip(choice, 0, N - 1)
+        pid = jnp.arange(B, dtype=jnp.int32)
+
+        keys, roles, caps = [], [], []
+
+        def emit(key, valid, role, cap=None):
+            keys.append(jnp.where(valid & live, key, GK_INVALID))
+            roles.append(jnp.full((B,), role, jnp.int32))
+            caps.append(cap if cap is not None
+                        else jnp.full((B,), 2**30, jnp.int32))
+
+        if state is not None:
+            # each capability pays only for its own machinery: affinity-
+            # only clusters never trace the spread sections and vice versa
+            # (the encoder's capability-flag convention)
+            node_dom = snap.node_domains[nsafe]  # [B, K]
+            boot_active = state.total == 0  # [S]
+            if snap.has_inter_pod_affinity:
+                for a in range(MA):
+                    sel = vsnap.pod_anti_terms[:, a, 0]
+                    k = jnp.clip(vsnap.pod_anti_terms[:, a, 1], 0, K - 1)
+                    d = jnp.take_along_axis(node_dom, k[:, None], 1)[:, 0]
+                    key = jnp.clip(sel, 0, S - 1) * (D + 1) + (d + 1)
+                    emit(key, (sel >= 0) & (d >= 0), _RB_ANTI)
+                for a in range(MA):
+                    sel = vsnap.pod_aff_terms[:, a, 0]
+                    scl = jnp.clip(sel, 0, S - 1)
+                    emit(GK_GLOBAL + scl, (sel >= 0) & boot_active[scl],
+                         _RB_BOOT)
+            if snap.has_topology_spread:
+                minc = interpod_ops.spread_minc(snap, state)  # [K*S]
+                for c in range(MC):
+                    k = vsnap.pod_tsc[:, c, 0]
+                    sel = vsnap.pod_tsc[:, c, 1]
+                    when = vsnap.pod_tsc[:, c, 2]
+                    kcl = jnp.clip(k, 0, K - 1)
+                    d = jnp.take_along_axis(node_dom, kcl[:, None], 1)[:, 0]
+                    scl = jnp.clip(sel, 0, S - 1)
+                    hard = (k >= 0) & (when == enc.WHEN_DO_NOT_SCHEDULE) & (
+                        d >= 0
+                    )
+                    cnt = state.counts[scl, jnp.clip(d, 0, D - 1)]  # [B]
+                    mc = minc[kcl * S + scl]
+                    cap = (
+                        vsnap.pod_tsc_skew[:, c].astype(jnp.float32)
+                        - cnt + mc
+                    ).astype(jnp.int32)
+                    emit(scl * (D + 1) + (d + 1), hard, _RB_SPREAD,
+                         cap=jnp.maximum(cap, 1))
+            # matchers feed the anti guard AND the spread arrival counts —
+            # needed whenever either capability is on
+            for m in range(MS_MATCH):
+                sel = vsels[:, m]
+                scl = jnp.clip(sel, 0, S - 1)
+                for k in range(K):
+                    d = node_dom[:, k]
+                    emit(scl * (D + 1) + (d + 1), (sel >= 0) & (d >= 0),
+                         _RB_MATCH)
+                if snap.has_inter_pod_affinity:
+                    emit(GK_GLOBAL + scl, (sel >= 0) & boot_active[scl],
+                         _RB_GMATCH)
+        if has_port_guards:
+            for j in range(MPorts):
+                ids = vsnap.pod_port_ids[:, j]
+                key = GK_PORT + nsafe * Q + jnp.clip(ids, 0, Q - 1)
+                emit(key, ids >= 0, _RB_PORT)
+
+        keys_c = jnp.concatenate(keys)
+        roles_c = jnp.concatenate(roles)
+        caps_c = jnp.concatenate(caps)
+        n_emit = len(keys)
+        pods_c = jnp.tile(pid, n_emit)
+        ranks_c = jnp.tile(vrank, n_emit)
+        alive = keys_c != GK_INVALID
+        roles_c = jnp.where(alive, roles_c, 0)
+
+        keys_s, ranks_s, pods_s, role_s, cap_s = jax.lax.sort(
+            (keys_c, ranks_c, pods_c, roles_c, caps_c), num_keys=2
+        )
+        before = _seg_scan_tables(
+            keys_s, pods_s,
+            {
+                "match": (role_s == _RB_MATCH).astype(jnp.int32),
+                "anti": (role_s == _RB_ANTI).astype(jnp.int32),
+                "boot": (role_s == _RB_BOOT).astype(jnp.int32),
+                "gmatch": (role_s == _RB_GMATCH).astype(jnp.int32),
+                "port": (role_s == _RB_PORT).astype(jnp.int32),
+                "arrive": ((role_s == _RB_MATCH) | (role_s == _RB_SPREAD))
+                .astype(jnp.int32),
+            },
+        )
+        ok_e = jnp.ones(keys_s.shape, bool)
+        ok_e &= jnp.where(role_s == _RB_ANTI, before["match"] == 0, True)
+        ok_e &= jnp.where(role_s == _RB_MATCH, before["anti"] == 0, True)
+        ok_e &= jnp.where(
+            role_s == _RB_BOOT,
+            (before["boot"] == 0) & (before["gmatch"] == 0),
+            True,
+        )
+        ok_e &= jnp.where(
+            role_s == _RB_SPREAD, before["arrive"] < cap_s, True
+        )
+        ok_e &= jnp.where(role_s == _RB_PORT, before["port"] == 0, True)
+        ok_e |= keys_s == GK_INVALID
+        ok_pod = (
+            jnp.ones((B,), jnp.int32).at[pods_s].min(ok_e.astype(jnp.int32))
+        )
+        return ok_pod > 0
+
+    def one_round(gid, act_v, node_req, ext):
+        """One claim/accept/update round over the pods in `gid` (global
+        ids; `act_v` marks which rows are genuinely active)."""
+        B = gid.shape[0]
+        vsnap = _pod_view(snap, gid)
+        vmp = m_pending[:, gid]
+        vsmask = static_mask[gid]
+        vsscore = static_score[gid]
+        vrank = rank_g[gid]
+        vsels = matched_sels_g[gid]
+        vovf = overflow_g[gid]
+
+        mask, score, _pf = dyn_batched_view_fn(
+            vsnap, vmp, node_req, ext, vsmask
+        )
+        mask = mask & vsmask & act_v[:, None]
+        eff = jnp.where(mask, vsscore + score + _tie_break(gid, N), NEG_INF)
+        pid = jnp.arange(B, dtype=jnp.int32)
+        nom = jnp.clip(vsnap.pod_nominated, 0, N - 1)
+        nom_ok = (vsnap.pod_nominated >= 0) & mask[pid, nom]
+        best = jnp.where(nom_ok, nom, jnp.argmax(eff, axis=1)).astype(
+            jnp.int32
+        )
+        has = mask[pid, best] & act_v & vsnap.pod_valid
+
+        # overflow claimants deferred while any normal claim exists; when
+        # only overflow claims remain, exactly one (lowest rank) runs alone
+        normal = has & ~vovf
+        any_normal = jnp.any(normal)
+        ovf_rank = jnp.min(jnp.where(has & vovf, vrank, _BIG))
+        ovf_pick = has & vovf & (vrank == ovf_rank) & ~any_normal
+        live = normal | ovf_pick
+
+        # ---- capacity acceptance (sorted segmented prefix) ----
+        sort_key = jnp.where(live, best * P + vrank, _BIG)
+        order = jnp.argsort(sort_key)
+        s_node = jnp.where(live, best, N)[order]
+        s_req = jnp.where(live[:, None], vsnap.pod_requested, 0.0)[order]
+        s_live = live[order]
+        cum = jnp.cumsum(s_req, axis=0)
+        before = cum - s_req
+        i = jnp.arange(B, dtype=jnp.int32)
+        seg_start = jnp.concatenate(
+            [jnp.ones((1,), bool), s_node[1:] != s_node[:-1]]
+        )
+        seg_first = jax.lax.cummax(jnp.where(seg_start, i, -1))
+        seg_before = before - before[seg_first]
+        nsafe = jnp.clip(s_node, 0, N - 1)
+        free = snap.node_allocatable[nsafe] - node_req[nsafe] + slack[nsafe]
+        fits = jnp.all(seg_before + s_req <= free, axis=1) & s_live
+        cap_ok = jnp.zeros((B,), bool).at[order].set(fits)
+
+        g_ok = guards_ok(vsnap, vrank, vsels, best, live, ext)
+        accepted = live & cap_ok & g_ok
+
+        node_of = jnp.where(accepted, best, 0)
+        req_add = jnp.where(accepted[:, None], vsnap.pod_requested, 0.0)
+        node_req = node_req.at[node_of].add(req_add)
+        ext = update_batched_view_fn(vsnap, vmp, ext, accepted, node_of)
+        return accepted, jnp.where(accepted, best, -1), node_req, ext
+
+    # ---- round 1: full pending set ----
+    gid0 = jnp.arange(P, dtype=jnp.int32)
+    acc0, node0, node_req, extra = one_round(
+        gid0, snap.pod_valid, snap.node_requested, extra
+    )
+    placed = jnp.where(acc0, node0, -1)
+    active = snap.pod_valid & ~acc0
+
+    # ---- rounds 2+: compacted to the lowest-rank actives ----
+    B = min(P, max(256, -(-P // compact) // 128 * 128))
+
+    def body(carry):
+        node_req, ext, placed, active, rnd, _ = carry
+        key = jnp.where(active, rank_g, _BIG)
+        gid = jnp.argsort(key)[:B].astype(jnp.int32)
+        act_v = active[gid]
+        accepted, node_of, node_req, ext = one_round(
+            gid, act_v, node_req, ext
+        )
+        placed = placed.at[gid].set(jnp.where(accepted, node_of, placed[gid]))
+        active = active.at[gid].set(act_v & ~accepted)
+        return (node_req, ext, placed, active, rnd + 1, jnp.any(accepted))
+
+    def cond(carry):
+        _, _, _, active, rnd, progressed = carry
+        return progressed & jnp.any(active) & (rnd < max_rounds)
+
+    node_req, extra, placed, active, rounds_used, _ = jax.lax.while_loop(
+        cond, body,
+        (node_req, extra, placed, active, jnp.int32(1), jnp.any(acc0)),
+    )
+
+    # final-state masks for reject attribution of leftover pods
+    fmask, _fs, per_filter = dyn_batched_view_fn(
+        snap, m_pending, node_req, extra, static_mask
+    )
+    return RoundsResult(
+        assignment=placed,
+        node_requested=node_req,
+        extra=extra,
+        rounds_used=rounds_used,
+        final_mask=fmask & static_mask,
+        final_per_filter=per_filter,
+    )

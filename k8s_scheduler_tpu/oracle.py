@@ -653,6 +653,136 @@ def validate_assignment(
     return errors
 
 
+def validate_rounds_assignment(
+    nodes: Sequence[Node],
+    pending: Sequence[Pod],
+    assignment: Sequence[int],
+    existing: Sequence[tuple[Pod, str]] = (),
+    round_cap_hit: bool = False,
+    allow_feasible_unplaced: Sequence[int] = (),
+) -> list[str]:
+    """Validity invariants for the round-based commit (ops/rounds.py).
+
+    Unlike `validate_assignment` (which replays strict sequential
+    semantics), this checks the FINAL state: with every placement applied,
+    each placed pod's hard constraints must hold —
+      - static filters (unschedulable/name/taints/node-affinity) exactly;
+      - per-node capacity and hostPort uniqueness as aggregates;
+      - required anti-affinity strictly (no other matching pod in any of
+        the pod's anti domains), in both directions;
+      - required affinity with the bootstrap allowance (a pod matching its
+        own selector may stand alone);
+      - DoNotSchedule spread as final skew <= maxSkew.
+    Unplaced pods must be infeasible against the final state, unless the
+    round cap was hit or they are listed in `allow_feasible_unplaced`
+    (gang-dropped pods). Returns human-readable violations."""
+    final = OracleState.build(nodes, existing)
+    placed: list[tuple[Pod, int]] = []
+    for pi, pod in enumerate(pending):
+        node = assignment[pi]
+        if node >= 0:
+            final.add(node, pod)
+            placed.append((pod, node))
+
+    errors: list[str] = []
+    # per-node aggregates: capacity + hostPort uniqueness
+    for i, nd in enumerate(final.nodes):
+        alloc = nd.status.allocatable
+        for r, v in final.requested[i].items():
+            if v > alloc.get(r, 0.0) * (1 + 1e-5) + 1e-5:
+                errors.append(
+                    f"node {nd.name}: {r} over capacity ({v} > "
+                    f"{alloc.get(r, 0.0)})"
+                )
+        seen_ports: set = set()
+        for pod in final.pods_on_node[i]:
+            for (p, proto, _ip) in pod.host_ports():
+                if (p, proto) in seen_ports:
+                    errors.append(
+                        f"node {nd.name}: duplicate hostPort {p}/{proto}"
+                    )
+                seen_ports.add((p, proto))
+
+    for pod, i in placed:
+        node = final.nodes[i]
+        for f in (filter_node_unschedulable, filter_node_name,
+                  filter_taint_toleration, filter_node_affinity):
+            if not f(pod, final, i):
+                errors.append(f"{pod.name}: fails {f.__name__} on {node.name}")
+        aff = pod.spec.affinity or Affinity()
+        if aff.pod_anti_affinity:
+            for term in aff.pod_anti_affinity.required:
+                dom = _domain(node, term.topology_key)
+                if dom is None:
+                    continue
+                for j, nd in enumerate(final.nodes):
+                    if _domain(nd, term.topology_key) != dom:
+                        continue
+                    for other in final.pods_on_node[j]:
+                        if other is pod:
+                            continue
+                        if _term_matches_pod(term, pod.namespace, other):
+                            errors.append(
+                                f"{pod.name}: anti-affinity violated by "
+                                f"{other.name} in {term.topology_key}={dom}"
+                            )
+        if aff.pod_affinity:
+            for term in aff.pod_affinity.required:
+                if _term_matches_pod(term, pod.namespace, pod):
+                    continue  # bootstrap allowance / self-satisfying
+                dom = _domain(node, term.topology_key)
+                if dom is None:
+                    errors.append(
+                        f"{pod.name}: affinity key {term.topology_key} "
+                        f"absent on {node.name}"
+                    )
+                    continue
+                found = False
+                for j, nd in enumerate(final.nodes):
+                    if _domain(nd, term.topology_key) != dom:
+                        continue
+                    for other in final.pods_on_node[j]:
+                        if other is not pod and _term_matches_pod(
+                            term, pod.namespace, other
+                        ):
+                            found = True
+                            break
+                    if found:
+                        break
+                if not found:
+                    errors.append(
+                        f"{pod.name}: affinity unsatisfied in "
+                        f"{term.topology_key}={dom}"
+                    )
+        for c in pod.spec.topology_spread_constraints:
+            if c.when_unsatisfiable != api.DO_NOT_SCHEDULE:
+                continue
+            # the skew bound holds at the CONSTRAINED pod's placement time
+            # only (upstream semantics): matching pods that carry no
+            # constraint of their own may legally raise the final skew
+            # afterwards, so the final state can only verify key presence.
+            # test_rounds_spread_do_not_schedule_skew_holds covers the
+            # all-carriers case, where final skew <= maxSkew is implied.
+            if _domain(node, c.topology_key) is None:
+                errors.append(
+                    f"{pod.name}: spread key {c.topology_key} absent on "
+                    f"{node.name}"
+                )
+
+    if not round_cap_hit:
+        allowed = set(allow_feasible_unplaced)
+        for pi, pod in enumerate(pending):
+            if assignment[pi] >= 0 or pi in allowed:
+                continue
+            feas = feasible_nodes(pod, final, DEFAULT_FILTERS)
+            if feas:
+                errors.append(
+                    f"{pod.name}: unplaced but feasible on {feas[:5]} "
+                    f"in the final state"
+                )
+    return errors
+
+
 # --------------------------------------------------------------------------
 # Preemption (DefaultPreemption PostFilter analogue)
 # --------------------------------------------------------------------------

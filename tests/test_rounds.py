@@ -1,0 +1,225 @@
+"""Round-based batched commit (ops/rounds.py): validity invariants,
+contention behaviour, determinism, and gang interplay.
+
+The rounds engine deliberately does NOT replicate the strict scan's exact
+placements (hash tie-break, scores against round-start state — the
+documented semantics contract in ops/rounds.py), so these tests check the
+properties that define correctness for it:
+
+  - every placement is valid under the FINAL cluster state (capacity,
+    ports, anti-affinity both directions, affinity w/ bootstrap, spread
+    skew) — `oracle.validate_rounds_assignment`;
+  - unplaced pods are genuinely infeasible against the final state;
+  - contention workloads (same hostPort, self-anti-affinity, tight
+    spread) converge across rounds to the same outcomes the sequential
+    scan reaches;
+  - identical snapshots produce identical assignments (determinism).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from k8s_scheduler_tpu import oracle
+from k8s_scheduler_tpu.core.cycle import build_cycle_fn
+from k8s_scheduler_tpu.models import SnapshotEncoder
+from k8s_scheduler_tpu.models.builders import MakeNode, MakePod
+from k8s_scheduler_tpu.utils.synth import make_cluster, make_gang_pods, make_pods
+
+
+def run_rounds(nodes, pods, existing=(), groups=(), **kw):
+    snap = SnapshotEncoder().encode(nodes, pods, existing, groups)
+    out = build_cycle_fn(commit_mode="rounds", **kw)(snap)
+    a = np.asarray(out.assignment)[: len(pods)]
+    return snap, out, a
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_rounds_validity_on_mixed_workload(seed):
+    nodes = make_cluster(40, taint_fraction=0.2)
+    pods = make_pods(
+        250,
+        seed=seed,
+        affinity_fraction=0.3,
+        anti_affinity_fraction=0.2,
+        spread_fraction=0.2,
+        selector_fraction=0.3,
+        toleration_fraction=0.2,
+        priorities=(0, 10, 100),
+        num_apps=25,
+    )
+    _, out, a = run_rounds(nodes, pods)
+    errors = oracle.validate_rounds_assignment(nodes, pods, a)
+    assert errors == [], errors[:10]
+
+
+def test_rounds_validity_with_existing_pods():
+    nodes = make_cluster(30)
+    existing_pods = make_pods(
+        60, seed=7, name_prefix="run", affinity_fraction=0.2,
+        anti_affinity_fraction=0.2, num_apps=10,
+    )
+    existing = [(p, f"node-{i % 30}") for i, p in enumerate(existing_pods)]
+    pods = make_pods(
+        120, seed=8, affinity_fraction=0.3, anti_affinity_fraction=0.3,
+        spread_fraction=0.3, num_apps=10,
+    )
+    _, out, a = run_rounds(nodes, pods, existing=existing)
+    errors = oracle.validate_rounds_assignment(nodes, pods, a, existing)
+    assert errors == [], errors[:10]
+
+
+def test_rounds_throughput_close_to_scan():
+    nodes = make_cluster(50)
+    pods = make_pods(
+        300, affinity_fraction=0.3, anti_affinity_fraction=0.2,
+        spread_fraction=0.2, num_apps=30,
+    )
+    snap = SnapshotEncoder().encode(nodes, pods)
+    scan = build_cycle_fn(commit_mode="scan")(snap)
+    rounds = build_cycle_fn(commit_mode="rounds")(snap)
+    v = np.asarray(snap.pod_valid)
+    n_scan = int((np.asarray(scan.assignment) >= 0)[v.nonzero()].sum())
+    n_rounds = int((np.asarray(rounds.assignment) >= 0)[v.nonzero()].sum())
+    # different tie-breaks can shift a few placements either way, but the
+    # engines must agree on workload-level throughput
+    assert abs(n_scan - n_rounds) <= max(3, int(0.02 * len(pods)))
+
+
+def test_rounds_hostport_exclusive_per_node():
+    # 12 pods all demanding hostPort 8080 on 4 nodes: exactly 4 place
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "32"}).obj() for i in range(4)]
+    pods = [
+        MakePod(f"p{i}").req({"cpu": "1"}).host_port(8080).created(float(i)).obj()
+        for i in range(12)
+    ]
+    _, out, a = run_rounds(nodes, pods)
+    placed = a[a >= 0]
+    assert len(placed) == 4
+    assert len(set(placed.tolist())) == 4  # one per node
+    assert oracle.validate_rounds_assignment(nodes, pods, a) == []
+
+
+def test_rounds_self_anti_affinity_one_per_node():
+    # classic one-replica-per-host: 6 replicas, 4 nodes -> 4 place
+    nodes = [
+        MakeNode(f"n{i}")
+        .capacity({"cpu": "32"})
+        .labels({"kubernetes.io/hostname": f"n{i}"})
+        .obj()
+        for i in range(4)
+    ]
+    pods = [
+        MakePod(f"r{i}")
+        .req({"cpu": "1"})
+        .labels({"app": "db"})
+        .pod_affinity("kubernetes.io/hostname", {"app": "db"}, anti=True)
+        .created(float(i))
+        .obj()
+        for i in range(6)
+    ]
+    _, out, a = run_rounds(nodes, pods)
+    placed = a[a >= 0]
+    assert len(placed) == 4
+    assert len(set(placed.tolist())) == 4
+    assert oracle.validate_rounds_assignment(nodes, pods, a) == []
+
+
+def test_rounds_spread_do_not_schedule_skew_holds():
+    # 3 zones x 2 nodes, 10 replicas, maxSkew=1 -> counts differ by <= 1
+    nodes = []
+    for i in range(6):
+        nodes.append(
+            MakeNode(f"n{i}")
+            .capacity({"cpu": "32"})
+            .labels({"topology.kubernetes.io/zone": f"z{i % 3}"})
+            .obj()
+        )
+    pods = [
+        MakePod(f"w{i}")
+        .req({"cpu": "1"})
+        .labels({"app": "web"})
+        .spread(1, "topology.kubernetes.io/zone", {"app": "web"})
+        .created(float(i))
+        .obj()
+        for i in range(10)
+    ]
+    _, out, a = run_rounds(nodes, pods)
+    assert (a >= 0).all()
+    zone_of = [i % 3 for i in range(6)]
+    counts = [0, 0, 0]
+    for node in a:
+        counts[zone_of[node]] += 1
+    assert max(counts) - min(counts) <= 1, counts
+    assert oracle.validate_rounds_assignment(nodes, pods, a) == []
+
+
+def test_rounds_affinity_bootstrap_and_colocation():
+    # a self-affine group: first pod bootstraps, the rest must co-locate
+    # in its zone
+    nodes = []
+    for i in range(4):
+        nodes.append(
+            MakeNode(f"n{i}")
+            .capacity({"cpu": "8"})
+            .labels({"topology.kubernetes.io/zone": f"z{i % 2}"})
+            .obj()
+        )
+    pods = [
+        MakePod(f"g{i}")
+        .req({"cpu": "1"})
+        .labels({"app": "grp"})
+        .pod_affinity("topology.kubernetes.io/zone", {"app": "grp"})
+        .created(float(i))
+        .obj()
+        for i in range(5)
+    ]
+    _, out, a = run_rounds(nodes, pods)
+    assert (a >= 0).all()
+    zones = {("z0" if n in (0, 2) else "z1") for n in a.tolist()}
+    assert len(zones) == 1, f"group split across zones: {sorted(zones)}"
+    assert oracle.validate_rounds_assignment(nodes, pods, a) == []
+
+
+def test_rounds_gang_unwind():
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "4"}).obj() for i in range(2)]
+    pods, groups = make_gang_pods(2, replicas=8, seed=3)
+    # 16 pods wanting >= 1 cpu each on 8 cpus: no gang fully places ->
+    # all-or-nothing unwind drops every placement of the failing group
+    snap = SnapshotEncoder().encode(nodes, pods, (), groups)
+    out = build_cycle_fn(commit_mode="rounds")(snap)
+    a = np.asarray(out.assignment)[: len(pods)]
+    dropped = np.asarray(out.gang_dropped)[: len(pods)]
+    placed_by_group = {}
+    for i, pod in enumerate(pods):
+        if a[i] >= 0:
+            placed_by_group.setdefault(pod.spec.pod_group, 0)
+            placed_by_group[pod.spec.pod_group] += 1
+    for g, n in placed_by_group.items():
+        assert n >= 8, f"group {g} placed {n} < minMember yet not unwound"
+    assert dropped.sum() >= 0  # unwind bookkeeping surfaced
+
+
+def test_rounds_deterministic():
+    nodes = make_cluster(30)
+    pods = make_pods(
+        200, affinity_fraction=0.3, anti_affinity_fraction=0.2,
+        spread_fraction=0.2, num_apps=20,
+    )
+    snap = SnapshotEncoder().encode(nodes, pods)
+    fn = build_cycle_fn(commit_mode="rounds")
+    a1 = np.asarray(fn(snap).assignment)
+    a2 = np.asarray(fn(snap).assignment)
+    assert (a1 == a2).all()
+
+
+def test_rounds_priority_dominance():
+    # one node, one slot: the high-priority pod must win it
+    nodes = [MakeNode("n0").capacity({"cpu": "2"}).obj()]
+    pods = [
+        MakePod("low").req({"cpu": "2"}).priority(0).created(0.0).obj(),
+        MakePod("high").req({"cpu": "2"}).priority(100).created(1.0).obj(),
+    ]
+    _, out, a = run_rounds(nodes, pods)
+    assert a[1] == 0 and a[0] == -1
