@@ -87,6 +87,7 @@ _DEFAULT_FILTERS = [
     "NodeAffinity",
     "NodePorts",
     "NodeResourcesFit",
+    "VolumeBinding",
     "InterPodAffinity",
     "PodTopologySpread",
 ]

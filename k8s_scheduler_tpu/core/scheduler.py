@@ -35,6 +35,9 @@ from ..internal.queue import (
     EVENT_POD_ADD,
     EVENT_POD_DELETE,
     EVENT_POD_UPDATE,
+    EVENT_PV_CHANGE,
+    EVENT_PVC_CHANGE,
+    EVENT_STORAGE_CLASS_CHANGE,
     SchedulingQueue,
 )
 from ..models.api import Node, Pod, PodGroup
@@ -95,6 +98,9 @@ class Scheduler:
         self._pad_bucket = pad_bucket
         self._profile_name = self.config.profiles[0].scheduler_name
         self._groups: dict[str, PodGroup] = {}
+        self._pvcs: dict[str, object] = {}  # "ns/name" -> PVC
+        self._pvs: dict[str, object] = {}  # name -> PV
+        self._storage_classes: dict[str, object] = {}
         # per-cycle decision log (consumed by the gRPC shim): what the last
         # schedule_cycle nominated (preemptors) and evicted (victims)
         self.last_nominations: list[tuple[Pod, str]] = []
@@ -151,6 +157,32 @@ class Scheduler:
     def add_pod_group(self, group: PodGroup) -> None:
         self._groups[group.name] = group
 
+    # ---- volume objects (VolumeBinding inputs) ---------------------------
+
+    def on_pvc_upsert(self, pvc) -> None:
+        self._pvcs[pvc.key] = pvc
+        self.queue.move_all_to_active_or_backoff(EVENT_PVC_CHANGE)
+
+    def on_pvc_delete(self, key: str) -> None:
+        self._pvcs.pop(key, None)
+        self.queue.move_all_to_active_or_backoff(EVENT_PVC_CHANGE)
+
+    def on_pv_upsert(self, pv) -> None:
+        self._pvs[pv.name] = pv
+        self.queue.move_all_to_active_or_backoff(EVENT_PV_CHANGE)
+
+    def on_pv_delete(self, name: str) -> None:
+        self._pvs.pop(name, None)
+        self.queue.move_all_to_active_or_backoff(EVENT_PV_CHANGE)
+
+    def on_storage_class_upsert(self, sc) -> None:
+        self._storage_classes[sc.name] = sc
+        self.queue.move_all_to_active_or_backoff(EVENT_STORAGE_CLASS_CHANGE)
+
+    def on_storage_class_delete(self, name: str) -> None:
+        self._storage_classes.pop(name, None)
+        self.queue.move_all_to_active_or_backoff(EVENT_STORAGE_CLASS_CHANGE)
+
     # ---- the cycle -------------------------------------------------------
 
     def schedule_cycle(self) -> CycleStats:
@@ -178,7 +210,11 @@ class Scheduler:
         self._encoder.pad_pods = _pad(len(pending), self._pad_bucket)
         self._encoder.pad_nodes = _pad(len(nodes), self._pad_bucket)
         snap = self._encoder.encode(
-            nodes, pending, existing, pod_groups=list(self._groups.values())
+            nodes, pending, existing,
+            pod_groups=list(self._groups.values()),
+            pvcs=list(self._pvcs.values()),
+            pvs=list(self._pvs.values()),
+            storage_classes=list(self._storage_classes.values()),
         )
         t_encode = self._now()
         self.metrics.cycle_duration.labels(phase="encode").observe(
@@ -334,6 +370,9 @@ class Scheduler:
             pending,
             self.cache.existing_pods(),
             pod_groups=list(self._groups.values()),
+            pvcs=list(self._pvcs.values()),
+            pvs=list(self._pvs.values()),
+            storage_classes=list(self._storage_classes.values()),
         )
         return profile_plugins(self.framework, snap, self.metrics, repeats)
 

@@ -205,6 +205,22 @@ class NodeAffinity(PluginBase):
         return labels_ops.preferred_score(ctx.snap, ctx.expr_node_mask)
 
 
+class VolumeBinding(PluginBase):
+    """PVC/PV feasibility (ops/volumes.py): bound-PV node affinity,
+    static-PV candidacy, and dynamic-provisioning topology for
+    WaitForFirstConsumer claims. Static (commitment-independent): volume
+    state only changes between cycles, via PVC/PV informer events."""
+
+    name = "VolumeBinding"
+
+    def static_mask(self, ctx: CycleContext):
+        from ..ops import volumes as volumes_ops
+
+        if not ctx.snap.has_volumes:
+            return None
+        return volumes_ops.volume_mask(ctx.snap, ctx.expr_node_mask)
+
+
 class TaintToleration(PluginBase):
     name = "TaintToleration"
 

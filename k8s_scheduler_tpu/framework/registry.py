@@ -40,6 +40,7 @@ def default_registry() -> Registry:
         p.NodePorts,
         p.NodeResourcesFit,
         p.NodeResourcesBalancedAllocation,
+        p.VolumeBinding,
         p.NodeAffinity,
         p.TaintToleration,
         p.ImageLocality,

@@ -18,10 +18,8 @@ from .registry import Registry, default_registry
 
 # Default-enabled plugins whose TPU kernels are scheduled but not landed:
 # silently skipped when missing from the registry (unlike unknown names,
-# which raise). Shrinks as kernels land.
-PLANNED_PLUGINS = frozenset({
-    "VolumeBinding",
-})
+# which raise). Empty — every default plugin has a kernel.
+PLANNED_PLUGINS: frozenset[str] = frozenset()
 
 
 class Framework:

@@ -43,6 +43,9 @@ EVENT_NODE_DELETE = "NodeDelete"
 EVENT_POD_ADD = "PodAdd"
 EVENT_POD_UPDATE = "PodUpdate"
 EVENT_POD_DELETE = "PodDelete"
+EVENT_PVC_CHANGE = "PvcChange"  # PVC add/update (e.g. became bound)
+EVENT_PV_CHANGE = "PvChange"  # PV add/update (e.g. became available)
+EVENT_STORAGE_CLASS_CHANGE = "StorageClassChange"
 EVENT_UNSCHEDULABLE_TIMEOUT = "UnschedulableTimeout"
 
 # Which failure reasons (plugin names) an event can unstick — the
@@ -66,6 +69,10 @@ QUEUEING_HINTS: dict[str, frozenset[str]] = {
     ),
     "Coscheduling": frozenset({EVENT_POD_ADD, EVENT_POD_DELETE,
                                EVENT_NODE_ADD, EVENT_NODE_UPDATE}),
+    "VolumeBinding": frozenset({
+        EVENT_NODE_ADD, EVENT_NODE_UPDATE, EVENT_PVC_CHANGE,
+        EVENT_PV_CHANGE, EVENT_STORAGE_CLASS_CHANGE,
+    }),
 }
 
 

@@ -199,6 +199,9 @@ class PodSpec:
     overhead: dict[str, float] = field(default_factory=dict)
     # Gang scheduling (out-of-tree Coscheduling plugin's PodGroup label):
     pod_group: str = ""
+    # PVC names this pod mounts (spec.volumes[].persistentVolumeClaim.
+    # claimName) — consumed by the VolumeBinding filter
+    volumes: tuple[str, ...] = ()
 
 
 @dataclass
@@ -281,6 +284,48 @@ class PodGroup:
 
     name: str
     min_member: int
+
+
+# ---------------------------------------------------------------------------
+# Volumes (VolumeBinding filter inputs)
+# ---------------------------------------------------------------------------
+
+VOLUME_BINDING_IMMEDIATE = "Immediate"
+VOLUME_BINDING_WAIT = "WaitForFirstConsumer"
+
+
+@dataclass
+class StorageClass:
+    name: str
+    volume_binding_mode: str = VOLUME_BINDING_IMMEDIATE
+    # dynamic provisioning available (provisioner != no-provisioner)
+    provisioner: bool = True
+    # allowedTopologies, compiled like node-affinity terms (OR of terms)
+    allowed_topologies: tuple[NodeSelectorTerm, ...] = ()
+
+
+@dataclass
+class PersistentVolume:
+    name: str
+    capacity: float = 0.0  # storage bytes
+    storage_class: str = ""
+    # spec.nodeAffinity.required: OR of terms restricting usable nodes
+    node_affinity: tuple[NodeSelectorTerm, ...] = ()
+    # claimRef: bound to this PVC ("namespace/name"); "" = available
+    claim_ref: str = ""
+
+
+@dataclass
+class PersistentVolumeClaim:
+    name: str
+    namespace: str = "default"
+    storage_class: str = ""
+    request: float = 0.0  # requested storage bytes
+    volume_name: str = ""  # bound PV ("" = unbound)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
 
 
 # ---------------------------------------------------------------------------

@@ -152,6 +152,10 @@ class MakePod:
         )
         return self
 
+    def volume(self, claim_name: str) -> "MakePod":
+        self._pod.spec.volumes = self._pod.spec.volumes + (claim_name,)
+        return self
+
     def group(self, name: str) -> "MakePod":
         self._pod.spec.pod_group = name
         return self

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, field
-from typing import Sequence
+from typing import Any, Sequence
 
 import numpy as np
 
@@ -176,6 +176,7 @@ class ClusterSnapshot:
     # without affinity pays zero for the affinity machinery
     has_inter_pod_affinity: bool
     has_topology_spread: bool
+    has_volumes: bool
 
     # --- real (unpadded) counts: 0-d arrays, NOT static — a changed pod
     # count must not recompile the cycle (only padded shapes are static) ---
@@ -251,6 +252,21 @@ class ClusterSnapshot:
     pod_can_preempt: np.ndarray  # bool [P] (preemptionPolicy != Never)
     pod_valid: np.ndarray  # bool [P]
 
+    # --- volumes (VolumeBinding): per-pod PVC constraints [P, MVol] and
+    # the PV table [V]. mode: -1 pad, 0 bound (vol_req = PV node-affinity
+    # requirement id), 1 unbound WaitForFirstConsumer (vol_class/vol_size
+    # select static PV candidates; vol_req = dynamic-provisioning
+    # allowed-topology requirement id, -1 = anywhere, -2 = no dynamic),
+    # 2 impossible (missing PVC / unbound Immediate) ---
+    pod_vol_mode: np.ndarray  # i32 [P, MVol]
+    pod_vol_req: np.ndarray  # i32 [P, MVol]
+    pod_vol_class: np.ndarray  # i32 [P, MVol] interned class name
+    pod_vol_size: np.ndarray  # f32 [P, MVol]
+    pv_req_id: np.ndarray  # i32 [V] node-affinity requirement (-1 = any)
+    pv_class: np.ndarray  # i32 [V] interned class name
+    pv_capacity: np.ndarray  # f32 [V]
+    pv_avail: np.ndarray  # bool [V] unclaimed
+
     # --- pod groups [G] ---
     group_min_member: np.ndarray  # i32 [G]
     group_existing_count: np.ndarray  # i32 [G] members already running
@@ -320,8 +336,14 @@ _register_pytree()
 
 
 class SnapshotEncoder:
-    """Builds `ClusterSnapshot`s. Holds interners so ids are stable across
-    cycles (incremental cache updates reuse one encoder instance)."""
+    """Builds `ClusterSnapshot`s. Holds interners AND the derived intern
+    tables (expressions, selectors, tolerations, taints, requirement sets,
+    image sets, groups, topology keys, domains) so every id is stable
+    across cycles — which lets per-object encoded rows be CACHED: a pod or
+    node object seen before costs one dict lookup plus array writes
+    instead of re-running the compile pipeline. Steady-state re-encodes
+    (same cluster, fresh pending set) are dominated by row assembly, not
+    Python compilation."""
 
     def __init__(
         self,
@@ -333,6 +355,28 @@ class SnapshotEncoder:
         self.resource_names = list(resource_names)
         self.pad_pods = pad_pods
         self.pad_nodes = pad_nodes
+        # persistent intern tables (grow-only; ids stable across encodes)
+        self._exprs_t = _InternTable()  # rows: (key, op, vals, num)
+        self._reqs_t = _InternTable()  # rows: tuple of terms (expr-id tuples)
+        self._prefs_t = _InternTable()  # rows: tuple of (exprs, weight)
+        self._tols_t = _InternTable()  # rows: sorted (key, op, val, effect)
+        self._taints_t = _InternTable()  # rows: sorted (key, val, effect)
+        self._sels_t = _InternTable()  # rows: tuple of expr ids
+        self._imgsets_t = _InternTable()  # rows: sorted image ids
+        self._image_ids: dict[str, int] = {}
+        self._image_sizes: dict[int, float] = {}
+        self._group_ids: dict[str, int] = {}
+        self._topo_keys: list[str] = [HOSTNAME_LABEL]
+        self._domain_map: dict[tuple[int, int], int] = {}
+        # per-object row caches, keyed by id(); the tuple holds a strong
+        # reference so a live entry's id can never be reused. matchFields
+        # expressions bake node INDICES in, so entries carrying them are
+        # tagged with the node epoch and recompiled when the node set maps
+        # differently.
+        self._pod_cache: dict[int, tuple[Any, dict]] = {}
+        self._node_cache: dict[int, tuple[Any, dict]] = {}
+        self._node_epoch = 0
+        self._node_names: tuple[str, ...] = ()
 
     # -- small helpers -----------------------------------------------------
 
@@ -351,22 +395,18 @@ class SnapshotEncoder:
         pending: Sequence[Pod],
         existing: Sequence[tuple[Pod, str]] = (),
         pod_groups: Sequence[api.PodGroup] = (),
+        pvcs: Sequence[api.PersistentVolumeClaim] = (),
+        pvs: Sequence[api.PersistentVolume] = (),
+        storage_classes: Sequence[api.StorageClass] = (),
     ) -> ClusterSnapshot:
         """One-shot encode. `existing` is (pod, node_name) for every pod
         already assigned (bound or assumed)."""
         S = self.strings
         rn = self.resource_names
-        # Discover all resource names first so vectors have a single width.
-        for nd in nodes:
-            self._resources_vec(nd.status.allocatable)
-        reqs_pending = [self._resources_vec(p.resource_requests()) for p in pending]
-        reqs_exist = [self._resources_vec(p.resource_requests()) for p, _ in existing]
-        R = len(rn)
-
-        def vec(x: np.ndarray) -> np.ndarray:
-            out = np.zeros(R, np.float32)
-            out[: x.shape[0]] = x
-            return out
+        # Resource-name discovery happens as rows are built (node_rowdata /
+        # pod_rowdata call _resources_vec, which appends unseen names), so
+        # the R axis is read only AFTER the row walks below; cached rows
+        # from earlier encodes may be shorter and are right-padded.
 
         n_real, p_real, e_real = len(nodes), len(pending), len(existing)
         N = self.pad_nodes or _pow2_bucket(n_real)
@@ -374,15 +414,19 @@ class SnapshotEncoder:
         E = _pow2_bucket(e_real) if e_real else 8
 
         node_index = {nd.name: i for i, nd in enumerate(nodes)}
+        names_now = tuple(nd.name for nd in nodes)
+        if names_now != self._node_names:
+            self._node_names = names_now
+            self._node_epoch += 1
 
-        # ---- tables built during the walk ----
-        exprs_t = _InternTable()  # rows: (key, op, vals, num)
-        reqs_t = _InternTable()  # rows: tuple of terms (each a tuple of expr ids)
-        prefs_t = _InternTable()  # rows: tuple of (exprs, weight)
-        tols_t = _InternTable()  # rows: sorted (key, op, val, effect)
-        taints_t = _InternTable()  # rows: sorted (key, val, effect)
-        sels_t = _InternTable()  # rows: tuple of expr ids
-        imgsets_t = _InternTable()  # rows: sorted image ids
+        # ---- persistent tables (ids stable across encodes) ----
+        exprs_t = self._exprs_t
+        reqs_t = self._reqs_t
+        prefs_t = self._prefs_t
+        tols_t = self._tols_t
+        taints_t = self._taints_t
+        sels_t = self._sels_t
+        imgsets_t = self._imgsets_t
 
         def intern_expr(key: int, op: int, vals: tuple[int, ...], num: float) -> int:
             return exprs_t.intern((key, op, vals, num))
@@ -456,7 +500,7 @@ class SnapshotEncoder:
                 )
             )
 
-        topo_keys: list[str] = [HOSTNAME_LABEL]
+        topo_keys = self._topo_keys
 
         def topo_key_idx(key: str) -> int:
             if key not in topo_keys:
@@ -486,7 +530,8 @@ class SnapshotEncoder:
                 )
             return out
 
-        image_ids: dict[str, int] = {}
+        image_ids = self._image_ids
+        image_sizes = self._image_sizes
 
         def image_id(name: str) -> int:
             i = image_ids.get(name)
@@ -498,8 +543,7 @@ class SnapshotEncoder:
         def compile_imageset(images: Sequence[str]) -> int:
             return imgsets_t.intern(tuple(sorted(image_id(i) for i in images)))
 
-        group_ids: dict[str, int] = {}
-        group_min: list[int] = []
+        group_ids = self._group_ids
         declared = {g.name: g.min_member for g in pod_groups}
 
         def group_id(name: str) -> int:
@@ -509,13 +553,232 @@ class SnapshotEncoder:
             if i is None:
                 i = len(group_ids)
                 group_ids[name] = i
-                group_min.append(declared.get(name, 0))
             return i
 
-        # ---- walk nodes ----
-        ML = _pad_dim(
-            max((len(nd.metadata.labels) + 1 for nd in nodes), default=1), 8
+        # ---- volumes (VolumeBinding inputs) ----
+        pvc_map = {c.key: c for c in pvcs}
+        pv_map = {v.name: v for v in pvs}
+        class_map = {s.name: s for s in storage_classes}
+        vol_sig = (
+            tuple(sorted(
+                (c.key, c.volume_name, c.storage_class, c.request)
+                for c in pvcs
+            )),
+            tuple(sorted(
+                (v.name, v.claim_ref, v.storage_class, v.capacity,
+                 v.node_affinity)
+                for v in pvs
+            )),
+            tuple(sorted(
+                (s.name, s.volume_binding_mode, s.provisioner,
+                 s.allowed_topologies)
+                for s in storage_classes
+            )),
         )
+        if vol_sig != getattr(self, "_vol_sig", None):
+            self._vol_sig = vol_sig
+            self._vol_epoch = getattr(self, "_vol_epoch", 0) + 1
+        vol_epoch = getattr(self, "_vol_epoch", 0)
+
+        def _terms_use_fields(terms) -> bool:
+            return any(t.match_fields for t in terms)
+
+        def compile_pod_vols(p: Pod) -> tuple[list, bool]:
+            """((mode, req_id, class_id, size) per mounted PVC, uses_fields)
+            — see the ClusterSnapshot field docs for the row encoding.
+            uses_fields marks rows whose compiled requirements bake node
+            INDICES in (matchFields), which must invalidate on node-set
+            changes."""
+            rows: list[tuple[int, int, int, float]] = []
+            uses_fields = False
+            for claim in p.spec.volumes:
+                pvc = pvc_map.get(f"{p.namespace}/{claim}")
+                if pvc is None:  # missing PVC: unschedulable (upstream
+                    rows.append((2, -1, -1, 0.0))  # UnschedulableAndUnresolvable)
+                    continue
+                if pvc.volume_name:
+                    pv = pv_map.get(pvc.volume_name)
+                    if pv is None:
+                        rows.append((2, -1, -1, 0.0))
+                        continue
+                    rid = (
+                        compile_node_affinity_required(pv.node_affinity)
+                        if pv.node_affinity else -1
+                    )
+                    uses_fields |= _terms_use_fields(pv.node_affinity)
+                    rows.append((0, rid, -1, 0.0))
+                    continue
+                cls = class_map.get(pvc.storage_class)
+                if cls is None or (
+                    cls.volume_binding_mode != api.VOLUME_BINDING_WAIT
+                ):
+                    # unbound Immediate-mode PVC: the volume binder owns
+                    # it; the pod stays unschedulable until bound
+                    rows.append((2, -1, -1, 0.0))
+                    continue
+                if cls.provisioner:
+                    dyn = (
+                        compile_node_affinity_required(cls.allowed_topologies)
+                        if cls.allowed_topologies else -1
+                    )
+                    uses_fields |= _terms_use_fields(cls.allowed_topologies)
+                else:
+                    dyn = -2
+                rows.append(
+                    (1, dyn, S.intern(pvc.storage_class), float(pvc.request))
+                )
+            return rows, uses_fields
+
+        # ---- walk nodes (cached per object) ----
+        def node_rowdata(nd: Node) -> dict:
+            hit = self._node_cache.get(id(nd))
+            if hit is not None and hit[0] is nd:
+                return hit[1]
+            labels = dict(nd.metadata.labels)
+            labels.setdefault(HOSTNAME_LABEL, nd.name)
+            imgs = []
+            for img in nd.status.images:
+                for nm in img.names:
+                    ii = image_id(nm)
+                    imgs.append(ii)
+                    image_sizes[ii] = float(img.size_bytes)
+            data = {
+                "alloc": self._resources_vec(nd.status.allocatable),
+                "unsched": nd.spec.unschedulable,
+                "taintset": compile_taints(nd.spec.taints),
+                "labels": [
+                    (S.intern(k), S.intern(v), _num_or_nan(v))
+                    for k, v in sorted(labels.items())
+                ],
+                "label_map": {k: S.intern(v) for k, v in labels.items()},
+                "images": imgs,
+            }
+            self._node_cache[id(nd)] = (nd, data)
+            return data
+
+        node_rows = [node_rowdata(nd) for nd in nodes]
+
+        # ---- per-pod row data (cached per object) ----
+        def pod_rowdata(p: Pod) -> dict:
+            hit = self._pod_cache.get(id(p))
+            if hit is not None and hit[0] is p:
+                data = hit[1]
+                if (
+                    data["epoch"] is None or data["epoch"] == self._node_epoch
+                ) and (
+                    data["vol_epoch"] is None
+                    or data["vol_epoch"] == vol_epoch
+                ):
+                    return data
+            a = _aff(p)
+            req_id = -1
+            pref_id = -1
+            uses_fields = False
+            if a.node_affinity and a.node_affinity.required:
+                req_id = compile_node_affinity_required(a.node_affinity.required)
+                uses_fields = uses_fields or any(
+                    t.match_fields for t in a.node_affinity.required
+                )
+            if a.node_affinity and a.node_affinity.preferred:
+                pref_id = compile_node_affinity_preferred(a.node_affinity.preferred)
+                uses_fields = uses_fields or any(
+                    t.preference.match_fields for t in a.node_affinity.preferred
+                )
+            sel_req_id = -1
+            if p.spec.node_selector:
+                term = NodeSelectorTerm(
+                    tuple(
+                        NodeSelectorRequirement(k, api.OP_IN, (v,))
+                        for k, v in sorted(p.spec.node_selector.items())
+                    )
+                )
+                sel_req_id = compile_node_affinity_required([term])
+            ns = p.namespace
+            aff: list[tuple[int, int]] = []
+            anti: list[tuple[int, int]] = []
+            prefs: list[tuple[int, int, float]] = []
+            if a.pod_affinity:
+                aff = compile_aff_terms(a.pod_affinity.required, ns)
+                for w in a.pod_affinity.preferred:
+                    (s, k) = compile_aff_terms([w.term], ns)[0]
+                    prefs.append((s, k, float(w.weight)))
+            if a.pod_anti_affinity:
+                anti = compile_aff_terms(a.pod_anti_affinity.required, ns)
+                for w in a.pod_anti_affinity.preferred:
+                    (s, k) = compile_aff_terms([w.term], ns)[0]
+                    prefs.append((s, k, -float(w.weight)))
+            tsc = []
+            for c in p.spec.topology_spread_constraints:
+                when = (
+                    WHEN_DO_NOT_SCHEDULE
+                    if c.when_unsatisfiable == api.DO_NOT_SCHEDULE
+                    else WHEN_SCHEDULE_ANYWAY
+                )
+                tsc.append((
+                    topo_key_idx(c.topology_key),
+                    compile_selector(c.label_selector, (ns,)),
+                    when,
+                    c.max_skew,
+                ))
+            labels = [(S.intern(NAMESPACE_KEY), S.intern(ns))] + [
+                (S.intern(k), S.intern(v))
+                for k, v in sorted(p.metadata.labels.items())
+            ]
+            ports = [
+                port * 4 + {"TCP": 0, "UDP": 1, "SCTP": 2}.get(proto, 3)
+                for (port, proto, _) in p.host_ports()
+            ]
+            vols, vol_fields = compile_pod_vols(p)
+            data = {
+                "reqvec": self._resources_vec(p.resource_requests()),
+                "prio": p.spec.priority,
+                "req_id": req_id,
+                "pref_id": pref_id,
+                "sel_req_id": sel_req_id,
+                "tolset": compile_tolerations(p.spec.tolerations),
+                "labels": labels,
+                "ports": ports,
+                "aff": aff,
+                "anti": anti,
+                "prefaff": prefs,
+                "tsc": tsc,
+                "group": p.spec.pod_group,
+                "imageset": compile_imageset(p.images()),
+                "can_preempt": p.spec.preemption_policy != "Never",
+                "vols": vols,
+                "vol_epoch": vol_epoch if p.spec.volumes else None,
+                "epoch": (
+                    self._node_epoch if (uses_fields or vol_fields) else None
+                ),
+            }
+            self._pod_cache[id(p)] = (p, data)
+            return data
+
+        pend_rows = [pod_rowdata(p) for p in pending]
+        exist_rows = [pod_rowdata(p) for p, _ in existing]
+        all_rows = pend_rows + exist_rows
+
+        # mark-and-sweep the caches against the live object set: memory
+        # stays bounded by the cluster without the full-recompile cliff a
+        # wholesale clear() would cause
+        live_pods = {id(p) for p in pending} | {id(p) for p, _ in existing}
+        if len(self._pod_cache) > 2 * max(len(live_pods), 1):
+            self._pod_cache = {
+                k: v for k, v in self._pod_cache.items() if k in live_pods
+            }
+        live_nodes = {id(nd) for nd in nodes}
+        if len(self._node_cache) > 2 * max(len(live_nodes), 1):
+            self._node_cache = {
+                k: v for k, v in self._node_cache.items() if k in live_nodes
+            }
+
+        # the resource-name axis is final only now (row building above
+        # discovered every name, including from cached-and-reused rows'
+        # earlier encodes — rn is grow-only)
+        R = len(rn)
+
+        # ---- assemble node arrays ----
+        ML = _pad_dim(max([len(d["labels"]) for d in node_rows] + [1]), 8)
         node_alloc = np.zeros((N, R), np.float32)
         node_requested = np.zeros((N, R), np.float32)
         node_unsched = np.zeros(N, bool)
@@ -527,27 +790,19 @@ class SnapshotEncoder:
         node_valid[:n_real] = True
 
         node_image_sets: list[list[int]] = []
-        image_sizes: dict[int, float] = {}
 
-        for i, nd in enumerate(nodes):
-            node_alloc[i] = vec(self._resources_vec(nd.status.allocatable))
-            node_unsched[i] = nd.spec.unschedulable
-            node_taintset[i] = compile_taints(nd.spec.taints)
-            labels = dict(nd.metadata.labels)
-            labels.setdefault(HOSTNAME_LABEL, nd.name)
-            for j, (k, v) in enumerate(sorted(labels.items())):
-                nl_keys[i, j] = S.intern(k)
-                nl_vals[i, j] = S.intern(v)
-                nl_num[i, j] = _num_or_nan(v)
-            imgs = []
-            for img in nd.status.images:
-                for nm in img.names:
-                    ii = image_id(nm)
-                    imgs.append(ii)
-                    image_sizes[ii] = float(img.size_bytes)
-            node_image_sets.append(imgs)
+        for i, d in enumerate(node_rows):
+            a = d["alloc"]
+            node_alloc[i, : a.shape[0]] = a
+            node_unsched[i] = d["unsched"]
+            node_taintset[i] = d["taintset"]
+            for j, (ki, vi, num) in enumerate(d["labels"]):
+                nl_keys[i, j] = ki
+                nl_vals[i, j] = vi
+                nl_num[i, j] = num
+            node_image_sets.append(d["images"])
 
-        # ---- walk pending pods ----
+        # ---- assemble pending-pod arrays ----
         pod_req = np.zeros((P, R), np.float32)
         pod_prio = np.zeros(P, np.int32)
         pod_node_name = np.full(P, -1, np.int32)
@@ -562,24 +817,11 @@ class SnapshotEncoder:
         pod_valid = np.zeros(P, bool)
         pod_valid[:p_real] = True
 
-        MPL = _pad_dim(
-            max(
-                [len(p.metadata.labels) + 1 for p in pending]
-                + [len(p.metadata.labels) + 1 for p, _ in existing]
-                + [1]
-            ),
-            8,
-        )
+        MPL = _pad_dim(max([len(d["labels"]) for d in all_rows] + [1]), 8)
         pl_keys = np.full((P, MPL), -1, np.int32)
         pl_vals = np.full((P, MPL), -1, np.int32)
 
-        MPorts = _pad_dim(
-            max(
-                [len(p.host_ports()) for p in pending]
-                + [1]
-            ),
-            4,
-        )
+        MPorts = _pad_dim(max([len(d["ports"]) for d in pend_rows] + [1]), 4)
         pod_ports = np.full((P, MPorts), -1, np.int32)
         pod_port_ids = np.full((P, MPorts), -1, np.int32)
         port_ids_t = _InternTable()  # distinct (port, proto) among pending
@@ -587,12 +829,8 @@ class SnapshotEncoder:
         MA = _pad_dim(
             max(
                 [
-                    max(
-                        len(_aff(p).pod_affinity.required) if _aff(p).pod_affinity else 0,
-                        len(_aff(p).pod_anti_affinity.required) if _aff(p).pod_anti_affinity else 0,
-                        _pref_count(p),
-                    )
-                    for p in list(pending) + [p for p, _ in existing]
+                    max(len(d["aff"]), len(d["anti"]), len(d["prefaff"]))
+                    for d in all_rows
                 ]
                 + [1]
             ),
@@ -603,85 +841,69 @@ class SnapshotEncoder:
         pod_pref_aff = np.full((P, MA, 2), -1, np.int32)
         pod_pref_aff_w = np.zeros((P, MA), np.float32)
 
-        MC = _pad_dim(
-            max([len(p.spec.topology_spread_constraints) for p in pending] + [1]), 4
-        )
+        MC = _pad_dim(max([len(d["tsc"]) for d in pend_rows] + [1]), 4)
         pod_tsc = np.full((P, MC, 3), -1, np.int32)
         pod_tsc_skew = np.zeros((P, MC), np.int32)
 
-        def encode_pod_labels(p: Pod, keys: np.ndarray, vals: np.ndarray, row: int) -> None:
-            keys[row, 0] = S.intern(NAMESPACE_KEY)
-            vals[row, 0] = S.intern(p.namespace)
-            for j, (k, v) in enumerate(sorted(p.metadata.labels.items()), start=1):
-                keys[row, j] = S.intern(k)
-                vals[row, j] = S.intern(v)
+        MVol = _pad_dim(max([len(d["vols"]) for d in pend_rows] + [1]), 2)
+        pod_vol_mode = np.full((P, MVol), -1, np.int32)
+        pod_vol_req = np.full((P, MVol), -1, np.int32)
+        pod_vol_class = np.full((P, MVol), -1, np.int32)
+        pod_vol_size = np.zeros((P, MVol), np.float32)
 
-        def encode_aff(p: Pod, row: int, aff_arr, anti_arr, pref_arr, pref_w) -> None:
-            a = _aff(p)
-            ns = p.namespace
-            if a.pod_affinity:
-                for j, t in enumerate(compile_aff_terms(a.pod_affinity.required, ns)):
-                    aff_arr[row, j] = t
-            if a.pod_anti_affinity:
-                for j, t in enumerate(compile_aff_terms(a.pod_anti_affinity.required, ns)):
-                    anti_arr[row, j] = t
-            prefs: list[tuple[int, int, float]] = []
-            if a.pod_affinity:
-                for w in a.pod_affinity.preferred:
-                    (s, k) = compile_aff_terms([w.term], ns)[0]
-                    prefs.append((s, k, float(w.weight)))
-            if a.pod_anti_affinity:
-                for w in a.pod_anti_affinity.preferred:
-                    (s, k) = compile_aff_terms([w.term], ns)[0]
-                    prefs.append((s, k, -float(w.weight)))
-            for j, (s, k, w) in enumerate(prefs):
-                pref_arr[row, j] = (s, k)
-                pref_w[row, j] = w
+        V = _pad_dim(len(pvs), 4)
+        pv_req_arr = np.full(V, -1, np.int32)
+        pv_class_arr = np.full(V, -1, np.int32)
+        pv_cap_arr = np.zeros(V, np.float32)
+        pv_avail_arr = np.zeros(V, bool)
+        claimed_pvs = {c.volume_name for c in pvcs if c.volume_name}
+        for i, pv in enumerate(pvs):
+            pv_req_arr[i] = (
+                compile_node_affinity_required(pv.node_affinity)
+                if pv.node_affinity else -1
+            )
+            pv_class_arr[i] = S.intern(pv.storage_class)
+            pv_cap_arr[i] = pv.capacity
+            pv_avail_arr[i] = not pv.claim_ref and pv.name not in claimed_pvs
 
-        for i, p in enumerate(pending):
-            pod_req[i] = vec(reqs_pending[i])
-            pod_prio[i] = p.spec.priority
+        for i, (p, d) in enumerate(zip(pending, pend_rows)):
+            rv = d["reqvec"]
+            pod_req[i, : rv.shape[0]] = rv
+            pod_prio[i] = d["prio"]
             if p.spec.node_name:
                 pod_node_name[i] = node_index.get(p.spec.node_name, -2)
             if p.nominated_node_name:
                 pod_nominated[i] = node_index.get(p.nominated_node_name, -1)
-            a = _aff(p)
-            if a.node_affinity and a.node_affinity.required:
-                pod_req_id[i] = compile_node_affinity_required(a.node_affinity.required)
-            if a.node_affinity and a.node_affinity.preferred:
-                pod_pref_id[i] = compile_node_affinity_preferred(a.node_affinity.preferred)
-            if p.spec.node_selector:
-                term = NodeSelectorTerm(
-                    tuple(
-                        NodeSelectorRequirement(k, api.OP_IN, (v,))
-                        for k, v in sorted(p.spec.node_selector.items())
-                    )
-                )
-                pod_sel_req_id[i] = compile_node_affinity_required([term])
-            pod_tolset[i] = compile_tolerations(p.spec.tolerations)
-            encode_pod_labels(p, pl_keys, pl_vals, i)
-            for j, (port, proto, _) in enumerate(p.host_ports()):
-                enc_port = port * 4 + {"TCP": 0, "UDP": 1, "SCTP": 2}.get(proto, 3)
+            pod_req_id[i] = d["req_id"]
+            pod_pref_id[i] = d["pref_id"]
+            pod_sel_req_id[i] = d["sel_req_id"]
+            pod_tolset[i] = d["tolset"]
+            for j, (ki, vi) in enumerate(d["labels"]):
+                pl_keys[i, j] = ki
+                pl_vals[i, j] = vi
+            for j, enc_port in enumerate(d["ports"]):
                 pod_ports[i, j] = enc_port
                 pod_port_ids[i, j] = port_ids_t.intern(enc_port)
-            encode_aff(p, i, pod_aff_terms, pod_anti_terms, pod_pref_aff, pod_pref_aff_w)
-            for j, c in enumerate(p.spec.topology_spread_constraints):
-                when = (
-                    WHEN_DO_NOT_SCHEDULE
-                    if c.when_unsatisfiable == api.DO_NOT_SCHEDULE
-                    else WHEN_SCHEDULE_ANYWAY
-                )
-                pod_tsc[i, j] = (
-                    topo_key_idx(c.topology_key),
-                    compile_selector(c.label_selector, (p.namespace,)),
-                    when,
-                )
-                pod_tsc_skew[i, j] = c.max_skew
-            pod_group_arr[i] = group_id(p.spec.pod_group)
-            pod_imageset[i] = compile_imageset(p.images())
-            pod_can_preempt[i] = p.spec.preemption_policy != "Never"
+            for j, t in enumerate(d["aff"]):
+                pod_aff_terms[i, j] = t
+            for j, t in enumerate(d["anti"]):
+                pod_anti_terms[i, j] = t
+            for j, (s, k, w) in enumerate(d["prefaff"]):
+                pod_pref_aff[i, j] = (s, k)
+                pod_pref_aff_w[i, j] = w
+            for j, (kidx, sel, when, skew) in enumerate(d["tsc"]):
+                pod_tsc[i, j] = (kidx, sel, when)
+                pod_tsc_skew[i, j] = skew
+            for j, (mode, rid, cid, size) in enumerate(d["vols"]):
+                pod_vol_mode[i, j] = mode
+                pod_vol_req[i, j] = rid
+                pod_vol_class[i, j] = cid
+                pod_vol_size[i, j] = size
+            pod_group_arr[i] = group_id(d["group"])
+            pod_imageset[i] = d["imageset"]
+            pod_can_preempt[i] = d["can_preempt"]
 
-        # ---- walk existing pods ----
+        # ---- assemble existing-pod arrays ----
         exist_node = np.full(E, -1, np.int32)
         exist_prio = np.zeros(E, np.int32)
         exist_req = np.zeros((E, R), np.float32)
@@ -697,26 +919,29 @@ class SnapshotEncoder:
         per_node: list[list[int]] = [[] for _ in range(N)]
         # existing pods' own (non-anti) required affinity is not re-checked
         # against incoming pods (upstream symmetry applies to anti-affinity
-        # and preferred terms only), so those terms go to a scratch array
-        scratch_aff = np.full((E, MA, 2), -1, np.int32)
+        # and preferred terms only), so required-affinity terms are dropped
 
         exist_group = np.full(E, -1, np.int32)
-        for i, (p, node_name) in enumerate(existing):
+        for i, ((p, node_name), d) in enumerate(zip(existing, exist_rows)):
             ni = node_index.get(node_name, -1)
             exist_node[i] = ni
-            exist_prio[i] = p.spec.priority
-            exist_group[i] = group_id(p.spec.pod_group)
-            exist_req[i] = vec(reqs_exist[i])
-            encode_pod_labels(p, el_keys, el_vals, i)
-            encode_aff(p, i, scratch_aff, exist_anti,
-                       exist_pref, exist_pref_w)
+            exist_prio[i] = d["prio"]
+            exist_group[i] = group_id(d["group"])
+            rv = d["reqvec"]
+            exist_req[i, : rv.shape[0]] = rv
+            for j, (ki, vi) in enumerate(d["labels"]):
+                el_keys[i, j] = ki
+                el_vals[i, j] = vi
+            for j, t in enumerate(d["anti"]):
+                exist_anti[i, j] = t
+            for j, (s, k, w) in enumerate(d["prefaff"]):
+                exist_pref[i, j] = (s, k)
+                exist_pref_w[i, j] = w
             if ni >= 0:
                 node_requested[ni] += exist_req[i]
                 per_node[ni].append(i)
-                for (port, proto, _) in p.host_ports():
-                    used_ports[ni].append(
-                        port * 4 + {"TCP": 0, "UDP": 1, "SCTP": 2}.get(proto, 3)
-                    )
+                for enc_port in d["ports"]:
+                    used_ports[ni].append(enc_port)
 
         MUP = _pad_dim(max([len(u) for u in used_ports] + [1]), 4)
         node_used_ports = np.full((N, MUP), -1, np.int32)
@@ -910,6 +1135,15 @@ class SnapshotEncoder:
                 or (exist_pref >= 0).any()
             ),
             has_topology_spread=bool((pod_tsc >= 0).any()),
+            has_volumes=bool((pod_vol_mode >= 0).any()),
+            pod_vol_mode=pod_vol_mode,
+            pod_vol_req=pod_vol_req,
+            pod_vol_class=pod_vol_class,
+            pod_vol_size=pod_vol_size,
+            pv_req_id=pv_req_arr,
+            pv_class=pv_class_arr,
+            pv_capacity=pv_cap_arr,
+            pv_avail=pv_avail_arr,
             pod_aff_terms=pod_aff_terms,
             pod_anti_terms=pod_anti_terms,
             pod_pref_aff=pod_pref_aff,

@@ -63,6 +63,12 @@ def run_preemption(
     excluded: jnp.ndarray | None = None,  # bool [P] never preempt (e.g.
     # gang-dropped members: they fit without eviction, their group is what
     # failed — upstream never runs PostFilter for Permit rejections)
+    budget: int = 1024,  # max preemptor candidates dry-run per cycle: the
+    # scan runs over the `budget` lowest-rank unschedulable pods instead of
+    # the whole pending set (a TPU scan step costs ~150us, so a full-P scan
+    # at 10k pods is seconds); candidates beyond the budget stay queued and
+    # get their attempt next cycle — upstream nominates one pod per
+    # ScheduleOne iteration, so a 1k-per-cycle budget is already generous
 ) -> PreemptionResult:
     P, N = static_mask.shape
     E = snap.E
@@ -92,11 +98,14 @@ def run_preemption(
     unschedulable = snap.pod_valid & (assignment < 0) & snap.pod_can_preempt
     if excluded is not None:
         unschedulable = unschedulable & ~excluded
-    order = jnp.argsort(snap.pod_order)
+    # compact to the budgeted lowest-rank candidates (rank order preserved)
+    C = min(P, budget)
+    cand_key = jnp.where(unschedulable, snap.pod_order, _BIG_I32)
+    cand_ids = jnp.argsort(cand_key)[:C].astype(jnp.int32)
 
     def step(carry, rank):
         k_claimed, nominated_req, victim_mask = carry
-        p = order[rank]
+        p = cand_ids[rank]
         prio = snap.pod_priority[p]
 
         # eligible victims: strictly lower priority than the preemptor
@@ -159,7 +168,7 @@ def run_preemption(
         jnp.zeros(E, bool),
     )
     (_, _, victims), (pods, noms) = jax.lax.scan(
-        step, init, jnp.arange(P, dtype=jnp.int32)
+        step, init, jnp.arange(C, dtype=jnp.int32)
     )
     nominated = jnp.full(P, -1, jnp.int32).at[pods].set(noms)
     return PreemptionResult(

@@ -1,0 +1,78 @@
+"""VolumeBinding filter kernel (SURVEY.md §2 C7).
+
+The reference's VolumeBinding plugin (expected
+`framework/plugins/volumebinding/` — [UNVERIFIED], mount empty) decides,
+per pod per node, whether the pod's PVCs can be satisfied there:
+
+  - a BOUND PVC restricts the pod to nodes satisfying its PV's
+    nodeAffinity (zone/hostname-restricted volumes);
+  - an UNBOUND WaitForFirstConsumer PVC needs either an available static
+    PV (class + capacity + nodeAffinity match) or dynamic provisioning
+    whose storage-class allowedTopologies admit the node;
+  - a missing PVC or an unbound Immediate-mode PVC makes the pod
+    unschedulable (upstream UnschedulableAndUnresolvable).
+
+TPU-native shape: PV nodeAffinity terms compile through the SAME
+requirement machinery as pod node-affinity (encoder interns them into
+`rq_exprs`), so the per-PV node masks are rows of the shared [Rq, N]
+requirement table. The static-candidate test batches into one
+[P*MVol, V] x [V, N] matmul; everything is gated on the `has_volumes`
+capability flag, so volume-free clusters never trace any of it.
+
+Same-cycle contention for one static PV (two pods, one volume) is NOT
+arbitrated in-cycle: upstream binds volumes in PreBind and relies on
+bind-failure retry for the loser, and this kernel inherits that contract
+(the agent reports the failed bind; the pod requeues).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import labels as labels_ops
+
+_CAP_EPS = 1e-3
+
+
+def volume_mask(snap, expr_mask: jnp.ndarray) -> jnp.ndarray:  # bool [P, N]
+    """Conjunction over each pod's PVC constraints (module docstring)."""
+    P, N = snap.P, snap.N
+    req = labels_ops.requirement_mask(snap.rq_exprs, expr_mask)  # [Rq, N]
+    Rq = req.shape[0]
+    MVol = snap.pod_vol_mode.shape[1]
+
+    def req_rows(ids):  # i32 [X] -> bool [X, N]; id < 0 -> all-True
+        r = req[jnp.clip(ids, 0, Rq - 1)]
+        return jnp.where((ids >= 0)[:, None], r, True)
+
+    pv_node_ok = req_rows(snap.pv_req_id) & snap.pv_avail[:, None]  # [V, N]
+
+    ok = jnp.ones((P, N), bool)
+    for j in range(MVol):
+        mode = snap.pod_vol_mode[:, j]  # [P]
+        rid = snap.pod_vol_req[:, j]
+        cls = snap.pod_vol_class[:, j]
+        size = snap.pod_vol_size[:, j]
+
+        rid_rows = req_rows(rid)  # [P, N] (bound PV affinity / dyn topology)
+
+        # static candidates: available PVs of the right class and size,
+        # usable on the node
+        cand = (
+            (snap.pv_class[None, :] == cls[:, None])
+            & (snap.pv_capacity[None, :] + _CAP_EPS >= size[:, None])
+        )  # [P, V] (availability folded into pv_node_ok)
+        static_ok = (
+            cand.astype(jnp.float32) @ pv_node_ok.astype(jnp.float32)
+        ) > 0.0  # [P, N]
+
+        dyn_ok = jnp.where(
+            (rid == -2)[:, None], False, rid_rows
+        )  # -1 folds to all-True via req_rows
+        row_ok = jnp.where(
+            (mode == 0)[:, None],
+            rid_rows,
+            jnp.where((mode == 1)[:, None], static_ok | dyn_ok, False),
+        )
+        ok &= jnp.where((mode >= 0)[:, None], row_ok, True)
+    return ok

@@ -117,14 +117,37 @@ class OracleState:
     # invalidate via a version bumped on every add/remove
     _version: int = 0
     _bootstrap: dict = dataclasses.field(default_factory=dict)
+    # volumes (VolumeBinding): keyed maps, empty = no volume constraints
+    pvcs: dict = dataclasses.field(default_factory=dict)  # "ns/name" -> PVC
+    pvs: dict = dataclasses.field(default_factory=dict)  # name -> PV
+    storage_classes: dict = dataclasses.field(default_factory=dict)
+    # derived volume indexes (built once; volume state is per-cycle input)
+    pvs_by_class: dict = dataclasses.field(default_factory=dict)
+    claimed_pv_names: set = dataclasses.field(default_factory=set)
 
     @staticmethod
-    def build(nodes: Sequence[Node], existing: Sequence[tuple[Pod, str]]) -> "OracleState":
+    def build(
+        nodes: Sequence[Node],
+        existing: Sequence[tuple[Pod, str]],
+        pvcs: Sequence = (),
+        pvs: Sequence = (),
+        storage_classes: Sequence = (),
+    ) -> "OracleState":
         idx = {n.name: i for i, n in enumerate(nodes)}
+        by_class: dict = {}
+        for v in pvs:
+            by_class.setdefault(v.storage_class, []).append(v)
         st = OracleState(
             nodes=list(nodes),
             requested=[{} for _ in nodes],
             pods_on_node=[[] for _ in nodes],
+            pvcs={c.key: c for c in pvcs},
+            pvs={v.name: v for v in pvs},
+            storage_classes={s.name: s for s in storage_classes},
+            pvs_by_class=by_class,
+            claimed_pv_names={
+                c.volume_name for c in pvcs if c.volume_name
+            },
         )
         for pod, node_name in existing:
             i = idx.get(node_name)
@@ -287,6 +310,51 @@ def filter_inter_pod_affinity(pod: Pod, state: OracleState, i: int) -> bool:
     return True
 
 
+def filter_volume_binding(pod: Pod, state: OracleState, i: int) -> bool:
+    """Mirror of ops/volumes.py: bound-PV node affinity; unbound
+    WaitForFirstConsumer claims need a static candidate PV or dynamic
+    provisioning whose allowedTopologies admit the node; missing PVCs and
+    unbound Immediate claims are unschedulable."""
+    if not pod.spec.volumes:
+        return True
+    node = state.nodes[i]
+    for claim in pod.spec.volumes:
+        pvc = state.pvcs.get(f"{pod.namespace}/{claim}")
+        if pvc is None:
+            return False
+        if pvc.volume_name:
+            pv = state.pvs.get(pvc.volume_name)
+            if pv is None:
+                return False
+            if pv.node_affinity and not any(
+                _match_term(node, t) for t in pv.node_affinity
+            ):
+                return False
+            continue
+        cls = state.storage_classes.get(pvc.storage_class)
+        if cls is None or cls.volume_binding_mode != api.VOLUME_BINDING_WAIT:
+            return False
+        ok = False
+        for pv in state.pvs_by_class.get(pvc.storage_class, ()):
+            if pv.claim_ref or pv.name in state.claimed_pv_names:
+                continue
+            if pv.capacity + 1e-3 < pvc.request:
+                continue
+            if pv.node_affinity and not any(
+                _match_term(node, t) for t in pv.node_affinity
+            ):
+                continue
+            ok = True
+            break
+        if not ok and cls.provisioner:
+            ok = not cls.allowed_topologies or any(
+                _match_term(node, t) for t in cls.allowed_topologies
+            )
+        if not ok:
+            return False
+    return True
+
+
 def filter_topology_spread(pod: Pod, state: OracleState, i: int) -> bool:
     node = state.nodes[i]
     for c in pod.spec.topology_spread_constraints:
@@ -321,6 +389,7 @@ DEFAULT_FILTERS = (
     filter_node_affinity,
     filter_node_ports,
     filter_node_resources_fit,
+    filter_volume_binding,
     filter_inter_pod_affinity,
     filter_topology_spread,
 )
@@ -954,10 +1023,13 @@ def schedule(
     existing: Sequence[tuple[Pod, str]] = (),
     weights: OracleWeights = OracleWeights(),
     filters=DEFAULT_FILTERS,
+    pvcs: Sequence = (),
+    pvs: Sequence = (),
+    storage_classes: Sequence = (),
 ) -> list[OracleDecision]:
     """Sequential greedy scheduling in (priority desc, creation asc) order —
     the reference's queue order (PrioritySort QueueSort plugin)."""
-    state = OracleState.build(nodes, existing)
+    state = OracleState.build(nodes, existing, pvcs, pvs, storage_classes)
     decisions: dict[int, int] = {}
     for pi in queue_order(pending):
         pod = pending[pi]

@@ -87,6 +87,9 @@ class SchedulerAgent:
         self._nodes: dict[str, Node] = {}
         self._pods: dict[str, tuple[Pod, str]] = {}  # uid -> (pod, bound_node)
         self._groups: dict[str, PodGroup] = {}
+        self._pvcs: dict[str, object] = {}
+        self._pvs: dict[str, object] = {}
+        self._classes: dict[str, object] = {}
         self._pending_failures: list[str] = []
         self._boot_id: str | None = None  # shim incarnation last fed state
         self._batch: pb.UpdateRequest | None = None  # open batched() request
@@ -132,6 +135,34 @@ class SchedulerAgent:
                                         min_member=group.min_member)]
             )
         )
+
+    # ---- volume objects (VolumeBinding inputs) ---------------------------
+
+    def upsert_pvc(self, pvc) -> None:
+        self._pvcs[pvc.key] = pvc
+        self._send(pb.UpdateRequest(pvc_upserts=[convert.pvc_to(pvc)]))
+
+    def delete_pvc(self, key: str) -> None:
+        self._pvcs.pop(key, None)
+        self._send(pb.UpdateRequest(pvc_deletes=[key]))
+
+    def upsert_pv(self, pv) -> None:
+        self._pvs[pv.name] = pv
+        self._send(pb.UpdateRequest(pv_upserts=[convert.pv_to(pv)]))
+
+    def delete_pv(self, name: str) -> None:
+        self._pvs.pop(name, None)
+        self._send(pb.UpdateRequest(pv_deletes=[name]))
+
+    def upsert_storage_class(self, sc) -> None:
+        self._classes[sc.name] = sc
+        self._send(
+            pb.UpdateRequest(storage_class_upserts=[convert.storage_class_to(sc)])
+        )
+
+    def delete_storage_class(self, name: str) -> None:
+        self._classes.pop(name, None)
+        self._send(pb.UpdateRequest(storage_class_deletes=[name]))
 
     # ---- the cycle -------------------------------------------------------
 
@@ -235,5 +266,11 @@ class SchedulerAgent:
             req.pod_adds.append(
                 pb.PodEvent(pod=convert.pod_to(pod), bound_node=bound)
             )
+        for pvc in self._pvcs.values():
+            req.pvc_upserts.append(convert.pvc_to(pvc))
+        for pv in self._pvs.values():
+            req.pv_upserts.append(convert.pv_to(pv))
+        for sc in self._classes.values():
+            req.storage_class_upserts.append(convert.storage_class_to(sc))
         resp = self.client.update(req)
         self._boot_id = resp.boot_id

@@ -129,8 +129,67 @@ def pod_from(p: pb.Pod) -> api.Pod:
             scheduler_name=s.scheduler_name or "default-scheduler",
             overhead=api._req_to_internal(dict(s.overhead)),
             pod_group=s.pod_group,
+            volumes=tuple(s.volumes),
         ),
         nominated_node_name=p.nominated_node_name,
+    )
+
+
+def pvc_from(c: pb.PersistentVolumeClaim) -> api.PersistentVolumeClaim:
+    return api.PersistentVolumeClaim(
+        name=c.name,
+        namespace=c.namespace or "default",
+        storage_class=c.storage_class,
+        request=c.request,
+        volume_name=c.volume_name,
+    )
+
+
+def pvc_to(c: api.PersistentVolumeClaim) -> pb.PersistentVolumeClaim:
+    return pb.PersistentVolumeClaim(
+        name=c.name,
+        namespace=c.namespace,
+        storage_class=c.storage_class,
+        request=c.request,
+        volume_name=c.volume_name,
+    )
+
+
+def pv_from(v: pb.PersistentVolume) -> api.PersistentVolume:
+    return api.PersistentVolume(
+        name=v.name,
+        capacity=v.capacity,
+        storage_class=v.storage_class,
+        node_affinity=tuple(_term_from(t) for t in v.node_affinity),
+        claim_ref=v.claim_ref,
+    )
+
+
+def pv_to(v: api.PersistentVolume) -> pb.PersistentVolume:
+    return pb.PersistentVolume(
+        name=v.name,
+        capacity=v.capacity,
+        storage_class=v.storage_class,
+        node_affinity=[_term_to(t) for t in v.node_affinity],
+        claim_ref=v.claim_ref,
+    )
+
+
+def storage_class_from(s: pb.StorageClass) -> api.StorageClass:
+    return api.StorageClass(
+        name=s.name,
+        volume_binding_mode=s.volume_binding_mode or api.VOLUME_BINDING_IMMEDIATE,
+        provisioner=s.provisioner,
+        allowed_topologies=tuple(_term_from(t) for t in s.allowed_topologies),
+    )
+
+
+def storage_class_to(s: api.StorageClass) -> pb.StorageClass:
+    return pb.StorageClass(
+        name=s.name,
+        volume_binding_mode=s.volume_binding_mode,
+        provisioner=s.provisioner,
+        allowed_topologies=[_term_to(t) for t in s.allowed_topologies],
     )
 
 
@@ -289,6 +348,7 @@ def pod_to(p: api.Pod) -> pb.Pod:
             scheduler_name=s.scheduler_name,
             overhead=_requests_to(s.overhead),
             pod_group=s.pod_group,
+            volumes=list(s.volumes),
         ),
         nominated_node_name=p.nominated_node_name,
     )

@@ -99,6 +99,18 @@ class SchedulerService:
             pod = self._uid_index.get(uid)
             if pod is not None:
                 s.queue.requeue_backoff(pod)
+        for c in request.pvc_upserts:
+            s.on_pvc_upsert(convert.pvc_from(c))
+        for key in request.pvc_deletes:
+            s.on_pvc_delete(key)
+        for v in request.pv_upserts:
+            s.on_pv_upsert(convert.pv_from(v))
+        for name in request.pv_deletes:
+            s.on_pv_delete(name)
+        for sc in request.storage_class_upserts:
+            s.on_storage_class_upsert(convert.storage_class_from(sc))
+        for name in request.storage_class_deletes:
+            s.on_storage_class_delete(name)
         return pb.UpdateResponse(boot_id=self.boot_id)
 
     def Cycle(self, request: pb.CycleRequest, context) -> pb.CycleResponse:

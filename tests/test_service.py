@@ -117,6 +117,50 @@ def test_service_schedules_over_the_wire(shim):
     assert b"scheduler_schedule_attempts_total" in client.metrics_text()
 
 
+def test_volume_binding_over_the_wire(shim):
+    from k8s_scheduler_tpu.models.api import (
+        VOLUME_BINDING_WAIT,
+        NodeSelectorRequirement,
+        NodeSelectorTerm,
+        PersistentVolume,
+        PersistentVolumeClaim,
+        StorageClass,
+    )
+
+    _, _, client = shim
+    applier = Applier()
+    agent = SchedulerAgent(client, applier.bind, applier.evict)
+    zone = "topology.kubernetes.io/zone"
+    for i in range(4):
+        agent.upsert_node(
+            MakeNode(f"n{i}")
+            .capacity({"cpu": "8"})
+            .labels({zone: f"z{i % 2}"})
+            .obj()
+        )
+    agent.upsert_storage_class(
+        StorageClass("local", VOLUME_BINDING_WAIT, provisioner=False)
+    )
+    agent.upsert_pv(
+        PersistentVolume(
+            "pv-z1", capacity=10.0, storage_class="local",
+            node_affinity=(
+                NodeSelectorTerm(
+                    (NodeSelectorRequirement(zone, "In", ("z1",)),)
+                ),
+            ),
+        )
+    )
+    agent.upsert_pvc(
+        PersistentVolumeClaim("data", storage_class="local", request=1.0)
+    )
+    agent.upsert_pod(MakePod("db").req({"cpu": "1"}).volume("data").obj())
+    resp = agent.run_cycle()
+    assert resp.stats.scheduled == 1
+    # the only candidate PV is zone-restricted to z1 (nodes n1, n3)
+    assert list(applier.bound.values())[0] in ("n1", "n3")
+
+
 def test_serve_raises_on_unbindable_address():
     server, _, port = serve("127.0.0.1:0")
     try:

@@ -1,0 +1,228 @@
+"""VolumeBinding filter: kernel/oracle differential tests incl. the
+zone-conflict cases (a bound PV pinned to one zone must pin the pod),
+static-PV candidacy, dynamic-provisioning topology, and the
+unschedulable cases (missing PVC, unbound Immediate claim)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from k8s_scheduler_tpu import oracle
+from k8s_scheduler_tpu.core.cycle import build_cycle_fn
+from k8s_scheduler_tpu.framework.interfaces import CycleContext
+from k8s_scheduler_tpu.framework.plugins import VolumeBinding
+from k8s_scheduler_tpu.models import SnapshotEncoder
+from k8s_scheduler_tpu.models.api import (
+    VOLUME_BINDING_WAIT,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+)
+from k8s_scheduler_tpu.models.builders import MakeNode, MakePod
+
+ZONE = "topology.kubernetes.io/zone"
+GiB = 1024**3
+
+
+def zone_term(*zones: str) -> NodeSelectorTerm:
+    return NodeSelectorTerm(
+        (NodeSelectorRequirement(ZONE, "In", tuple(zones)),)
+    )
+
+
+def make_zoned_nodes(n_per_zone=2, zones=("z0", "z1", "z2")):
+    nodes = []
+    for z in zones:
+        for i in range(n_per_zone):
+            nodes.append(
+                MakeNode(f"{z}-n{i}")
+                .capacity({"cpu": "8"})
+                .labels({ZONE: z})
+                .obj()
+            )
+    return nodes
+
+
+def kernel_mask(nodes, pods, pvcs=(), pvs=(), classes=()):
+    snap = SnapshotEncoder().encode(
+        nodes, pods, pvcs=pvcs, pvs=pvs, storage_classes=classes
+    )
+    plugin = VolumeBinding()
+    ctx = CycleContext(snap)
+    m = plugin.static_mask(ctx)
+    if m is None:
+        return None, snap
+    return np.asarray(m), snap
+
+
+def oracle_mask(nodes, pods, pvcs=(), pvs=(), classes=()):
+    state = oracle.OracleState.build(nodes, (), pvcs, pvs, classes)
+    return np.array(
+        [
+            [oracle.filter_volume_binding(p, state, i)
+             for i in range(len(nodes))]
+            for p in pods
+        ]
+    )
+
+
+def assert_differential(nodes, pods, pvcs=(), pvs=(), classes=()):
+    got, snap = kernel_mask(nodes, pods, pvcs, pvs, classes)
+    want = oracle_mask(nodes, pods, pvcs, pvs, classes)
+    assert got is not None
+    np.testing.assert_array_equal(
+        got[: len(pods), : len(nodes)], want,
+        err_msg="kernel/oracle VolumeBinding mask disagreement",
+    )
+
+
+def test_bound_pv_zone_conflict_pins_pod():
+    nodes = make_zoned_nodes()
+    pvs = [
+        PersistentVolume(
+            "pv-z1", capacity=10 * GiB, storage_class="ssd",
+            node_affinity=(zone_term("z1"),), claim_ref="default/data",
+        )
+    ]
+    pvcs = [
+        PersistentVolumeClaim(
+            "data", storage_class="ssd", request=5 * GiB,
+            volume_name="pv-z1",
+        )
+    ]
+    pods = [MakePod("db").req({"cpu": "1"}).volume("data").obj()]
+    got, _ = kernel_mask(nodes, pods, pvcs, pvs)
+    # only the two z1 nodes are feasible
+    assert got[0, :6].tolist() == [False, False, True, True, False, False]
+    assert_differential(nodes, pods, pvcs, pvs)
+
+
+def test_unbound_wait_class_static_pv_candidates():
+    nodes = make_zoned_nodes()
+    classes = [
+        StorageClass("local", VOLUME_BINDING_WAIT, provisioner=False)
+    ]
+    pvs = [
+        PersistentVolume("pv-a", capacity=10 * GiB, storage_class="local",
+                         node_affinity=(zone_term("z0"),)),
+        PersistentVolume("pv-small", capacity=1 * GiB,
+                         storage_class="local",
+                         node_affinity=(zone_term("z2"),)),
+    ]
+    pvcs = [
+        PersistentVolumeClaim("scratch", storage_class="local",
+                              request=5 * GiB)
+    ]
+    pods = [MakePod("w").req({"cpu": "1"}).volume("scratch").obj()]
+    got, _ = kernel_mask(nodes, pods, pvcs, pvs, classes)
+    # pv-a fits (z0); pv-small is too small (z2 excluded); no provisioner
+    assert got[0, :6].tolist() == [True, True, False, False, False, False]
+    assert_differential(nodes, pods, pvcs, pvs, classes)
+
+
+def test_dynamic_provisioning_allowed_topologies():
+    nodes = make_zoned_nodes()
+    classes = [
+        StorageClass(
+            "ebs", VOLUME_BINDING_WAIT, provisioner=True,
+            allowed_topologies=(zone_term("z2"),),
+        )
+    ]
+    pvcs = [
+        PersistentVolumeClaim("dyn", storage_class="ebs", request=5 * GiB)
+    ]
+    pods = [MakePod("w").req({"cpu": "1"}).volume("dyn").obj()]
+    got, _ = kernel_mask(nodes, pods, pvcs, classes=classes)
+    assert got[0, :6].tolist() == [False, False, False, False, True, True]
+    assert_differential(nodes, pods, pvcs, classes=classes)
+
+
+def test_missing_pvc_and_unbound_immediate_are_unschedulable():
+    nodes = make_zoned_nodes()
+    classes = [StorageClass("imm")]  # Immediate mode
+    pvcs = [
+        PersistentVolumeClaim("imm-claim", storage_class="imm",
+                              request=1 * GiB)
+    ]
+    pods = [
+        MakePod("no-pvc").req({"cpu": "1"}).volume("ghost").obj(),
+        MakePod("imm").req({"cpu": "1"}).volume("imm-claim").obj(),
+    ]
+    got, _ = kernel_mask(nodes, pods, pvcs, classes=classes)
+    assert not got[0].any()
+    assert not got[1].any()
+    assert_differential(nodes, pods, pvcs, classes=classes)
+
+
+def test_claimed_pv_is_not_a_candidate():
+    nodes = make_zoned_nodes()
+    classes = [
+        StorageClass("local", VOLUME_BINDING_WAIT, provisioner=False)
+    ]
+    pvs = [
+        PersistentVolume("pv-a", capacity=10 * GiB, storage_class="local",
+                         claim_ref="other/taken"),
+    ]
+    pvcs = [
+        PersistentVolumeClaim("scratch", storage_class="local",
+                              request=5 * GiB)
+    ]
+    pods = [MakePod("w").req({"cpu": "1"}).volume("scratch").obj()]
+    got, _ = kernel_mask(nodes, pods, pvcs, pvs, classes)
+    assert not got[0].any()
+    assert_differential(nodes, pods, pvcs, pvs, classes)
+
+
+def test_multi_volume_conjunction():
+    nodes = make_zoned_nodes()
+    pvs = [
+        PersistentVolume("pv-z0z1", capacity=10 * GiB, storage_class="ssd",
+                         node_affinity=(zone_term("z0", "z1"),),
+                         claim_ref="default/a"),
+        PersistentVolume("pv-z1z2", capacity=10 * GiB, storage_class="ssd",
+                         node_affinity=(zone_term("z1", "z2"),),
+                         claim_ref="default/b"),
+    ]
+    pvcs = [
+        PersistentVolumeClaim("a", storage_class="ssd", request=GiB,
+                              volume_name="pv-z0z1"),
+        PersistentVolumeClaim("b", storage_class="ssd", request=GiB,
+                              volume_name="pv-z1z2"),
+    ]
+    pods = [
+        MakePod("both").req({"cpu": "1"}).volume("a").volume("b").obj()
+    ]
+    got, _ = kernel_mask(nodes, pods, pvcs, pvs)
+    # intersection: z1 only
+    assert got[0, :6].tolist() == [False, False, True, True, False, False]
+    assert_differential(nodes, pods, pvcs, pvs)
+
+
+def test_volume_free_cluster_pays_nothing():
+    nodes = make_zoned_nodes()
+    pods = [MakePod("plain").req({"cpu": "1"}).obj()]
+    got, snap = kernel_mask(nodes, pods)
+    assert got is None  # capability flag off -> kernel never traced
+    assert not snap.has_volumes
+
+
+def test_end_to_end_cycle_respects_volume_zone():
+    nodes = make_zoned_nodes()
+    pvs = [
+        PersistentVolume("pv-z2", capacity=10 * GiB, storage_class="ssd",
+                         node_affinity=(zone_term("z2"),),
+                         claim_ref="default/data"),
+    ]
+    pvcs = [
+        PersistentVolumeClaim("data", storage_class="ssd", request=GiB,
+                              volume_name="pv-z2"),
+    ]
+    pods = [MakePod("db").req({"cpu": "1"}).volume("data").obj()]
+    snap = SnapshotEncoder().encode(nodes, pods, pvcs=pvcs, pvs=pvs)
+    for mode in ("scan", "rounds"):
+        out = build_cycle_fn(commit_mode=mode)(snap)
+        a = int(np.asarray(out.assignment)[0])
+        assert a in (4, 5), f"{mode}: pod landed outside z2 (node {a})"
