@@ -60,6 +60,12 @@ def main(argv: list[str] | None = None) -> int:
         load_config(args.config) if args.config else SchedulerConfiguration()
     )
 
+    # multi-host (DCN) runtime: a no-op unless the launcher set the JAX
+    # coordinator env vars (parallel/mesh.py initialize_distributed)
+    from ..parallel.mesh import initialize_distributed
+
+    initialize_distributed()
+
     # the shim owns the Scheduler; import deferred so --help stays instant
     from ..service.server import serve
 

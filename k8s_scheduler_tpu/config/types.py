@@ -58,6 +58,22 @@ class Profile:
 
 
 @dataclass
+class Extender:
+    """HTTP scheduler-extender config (upstream `Extender` in
+    KubeSchedulerConfiguration): filter/prioritize/bind delegation to an
+    external webhook."""
+
+    url_prefix: str
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    bind_verb: str = ""
+    weight: int = 1
+    http_timeout_seconds: float = 5.0
+    # errors from an ignorable extender don't fail the pod's attempt
+    ignorable: bool = False
+
+
+@dataclass
 class SchedulerConfiguration:
     profiles: list[Profile] = field(default_factory=lambda: [Profile()])
     percentage_of_nodes_to_score: int = 0  # 0 = adaptive/all (upstream default)
@@ -69,6 +85,7 @@ class SchedulerConfiguration:
     # "rounds" = batched round commit (production default at scale),
     # "scan" = strict sequential per-pod scan (exact ScheduleOne order)
     commit_mode: str = "rounds"
+    extenders: list[Extender] = field(default_factory=list)
 
     def profile(self, scheduler_name: str = "default-scheduler") -> Profile:
         for p in self.profiles:
@@ -109,6 +126,22 @@ def default_plugins() -> dict[str, list[PluginEntry]]:
         "score": [PluginEntry(n, w) for n, w in _DEFAULT_SCORES],
         "post_filter": [PluginEntry(n) for n in _DEFAULT_POST_FILTERS],
     }
+
+
+def _duration_seconds(v) -> float:
+    """Upstream serializes durations as strings ('5s', '500ms', '1m30s');
+    accept those and plain numbers."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    import re
+
+    total = 0.0
+    for num, unit in re.findall(r"([0-9.]+)(h|m(?!s)|s|ms|us|ns)", str(v)):
+        total += float(num) * {
+            "h": 3600.0, "m": 60.0, "s": 1.0,
+            "ms": 1e-3, "us": 1e-6, "ns": 1e-9,
+        }[unit]
+    return total or 5.0
 
 
 def _plugin_set_from_dict(d: dict) -> PluginSet:
@@ -168,6 +201,20 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         pod_max_backoff_seconds=data.get("podMaxBackoffSeconds", 10.0),
         gang_scheduling=data.get("gangScheduling", True),
         commit_mode=data.get("commitMode", "rounds"),
+        extenders=[
+            Extender(
+                url_prefix=e["urlPrefix"],
+                filter_verb=e.get("filterVerb", ""),
+                prioritize_verb=e.get("prioritizeVerb", ""),
+                bind_verb=e.get("bindVerb", ""),
+                weight=e.get("weight", 1),
+                http_timeout_seconds=_duration_seconds(
+                    e.get("httpTimeout", 5.0)
+                ),
+                ignorable=e.get("ignorable", False),
+            )
+            for e in data.get("extenders", [])
+        ],
     )
 
 

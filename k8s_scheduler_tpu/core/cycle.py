@@ -39,13 +39,52 @@ class CycleResult:
     node_requested: jnp.ndarray  # f32 [N, R] post-cycle
     unschedulable: jnp.ndarray  # bool [P] valid pod that found no node
     gang_dropped: jnp.ndarray  # bool [P] placed, then unwound (group failed)
-    static_mask: jnp.ndarray  # bool [P, N] framework static feasibility —
-    # returned so the PostFilter pass reuses it instead of re-running the
-    # whole static filter pipeline
+    preempt_gate: jnp.ndarray  # bool [P, N]: static feasibility AND every
+    # NON-resource dynamic filter evaluated against the FINAL post-commit
+    # state — the PostFilter candidate mask. Preemption relaxes resource
+    # constraints only, so a node that fails ports/affinity/spread against
+    # the end-of-cycle state must not be nominated (it would be rejected
+    # again next cycle, wasting the eviction).
     reject_counts: jnp.ndarray  # i32 [P, F] nodes first-rejected per filter
     # (static + dynamic attribution summed; columns = Framework.filter_names)
     # — feeds FailedScheduling events and requeue queueing hints
     rounds_used: jnp.ndarray  # i32 [] commit rounds consumed (0 in scan mode)
+
+
+def sampling_mask(snap: ClusterSnapshot, pct: int) -> jnp.ndarray:
+    """percentageOfNodesToScore: restrict each pod to a rotating window of
+    candidate nodes (bool [P, N]).
+
+    Upstream numFeasibleNodesToFind semantics: clusters of <100 nodes (or
+    pct >= 100) consider everything; otherwise the candidate count is
+    numAllNodes * pct / 100 (adaptive pct = 50 - numAllNodes/125, floor 5,
+    when the knob is 0), floored at 100 nodes. Upstream stops SCANNING
+    after finding that many feasible nodes from a rotating start index;
+    the batched analogue samples that many CANDIDATE nodes per pod from a
+    deterministic per-pod rotation — a documented deviation (data-
+    dependent early exit is anti-TPU), strictly more selective, and the
+    sample rotates with the pod's queue rank exactly so different pods
+    spread load over different nodes."""
+    n = snap.num_nodes.astype(jnp.int32)  # real node count (traced)
+    if pct >= 100:
+        return jnp.ones((snap.P, snap.N), bool)
+    if pct <= 0:
+        adaptive = jnp.maximum(50 - n // 125, 5)
+    else:
+        adaptive = jnp.int32(pct)
+    k = jnp.maximum(n * adaptive // 100, 100)  # min-feasible floor
+    # rotate per pod rank AND per cycle: a pod whose feasible nodes fall
+    # outside this cycle's window gets a different window next cycle, so
+    # sampling delays but never permanently starves (upstream's rotating
+    # global scan index has the same property)
+    off = (
+        snap.pod_order.astype(jnp.int32) * 75347
+        + snap.cycle_index.astype(jnp.int32) * 31337
+    ) % jnp.maximum(n, 1)
+    col = jax.lax.broadcasted_iota(jnp.int32, (snap.P, snap.N), 1)
+    win = (col - off[:, None]) % jnp.maximum(n, 1)
+    # clusters under the floor consider every node (win < k always)
+    return win < k
 
 
 def build_cycle_fn(
@@ -53,6 +92,7 @@ def build_cycle_fn(
     gang_scheduling: bool = True,
     commit_mode: str = "scan",
     max_rounds: int = 64,
+    percentage_of_nodes_to_score: int = 0,  # 0 = adaptive (upstream default)
 ) -> Callable[[ClusterSnapshot], CycleResult]:
     """Compile the cycle for a framework (default: the default plugin set).
     The returned callable is jitted; snapshots with identical padded shapes
@@ -83,6 +123,22 @@ def build_cycle_fn(
     def cycle(snap: ClusterSnapshot) -> CycleResult:
         ctx = CycleContext(snap)
         smask, sscore, srejects = fw.static(ctx)
+        if snap.has_extender:
+            # HTTP-extender Filter/Prioritize verdicts, computed host-side
+            # before the cycle (upstream runs extenders after in-tree
+            # filters; rejections are attributed to the base mask)
+            smask = smask & snap.pod_extender_mask
+            sscore = sscore + snap.pod_extender_score
+        if percentage_of_nodes_to_score < 100:
+            # 0 = adaptive percentage, like upstream's default; the <100-
+            # node floor inside sampling_mask keeps small clusters exact
+            smask = smask & sampling_mask(snap, percentage_of_nodes_to_score)
+        if snap.has_inter_pod_affinity or snap.has_topology_spread:
+            # materialize the shared match tables at CYCLE scope: the scan
+            # body would otherwise compute-and-cache them inside its own
+            # trace, and the post-commit gate pass reading the cache would
+            # see an escaped inner tracer
+            ctx.matched_pending
         extra = fw.extra_init(ctx)
 
         if commit_mode == "rounds":
@@ -168,8 +224,23 @@ def build_cycle_fn(
                 result, dropped, snap.pod_requested
             )
         unsched = snap.pod_valid & (result.assignment < 0)
+
+        # PostFilter candidate gate: static AND non-resource dynamic masks
+        # vs the final state (rounds mode computed them already; scan mode
+        # pays one batched pass — it targets small pending sets)
+        if commit_mode == "rounds":
+            per_filter_final = rres.final_per_filter
+        else:
+            _m, _s, per_filter_final = fw.dyn_batched(
+                ctx, result.node_requested, result.extra, smask
+            )
+        gate = smask
+        for f, m in zip(fw.filters, per_filter_final):
+            if m is not None and f.name != "NodeResourcesFit":
+                gate = gate & m
+
         return CycleResult(
-            result.assignment, result.node_requested, unsched, dropped, smask,
+            result.assignment, result.node_requested, unsched, dropped, gate,
             srejects + result.dyn_aux, rounds_used,
         )
 
@@ -193,7 +264,7 @@ def build_preemption_fn(framework: Framework | None = None):
             ctx,
             result.assignment,
             result.node_requested,
-            result.static_mask,
+            result.preempt_gate,
             excluded=result.gang_dropped,
         )
 

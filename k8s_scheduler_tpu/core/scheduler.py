@@ -78,6 +78,7 @@ class Scheduler:
         pad_bucket: int = 64,
         metrics: SchedulerMetrics | None = None,
         events: EventRecorder | None = None,
+        host_plugins: "list | None" = None,
     ) -> None:
         self.config = config or SchedulerConfiguration()
         self.framework = Framework.from_config(self.config)
@@ -101,6 +102,13 @@ class Scheduler:
         self._pvcs: dict[str, object] = {}  # "ns/name" -> PVC
         self._pvs: dict[str, object] = {}  # name -> PV
         self._storage_classes: dict[str, object] = {}
+        self._pdbs: dict[str, object] = {}  # "ns/name" -> PDB
+        # host-side extension points (Reserve/Permit/PreBind/PostBind) and
+        # HTTP scheduler extenders — framework/host.py
+        from ..framework.host import HTTPExtender
+
+        self.host_plugins = list(host_plugins or [])
+        self.extenders = [HTTPExtender(c) for c in self.config.extenders]
         # per-cycle decision log (consumed by the gRPC shim): what the last
         # schedule_cycle nominated (preemptors) and evicted (victims)
         self.last_nominations: list[tuple[Pod, str]] = []
@@ -113,6 +121,9 @@ class Scheduler:
             self.framework,
             gang_scheduling=self.config.gang_scheduling,
             commit_mode=self.config.commit_mode,
+            percentage_of_nodes_to_score=(
+                self.config.percentage_of_nodes_to_score
+            ),
         )
         self._preempt = build_preemption_fn(self.framework)
 
@@ -183,6 +194,12 @@ class Scheduler:
         self._storage_classes.pop(name, None)
         self.queue.move_all_to_active_or_backoff(EVENT_STORAGE_CLASS_CHANGE)
 
+    def on_pdb_upsert(self, pdb) -> None:
+        self._pdbs[pdb.key] = pdb
+
+    def on_pdb_delete(self, key: str) -> None:
+        self._pdbs.pop(key, None)
+
     # ---- the cycle -------------------------------------------------------
 
     def schedule_cycle(self) -> CycleStats:
@@ -215,7 +232,28 @@ class Scheduler:
             pvcs=list(self._pvcs.values()),
             pvs=list(self._pvs.values()),
             storage_classes=list(self._storage_classes.values()),
+            pdbs=list(self._pdbs.values()),
         )
+        extender_errors: dict[int, str] = {}
+        if self.extenders:
+            from ..framework.host import run_extender_prepass
+
+            emask, escore, extender_errors = run_extender_prepass(
+                self.extenders, pending, nodes
+            )
+            if emask is not None:
+                import dataclasses as _dc
+
+                full_mask = np.ones((snap.P, snap.N), bool)
+                full_score = np.zeros((snap.P, snap.N), np.float32)
+                full_mask[: len(pending), : len(nodes)] = emask
+                full_score[: len(pending), : len(nodes)] = escore
+                snap = _dc.replace(
+                    snap,
+                    has_extender=True,
+                    pod_extender_mask=full_mask,
+                    pod_extender_score=full_score,
+                )
         t_encode = self._now()
         self.metrics.cycle_duration.labels(phase="encode").observe(
             t_encode - t0
@@ -248,6 +286,13 @@ class Scheduler:
         # binding (upstream attempt duration = algorithm + bind)
         def per_pod_s() -> float:
             return (self._now() - t0) / max(len(pending), 1)
+        from ..framework.host import (
+            HostPluginRejection,
+            run_post_bind,
+            run_reserve_permit_prebind,
+            run_unreserve,
+        )
+
         for i, pod in enumerate(pending):
             node_idx = int(assignment[i])
             if node_idx >= 0:
@@ -263,10 +308,40 @@ class Scheduler:
                         "error", per_pod_s(), self._profile_name
                     )
                     continue
+                # Reserve -> Permit -> PreBind host extension points
+                try:
+                    run_reserve_permit_prebind(
+                        self.host_plugins, pod, node_name
+                    )
+                except HostPluginRejection as rej:
+                    self.cache.forget(pod.uid)
+                    if rej.point == "PreBind":
+                        # transient pre-bind failure: retry with backoff
+                        self.queue.requeue_backoff(pod)
+                        stats.bind_errors += 1
+                        self.metrics.observe_attempt(
+                            "error", per_pod_s(), self._profile_name
+                        )
+                    else:
+                        # Reserve/Permit veto: unschedulable, attributed
+                        # to the vetoing host plugin
+                        self.events.failed_scheduling(
+                            pod, f"{rej.plugin} rejected at {rej.point}: "
+                            f"{rej.reason}"
+                        )
+                        self.queue.requeue_unschedulable(
+                            pod, reasons=(rej.plugin,)
+                        )
+                        stats.unschedulable += 1
+                        self.metrics.observe_attempt(
+                            "unschedulable", per_pod_s(), self._profile_name
+                        )
+                    continue
                 t_bind = self._now()
                 try:
-                    self.binder(pod, node_name)
+                    self._bind(pod, node_name)
                 except Exception:
+                    run_unreserve(self.host_plugins, pod, node_name)
                     self.cache.forget(pod.uid)
                     self.queue.requeue_backoff(pod)
                     stats.bind_errors += 1
@@ -276,6 +351,7 @@ class Scheduler:
                     continue
                 self.metrics.binding_duration.observe(self._now() - t_bind)
                 self.cache.finish_binding(pod.uid)
+                run_post_bind(self.host_plugins, pod, node_name)
                 self.events.scheduled(pod, node_name)
                 stats.scheduled += 1
                 self.metrics.pod_scheduling_attempts.observe(
@@ -285,6 +361,15 @@ class Scheduler:
                     "scheduled", per_pod_s(), self._profile_name
                 )
             else:
+                if i in extender_errors:
+                    # non-ignorable extender failure: retry with backoff
+                    # (transient webhook errors must not park the pod)
+                    self.queue.requeue_backoff(pod)
+                    stats.bind_errors += 1
+                    self.metrics.observe_attempt(
+                        "error", per_pod_s(), self._profile_name
+                    )
+                    continue
                 if nominated is not None and nominated[i] >= 0:
                     pod.nominated_node_name = nodes[int(nominated[i])].name
                     self.last_nominations.append(
@@ -340,6 +425,15 @@ class Scheduler:
         )
         self._update_gauges()
         return stats
+
+    def _bind(self, pod: Pod, node_name: str) -> None:
+        """Bind, delegating to the first bind-verb extender (upstream: an
+        extender with a bind verb replaces the default binder)."""
+        for ext in self.extenders:
+            if ext.is_binder:
+                ext.bind(pod, node_name)
+                return
+        self.binder(pod, node_name)
 
     def _update_gauges(self) -> None:
         self.metrics.set_pending(self.queue.pending_counts())

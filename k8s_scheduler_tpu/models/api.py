@@ -286,6 +286,21 @@ class PodGroup:
     min_member: int
 
 
+@dataclass
+class PodDisruptionBudget:
+    """PDB, as preemption consumes it: how many voluntary disruptions the
+    selected pods can absorb right now (status.disruptionsAllowed)."""
+
+    name: str
+    namespace: str = "default"
+    selector: "LabelSelector" = field(default_factory=lambda: LabelSelector())
+    disruptions_allowed: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
 # ---------------------------------------------------------------------------
 # Volumes (VolumeBinding filter inputs)
 # ---------------------------------------------------------------------------

@@ -184,6 +184,10 @@ class ClusterSnapshot:
     num_pending: np.ndarray
     num_existing: np.ndarray
     num_domains: np.ndarray
+    # monotone per-encoder cycle counter (0-d i32): rotates the node-
+    # sampling windows across cycles so percentageOfNodesToScore can never
+    # permanently starve a pod whose feasible nodes sit outside one window
+    cycle_index: np.ndarray
 
     # --- nodes [N...] ---
     node_allocatable: np.ndarray  # f32 [N, R]
@@ -277,6 +281,8 @@ class ClusterSnapshot:
     # --- existing pods [E...] ---
     exist_node: np.ndarray  # i32 [E] node index
     exist_priority: np.ndarray  # i32 [E]
+    exist_start: np.ndarray  # f32 [E] creation timestamp (victim tie-break)
+    exist_pdb: np.ndarray  # i32 [E, MB] selecting PDB ids (-1 pad)
     exist_requested: np.ndarray  # f32 [E, R]
     exist_label_keys: np.ndarray  # i32 [E, MPL]
     exist_label_vals: np.ndarray  # i32 [E, MPL]
@@ -293,6 +299,15 @@ class ClusterSnapshot:
     domain_key: np.ndarray  # i32 [D] which topology-key axis each domain is under
     # number of nodes per domain (for spread normalization)
     domain_node_count: np.ndarray  # f32 [D]
+
+    # --- PodDisruptionBudgets [GP] (preemption consumes them) ---
+    pdb_allowed: np.ndarray  # i32 [GP] status.disruptionsAllowed
+
+    # --- HTTP-extender verdicts (host-computed AFTER encode via
+    # dataclasses.replace; None and never traced unless `has_extender`) ---
+    has_extender: bool = False
+    pod_extender_mask: np.ndarray = None  # bool [P, N]
+    pod_extender_score: np.ndarray = None  # f32 [P, N] weighted
 
     @property
     def P(self) -> int:
@@ -377,6 +392,7 @@ class SnapshotEncoder:
         self._node_cache: dict[int, tuple[Any, dict]] = {}
         self._node_epoch = 0
         self._node_names: tuple[str, ...] = ()
+        self._cycle_index = 0  # bumped per encode (sampling rotation)
 
     # -- small helpers -----------------------------------------------------
 
@@ -398,6 +414,7 @@ class SnapshotEncoder:
         pvcs: Sequence[api.PersistentVolumeClaim] = (),
         pvs: Sequence[api.PersistentVolume] = (),
         storage_classes: Sequence[api.StorageClass] = (),
+        pdbs: Sequence[api.PodDisruptionBudget] = (),
     ) -> ClusterSnapshot:
         """One-shot encode. `existing` is (pod, node_name) for every pod
         already assigned (bound or assumed)."""
@@ -409,6 +426,7 @@ class SnapshotEncoder:
         # from earlier encodes may be shorter and are right-padded.
 
         n_real, p_real, e_real = len(nodes), len(pending), len(existing)
+        self._cycle_index += 1
         N = self.pad_nodes or _pow2_bucket(n_real)
         P = self.pad_pods or _pow2_bucket(p_real)
         E = _pow2_bucket(e_real) if e_real else 8
@@ -904,6 +922,42 @@ class SnapshotEncoder:
             pod_can_preempt[i] = d["can_preempt"]
 
         # ---- assemble existing-pod arrays ----
+        def _pdb_matches(pdb: api.PodDisruptionBudget, p: Pod) -> bool:
+            if p.namespace != pdb.namespace:
+                return False
+            sel = pdb.selector
+            for k, v in sel.match_labels.items():
+                if p.metadata.labels.get(k) != v:
+                    return False
+            for e in sel.match_expressions:
+                val = p.metadata.labels.get(e.key)
+                if e.operator == api.OP_IN and val not in e.values:
+                    return False
+                if e.operator == api.OP_NOT_IN and val in e.values:
+                    return False
+                if e.operator == api.OP_EXISTS and val is None:
+                    return False
+                if e.operator == api.OP_DOES_NOT_EXIST and val is not None:
+                    return False
+            return True
+
+        MB = 2  # PDBs tracked per pod (more than 2 selecting one pod is
+        # pathological; extras conservatively protect via the first two)
+        GP = max(len(pdbs), 1)
+        pdb_allowed = np.zeros(GP, np.int32)
+        for gi, pdb in enumerate(pdbs):
+            pdb_allowed[gi] = pdb.disruptions_allowed
+        exist_pdb = np.full((E, MB), -1, np.int32)
+        # start times are stored RELATIVE to the oldest existing pod:
+        # float32 at Unix-epoch magnitude (~1.7e9) has ~128s resolution,
+        # which would collapse the preemption start-time tie-break; only
+        # the within-snapshot ORDER matters
+        start_base = min(
+            (p.metadata.creation_timestamp for p, _ in existing),
+            default=0.0,
+        )
+        exist_start = np.zeros(E, np.float32)
+
         exist_node = np.full(E, -1, np.int32)
         exist_prio = np.zeros(E, np.int32)
         exist_req = np.zeros((E, R), np.float32)
@@ -926,6 +980,15 @@ class SnapshotEncoder:
             ni = node_index.get(node_name, -1)
             exist_node[i] = ni
             exist_prio[i] = d["prio"]
+            exist_start[i] = p.metadata.creation_timestamp - start_base
+            if pdbs:
+                b = 0
+                for gi, pdb in enumerate(pdbs):
+                    if b >= MB:
+                        break
+                    if _pdb_matches(pdb, p):
+                        exist_pdb[i, b] = gi
+                        b += 1
             exist_group[i] = group_id(d["group"])
             rv = d["reqvec"]
             exist_req[i, : rv.shape[0]] = rv
@@ -1084,6 +1147,7 @@ class SnapshotEncoder:
             num_pending=np.asarray(p_real, np.int32),
             num_existing=np.asarray(e_real, np.int32),
             num_domains=np.asarray(len(domain_map), np.int32),
+            cycle_index=np.asarray(self._cycle_index, np.int32),
             topology_keys=tuple(topo_keys),
             node_allocatable=node_alloc,
             node_requested=node_requested,
@@ -1159,7 +1223,10 @@ class SnapshotEncoder:
             imgset_sizes=imgset_sizes,
             exist_node=exist_node,
             exist_priority=exist_prio,
+            exist_start=exist_start,
+            exist_pdb=exist_pdb,
             exist_requested=exist_req,
+            pdb_allowed=pdb_allowed,
             exist_label_keys=el_keys,
             exist_label_vals=el_vals,
             exist_anti_terms=exist_anti,

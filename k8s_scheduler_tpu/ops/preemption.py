@@ -24,13 +24,26 @@ how many victims are already claimed (`k_claimed`) and the resources
 nominated pods will consume (`nominated_req`), so two preemptors never
 count the same freed capacity.
 
+PodDisruptionBudgets: a victim protected by a PDB whose remaining budget
+(disruptionsAllowed minus victims already claimed THIS cycle) is exhausted
+truncates the node's eligible prefix — no prefix reaching past it is
+considered, and claimed victims decrement their PDBs' budgets in the scan
+carry so one cycle never over-disrupts a budget. (Upstream prefers
+PDB-violating victims last but may still evict them; this kernel never
+does — strictly conservative.)
+
+Tie-breaks mirror upstream pickOneNodeForPreemption: min highest-victim
+priority, min victim priority sum, min victim count, then LATEST start
+time of the highest victim (prefer evicting younger pods), then lowest
+node index.
+
 Documented deviation from upstream: victim removal only relaxes RESOURCE
 constraints here. Upstream re-runs all filters with victims removed, so a
 pod blocked by (say) anti-affinity toward a victim can preempt it; this
-kernel requires the static mask (labels/taints/ports/...) to pass with the
-victims still present — strictly conservative (never evicts where upstream
-would not). PDBs and victim start-time tie-breaks are not modeled (no such
-state in the snapshot); the final tie-break is lowest node index.
+kernel requires `candidate_mask` (static + non-resource dynamic filters
+against the post-cycle state — CycleResult.preempt_gate) to pass with the
+victims still present — strictly conservative (never evicts where
+upstream would not).
 """
 
 from __future__ import annotations
@@ -59,7 +72,8 @@ def run_preemption(
     *,
     assignment: jnp.ndarray,  # i32 [P] from the commit scan (-1 = unsched)
     node_requested: jnp.ndarray,  # f32 [N, R] post-cycle running requests
-    static_mask: jnp.ndarray,  # bool [P, N] framework static feasibility
+    static_mask: jnp.ndarray,  # bool [P, N] candidate gate: static + non-
+    # resource dynamic feasibility vs the final state (preempt_gate)
     excluded: jnp.ndarray | None = None,  # bool [P] never preempt (e.g.
     # gang-dropped members: they fit without eviction, their group is what
     # failed — upstream never runs PostFilter for Permit rejections)
@@ -83,6 +97,14 @@ def run_preemption(
     vict_req = jnp.where(
         vict_valid[:, :, None], snap.exist_requested[safe_idx], 0.0
     )  # [N, MPN, R]
+    vict_start = jnp.where(
+        vict_valid, snap.exist_start[safe_idx], 0.0
+    )  # [N, MPN]
+    vict_pdb = jnp.where(
+        vict_valid[:, :, None], snap.exist_pdb[safe_idx], -1
+    )  # [N, MPN, MB]
+    GP = snap.pdb_allowed.shape[0]
+    MB = vict_pdb.shape[2]
     # prefix_freed[:, k] = resources freed by evicting the first k victims
     prefix_freed = jnp.concatenate(
         [jnp.zeros_like(vict_req[:, :1]), jnp.cumsum(vict_req, axis=1)], axis=1
@@ -104,12 +126,25 @@ def run_preemption(
     cand_ids = jnp.argsort(cand_key)[:C].astype(jnp.int32)
 
     def step(carry, rank):
-        k_claimed, nominated_req, victim_mask = carry
+        k_claimed, nominated_req, victim_mask, pdb_used = carry
         p = cand_ids[rank]
         prio = snap.pod_priority[p]
 
         # eligible victims: strictly lower priority than the preemptor
         elig = jnp.sum(vict_valid & (vict_prio < prio), axis=1).astype(jnp.int32)
+        # PDB truncation: a victim whose remaining budget is exhausted
+        # caps the usable prefix at its position (prefixes never skip)
+        budget = snap.pdb_allowed - pdb_used  # [GP]
+        prot = jnp.zeros(vict_valid.shape, bool)
+        for b in range(MB):
+            g = vict_pdb[:, :, b]
+            prot |= (g >= 0) & (budget[jnp.clip(g, 0, GP - 1)] <= 0)
+        prot &= vict_valid
+        pos = jnp.arange(MPN, dtype=jnp.int32)[None, :]
+        first_prot = jnp.min(
+            jnp.where(prot, pos, MPN), axis=1
+        ).astype(jnp.int32)  # [N]
+        elig = jnp.minimum(elig, first_prot)
         free_base = (
             snap.node_allocatable - node_requested - nominated_req + slack
         )  # [N, R]
@@ -138,36 +173,50 @@ def run_preemption(
         )
         n_vict = k_min - k_claimed
 
-        def lexmin(cand, key):
-            key = jnp.where(cand, key, _BIG_I32)
+        def lexmin(cand, key, big=_BIG_I32):
+            key = jnp.where(cand, key, big)
             return cand & (key == jnp.min(key))
 
         best = lexmin(candidate, max_vict_prio)
         best = lexmin(best, sum_vict_prio)
         best = lexmin(best, n_vict)
+        # upstream: prefer the node whose highest victim started LATEST
+        # (evict younger pods); minimize the negated start time
+        hi_start = jnp.take_along_axis(vict_start, last[:, None], axis=1)[:, 0]
+        best = lexmin(best, -hi_start, big=jnp.float32(jnp.inf))
         b = jnp.argmax(best).astype(jnp.int32)  # lowest node index among ties
 
         do = unschedulable[p] & jnp.any(candidate)
         nominated_p = jnp.where(do, b, jnp.int32(-1))
 
         # claim victims node_pods[b, k_claimed[b]:k_min[b]]
-        pos = jnp.arange(MPN, dtype=jnp.int32)
-        newly = do & (pos >= k_claimed[b]) & (pos < k_min[b]) & vict_valid[b]
+        pos1 = jnp.arange(MPN, dtype=jnp.int32)
+        newly = do & (pos1 >= k_claimed[b]) & (pos1 < k_min[b]) & vict_valid[b]
         victim_mask = victim_mask.at[safe_idx[b]].max(newly)
+        # newly-claimed victims consume their PDBs' budgets
+        for bb in range(MB):
+            g = vict_pdb[b, :, bb]  # [MPN]
+            pdb_used = pdb_used.at[jnp.clip(g, 0, GP - 1)].add(
+                jnp.where(newly & (g >= 0), 1, 0)
+            )
         k_claimed = k_claimed.at[b].set(
             jnp.where(do, k_min[b], k_claimed[b])
         )
         nominated_req = nominated_req.at[b].add(
             jnp.where(do, snap.pod_requested[p], 0.0)
         )
-        return (k_claimed, nominated_req, victim_mask), (p, nominated_p)
+        return (
+            (k_claimed, nominated_req, victim_mask, pdb_used),
+            (p, nominated_p),
+        )
 
     init = (
         jnp.zeros(N, jnp.int32),
         jnp.zeros_like(node_requested),
         jnp.zeros(E, bool),
+        jnp.zeros(GP, jnp.int32),
     )
-    (_, _, victims), (pods, noms) = jax.lax.scan(
+    (_, _, victims, _), (pods, noms) = jax.lax.scan(
         step, init, jnp.arange(C, dtype=jnp.int32)
     )
     nominated = jnp.full(P, -1, jnp.int32).at[pods].set(noms)

@@ -128,7 +128,9 @@ def _pod_view(snap, gid: jnp.ndarray):
     updates = {
         f.name: getattr(snap, f.name)[gid]
         for f in dataclasses.fields(snap)
-        if f.name.startswith("pod_")
+        # extender verdicts (None unless configured) are pre-folded into
+        # the static mask/score, so views never need them
+        if f.name.startswith("pod_") and getattr(snap, f.name) is not None
     }
     return dataclasses.replace(snap, **updates)
 

@@ -856,16 +856,24 @@ def validate_rounds_assignment(
 # Preemption (DefaultPreemption PostFilter analogue)
 # --------------------------------------------------------------------------
 
-# The static (commitment-independent) filters the preemption candidate check
-# uses — mirrors the kernel exactly: victim removal only relaxes RESOURCE
+# The candidate gate the preemption pass uses — mirrors the kernel's
+# CycleResult.preempt_gate: victim removal only relaxes RESOURCE
 # constraints; everything else must pass with victims still present (see
-# ops/preemption.py's documented deviation from upstream).
+# ops/preemption.py's documented deviation from upstream). Static filters
+# run against the pre-cycle state; the state-dependent filters (ports,
+# inter-pod affinity, topology spread, volumes) run against the POST-cycle
+# state, like the kernel's final-state gate.
 PREEMPTION_STATIC_FILTERS = (
     filter_node_unschedulable,
     filter_node_name,
     filter_taint_toleration,
     filter_node_affinity,
+    filter_volume_binding,
+)
+PREEMPTION_POST_FILTERS = (
     filter_node_ports,
+    filter_inter_pod_affinity,
+    filter_topology_spread,
 )
 
 
@@ -916,16 +924,34 @@ def schedule_with_preemption(
     existing: Sequence[tuple[Pod, str]] = (),
     weights: "OracleWeights | None" = None,
     filters=None,
+    pdbs: Sequence = (),
+    pvcs: Sequence = (),
+    pvs: Sequence = (),
+    storage_classes: Sequence = (),
 ) -> tuple[list[OracleDecision], list["OraclePreemption"]]:
     """schedule() then the preemption pass on whatever stayed pending."""
     weights = weights or OracleWeights()
     filters = filters or DEFAULT_FILTERS
-    decisions = schedule(nodes, pending, existing, weights, filters)
-    post_state = OracleState.build(nodes, existing)
+    decisions = schedule(
+        nodes, pending, existing, weights, filters, pvcs, pvs,
+        storage_classes,
+    )
+    post_state = OracleState.build(
+        nodes, existing, pvcs, pvs, storage_classes
+    )
     for d in decisions:
         if d.node_index >= 0:
             post_state.add(d.node_index, d.pod)
-    return decisions, preempt(nodes, pending, existing, decisions, post_state)
+    return decisions, preempt(
+        nodes, pending, existing, decisions, post_state, pdbs=pdbs,
+        pvcs=pvcs, pvs=pvs, storage_classes=storage_classes,
+    )
+
+
+def _pdb_selects(pdb, pod: Pod) -> bool:
+    if pod.namespace != pdb.namespace:
+        return False
+    return match_label_selector(pdb.selector, pod.metadata.labels)
 
 
 def preempt(
@@ -934,16 +960,32 @@ def preempt(
     existing: Sequence[tuple[Pod, str]],
     decisions: Sequence[OracleDecision],
     post_state: OracleState,
+    pdbs: Sequence = (),
+    pvcs: Sequence = (),
+    pvs: Sequence = (),
+    storage_classes: Sequence = (),
 ) -> list[OraclePreemption]:
     """Sequential preemption over the unschedulable pods in queue order,
     mirroring ops/preemption.py's semantics: per node, victims are a prefix
     of the existing pods sorted ascending by priority; the minimal prefix
-    that frees enough resources wins; node choice minimizes (highest victim
-    priority, victim priority sum, victim count, node index). `post_state`
-    is the oracle state AFTER the scheduling pass (committed pods consume
-    capacity); the static filters run against the pre-cycle state."""
+    that frees enough resources wins; a victim protected by an exhausted
+    PodDisruptionBudget truncates the usable prefix (claims decrement
+    budgets within the pass); node choice minimizes (highest victim
+    priority, victim priority sum, victim count, -(highest victim start
+    time), node index). `post_state` is the oracle state AFTER the
+    scheduling pass (committed pods consume capacity); the static filters
+    run against the pre-cycle state."""
     idx = {n.name: i for i, n in enumerate(nodes)}
-    static_state = OracleState.build(nodes, existing)
+    static_state = OracleState.build(
+        nodes, existing, pvcs, pvs, storage_classes
+    )
+    # PDB bookkeeping: per existing pod, the (first two) selecting PDBs —
+    # same MB=2 cap as the encoder
+    pdb_used = [0] * len(pdbs)
+    pod_pdbs: list[list[int]] = []
+    for p, _node in existing:
+        sels = [gi for gi, pdb in enumerate(pdbs) if _pdb_selects(pdb, p)]
+        pod_pdbs.append(sels[:2])
     # per-node victim lists: (priority asc, -existing_index) — same order as
     # the encoder's node_pods table
     per_node: list[list[int]] = [[] for _ in nodes]
@@ -964,15 +1006,25 @@ def preempt(
     for pi in unsched:
         pod = pending[pi]
         req = pod.resource_requests()
-        candidates = []  # (max_prio, sum_prio, n_vict, node, k_min)
+        candidates = []  # (max_prio, sum_prio, n_vict, -hi_start, node, k_min)
         for i in range(len(nodes)):
             if not all(f(pod, static_state, i) for f in PREEMPTION_STATIC_FILTERS):
+                continue
+            if not all(f(pod, post_state, i) for f in PREEMPTION_POST_FILTERS):
                 continue
             victs = per_node[i]
             elig = sum(
                 1 for e in victs
                 if existing[e][0].spec.priority < pod.spec.priority
             )
+            # PDB truncation: an exhausted-budget victim caps the prefix
+            for pos_, e in enumerate(victs):
+                if any(
+                    pdbs[g].disruptions_allowed - pdb_used[g] <= 0
+                    for g in pod_pdbs[e]
+                ):
+                    elig = min(elig, pos_)
+                    break
 
             def fits(k: int) -> bool:
                 alloc = nodes[i].status.allocatable
@@ -999,18 +1051,23 @@ def preempt(
             if k_min is None or k_min <= k_claimed[i]:
                 continue  # no help, or helps without evictions (not preemption)
             new = victs[k_claimed[i]:k_min]
+            hi = victs[k_min - 1]  # highest-priority (last) prefix victim
             candidates.append((
                 max(existing[e][0].spec.priority for e in new),
                 sum(existing[e][0].spec.priority for e in new),
                 len(new),
+                -existing[hi][0].metadata.creation_timestamp,
                 i,
                 k_min,
             ))
         if not candidates:
             continue
-        max_p, sum_p, n_v, node, k_min = min(candidates)
+        max_p, sum_p, n_v, neg_start, node, k_min = min(candidates)
         victims = per_node[node][k_claimed[node]:k_min]
         k_claimed[node] = k_min
+        for e in victims:
+            for g in pod_pdbs[e]:
+                pdb_used[g] += 1
         for r, v in req.items():
             nominated_req[node][r] = nominated_req[node].get(r, 0.0) + v
         out.append(OraclePreemption(pi, node, victims))

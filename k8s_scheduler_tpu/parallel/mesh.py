@@ -17,6 +17,40 @@ import dataclasses
 import numpy as np
 
 
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host (DCN) initialization (SURVEY.md §5.8).
+
+    One scheduler process per TPU host; `jax.distributed.initialize` wires
+    the hosts into one runtime so `jax.devices()` spans every chip and
+    `make_mesh` lays axes over ICI within a host and DCN across hosts
+    (JAX orders devices host-major, so the trailing mesh dimension stays
+    intra-host — put the collective-heavy 'nodes' axis there). Arguments
+    default to the standard JAX env vars (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID), so launchers that set those can
+    call this with no arguments. A no-op on single-process deployments.
+
+    Host-side state (queue/cache, the gRPC shim) stays on process 0 — the
+    cluster-facing link is unchanged; only the device program spans hosts.
+    """
+    import os
+
+    import jax
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return  # single host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
 def make_mesh(devices=None, nodes_axis: int = 1):
     """1-D ('pods',) mesh by default; pass nodes_axis>1 for a 2-D
     ('pods','nodes') mesh at large node counts."""

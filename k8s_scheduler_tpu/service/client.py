@@ -90,6 +90,7 @@ class SchedulerAgent:
         self._pvcs: dict[str, object] = {}
         self._pvs: dict[str, object] = {}
         self._classes: dict[str, object] = {}
+        self._pdbs: dict[str, object] = {}
         self._pending_failures: list[str] = []
         self._boot_id: str | None = None  # shim incarnation last fed state
         self._batch: pb.UpdateRequest | None = None  # open batched() request
@@ -163,6 +164,14 @@ class SchedulerAgent:
     def delete_storage_class(self, name: str) -> None:
         self._classes.pop(name, None)
         self._send(pb.UpdateRequest(storage_class_deletes=[name]))
+
+    def upsert_pdb(self, pdb) -> None:
+        self._pdbs[pdb.key] = pdb
+        self._send(pb.UpdateRequest(pdb_upserts=[convert.pdb_to(pdb)]))
+
+    def delete_pdb(self, key: str) -> None:
+        self._pdbs.pop(key, None)
+        self._send(pb.UpdateRequest(pdb_deletes=[key]))
 
     # ---- the cycle -------------------------------------------------------
 
@@ -272,5 +281,7 @@ class SchedulerAgent:
             req.pv_upserts.append(convert.pv_to(pv))
         for sc in self._classes.values():
             req.storage_class_upserts.append(convert.storage_class_to(sc))
+        for pdb in self._pdbs.values():
+            req.pdb_upserts.append(convert.pdb_to(pdb))
         resp = self.client.update(req)
         self._boot_id = resp.boot_id

@@ -184,6 +184,24 @@ def storage_class_from(s: pb.StorageClass) -> api.StorageClass:
     )
 
 
+def pdb_from(p: pb.PodDisruptionBudget) -> api.PodDisruptionBudget:
+    return api.PodDisruptionBudget(
+        name=p.name,
+        namespace=p.namespace or "default",
+        selector=_selector_from(p.selector),
+        disruptions_allowed=p.disruptions_allowed,
+    )
+
+
+def pdb_to(p: api.PodDisruptionBudget) -> pb.PodDisruptionBudget:
+    return pb.PodDisruptionBudget(
+        name=p.name,
+        namespace=p.namespace,
+        selector=_selector_to(p.selector),
+        disruptions_allowed=p.disruptions_allowed,
+    )
+
+
 def storage_class_to(s: api.StorageClass) -> pb.StorageClass:
     return pb.StorageClass(
         name=s.name,

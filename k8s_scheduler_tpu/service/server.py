@@ -111,6 +111,10 @@ class SchedulerService:
             s.on_storage_class_upsert(convert.storage_class_from(sc))
         for name in request.storage_class_deletes:
             s.on_storage_class_delete(name)
+        for pdb in request.pdb_upserts:
+            s.on_pdb_upsert(convert.pdb_from(pdb))
+        for key in request.pdb_deletes:
+            s.on_pdb_delete(key)
         return pb.UpdateResponse(boot_id=self.boot_id)
 
     def Cycle(self, request: pb.CycleRequest, context) -> pb.CycleResponse:

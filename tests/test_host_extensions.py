@@ -1,0 +1,205 @@
+"""Host-side extension points (Reserve/Permit/PreBind/PostBind) and the
+HTTP scheduler-extender shim (SURVEY.md §2 C10)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from k8s_scheduler_tpu.config import load_config
+from k8s_scheduler_tpu.core.scheduler import Scheduler
+from k8s_scheduler_tpu.framework.host import HostPlugin
+from k8s_scheduler_tpu.models.builders import MakeNode, MakePod
+
+
+class RecordingPlugin(HostPlugin):
+    name = "Recorder"
+
+    def __init__(self):
+        self.calls = []
+
+    def reserve(self, pod, node_name):
+        self.calls.append(("reserve", pod.name, node_name))
+        return None
+
+    def unreserve(self, pod, node_name):
+        self.calls.append(("unreserve", pod.name, node_name))
+
+    def permit(self, pod, node_name):
+        self.calls.append(("permit", pod.name, node_name))
+        return None
+
+    def pre_bind(self, pod, node_name):
+        self.calls.append(("pre_bind", pod.name, node_name))
+        return None
+
+    def post_bind(self, pod, node_name):
+        self.calls.append(("post_bind", pod.name, node_name))
+
+
+class VetoPlugin(HostPlugin):
+    """Out-of-tree plugin that vetoes binds of pods labeled deny=yes."""
+
+    name = "Veto"
+
+    def __init__(self, point="Permit"):
+        self.point = point
+
+    def permit(self, pod, node_name):
+        if self.point == "Permit" and pod.metadata.labels.get("deny") == "yes":
+            return "policy says no"
+        return None
+
+    def pre_bind(self, pod, node_name):
+        if self.point == "PreBind" and pod.metadata.labels.get("deny") == "yes":
+            return "attach failed"
+        return None
+
+
+def make_sched(**kw):
+    bound = {}
+    s = Scheduler(
+        binder=lambda pod, node: bound.__setitem__(pod.uid, node), **kw
+    )
+    return s, bound
+
+
+def test_host_plugin_lifecycle_order():
+    rec = RecordingPlugin()
+    s, bound = make_sched(host_plugins=[rec])
+    s.on_node_add(MakeNode("n0").capacity({"cpu": "4"}).obj())
+    s.on_pod_add(MakePod("p0").req({"cpu": "1"}).obj())
+    stats = s.schedule_cycle()
+    assert stats.scheduled == 1 and bound
+    assert [c[0] for c in rec.calls] == [
+        "reserve", "permit", "pre_bind", "post_bind"
+    ]
+
+
+def test_permit_veto_blocks_bind_and_requeues_unschedulable():
+    rec = RecordingPlugin()
+    s, bound = make_sched(host_plugins=[rec, VetoPlugin("Permit")])
+    s.on_node_add(MakeNode("n0").capacity({"cpu": "4"}).obj())
+    s.on_pod_add(MakePod("ok").req({"cpu": "1"}).obj())
+    s.on_pod_add(
+        MakePod("bad").req({"cpu": "1"}).labels({"deny": "yes"}).obj()
+    )
+    stats = s.schedule_cycle()
+    assert stats.scheduled == 1
+    assert stats.unschedulable == 1
+    assert len(bound) == 1
+    # the vetoed pod's reservation was rolled back
+    assert ("unreserve", "bad", "n0") in rec.calls
+    # veto reason reaches the events stream
+    msgs = [e.message for e in s.events.events()
+            if e.reason == "FailedScheduling"]
+    assert any("Veto rejected at Permit" in m for m in msgs)
+
+
+def test_prebind_failure_retries_with_backoff():
+    s, bound = make_sched(host_plugins=[VetoPlugin("PreBind")])
+    s.on_node_add(MakeNode("n0").capacity({"cpu": "4"}).obj())
+    s.on_pod_add(
+        MakePod("bad").req({"cpu": "1"}).labels({"deny": "yes"}).obj()
+    )
+    stats = s.schedule_cycle()
+    assert stats.bind_errors == 1 and not bound
+    # pod is in backoff, not unschedulable
+    assert s.queue.pending_counts().get("backoff", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP extender
+# ---------------------------------------------------------------------------
+
+
+class _ExtenderHandler(BaseHTTPRequestHandler):
+    calls: list = []
+
+    def do_POST(self):
+        body = json.loads(
+            self.rfile.read(int(self.headers["Content-Length"]))
+        )
+        type(self).calls.append((self.path, body))
+        if self.path.endswith("/filter"):
+            # only nodes labeled allowed (name ends with '1') pass
+            names = [n for n in body["NodeNames"] if n.endswith("1")]
+            out = {"NodeNames": names}
+        elif self.path.endswith("/prioritize"):
+            out = {
+                "Items": [
+                    {"Host": n, "Score": 10 if n == "n1" else 0}
+                    for n in body["NodeNames"]
+                ]
+            }
+        elif self.path.endswith("/bind"):
+            out = {"Error": ""}
+        else:
+            out = {"Error": f"unknown verb {self.path}"}
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture()
+def extender_server():
+    _ExtenderHandler.calls = []
+    srv = HTTPServer(("127.0.0.1", 0), _ExtenderHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}/scheduler"
+    srv.shutdown()
+
+
+def test_extender_filter_and_bind_delegation(extender_server):
+    cfg = load_config({
+        "extenders": [{
+            "urlPrefix": extender_server,
+            "filterVerb": "filter",
+            "prioritizeVerb": "prioritize",
+            "bindVerb": "bind",
+            "weight": 2,
+        }]
+    })
+    s, bound = make_sched(config=cfg)
+    for i in range(3):
+        s.on_node_add(MakeNode(f"n{i}").capacity({"cpu": "4"}).obj())
+    s.on_pod_add(MakePod("p0").req({"cpu": "1"}).obj())
+    stats = s.schedule_cycle()
+    assert stats.scheduled == 1
+    # the default binder was NOT used: the extender owns binding
+    assert not bound
+    paths = [p for p, _ in _ExtenderHandler.calls]
+    assert any(p.endswith("/filter") for p in paths)
+    assert any(p.endswith("/bind") for p in paths)
+    # only n1 passed the extender filter
+    bind_calls = [b for p, b in _ExtenderHandler.calls if p.endswith("/bind")]
+    assert bind_calls[0]["Node"] == "n1"
+
+
+def test_extender_error_nonignorable_backoff():
+    cfg = load_config({
+        "extenders": [{
+            # nothing listens on port 9: connection refused -> ExtenderError
+            "urlPrefix": "http://127.0.0.1:9/scheduler",
+            "filterVerb": "filter",
+            "httpTimeout": 0.5,
+            "ignorable": False,
+        }]
+    })
+    s, bound = make_sched(config=cfg)
+    s.on_node_add(MakeNode("n1").capacity({"cpu": "4"}).obj())
+    s.on_pod_add(MakePod("p0").req({"cpu": "1"}).obj())
+    stats = s.schedule_cycle()
+    assert stats.scheduled == 0 and not bound
+    assert stats.bind_errors == 1
+    assert s.queue.pending_counts().get("backoff", 0) == 1

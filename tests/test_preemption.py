@@ -8,8 +8,8 @@ from k8s_scheduler_tpu.core import build_cycle_fn, build_preemption_fn
 from k8s_scheduler_tpu.models import MakeNode, MakePod, SnapshotEncoder
 
 
-def run_both(nodes, pods, existing=()):
-    snap = SnapshotEncoder().encode(nodes, pods, existing)
+def run_both(nodes, pods, existing=(), pdbs=()):
+    snap = SnapshotEncoder().encode(nodes, pods, existing, pdbs=pdbs)
     cycle = build_cycle_fn()
     result = cycle(snap)
     pre = build_preemption_fn()(snap, result)
@@ -17,7 +17,7 @@ def run_both(nodes, pods, existing=()):
     got_victims = sorted(np.flatnonzero(np.asarray(pre.victims)).tolist())
 
     decisions, preemptions = oracle.schedule_with_preemption(
-        nodes, pods, existing
+        nodes, pods, existing, pdbs=pdbs
     )
     want_nom = [-1] * len(pods)
     want_victims = []
@@ -167,6 +167,73 @@ def test_schedulable_pods_do_not_preempt():
     assert got == want == ([-1], [])
     assert np.asarray(result.assignment)[0] == 1
     assert int(pre.num_preemptors) == 0
+
+
+def test_pdb_protected_victim_truncates_prefix():
+    from k8s_scheduler_tpu.models.api import LabelSelector, PodDisruptionBudget
+
+    nodes = [MakeNode("n0").capacity({"cpu": "2"}).obj()]
+    existing = [
+        (MakePod("protected").req({"cpu": "1"}).priority(1)
+         .labels({"app": "db"}).obj(), "n0"),
+        (MakePod("free").req({"cpu": "900m"}).priority(2).obj(), "n0"),
+    ]
+    pods = [MakePod("urgent").req({"cpu": "1800m"}).priority(10).obj()]
+    pdbs = [PodDisruptionBudget(
+        "db-pdb", selector=LabelSelector(match_labels={"app": "db"}),
+        disruptions_allowed=0,
+    )]
+    # the lowest-priority victim is PDB-protected: the prefix is truncated
+    # at it, so no eviction set frees enough -> no preemption at all
+    got, want, _ = run_both(nodes, pods, existing, pdbs=pdbs)
+    assert got == want == ([-1], [])
+    # with budget, the same setup preempts
+    pdbs[0].disruptions_allowed = 1
+    got, want, _ = run_both(nodes, pods, existing, pdbs=pdbs)
+    assert got == want
+    assert got[0] == [0]
+
+
+def test_pdb_budget_consumed_within_cycle():
+    from k8s_scheduler_tpu.models.api import LabelSelector, PodDisruptionBudget
+
+    # two nodes, each holding one member of the same PDB group with
+    # budget 1: only ONE preemptor may evict this cycle
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "1"}).obj() for i in range(2)]
+    existing = [
+        (MakePod(f"m{i}").req({"cpu": "1"}).priority(1)
+         .labels({"app": "db"}).created(float(i)).obj(), f"n{i}")
+        for i in range(2)
+    ]
+    pods = [
+        MakePod(f"hi{i}").req({"cpu": "1"}).priority(10)
+        .created(float(10 + i)).obj()
+        for i in range(2)
+    ]
+    pdbs = [PodDisruptionBudget(
+        "db-pdb", selector=LabelSelector(match_labels={"app": "db"}),
+        disruptions_allowed=1,
+    )]
+    got, want, _ = run_both(nodes, pods, existing, pdbs=pdbs)
+    assert got == want
+    assert sum(1 for n in got[0] if n >= 0) == 1
+    assert len(got[1]) == 1
+
+
+def test_start_time_tie_break_prefers_younger_victim():
+    # two identical nodes/victims except start time: evict the younger
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "1"}).obj() for i in range(2)]
+    existing = [
+        (MakePod("old").req({"cpu": "1"}).priority(1).created(100.0).obj(),
+         "n0"),
+        (MakePod("young").req({"cpu": "1"}).priority(1).created(200.0).obj(),
+         "n1"),
+    ]
+    pods = [MakePod("hi").req({"cpu": "1"}).priority(10).obj()]
+    got, want, _ = run_both(nodes, pods, existing)
+    assert got == want
+    assert got[0] == [1]  # n1 hosts the younger victim
+    assert got[1] == [1]
 
 
 def test_randomized_differential_preemption():

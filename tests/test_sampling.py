@@ -1,0 +1,71 @@
+"""percentageOfNodesToScore: the knob must have an observable effect
+(VERDICT r1 #9 — previously parsed but dead)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from k8s_scheduler_tpu.core.cycle import build_cycle_fn
+from k8s_scheduler_tpu.models import SnapshotEncoder
+from k8s_scheduler_tpu.models.builders import MakeNode, MakePod
+
+
+def _cluster(n=200):
+    return [MakeNode(f"n{i}").capacity({"cpu": "8"}).labels(
+        {"slot": str(i)}) .obj() for i in range(n)]
+
+
+def test_sampling_window_excludes_far_nodes():
+    # rank-0's 50% window on this snapshot (cycle_index=1) covers
+    # (c - 137) % 200 < 100, i.e. [137, 199] + [0, 36]; the only feasible
+    # node (slot=100) sits outside it, so sampled scheduling must fail
+    # where full scoring succeeds
+    nodes = _cluster(200)
+    pods = [MakePod("p0").req({"cpu": "1"})
+            .node_selector({"slot": "100"}).obj()]
+    snap = SnapshotEncoder().encode(nodes, pods)
+    full = build_cycle_fn(percentage_of_nodes_to_score=100)(snap)
+    sampled = build_cycle_fn(percentage_of_nodes_to_score=50)(snap)
+    assert int(np.asarray(full.assignment)[0]) == 100
+    assert int(np.asarray(sampled.assignment)[0]) == -1
+
+
+def test_sampling_rotates_across_cycles_no_starvation():
+    # the same pod re-encoded on later cycles gets different windows, so
+    # an excluded-this-cycle node becomes reachable in a later cycle
+    nodes = _cluster(200)
+    pods = [MakePod("p0").req({"cpu": "1"})
+            .node_selector({"slot": "100"}).obj()]
+    enc = SnapshotEncoder()
+    fn = build_cycle_fn(percentage_of_nodes_to_score=50)
+    placed = []
+    for _ in range(6):
+        snap = enc.encode(nodes, pods)
+        placed.append(int(np.asarray(fn(snap).assignment)[0]))
+    assert 100 in placed, f"sampling starved the pod across cycles: {placed}"
+
+
+def test_small_clusters_are_never_sampled():
+    # <100-node floor: adaptive default must not drop candidates
+    nodes = _cluster(50)
+    pods = [MakePod("p0").req({"cpu": "1"})
+            .node_selector({"slot": "49"}).obj()]
+    snap = SnapshotEncoder().encode(nodes, pods)
+    out = build_cycle_fn(percentage_of_nodes_to_score=0)(snap)
+    assert int(np.asarray(out.assignment)[0]) == 49
+
+
+def test_sampling_rotates_with_rank():
+    # many identical pods: rotation spreads their windows, so a large
+    # cluster still fills evenly under aggressive sampling
+    nodes = _cluster(200)
+    pods = [
+        MakePod(f"p{i}").req({"cpu": "1"}).created(float(i)).obj()
+        for i in range(100)
+    ]
+    snap = SnapshotEncoder().encode(nodes, pods)
+    out = build_cycle_fn(percentage_of_nodes_to_score=50)(snap)
+    a = np.asarray(out.assignment)[:100]
+    assert (a >= 0).all()
+    # windows rotate: placements are not all in the first half
+    assert (a >= 100).any()
