@@ -39,12 +39,15 @@ class CycleResult:
     node_requested: jnp.ndarray  # f32 [N, R] post-cycle
     unschedulable: jnp.ndarray  # bool [P] valid pod that found no node
     gang_dropped: jnp.ndarray  # bool [P] placed, then unwound (group failed)
-    preempt_gate: jnp.ndarray  # bool [P, N]: static feasibility AND every
-    # NON-resource dynamic filter evaluated against the FINAL post-commit
-    # state — the PostFilter candidate mask. Preemption relaxes resource
-    # constraints only, so a node that fails ports/affinity/spread against
-    # the end-of-cycle state must not be nominated (it would be rejected
-    # again next cycle, wasting the eviction).
+    preempt_gate: jnp.ndarray  # bool [P, N]: the PostFilter candidate
+    # mask — static feasibility (WITHOUT the node-sampling window;
+    # preemption considers every node, as upstream findCandidates does)
+    # AND the NodePorts dynamic mask against the FINAL post-commit state.
+    # Ports gate because a port claimed by a this-cycle winner cannot be
+    # freed by evicting existing pods — nominating there wastes the
+    # eviction. Affinity/spread dynamic masks deliberately do NOT gate:
+    # evicting matching victims lowers the domain counts, so those
+    # constraints can genuinely clear by the next cycle.
     reject_counts: jnp.ndarray  # i32 [P, F] nodes first-rejected per filter
     # (static + dynamic attribution summed; columns = Framework.filter_names)
     # — feeds FailedScheduling events and requeue queueing hints
@@ -129,6 +132,7 @@ def build_cycle_fn(
             # filters; rejections are attributed to the base mask)
             smask = smask & snap.pod_extender_mask
             sscore = sscore + snap.pod_extender_score
+        smask_all_nodes = smask  # pre-sampling (preemption gate base)
         if percentage_of_nodes_to_score < 100:
             # 0 = adaptive percentage, like upstream's default; the <100-
             # node floor inside sampling_mask keeps small clusters exact
@@ -225,8 +229,9 @@ def build_cycle_fn(
             )
         unsched = snap.pod_valid & (result.assignment < 0)
 
-        # PostFilter candidate gate: static AND non-resource dynamic masks
-        # vs the final state (rounds mode computed them already; scan mode
+        # PostFilter candidate gate (see CycleResult.preempt_gate): static
+        # without sampling, plus the final-state NodePorts dynamic mask
+        # (rounds mode computed the per-filter masks already; scan mode
         # pays one batched pass — it targets small pending sets)
         if commit_mode == "rounds":
             per_filter_final = rres.final_per_filter
@@ -234,9 +239,9 @@ def build_cycle_fn(
             _m, _s, per_filter_final = fw.dyn_batched(
                 ctx, result.node_requested, result.extra, smask
             )
-        gate = smask
+        gate = smask_all_nodes
         for f, m in zip(fw.filters, per_filter_final):
-            if m is not None and f.name != "NodeResourcesFit":
+            if m is not None and f.name == "NodePorts":
                 gate = gate & m
 
         return CycleResult(

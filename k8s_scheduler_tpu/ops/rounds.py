@@ -112,14 +112,23 @@ def _matched_active(m_pending, active_sel, ms: int):
     """Per-pod list of up to `ms` guard-active selectors it matches.
 
     Returns (sels i32 [P, ms] (-1 pad), overflow bool [P]). Selector ids
-    ascending (deterministic)."""
+    ascending (deterministic). Implemented as `ms` masked argmin passes —
+    a lax.top_k here would sort the whole [P, S] table, which costs
+    hundreds of ms at 10k pods for a table that is almost entirely
+    False."""
     S, P = m_pending.shape
-    m = m_pending & active_sel[:, None]  # [S, P]
-    vals = jnp.where(m, (S - jnp.arange(S, dtype=jnp.int32))[:, None], 0)
-    top, idx = jax.lax.top_k(vals.T, ms)  # [P, ms]
-    sels = jnp.where(top > 0, idx, -1)
-    overflow = jnp.sum(m, axis=0) > ms
-    return sels.astype(jnp.int32), overflow
+    m = (m_pending & active_sel[:, None]).T  # [P, S]
+    sel_ids = jnp.arange(S, dtype=jnp.int32)[None, :]
+    cols = []
+    remaining = m
+    for _ in range(ms):
+        # lowest matching selector id still unclaimed
+        cand = jnp.where(remaining, sel_ids, S)
+        nxt = jnp.min(cand, axis=1).astype(jnp.int32)  # [P]
+        cols.append(jnp.where(nxt < S, nxt, -1))
+        remaining = remaining & (sel_ids != nxt[:, None])
+    overflow = jnp.any(remaining, axis=1)
+    return jnp.stack(cols, axis=1), overflow
 
 
 def _pod_view(snap, gid: jnp.ndarray):

@@ -858,11 +858,12 @@ def validate_rounds_assignment(
 
 # The candidate gate the preemption pass uses — mirrors the kernel's
 # CycleResult.preempt_gate: victim removal only relaxes RESOURCE
-# constraints; everything else must pass with victims still present (see
-# ops/preemption.py's documented deviation from upstream). Static filters
-# run against the pre-cycle state; the state-dependent filters (ports,
-# inter-pod affinity, topology spread, volumes) run against the POST-cycle
-# state, like the kernel's final-state gate.
+# constraints; the static filters (plus volumes) must pass with victims
+# present, and ports must pass against the POST-cycle state (a port
+# claimed by a this-cycle winner cannot be freed by eviction). Affinity/
+# spread do NOT gate candidates — evicting matching victims lowers the
+# counts, so those constraints can clear by the next cycle (see
+# CycleResult.preempt_gate).
 PREEMPTION_STATIC_FILTERS = (
     filter_node_unschedulable,
     filter_node_name,
@@ -872,8 +873,6 @@ PREEMPTION_STATIC_FILTERS = (
 )
 PREEMPTION_POST_FILTERS = (
     filter_node_ports,
-    filter_inter_pod_affinity,
-    filter_topology_spread,
 )
 
 
