@@ -23,7 +23,7 @@ import sys
 TARGET_DECISIONS_PER_SEC = 50_000.0
 
 # distinct snapshots per config; overridable via BENCH_SNAPSHOTS
-DEFAULT_SNAPSHOTS = {1: 50, 2: 50, 3: 10, 4: 5, 5: 10}
+DEFAULT_SNAPSHOTS = {1: 50, 2: 50, 3: 50, 4: 30, 5: 30}
 
 
 def main() -> None:

@@ -660,14 +660,17 @@ class SnapshotEncoder:
                     ii = image_id(nm)
                     imgs.append(ii)
                     image_sizes[ii] = float(img.size_bytes)
+            rows = [
+                (S.intern(k), S.intern(v), _num_or_nan(v))
+                for k, v in sorted(labels.items())
+            ]
             data = {
                 "alloc": self._resources_vec(nd.status.allocatable),
                 "unsched": nd.spec.unschedulable,
                 "taintset": compile_taints(nd.spec.taints),
-                "labels": [
-                    (S.intern(k), S.intern(v), _num_or_nan(v))
-                    for k, v in sorted(labels.items())
-                ],
+                "lab_k": np.array([k for k, _, _ in rows], np.int32),
+                "lab_v": np.array([v for _, v, _ in rows], np.int32),
+                "lab_num": np.array([n for _, _, n in rows], np.float32),
                 "label_map": {k: S.intern(v) for k, v in labels.items()},
                 "images": imgs,
             }
@@ -747,23 +750,38 @@ class SnapshotEncoder:
                 for (port, proto, _) in p.host_ports()
             ]
             vols, vol_fields = compile_pod_vols(p)
+            # rows are PACKED numpy sections: assembly is a native strided
+            # scatter (k8s_scheduler_tpu/native) instead of per-pod Python
+            # array writes
             data = {
                 "reqvec": self._resources_vec(p.resource_requests()),
                 "prio": p.spec.priority,
+                "creation": p.metadata.creation_timestamp,
                 "req_id": req_id,
                 "pref_id": pref_id,
                 "sel_req_id": sel_req_id,
                 "tolset": compile_tolerations(p.spec.tolerations),
-                "labels": labels,
-                "ports": ports,
-                "aff": aff,
-                "anti": anti,
-                "prefaff": prefs,
-                "tsc": tsc,
-                "group": p.spec.pod_group,
+                "lab_k": np.array([k for k, _ in labels], np.int32),
+                "lab_v": np.array([v for _, v in labels], np.int32),
+                "ports": np.array(ports, np.int32),
+                "aff": np.array(aff, np.int32).reshape(-1),
+                "anti": np.array(anti, np.int32).reshape(-1),
+                "pref": np.array(
+                    [(s, k) for s, k, _ in prefs], np.int32
+                ).reshape(-1),
+                "pref_w": np.array([w for _, _, w in prefs], np.float32),
+                "tsc": np.array(
+                    [(k, s, w) for k, s, w, _ in tsc], np.int32
+                ).reshape(-1),
+                "tsc_skew": np.array([sk for _, _, _, sk in tsc], np.int32),
+                "n_aff": max(len(aff), len(anti), len(prefs)),
+                "gid": group_id(p.spec.pod_group),
                 "imageset": compile_imageset(p.images()),
                 "can_preempt": p.spec.preemption_policy != "Never",
-                "vols": vols,
+                "vol_mode": np.array([m for m, _, _, _ in vols], np.int32),
+                "vol_req": np.array([r for _, r, _, _ in vols], np.int32),
+                "vol_cls": np.array([c for _, _, c, _ in vols], np.int32),
+                "vol_size": np.array([s for _, _, _, s in vols], np.float32),
                 "vol_epoch": vol_epoch if p.spec.volumes else None,
                 "epoch": (
                     self._node_epoch if (uses_fields or vol_fields) else None
@@ -795,8 +813,10 @@ class SnapshotEncoder:
         # earlier encodes — rn is grow-only)
         R = len(rn)
 
-        # ---- assemble node arrays ----
-        ML = _pad_dim(max([len(d["labels"]) for d in node_rows] + [1]), 8)
+        # ---- assemble node arrays (native strided scatters) ----
+        from .. import native
+
+        ML = _pad_dim(max([len(d["lab_k"]) for d in node_rows] + [1]), 8)
         node_alloc = np.zeros((N, R), np.float32)
         node_requested = np.zeros((N, R), np.float32)
         node_unsched = np.zeros(N, bool)
@@ -807,20 +827,15 @@ class SnapshotEncoder:
         node_valid = np.zeros(N, bool)
         node_valid[:n_real] = True
 
-        node_image_sets: list[list[int]] = []
+        native.scatter_rows(node_alloc, [d["alloc"] for d in node_rows])
+        native.fill_scalars(node_unsched, [d["unsched"] for d in node_rows])
+        native.fill_scalars(node_taintset, [d["taintset"] for d in node_rows])
+        native.scatter_rows(nl_keys, [d["lab_k"] for d in node_rows])
+        native.scatter_rows(nl_vals, [d["lab_v"] for d in node_rows])
+        native.scatter_rows(nl_num, [d["lab_num"] for d in node_rows])
+        node_image_sets = [d["images"] for d in node_rows]
 
-        for i, d in enumerate(node_rows):
-            a = d["alloc"]
-            node_alloc[i, : a.shape[0]] = a
-            node_unsched[i] = d["unsched"]
-            node_taintset[i] = d["taintset"]
-            for j, (ki, vi, num) in enumerate(d["labels"]):
-                nl_keys[i, j] = ki
-                nl_vals[i, j] = vi
-                nl_num[i, j] = num
-            node_image_sets.append(d["images"])
-
-        # ---- assemble pending-pod arrays ----
+        # ---- assemble pending-pod arrays (native strided scatters) ----
         pod_req = np.zeros((P, R), np.float32)
         pod_prio = np.zeros(P, np.int32)
         pod_node_name = np.full(P, -1, np.int32)
@@ -835,7 +850,7 @@ class SnapshotEncoder:
         pod_valid = np.zeros(P, bool)
         pod_valid[:p_real] = True
 
-        MPL = _pad_dim(max([len(d["labels"]) for d in all_rows] + [1]), 8)
+        MPL = _pad_dim(max([len(d["lab_k"]) for d in all_rows] + [1]), 8)
         pl_keys = np.full((P, MPL), -1, np.int32)
         pl_vals = np.full((P, MPL), -1, np.int32)
 
@@ -844,26 +859,19 @@ class SnapshotEncoder:
         pod_port_ids = np.full((P, MPorts), -1, np.int32)
         port_ids_t = _InternTable()  # distinct (port, proto) among pending
 
-        MA = _pad_dim(
-            max(
-                [
-                    max(len(d["aff"]), len(d["anti"]), len(d["prefaff"]))
-                    for d in all_rows
-                ]
-                + [1]
-            ),
-            4,
-        )
+        MA = _pad_dim(max([d["n_aff"] for d in all_rows] + [1]), 4)
         pod_aff_terms = np.full((P, MA, 2), -1, np.int32)
         pod_anti_terms = np.full((P, MA, 2), -1, np.int32)
         pod_pref_aff = np.full((P, MA, 2), -1, np.int32)
         pod_pref_aff_w = np.zeros((P, MA), np.float32)
 
-        MC = _pad_dim(max([len(d["tsc"]) for d in pend_rows] + [1]), 4)
+        MC = _pad_dim(max([len(d["tsc_skew"]) for d in pend_rows] + [1]), 4)
         pod_tsc = np.full((P, MC, 3), -1, np.int32)
         pod_tsc_skew = np.zeros((P, MC), np.int32)
 
-        MVol = _pad_dim(max([len(d["vols"]) for d in pend_rows] + [1]), 2)
+        MVol = _pad_dim(
+            max([len(d["vol_mode"]) for d in pend_rows] + [1]), 2
+        )
         pod_vol_mode = np.full((P, MVol), -1, np.int32)
         pod_vol_req = np.full((P, MVol), -1, np.int32)
         pod_vol_class = np.full((P, MVol), -1, np.int32)
@@ -884,42 +892,50 @@ class SnapshotEncoder:
             pv_cap_arr[i] = pv.capacity
             pv_avail_arr[i] = not pv.claim_ref and pv.name not in claimed_pvs
 
+        native.scatter_rows(pod_req, [d["reqvec"] for d in pend_rows])
+        native.fill_scalars(pod_prio, [d["prio"] for d in pend_rows])
+        native.fill_scalars(pod_req_id, [d["req_id"] for d in pend_rows])
+        native.fill_scalars(pod_pref_id, [d["pref_id"] for d in pend_rows])
+        native.fill_scalars(
+            pod_sel_req_id, [d["sel_req_id"] for d in pend_rows]
+        )
+        native.fill_scalars(pod_tolset, [d["tolset"] for d in pend_rows])
+        native.fill_scalars(pod_group_arr, [d["gid"] for d in pend_rows])
+        native.fill_scalars(pod_imageset, [d["imageset"] for d in pend_rows])
+        native.fill_scalars(
+            pod_can_preempt, [d["can_preempt"] for d in pend_rows]
+        )
+        native.scatter_rows(pl_keys, [d["lab_k"] for d in pend_rows])
+        native.scatter_rows(pl_vals, [d["lab_v"] for d in pend_rows])
+        native.scatter_rows(pod_ports, [d["ports"] for d in pend_rows])
+        native.scatter_rows(
+            pod_aff_terms.reshape(P, MA * 2), [d["aff"] for d in pend_rows]
+        )
+        native.scatter_rows(
+            pod_anti_terms.reshape(P, MA * 2), [d["anti"] for d in pend_rows]
+        )
+        native.scatter_rows(
+            pod_pref_aff.reshape(P, MA * 2), [d["pref"] for d in pend_rows]
+        )
+        native.scatter_rows(pod_pref_aff_w, [d["pref_w"] for d in pend_rows])
+        native.scatter_rows(
+            pod_tsc.reshape(P, MC * 3), [d["tsc"] for d in pend_rows]
+        )
+        native.scatter_rows(pod_tsc_skew, [d["tsc_skew"] for d in pend_rows])
+        native.scatter_rows(pod_vol_mode, [d["vol_mode"] for d in pend_rows])
+        native.scatter_rows(pod_vol_req, [d["vol_req"] for d in pend_rows])
+        native.scatter_rows(pod_vol_class, [d["vol_cls"] for d in pend_rows])
+        native.scatter_rows(pod_vol_size, [d["vol_size"] for d in pend_rows])
+        # sparse per-pod residue: pinned/nominated nodes and the per-cycle
+        # distinct-port interning (pods carrying those are rare)
         for i, (p, d) in enumerate(zip(pending, pend_rows)):
-            rv = d["reqvec"]
-            pod_req[i, : rv.shape[0]] = rv
-            pod_prio[i] = d["prio"]
             if p.spec.node_name:
                 pod_node_name[i] = node_index.get(p.spec.node_name, -2)
             if p.nominated_node_name:
                 pod_nominated[i] = node_index.get(p.nominated_node_name, -1)
-            pod_req_id[i] = d["req_id"]
-            pod_pref_id[i] = d["pref_id"]
-            pod_sel_req_id[i] = d["sel_req_id"]
-            pod_tolset[i] = d["tolset"]
-            for j, (ki, vi) in enumerate(d["labels"]):
-                pl_keys[i, j] = ki
-                pl_vals[i, j] = vi
-            for j, enc_port in enumerate(d["ports"]):
-                pod_ports[i, j] = enc_port
-                pod_port_ids[i, j] = port_ids_t.intern(enc_port)
-            for j, t in enumerate(d["aff"]):
-                pod_aff_terms[i, j] = t
-            for j, t in enumerate(d["anti"]):
-                pod_anti_terms[i, j] = t
-            for j, (s, k, w) in enumerate(d["prefaff"]):
-                pod_pref_aff[i, j] = (s, k)
-                pod_pref_aff_w[i, j] = w
-            for j, (kidx, sel, when, skew) in enumerate(d["tsc"]):
-                pod_tsc[i, j] = (kidx, sel, when)
-                pod_tsc_skew[i, j] = skew
-            for j, (mode, rid, cid, size) in enumerate(d["vols"]):
-                pod_vol_mode[i, j] = mode
-                pod_vol_req[i, j] = rid
-                pod_vol_class[i, j] = cid
-                pod_vol_size[i, j] = size
-            pod_group_arr[i] = group_id(d["group"])
-            pod_imageset[i] = d["imageset"]
-            pod_can_preempt[i] = d["can_preempt"]
+            if len(d["ports"]):
+                for j, enc_port in enumerate(d["ports"]):
+                    pod_port_ids[i, j] = port_ids_t.intern(int(enc_port))
 
         # ---- assemble existing-pod arrays ----
         def _pdb_matches(pdb: api.PodDisruptionBudget, p: Pod) -> bool:
@@ -970,18 +986,31 @@ class SnapshotEncoder:
         exist_valid[:e_real] = True
 
         used_ports: list[list[int]] = [[] for _ in range(N)]
-        per_node: list[list[int]] = [[] for _ in range(N)]
         # existing pods' own (non-anti) required affinity is not re-checked
         # against incoming pods (upstream symmetry applies to anti-affinity
         # and preferred terms only), so required-affinity terms are dropped
 
         exist_group = np.full(E, -1, np.int32)
-        for i, ((p, node_name), d) in enumerate(zip(existing, exist_rows)):
-            ni = node_index.get(node_name, -1)
-            exist_node[i] = ni
-            exist_prio[i] = d["prio"]
-            exist_start[i] = p.metadata.creation_timestamp - start_base
-            if pdbs:
+        native.fill_scalars(exist_prio, [d["prio"] for d in exist_rows])
+        native.fill_scalars(exist_group, [d["gid"] for d in exist_rows])
+        native.fill_scalars(
+            exist_start, [d["creation"] - start_base for d in exist_rows]
+        )
+        native.fill_scalars(
+            exist_node, [node_index.get(nm, -1) for _, nm in existing]
+        )
+        native.scatter_rows(exist_req, [d["reqvec"] for d in exist_rows])
+        native.scatter_rows(el_keys, [d["lab_k"] for d in exist_rows])
+        native.scatter_rows(el_vals, [d["lab_v"] for d in exist_rows])
+        native.scatter_rows(
+            exist_anti.reshape(E, MA * 2), [d["anti"] for d in exist_rows]
+        )
+        native.scatter_rows(
+            exist_pref.reshape(E, MA * 2), [d["pref"] for d in exist_rows]
+        )
+        native.scatter_rows(exist_pref_w, [d["pref_w"] for d in exist_rows])
+        if pdbs:
+            for i, (p, _nm) in enumerate(existing):
                 b = 0
                 for gi, pdb in enumerate(pdbs):
                     if b >= MB:
@@ -989,33 +1018,46 @@ class SnapshotEncoder:
                     if _pdb_matches(pdb, p):
                         exist_pdb[i, b] = gi
                         b += 1
-            exist_group[i] = group_id(d["group"])
-            rv = d["reqvec"]
-            exist_req[i, : rv.shape[0]] = rv
-            for j, (ki, vi) in enumerate(d["labels"]):
-                el_keys[i, j] = ki
-                el_vals[i, j] = vi
-            for j, t in enumerate(d["anti"]):
-                exist_anti[i, j] = t
-            for j, (s, k, w) in enumerate(d["prefaff"]):
-                exist_pref[i, j] = (s, k)
-                exist_pref_w[i, j] = w
-            if ni >= 0:
-                node_requested[ni] += exist_req[i]
-                per_node[ni].append(i)
-                for enc_port in d["ports"]:
-                    used_ports[ni].append(enc_port)
+
+        # per-node aggregation, vectorized: requested sums, the priority-
+        # sorted victim table; used ports stay a sparse residue loop
+        en = exist_node[:e_real]
+        placed_mask = en >= 0
+        np.add.at(
+            node_requested, en[placed_mask], exist_req[:e_real][placed_mask]
+        )
+        for i, d in enumerate(exist_rows):
+            if len(d["ports"]) and exist_node[i] >= 0:
+                used_ports[int(exist_node[i])].extend(
+                    int(x) for x in d["ports"]
+                )
 
         MUP = _pad_dim(max([len(u) for u in used_ports] + [1]), 4)
         node_used_ports = np.full((N, MUP), -1, np.int32)
         for i, u in enumerate(used_ports):
-            node_used_ports[i, : len(u)] = u
+            if u:
+                node_used_ports[i, : len(u)] = u
 
-        MPN = _pad_dim(max([len(x) for x in per_node] + [1]), 8)
-        node_pods = np.full((N, MPN), -1, np.int32)
-        for i, idxs in enumerate(per_node):
-            idxs = sorted(idxs, key=lambda e: (exist_prio[e], -e))
-            node_pods[i, : len(idxs)] = idxs
+        # node_pods [N, MPN]: existing indices per node, ascending priority
+        # (ties: higher index first — same key the per-node sort used)
+        e_ids = np.flatnonzero(placed_mask)
+        if e_ids.size:
+            order_v = np.lexsort(
+                (-e_ids, exist_prio[:e_real][e_ids], en[e_ids])
+            )
+            se = e_ids[order_v].astype(np.int32)
+            sn = en[se]
+            starts = np.r_[True, sn[1:] != sn[:-1]]
+            group_start = np.maximum.accumulate(
+                np.where(starts, np.arange(sn.size), 0)
+            )
+            col = np.arange(sn.size) - group_start
+            MPN = _pad_dim(int(col.max()) + 1, 8)
+            node_pods = np.full((N, MPN), -1, np.int32)
+            node_pods[sn, col] = se
+        else:
+            MPN = _pad_dim(1, 8)
+            node_pods = np.full((N, MPN), -1, np.int32)
 
         # ---- topology domains (flat ids across keys) ----
         K = len(topo_keys)
@@ -1132,14 +1174,15 @@ class SnapshotEncoder:
                 group_existing_count[g] += 1
 
         # Pod ordering rank: priority desc, then creation ts asc, then index.
-        order_key = sorted(
-            range(p_real),
-            key=lambda i: (-pending[i].spec.priority,
-                           pending[i].metadata.creation_timestamp, i),
-        )
         pod_order = np.full(P, np.iinfo(np.int32).max, np.int32)
-        for rank, i in enumerate(order_key):
-            pod_order[i] = rank
+        if p_real:
+            creation = np.array(
+                [d["creation"] for d in pend_rows], np.float64
+            )
+            order_key = np.lexsort(
+                (np.arange(p_real), creation, -pod_prio[:p_real])
+            )
+            pod_order[order_key] = np.arange(p_real, dtype=np.int32)
 
         return ClusterSnapshot(
             resource_names=tuple(rn),

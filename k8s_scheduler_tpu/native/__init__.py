@@ -1,0 +1,78 @@
+"""Native (C++) fast paths for the host runtime.
+
+`_fastassemble` (fastassemble.cc) accelerates snapshot-row assembly — the
+steady-state encode bottleneck once per-object rows are cached. Build it
+with `make -C k8s_scheduler_tpu/native`; every caller falls back to the
+equivalent numpy loops when the extension is absent, and
+`HAVE_FASTASSEMBLE` says which path is active. On import we attempt a
+one-shot build if a compiler is available and the .so is missing (cheap,
+~1s, best-effort)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HAVE_FASTASSEMBLE = False
+scatter_rows = None
+scatter_rows_at = None
+fill_scalars = None
+
+
+def _try_import() -> bool:
+    global HAVE_FASTASSEMBLE, scatter_rows, scatter_rows_at, fill_scalars
+    try:
+        from . import _fastassemble  # type: ignore[attr-defined]
+    except ImportError:
+        return False
+    HAVE_FASTASSEMBLE = True
+    scatter_rows = _fastassemble.scatter_rows
+    scatter_rows_at = _fastassemble.scatter_rows_at
+    fill_scalars = _fastassemble.fill_scalars
+    return True
+
+
+def _try_build() -> None:
+    here = os.path.dirname(__file__)
+    try:
+        subprocess.run(
+            ["make", "-s", f"PY={sys.executable}"],
+            cwd=here,
+            timeout=120,
+            check=True,
+            capture_output=True,
+        )
+    except Exception:
+        pass  # no toolchain / read-only checkout: numpy fallback
+
+
+def _py_scatter_rows(dst, rows):
+    w = dst.shape[1]
+    for i, r in enumerate(rows):
+        if r is None:
+            continue
+        n = min(len(r), w)
+        dst[i, :n] = r[:n]
+
+
+def _py_scatter_rows_at(dst, index, rows):
+    w = dst.shape[1]
+    for i, r in enumerate(rows):
+        if r is None:
+            continue
+        n = min(len(r), w)
+        dst[index[i], :n] = r[:n]
+
+
+def _py_fill_scalars(dst, values):
+    n = min(len(values), dst.shape[0])
+    dst[:n] = values[:n]
+
+
+if not _try_import():
+    _try_build()
+    if not _try_import():
+        scatter_rows = _py_scatter_rows
+        scatter_rows_at = _py_scatter_rows_at
+        fill_scalars = _py_fill_scalars

@@ -1,0 +1,198 @@
+// Native snapshot-row assembly (SURVEY.md §2 C-notes: "C++ encoder if
+// Python encoding becomes the bottleneck" — it did: the per-pod Python
+// array writes dominate steady-state re-encode).
+//
+// One exported function per access pattern, CPython C API + the buffer
+// protocol only (no pybind11 in this image):
+//
+//   scatter_rows(dst, rows, width)
+//       dst: 2-D C-contiguous numpy array [R, W_dst]
+//       rows: list of 1-D arrays (same dtype), row i copied into
+//             dst[i, :len(rows[i])]; rows beyond width are truncated.
+//   scatter_rows_at(dst, index, rows)
+//       like scatter_rows but row i goes to dst[index[i], :].
+//
+// The Python encoder falls back to per-row numpy writes when this
+// module isn't built (k8s_scheduler_tpu/native/__init__.py), so the
+// extension is an accelerator, not a dependency. Build: `make -C
+// k8s_scheduler_tpu/native` (or setup.py build_ext --inplace).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+
+namespace {
+
+struct View {
+  Py_buffer buf{};
+  bool ok = false;
+  ~View() {
+    if (ok) PyBuffer_Release(&buf);
+  }
+  bool acquire(PyObject* obj, int flags) {
+    if (PyObject_GetBuffer(obj, &buf, flags) != 0) return false;
+    ok = true;
+    return true;
+  }
+};
+
+// dst[i or index[i], :len(row_i)] = row_i for every row in `rows`.
+PyObject* scatter_impl(PyObject* dst_obj, PyObject* index_obj,
+                       PyObject* rows_obj) {
+  View dst;
+  if (!dst.acquire(dst_obj, PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE)) {
+    return nullptr;
+  }
+  if (dst.buf.ndim != 2) {
+    PyErr_SetString(PyExc_ValueError, "dst must be 2-D");
+    return nullptr;
+  }
+  const Py_ssize_t n_rows = dst.buf.shape[0];
+  const Py_ssize_t width_bytes = dst.buf.shape[1] * dst.buf.itemsize;
+  char* base = static_cast<char*>(dst.buf.buf);
+
+  View index;
+  const long* idx = nullptr;
+  Py_ssize_t n_idx = 0;
+  if (index_obj != nullptr && index_obj != Py_None) {
+    if (!index.acquire(index_obj, PyBUF_C_CONTIGUOUS)) return nullptr;
+    if (index.buf.ndim != 1 || index.buf.itemsize != sizeof(long)) {
+      PyErr_SetString(PyExc_ValueError, "index must be 1-D int64");
+      return nullptr;
+    }
+    idx = static_cast<const long*>(index.buf.buf);
+    n_idx = index.buf.shape[0];
+  }
+
+  PyObject* seq = PySequence_Fast(rows_obj, "rows must be a sequence");
+  if (seq == nullptr) return nullptr;
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (idx != nullptr && n > n_idx) {
+    Py_DECREF(seq);
+    PyErr_SetString(PyExc_ValueError, "index shorter than rows");
+    return nullptr;
+  }
+
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* row = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
+    if (row == Py_None) continue;
+    View rv;
+    if (!rv.acquire(row, PyBUF_C_CONTIGUOUS)) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    if (rv.buf.itemsize != dst.buf.itemsize) {
+      Py_DECREF(seq);
+      PyErr_Format(PyExc_ValueError,
+                   "row %zd itemsize %zd != dst itemsize %zd", i,
+                   rv.buf.itemsize, dst.buf.itemsize);
+      return nullptr;
+    }
+    const Py_ssize_t target = idx ? idx[i] : i;
+    if (target < 0 || target >= n_rows) {
+      Py_DECREF(seq);
+      PyErr_Format(PyExc_IndexError, "row %zd target %zd out of range", i,
+                   target);
+      return nullptr;
+    }
+    Py_ssize_t bytes = rv.buf.len;
+    if (bytes > width_bytes) bytes = width_bytes;  // truncate to dst width
+    std::memcpy(base + target * width_bytes, rv.buf.buf,
+                static_cast<size_t>(bytes));
+  }
+  Py_DECREF(seq);
+  Py_RETURN_NONE;
+}
+
+PyObject* scatter_rows(PyObject*, PyObject* args) {
+  PyObject* dst;
+  PyObject* rows;
+  if (!PyArg_ParseTuple(args, "OO", &dst, &rows)) return nullptr;
+  return scatter_impl(dst, nullptr, rows);
+}
+
+PyObject* scatter_rows_at(PyObject*, PyObject* args) {
+  PyObject* dst;
+  PyObject* index;
+  PyObject* rows;
+  if (!PyArg_ParseTuple(args, "OOO", &dst, &index, &rows)) return nullptr;
+  return scatter_impl(dst, index, rows);
+}
+
+// fill_scalars(dst_1d, values_list): dst[i] = values[i] for int32/float32
+// destinations, accepting Python ints/floats — one C call replaces a
+// Python loop of P scalar __setitem__ dispatches.
+PyObject* fill_scalars(PyObject*, PyObject* args) {
+  PyObject* dst_obj;
+  PyObject* vals_obj;
+  if (!PyArg_ParseTuple(args, "OO", &dst_obj, &vals_obj)) return nullptr;
+  View dst;
+  if (!dst.acquire(dst_obj,
+                   PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE | PyBUF_FORMAT)) {
+    return nullptr;
+  }
+  if (dst.buf.ndim != 1) {
+    PyErr_SetString(PyExc_ValueError, "dst must be 1-D");
+    return nullptr;
+  }
+  PyObject* seq = PySequence_Fast(vals_obj, "values must be a sequence");
+  if (seq == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  if (n > dst.buf.shape[0]) n = dst.buf.shape[0];
+  const Py_ssize_t isz = dst.buf.itemsize;
+  char* base = static_cast<char*>(dst.buf.buf);
+  const char kind = dst.buf.format ? dst.buf.format[0] : 'i';
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* v = PySequence_Fast_GET_ITEM(seq, i);
+    if (kind == 'f' && isz == 4) {
+      const double d = PyFloat_AsDouble(v);
+      if (d == -1.0 && PyErr_Occurred()) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      reinterpret_cast<float*>(base)[i] = static_cast<float>(d);
+    } else if (isz == 4) {
+      const long x = PyLong_AsLong(v);
+      if (x == -1 && PyErr_Occurred()) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      reinterpret_cast<int*>(base)[i] = static_cast<int>(x);
+    } else if (isz == 1) {
+      const int t = PyObject_IsTrue(v);
+      if (t < 0) {
+        Py_DECREF(seq);
+        return nullptr;
+      }
+      base[i] = static_cast<char>(t);
+    } else {
+      Py_DECREF(seq);
+      PyErr_SetString(PyExc_ValueError, "unsupported dst dtype");
+      return nullptr;
+    }
+  }
+  Py_DECREF(seq);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef methods[] = {
+    {"scatter_rows", scatter_rows, METH_VARARGS,
+     "scatter_rows(dst2d, rows): dst[i, :len(rows[i])] = rows[i]"},
+    {"scatter_rows_at", scatter_rows_at, METH_VARARGS,
+     "scatter_rows_at(dst2d, index_i64, rows): dst[index[i], :] = rows[i]"},
+    {"fill_scalars", fill_scalars, METH_VARARGS,
+     "fill_scalars(dst1d, values): dst[i] = values[i]"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_fastassemble",
+    "native snapshot-row assembly (see fastassemble.cc)", -1, methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__fastassemble(void) {
+  return PyModule_Create(&module);
+}

@@ -11,6 +11,7 @@ gather — O(Tl*Ts*slots) + O(P*N) gather instead of O(P*N*taints*tols).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..models import encoding as enc
@@ -63,8 +64,6 @@ def _pair_lookup(table, row_ids, col_ids) -> jnp.ndarray:
     arbitrary-index gather (a single such gather costs ~0.4s at 10k x 5k
     on TPU — scalar access pattern). Two one-hot matmuls ride the MXU
     instead: [P, A] @ [A, B] -> [P, B] @ [B, N]."""
-    import jax
-
     A, B = table.shape
     oh_rows = jax.nn.one_hot(row_ids, A, dtype=jnp.float32)  # [P, A]
     rows = oh_rows @ table.astype(jnp.float32)  # [P, B]
