@@ -52,6 +52,10 @@ class CycleResult:
     # (static + dynamic attribution summed; columns = Framework.filter_names)
     # — feeds FailedScheduling events and requeue queueing hints
     rounds_used: jnp.ndarray  # i32 [] commit rounds consumed (0 in scan mode)
+    accepted_per_round: jnp.ndarray  # i32 [max_rounds] acceptance counts
+    # per commit round (zeros in scan mode) — convergence diagnostics
+    diag_per_round: jnp.ndarray  # i32 [max_rounds, 3] (live claims,
+    # capacity rejections, guard rejections) per round, summed over passes
 
 
 def sampling_mask(snap: ClusterSnapshot, pct: int) -> jnp.ndarray:
@@ -174,6 +178,7 @@ def build_cycle_fn(
                 update_batched_view_fn=update_batched_view_fn,
                 extra=extra,
                 max_rounds=max_rounds,
+                score_anchor_fn=lambda nr: fw.score_anchor(ctx, nr),
             )
             # dynamic reject attribution vs the FINAL state, for the pods
             # that never placed (same column convention as fw.static)
@@ -187,6 +192,8 @@ def build_cycle_fn(
                 ),
             )
             rounds_used = rres.rounds_used
+            accepted_per_round = rres.accepted_per_round
+            diag_per_round = rres.diag_per_round
         else:
             def dyn_fn(p, node_req, ext, static_row):
                 return fw.dyn(ctx, p, node_req, ext, static_row)
@@ -195,6 +202,8 @@ def build_cycle_fn(
                 return fw.extra_update(ctx, ext, p, node, ok)
 
             rounds_used = jnp.int32(0)
+            accepted_per_round = jnp.zeros((max_rounds,), jnp.int32)
+            diag_per_round = jnp.zeros((max_rounds, 3), jnp.int32)
             order = jnp.argsort(snap.pod_order)
             result = commit_ops.greedy_commit(
                 order=order,
@@ -246,7 +255,8 @@ def build_cycle_fn(
 
         return CycleResult(
             result.assignment, result.node_requested, unsched, dropped, gate,
-            srejects + result.dyn_aux, rounds_used,
+            srejects + result.dyn_aux, rounds_used, accepted_per_round,
+            diag_per_round,
         )
 
     return cycle

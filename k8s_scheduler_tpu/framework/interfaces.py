@@ -144,6 +144,18 @@ class PluginBase:
         into this plugin's extra state."""
         return extra
 
+    def score_node_anchor(self, ctx: CycleContext,
+                          node_requested) -> jnp.ndarray | None:
+        """Node-local component of this plugin's dynamic score at the
+        given node_requested (f32 [N]), or None if the score has no such
+        component. The rounds engine adds (anchor(now) - anchor(round
+        start)) to stale claim scores between acceptance passes so a node
+        that fills up loses attractiveness immediately — the batched
+        analogue of sequential scheduling's per-pod score freshness. Used
+        ONLY for claim ordering; masks and reported scores are
+        unaffected."""
+        return None
+
     # --- PostFilter (preemption): runs after the commit scan over the
     # pods that found no node; returns a PreemptionResult or None.
     # `excluded` [P] marks pods that must not preempt (gang-dropped) ---

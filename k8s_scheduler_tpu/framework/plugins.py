@@ -137,15 +137,17 @@ class NodeResourcesFit(PluginBase):
             snap.pod_requested[p], snap.node_allocatable, node_requested
         )
 
-    def dyn_score(self, ctx: CycleContext, p, node_requested, extra, feasible):
-        snap = ctx.snap
+    def _strategy_fn(self):
         strategy = self.args.get("scoring_strategy", "LeastAllocated")
-        fn = (
+        return (
             res_ops.most_requested_score
             if strategy == "MostAllocated"
             else res_ops.least_requested_score
         )
-        return fn(
+
+    def dyn_score(self, ctx: CycleContext, p, node_requested, extra, feasible):
+        snap = ctx.snap
+        return self._strategy_fn()(
             snap.pod_requested[p],
             snap.node_allocatable,
             node_requested,
@@ -162,14 +164,17 @@ class NodeResourcesFit(PluginBase):
     def dyn_score_batched(self, ctx: CycleContext, node_requested, extra,
                           feasible, shared):
         snap = ctx.snap
-        strategy = self.args.get("scoring_strategy", "LeastAllocated")
-        fn = (
-            res_ops.most_requested_score
-            if strategy == "MostAllocated"
-            else res_ops.least_requested_score
-        )
-        return fn(
+        return self._strategy_fn()(
             snap.pod_requested[:, None, :],
+            snap.node_allocatable,
+            node_requested,
+            _score_resource_weights(snap, self.args),
+        )
+
+    def score_node_anchor(self, ctx: CycleContext, node_requested):
+        snap = ctx.snap
+        return self._strategy_fn()(
+            jnp.zeros_like(snap.node_allocatable[:1, :1]),  # zero pod
             snap.node_allocatable,
             node_requested,
             _score_resource_weights(snap, self.args),
@@ -192,6 +197,14 @@ class NodeResourcesBalancedAllocation(PluginBase):
         return res_ops.balanced_allocation_score(
             snap.pod_requested[:, None, :], snap.node_allocatable,
             node_requested, _score_resource_weights(snap, self.args),
+        )
+
+    def score_node_anchor(self, ctx: CycleContext, node_requested):
+        snap = ctx.snap
+        return res_ops.balanced_allocation_score(
+            jnp.zeros_like(snap.node_allocatable[:1, :1]),
+            snap.node_allocatable, node_requested,
+            _score_resource_weights(snap, self.args),
         )
 
 

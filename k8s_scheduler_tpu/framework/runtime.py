@@ -219,6 +219,17 @@ class Framework:
                 mask = mask & m
         return jnp.stack(cols, axis=1)
 
+    def score_anchor(self, ctx: CycleContext, node_requested):
+        """Weighted sum of the enabled score plugins' node-local capacity
+        components (f32 [N]), or None when no plugin has one. See
+        PluginBase.score_node_anchor."""
+        total = None
+        for s, w in self.scores:
+            a = s.score_node_anchor(ctx, node_requested)
+            if a is not None:
+                total = w * a if total is None else total + w * a
+        return total
+
     def extra_update_batched(self, ctx: CycleContext, extra, accepted,
                              node_of):
         out = dict(extra)

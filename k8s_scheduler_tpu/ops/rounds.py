@@ -14,8 +14,19 @@ the MXU/VPU at full width:
                (nominated node first, then argmax with a deterministic
                hash tie-break — the analogue of upstream selectHost's
                random tie-break, which also prevents herding).
-  2. ACCEPT  — claims are resolved in `pod_order` rank without any
-               sequential host loop:
+  2. ACCEPT  — a number of cheap acceptance PASSES (waterfall): in each
+               pass, every still-unaccepted pod claims its best node among
+               choices not yet known-dead, with the capacity-sensitive
+               node-local score component RE-ANCHORED to the in-round
+               node_req (a filling node loses attractiveness immediately —
+               the spread mechanism sequential scheduling gets from score
+               freshness); capacity losers fall to their next-best node in
+               the next pass, reusing the round's masks (no dyn
+               recompute). At round end, ONE guard sweep checks all
+               capacity-accepted claims for mutual consistency and revokes
+               violators (they retry next round against refreshed masks).
+               Within a pass, claims resolve in `pod_order` rank without
+               any sequential host loop:
                a. per-node capacity: sort claims by (node, rank), then a
                   segmented exclusive prefix-sum of requests admits each
                   claimant iff it still fits (earlier-rank claimants of
@@ -74,7 +85,13 @@ from . import interpod as interpod_ops
 NEG_INF = -1e9
 _REL_EPS = 1e-5  # mirrors ops/resources.py fit slack
 MS_MATCH = 4  # guard-active selectors tracked per pod (overflow = defer)
-TIE_EPS = 1e-3
+# Claim scores are rounded to INTEGERS before the hash tie-break — the
+# upstream scheduler's own granularity (plugin Score returns int64 in
+# [0, 100]; selectHost random-tie-breaks across the whole max class).
+# Keeping f32 score sums un-rounded created artificial total orders that
+# herded every pod's claim onto the same argmax node; integer classes let
+# the per-pod hash spread contending claims across all equally-good nodes.
+TIE_EPS = 0.9375  # hash spread, strictly below the integer quantum
 _PR1 = jnp.uint32(2654435761)
 _PR2 = jnp.uint32(40503)
 _BIG = jnp.int32(2**31 - 1)
@@ -95,6 +112,10 @@ class RoundsResult:
     node_requested: jnp.ndarray  # f32 [N, R] post-commit
     extra: Any  # final plugin state
     rounds_used: jnp.ndarray  # i32 []
+    accepted_per_round: jnp.ndarray  # i32 [max_rounds] acceptance counts
+    diag_per_round: jnp.ndarray  # i32 [max_rounds, 3] summed over passes:
+    # (live claims, capacity rejections, guard rejections) — convergence
+    # diagnostics, negligible cost
     final_mask: jnp.ndarray  # bool [P, N] dyn&static mask vs FINAL state
     final_per_filter: Any  # list of [P,N] masks (None for maskless), final
 
@@ -185,6 +206,10 @@ def rounds_commit(
     extra: Any,
     max_rounds: int = 64,
     compact: int = 8,
+    passes: int = 8,
+    passes_round0: int = 16,
+    score_anchor_fn: Callable | None = None,  # node_requested -> f32 [N]
+    # capacity-sensitive node-local score component (Framework.score_anchor)
 ) -> RoundsResult:
     P, N = static_mask.shape
     S = m_pending.shape[0]
@@ -221,7 +246,10 @@ def rounds_commit(
     slack = _REL_EPS * snap.node_allocatable + _REL_EPS  # [N, R]
 
     def guards_ok(vsnap, vrank, vsels, choice, live, ext_state):
-        """Participant-table sweep; ok bool [B] for live claims."""
+        """Participant-table sweep over the round's accepted claims;
+        ok bool [B]. Within a (selector/port, domain/node) group, entries
+        resolve in rank order — the same outcome a sequential pass over
+        the claims would produce."""
         B = vrank.shape[0]
         state = _owner_state(ext_state) if has_guards else None
         if state is None and not has_port_guards:
@@ -335,9 +363,21 @@ def rounds_commit(
         )
         return ok_pod > 0
 
-    def one_round(gid, act_v, node_req, ext):
-        """One claim/accept/update round over the pods in `gid` (global
-        ids; `act_v` marks which rows are genuinely active)."""
+    def one_round(gid, act_v, node_req, ext, passes: int):
+        """One round over the pods in `gid` (global ids; `act_v` marks
+        which rows are genuinely active).
+
+        The round computes plugin masks/scores ONCE, then runs `passes`
+        CAPACITY-ONLY acceptance passes: in each pass every
+        still-unaccepted pod claims its best node (score re-anchored to
+        the in-round node_req) among choices not yet known-dead, claims
+        resolve by a (node, rank) capacity prefix, and losers that no
+        longer fit the node alone mark the choice dead and fall to their
+        next-best node next pass — without waiting a full dyn recompute.
+        ONE guard sweep at round end checks every capacity-accepted claim
+        for mutual consistency (original ranks decide within a group) and
+        REVOKES violators, who retry next round against refreshed
+        masks."""
         B = gid.shape[0]
         vsnap = _pod_view(snap, gid)
         vmp = m_pending[:, gid]
@@ -351,81 +391,167 @@ def rounds_commit(
             vsnap, vmp, node_req, ext, vsmask
         )
         mask = mask & vsmask & act_v[:, None]
-        eff = jnp.where(mask, vsscore + score + _tie_break(gid, N), NEG_INF)
+        base = vsscore + score  # un-rounded; claim ranking re-rounds with
+        # the per-pass anchor delta applied (see score_node_anchor)
+        tie = _tie_break(gid, N)
+        anchor0 = (
+            score_anchor_fn(node_req) if score_anchor_fn is not None else None
+        )
         pid = jnp.arange(B, dtype=jnp.int32)
-        nom = jnp.clip(vsnap.pod_nominated, 0, N - 1)
-        nom_ok = (vsnap.pod_nominated >= 0) & mask[pid, nom]
-        best = jnp.where(nom_ok, nom, jnp.argmax(eff, axis=1)).astype(
-            jnp.int32
-        )
-        has = mask[pid, best] & act_v & vsnap.pod_valid
-
-        # overflow claimants deferred while any normal claim exists; when
-        # only overflow claims remain, exactly one (lowest rank) runs alone
-        normal = has & ~vovf
-        any_normal = jnp.any(normal)
-        ovf_rank = jnp.min(jnp.where(has & vovf, vrank, _BIG))
-        ovf_pick = has & vovf & (vrank == ovf_rank) & ~any_normal
-        live = normal | ovf_pick
-
-        # ---- capacity acceptance (sorted segmented prefix) ----
-        sort_key = jnp.where(live, best * P + vrank, _BIG)
-        order = jnp.argsort(sort_key)
-        s_node = jnp.where(live, best, N)[order]
-        s_req = jnp.where(live[:, None], vsnap.pod_requested, 0.0)[order]
-        s_live = live[order]
-        cum = jnp.cumsum(s_req, axis=0)
-        before = cum - s_req
         i = jnp.arange(B, dtype=jnp.int32)
-        seg_start = jnp.concatenate(
-            [jnp.ones((1,), bool), s_node[1:] != s_node[:-1]]
+
+        acc = jnp.zeros((B,), bool)
+        acc_node = jnp.full((B,), -1, jnp.int32)
+        dead = jnp.zeros((B, N), bool)
+        diag = jnp.zeros((3,), jnp.int32)
+        for t in range(passes):
+            avail = mask & ~dead & ~acc[:, None]
+            if anchor0 is not None and t > 0:
+                # nodes that filled this round lose attractiveness NOW —
+                # the spread mechanism that sequential scheduling gets
+                # from per-pod score freshness
+                delta = score_anchor_fn(node_req) - anchor0  # [N]
+                scored = jnp.round(base + delta[None, :]) + tie
+            else:
+                scored = jnp.round(base) + tie
+            eff_t = jnp.where(avail, scored, NEG_INF)
+            nom = jnp.clip(vsnap.pod_nominated, 0, N - 1)
+            nom_ok = (vsnap.pod_nominated >= 0) & avail[pid, nom]
+            best = jnp.where(nom_ok, nom, jnp.argmax(eff_t, axis=1)).astype(
+                jnp.int32
+            )
+            has = avail[pid, best] & act_v & vsnap.pod_valid & ~acc
+
+            # Overflow claimants (matching more guard-active selectors than
+            # the MS_MATCH table tracks) are invisible to other claims'
+            # guard checks, so one may only be accepted in a round that
+            # accepts NOTHING else: the final pass goes overflow-exclusive
+            # (lowest rank, alone) iff the round is still empty-handed.
+            normal = has & ~vovf
+            if t == passes - 1:
+                allow_ovf = ~jnp.any(acc) & ~jnp.any(normal)
+                ovf_rank = jnp.min(jnp.where(has & vovf, vrank, _BIG))
+                ovf_pick = has & vovf & (vrank == ovf_rank) & allow_ovf
+            else:
+                ovf_pick = jnp.zeros_like(normal)
+            live = normal | ovf_pick
+
+            # ---- capacity (sorted segmented prefix vs in-round state) ----
+            # Passes accept on capacity ONLY; the guard sweep runs once at
+            # round end over all capacity-accepted claims and revokes
+            # violators (see below) — guards are ~5% of rejections but the
+            # table sort is the dominant per-pass cost, so it must not run
+            # per pass.
+            sort_key = jnp.where(live, best * P + vrank, _BIG)
+            order = jnp.argsort(sort_key)
+            s_node = jnp.where(live, best, N)[order]
+            s_req = jnp.where(live[:, None], vsnap.pod_requested, 0.0)[order]
+            s_live = live[order]
+            cum = jnp.cumsum(s_req, axis=0)
+            before = cum - s_req
+            seg_start = jnp.concatenate(
+                [jnp.ones((1,), bool), s_node[1:] != s_node[:-1]]
+            )
+            seg_first = jax.lax.cummax(jnp.where(seg_start, i, -1))
+            seg_before = before - before[seg_first]
+            nsafe = jnp.clip(s_node, 0, N - 1)
+            free = (
+                snap.node_allocatable[nsafe] - node_req[nsafe] + slack[nsafe]
+            )
+            fits = jnp.all(seg_before + s_req <= free, axis=1) & s_live
+            accepted_t = jnp.zeros((B,), bool).at[order].set(fits)
+
+            node_of_t = jnp.where(accepted_t, best, 0)
+            req_add = jnp.where(accepted_t[:, None], vsnap.pod_requested, 0.0)
+            node_req = node_req.at[node_of_t].add(req_add)
+            acc = acc | accepted_t
+            acc_node = jnp.where(accepted_t, best, acc_node)
+            # A capacity loser keeps the node alive if it still fits ALONE
+            # in the node's post-pass free space: the segmented prefix
+            # charges REJECTED earlier-rank claims too (a huge non-fitting
+            # claim shadows smaller ones behind it), so such losers retry
+            # next pass once the contenders have settled elsewhere.
+            bsafe = jnp.clip(best, 0, N - 1)
+            fits_alone = jnp.all(
+                vsnap.pod_requested
+                <= snap.node_allocatable[bsafe] - node_req[bsafe]
+                + slack[bsafe],
+                axis=1,
+            )
+            dead = dead.at[pid, best].max(
+                live & ~accepted_t & ~fits_alone
+            )
+            diag = diag + jnp.stack([
+                jnp.sum(live, dtype=jnp.int32),
+                jnp.sum(live & ~accepted_t, dtype=jnp.int32),
+                jnp.zeros((), jnp.int32),
+            ])
+
+        # ---- round-end guard sweep over ALL capacity-accepted claims ----
+        # Revoking a violator leaves node_req slightly over-charged for
+        # claims accepted after it this round — those stay valid (the node
+        # is merely LESS full than they assumed). Revoked pods retry next
+        # round; persistent violations (anti slot held by the winner) are
+        # then excluded by the refreshed dyn masks.
+        g_ok = guards_ok(vsnap, vrank, vsels, acc_node, acc, ext)
+        revoked = acc & ~g_ok
+        node_req = node_req.at[jnp.where(revoked, acc_node, 0)].add(
+            jnp.where(revoked[:, None], -vsnap.pod_requested, 0.0)
         )
-        seg_first = jax.lax.cummax(jnp.where(seg_start, i, -1))
-        seg_before = before - before[seg_first]
-        nsafe = jnp.clip(s_node, 0, N - 1)
-        free = snap.node_allocatable[nsafe] - node_req[nsafe] + slack[nsafe]
-        fits = jnp.all(seg_before + s_req <= free, axis=1) & s_live
-        cap_ok = jnp.zeros((B,), bool).at[order].set(fits)
+        acc = acc & g_ok
+        acc_node = jnp.where(acc, acc_node, -1)
+        diag = diag + jnp.stack([
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.int32),
+            jnp.sum(revoked, dtype=jnp.int32),
+        ])
 
-        g_ok = guards_ok(vsnap, vrank, vsels, best, live, ext)
-        accepted = live & cap_ok & g_ok
-
-        node_of = jnp.where(accepted, best, 0)
-        req_add = jnp.where(accepted[:, None], vsnap.pod_requested, 0.0)
-        node_req = node_req.at[node_of].add(req_add)
-        ext = update_batched_view_fn(vsnap, vmp, ext, accepted, node_of)
-        return accepted, jnp.where(accepted, best, -1), node_req, ext
+        ext = update_batched_view_fn(
+            vsnap, vmp, ext, acc, jnp.where(acc, acc_node, 0)
+        )
+        return acc, acc_node, node_req, ext, diag
 
     # ---- round 1: full pending set ----
     gid0 = jnp.arange(P, dtype=jnp.int32)
-    acc0, node0, node_req, extra = one_round(
-        gid0, snap.pod_valid, snap.node_requested, extra
+    acc0, node0, node_req, extra, diag0 = one_round(
+        gid0, snap.pod_valid, snap.node_requested, extra, passes_round0
     )
     placed = jnp.where(acc0, node0, -1)
     active = snap.pod_valid & ~acc0
+    acc_hist = jnp.zeros((max_rounds,), jnp.int32).at[0].set(
+        jnp.sum(acc0, dtype=jnp.int32)
+    )
+    diag_hist = jnp.zeros((max_rounds, 3), jnp.int32).at[0].set(diag0)
 
     # ---- rounds 2+: compacted to the lowest-rank actives ----
     B = min(P, max(256, -(-P // compact) // 128 * 128))
 
     def body(carry):
-        node_req, ext, placed, active, rnd, _ = carry
+        node_req, ext, placed, active, rnd, _, hist, dhist = carry
         key = jnp.where(active, rank_g, _BIG)
         gid = jnp.argsort(key)[:B].astype(jnp.int32)
         act_v = active[gid]
-        accepted, node_of, node_req, ext = one_round(
-            gid, act_v, node_req, ext
+        accepted, node_of, node_req, ext, diag = one_round(
+            gid, act_v, node_req, ext, passes
         )
         placed = placed.at[gid].set(jnp.where(accepted, node_of, placed[gid]))
         active = active.at[gid].set(act_v & ~accepted)
-        return (node_req, ext, placed, active, rnd + 1, jnp.any(accepted))
+        n_acc = jnp.sum(accepted, dtype=jnp.int32)
+        hist = hist.at[jnp.minimum(rnd, max_rounds - 1)].set(n_acc)
+        dhist = dhist.at[jnp.minimum(rnd, max_rounds - 1)].set(diag)
+        return (node_req, ext, placed, active, rnd + 1, n_acc > 0, hist,
+                dhist)
 
     def cond(carry):
-        _, _, _, active, rnd, progressed = carry
+        _, _, _, active, rnd, progressed, _, _ = carry
         return progressed & jnp.any(active) & (rnd < max_rounds)
 
-    node_req, extra, placed, active, rounds_used, _ = jax.lax.while_loop(
-        cond, body,
-        (node_req, extra, placed, active, jnp.int32(1), jnp.any(acc0)),
+    node_req, extra, placed, active, rounds_used, _, acc_hist, diag_hist = (
+        jax.lax.while_loop(
+            cond, body,
+            (node_req, extra, placed, active, jnp.int32(1), jnp.any(acc0),
+             acc_hist, diag_hist),
+        )
     )
 
     # final-state masks for reject attribution of leftover pods
@@ -437,6 +563,8 @@ def rounds_commit(
         node_requested=node_req,
         extra=extra,
         rounds_used=rounds_used,
+        accepted_per_round=acc_hist,
+        diag_per_round=diag_hist,
         final_mask=fmask & static_mask,
         final_per_filter=per_filter,
     )

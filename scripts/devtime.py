@@ -1,0 +1,46 @@
+"""True device-time measurement through the tunnel: dispatch the same
+jitted program `reps` times back-to-back (async queue pipelines them on
+device), force once at the end; slope = device time per call, intercept =
+the fixed round-trip. Reports (total - roundtrip)/reps.
+
+Usage as a library:  from scripts.devtime import devtime
+"""
+
+import time
+
+import numpy as np
+
+
+def _force(out):
+    leaf = None
+    import jax
+
+    for x in jax.tree_util.tree_leaves(out):
+        leaf = x
+    if leaf is not None:
+        np.asarray(leaf if leaf.ndim == 0 else leaf.ravel()[:1])
+
+
+def devtime(fn, *args, reps=8, warmup=True):
+    """Seconds of device time per call (dispatch-overhead amortized)."""
+    if warmup:
+        _force(fn(*args))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = fn(*args)
+    _force(out)
+    total = time.perf_counter() - t0
+    # fixed round-trip measured with a single dispatch of the same fn
+    t0 = time.perf_counter()
+    _force(fn(*args))
+    single = time.perf_counter() - t0
+    # single = rt + dev; total = rt + reps*dev  (if queue pipelines)
+    dev = (total - single) / max(reps - 1, 1)
+    return dev
+
+
+def report(label, fn, *args, reps=8):
+    d = devtime(fn, *args, reps=reps)
+    print(f"{label:44s} {d*1e3:9.2f} ms/call (device)", flush=True)
+    return d

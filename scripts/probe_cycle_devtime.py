@@ -1,0 +1,48 @@
+"""Devtime of the current cycle at a config, with the snapshot staged on
+device (isolates H2D from compute).
+
+Run:  python scripts/probe_cycle_devtime.py [cfg]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
+from devtime import report
+from k8s_scheduler_tpu.core import build_cycle_fn, build_preemption_fn
+from k8s_scheduler_tpu.models import SnapshotEncoder
+
+
+def main():
+    cfg = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    P_real, N_real = CONFIG_SHAPES[cfg]
+    enc = SnapshotEncoder(pad_pods=_pad(P_real), pad_nodes=_pad(N_real))
+    bn, be = make_config_base(cfg)
+    _n, pods, _e, groups = make_config_workload(cfg, seed=1000)
+    snap = enc.encode(bn, pods, be, groups)
+    dsnap = jax.device_put(snap)
+    jax.block_until_ready(jax.tree_util.tree_leaves(dsnap)[0])
+
+    cycle = build_cycle_fn(commit_mode="rounds")
+    t0 = time.perf_counter()
+    out = cycle(dsnap)
+    np.asarray(out.assignment)
+    print(f"compile+first: {time.perf_counter()-t0:.1f}s", flush=True)
+    print("rounds:", int(np.asarray(out.rounds_used)),
+          "unsched:", int(np.asarray(out.unschedulable).sum()), flush=True)
+
+    report("cycle (device-staged snap)", cycle, dsnap)
+    report("cycle (numpy snap, H2D per call)", cycle, snap)
+
+    pre = build_preemption_fn()
+    if pre is not None and cfg == 4:
+        report("preemption pass", pre, dsnap, out)
+
+
+if __name__ == "__main__":
+    main()

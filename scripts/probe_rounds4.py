@@ -1,0 +1,52 @@
+"""Print the rounds-engine acceptance history at a given config.
+
+Run:  python scripts/probe_rounds4.py [cfg]   (add CPU=1 for cpu backend)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+if os.environ.get("CPU") == "1":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
+from k8s_scheduler_tpu.core import build_cycle_fn, build_preemption_fn
+from k8s_scheduler_tpu.models import SnapshotEncoder
+
+
+def main():
+    cfg = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    P_real, N_real = CONFIG_SHAPES[cfg]
+    enc = SnapshotEncoder(pad_pods=_pad(P_real), pad_nodes=_pad(N_real))
+    base_nodes, base_existing = make_config_base(cfg)
+    _n, pods, _e, groups = make_config_workload(cfg, seed=1000)
+    snap = enc.encode(base_nodes, pods, base_existing, groups)
+
+    cycle = build_cycle_fn(commit_mode="rounds")
+    out = cycle(snap)
+    np.asarray(out.assignment)
+    t0 = time.perf_counter()
+    out = cycle(snap)
+    np.asarray(out.assignment)
+    print(f"cycle: {(time.perf_counter()-t0)*1e3:.1f} ms")
+    hist = np.asarray(out.accepted_per_round)
+    used = int(np.asarray(out.rounds_used))
+    print("rounds_used:", used)
+    print("accepted_per_round:", hist[:used].tolist())
+    print("unschedulable:", int(np.asarray(out.unschedulable).sum()),
+          "gang_dropped:", int(np.asarray(out.gang_dropped).sum()))
+    diag = np.asarray(out.diag_per_round)[:used]
+    print("per-round (live, cap_rej, guard_rej):")
+    for r in range(used):
+        print(f"  r{r}: {diag[r].tolist()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Ablation profile of the config-#4 cycle: where do the milliseconds go?
+
+Times (forced-sync, best of 3) each stage of the production program in
+isolation on the real device:
+  - encode (host)
+  - full cycle (rounds engine)
+  - cycle with max_rounds=1 (round-1 only)
+  - static masks/scores only
+  - dyn_batched over the full [P, N] once
+  - final attribution pass proxy (same dyn_batched)
+  - preemption pass
+Run:  python scripts/profile_cycle4.py [cfg]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
+from k8s_scheduler_tpu.core import build_cycle_fn, build_preemption_fn
+from k8s_scheduler_tpu.core.cycle import sampling_mask
+from k8s_scheduler_tpu.framework.interfaces import CycleContext
+from k8s_scheduler_tpu.framework.runtime import Framework
+from k8s_scheduler_tpu.models import SnapshotEncoder
+
+
+def timed(label, fn, *args, n=3):
+    outs = None
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        outs = fn(*args)
+        jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if hasattr(x, "shape") else x, outs
+        )
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label:40s} {best*1e3:9.1f} ms")
+    return outs
+
+
+def main():
+    cfg = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    P_real, N_real = CONFIG_SHAPES[cfg]
+    enc = SnapshotEncoder(pad_pods=_pad(P_real), pad_nodes=_pad(N_real))
+    base_nodes, base_existing = make_config_base(cfg)
+    _n, pods, _e, groups = make_config_workload(cfg, seed=1000)
+
+    t0 = time.perf_counter()
+    snap = enc.encode(base_nodes, pods, base_existing, groups)
+    print(f"{'encode (cold)':40s} {(time.perf_counter()-t0)*1e3:9.1f} ms")
+    t0 = time.perf_counter()
+    snap = enc.encode(base_nodes, pods, base_existing, groups)
+    print(f"{'encode (warm rows)':40s} {(time.perf_counter()-t0)*1e3:9.1f} ms")
+
+    fw = Framework.from_config()
+
+    cycle = build_cycle_fn(commit_mode="rounds")
+    t0 = time.perf_counter()
+    out = cycle(snap)
+    np.asarray(out.assignment)
+    print(f"{'cycle compile+run':40s} {(time.perf_counter()-t0)*1e3:9.1f} ms")
+    out = timed("cycle (full rounds)", cycle, snap)
+    print("  rounds_used:", int(np.asarray(out.rounds_used)),
+          " unsched:", int(np.asarray(out.unschedulable).sum()))
+
+    cycle1 = build_cycle_fn(commit_mode="rounds", max_rounds=1)
+    t0 = time.perf_counter()
+    o1 = cycle1(snap)
+    np.asarray(o1.assignment)
+    print(f"{'cycle1 compile+run':40s} {(time.perf_counter()-t0)*1e3:9.1f} ms")
+    timed("cycle (max_rounds=1)", cycle1, snap)
+
+    @jax.jit
+    def static_only(snap):
+        ctx = CycleContext(snap)
+        m, s, r = fw.static(ctx)
+        return m.sum(), s.sum(), r.sum()
+
+    t0 = time.perf_counter(); static_only(snap); print(f"{'static compile':40s} {(time.perf_counter()-t0)*1e3:9.1f} ms")
+    timed("static masks+scores+attribution", static_only, snap)
+
+    @jax.jit
+    def dyn_once(snap):
+        ctx = CycleContext(snap)
+        smask, _, _ = fw.static(ctx)
+        if snap.has_inter_pod_affinity or snap.has_topology_spread:
+            ctx.matched_pending
+        extra = fw.extra_init(ctx)
+        m, s, pf = fw.dyn_batched(ctx, snap.node_requested, extra, smask)
+        return m.sum(), s.sum()
+
+    t0 = time.perf_counter(); dyn_once(snap); print(f"{'static+dyn compile':40s} {(time.perf_counter()-t0)*1e3:9.1f} ms")
+    timed("static + dyn_batched (1 full pass)", dyn_once, snap)
+
+    @jax.jit
+    def extra_init_only(snap):
+        ctx = CycleContext(snap)
+        if snap.has_inter_pod_affinity or snap.has_topology_spread:
+            ctx.matched_pending
+        extra = fw.extra_init(ctx)
+        return jax.tree_util.tree_map(lambda x: x.sum(), extra)
+
+    t0 = time.perf_counter(); extra_init_only(snap); print(f"{'extra_init compile':40s} {(time.perf_counter()-t0)*1e3:9.1f} ms")
+    timed("matched tables + extra_init", extra_init_only, snap)
+
+    pre = build_preemption_fn()
+    if pre is not None and cfg == 4:
+        t0 = time.perf_counter()
+        pr = pre(snap, out)
+        np.asarray(pr.nominated)
+        print(f"{'preempt compile+run':40s} {(time.perf_counter()-t0)*1e3:9.1f} ms")
+        timed("preemption pass", pre, snap, out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Device-time profile of the config-#4 cycle pieces (dispatch-amortized).
+
+Run:  python scripts/profile_device4.py [cfg] [passes]
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
+from devtime import report
+from k8s_scheduler_tpu.core import build_cycle_fn, build_preemption_fn
+from k8s_scheduler_tpu.framework.interfaces import CycleContext
+from k8s_scheduler_tpu.framework.runtime import Framework
+from k8s_scheduler_tpu.models import SnapshotEncoder
+
+
+def main():
+    cfg = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    P_real, N_real = CONFIG_SHAPES[cfg]
+    enc = SnapshotEncoder(pad_pods=_pad(P_real), pad_nodes=_pad(N_real))
+    base_nodes, base_existing = make_config_base(cfg)
+    _n, pods, _e, groups = make_config_workload(cfg, seed=1000)
+    snap = enc.encode(base_nodes, pods, base_existing, groups)
+    fw = Framework.from_config()
+
+    report("noop", jax.jit(lambda s: s.pod_valid.sum()), snap)
+
+    @jax.jit
+    def static_only(s):
+        ctx = CycleContext(s)
+        m, sc, r = fw.static(ctx)
+        return m.sum(), sc.sum(), r.sum()
+
+    report("static masks+scores+attribution", static_only, snap)
+
+    @jax.jit
+    def extra_init_only(s):
+        ctx = CycleContext(s)
+        if s.has_inter_pod_affinity or s.has_topology_spread:
+            ctx.matched_pending
+        extra = fw.extra_init(ctx)
+        return jax.tree_util.tree_map(lambda x: x.sum(), extra)
+
+    report("matched tables + extra_init", extra_init_only, snap)
+
+    @jax.jit
+    def dyn_only(s):
+        ctx = CycleContext(s)
+        smask, _, _ = fw.static(ctx)
+        if s.has_inter_pod_affinity or s.has_topology_spread:
+            ctx.matched_pending
+        extra = fw.extra_init(ctx)
+        m, sc, pf = fw.dyn_batched(ctx, s.node_requested, extra, smask)
+        return m.sum(), sc.sum()
+
+    report("static + init + 1 full dyn pass", dyn_only, snap)
+
+    cycle = build_cycle_fn(commit_mode="rounds")
+    out = report("cycle (rounds, current)", cycle, snap)
+    pre = build_preemption_fn()
+    if pre is not None and cfg == 4:
+        o = cycle(snap)
+        report("preemption pass", pre, snap, o)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""Per-plugin and per-primitive timing at config-#4 scale.
+
+Each candidate hot spot gets its own tiny jit returning a scalar (so
+device->host transfer is negligible); a no-op jit measures the fixed
+dispatch overhead to subtract mentally. Best of 5.
+
+Run:  python scripts/profile_plugins4.py [cfg]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
+from k8s_scheduler_tpu.framework.interfaces import CycleContext
+from k8s_scheduler_tpu.framework.runtime import Framework
+from k8s_scheduler_tpu.models import SnapshotEncoder
+from k8s_scheduler_tpu.ops import interpod as ip
+
+
+def timed(label, fn, *args, n=5):
+    fn(*args)  # compile
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        r = fn(*args)
+        np.asarray(r)
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label:44s} {best*1e3:9.1f} ms", flush=True)
+    return best
+
+
+def main():
+    cfg = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    P_real, N_real = CONFIG_SHAPES[cfg]
+    enc = SnapshotEncoder(pad_pods=_pad(P_real), pad_nodes=_pad(N_real))
+    base_nodes, base_existing = make_config_base(cfg)
+    _n, pods, _e, groups = make_config_workload(cfg, seed=1000)
+    snap = enc.encode(base_nodes, pods, base_existing, groups)
+    print(f"P={snap.P} N={snap.N} E={snap.E} S={snap.sel_exprs.shape[0]} "
+          f"D={snap.domain_key.shape[0]} K={snap.node_domains.shape[1]} "
+          f"MA={snap.pod_anti_terms.shape[1]} MC={snap.pod_tsc.shape[1]} "
+          f"Ex={snap.expr_key.shape[0] if hasattr(snap, 'expr_key') else '?'}",
+          flush=True)
+
+    fw = Framework.from_config()
+
+    timed("noop dispatch", jax.jit(lambda s: s.pod_valid.sum()), snap)
+
+    # per-plugin static masks
+    for f in fw.filters:
+        g = jax.jit(lambda s, f=f: (lambda m: m.sum() if m is not None else jnp.int32(0))(f.static_mask(CycleContext(s))))
+        timed(f"static_mask {f.name}", g, snap)
+    for s_, w in fw.scores:
+        g = jax.jit(lambda s, s_=s_: (lambda v: v.sum() if v is not None else jnp.float32(0))(s_.static_score(CycleContext(s))))
+        timed(f"static_score {s_.name}", g, snap)
+
+    # matched tables
+    timed("matched_pending [S,P]", jax.jit(lambda s: ip.matched_pending(s).sum()), snap)
+    timed("matched_existing [S,E]", jax.jit(lambda s: ip.matched_existing(s).sum()), snap)
+
+    def init_state(s):
+        st = ip.initial_state(s, ip.matched_existing(s))
+        return st.counts.sum() + st.total.sum() + st.anti_presence.sum() + st.pref_sym.sum()
+    timed("initial_state (all tables)", jax.jit(init_state), snap)
+
+    def cbn_f(s):
+        st = ip.initial_state(s, ip.matched_existing(s))
+        return ip.counts_by_node(s, st).sum()
+    timed("initial_state + counts_by_node", jax.jit(cbn_f), snap)
+
+    # dyn pieces on full [P, N]
+    def mk_state(s):
+        return ip.initial_state(s, ip.matched_existing(s))
+
+    def aff_mask(s):
+        st = mk_state(s)
+        mp = ip.matched_pending(s)
+        cbn = ip.counts_by_node(s, st)
+        return ip.affinity_mask_batched(s, st, mp, cbn).sum()
+    timed("affinity_mask_batched (incl deps)", jax.jit(aff_mask), snap)
+
+    def aff_score(s):
+        st = mk_state(s)
+        mp = ip.matched_pending(s)
+        cbn = ip.counts_by_node(s, st)
+        feas = jnp.ones((s.P, s.N), bool)
+        return ip.affinity_score_batched(s, st, mp, cbn, feas).sum()
+    timed("affinity_score_batched (incl deps)", jax.jit(aff_score), snap)
+
+    def spread_m(s):
+        st = mk_state(s)
+        cbn = ip.counts_by_node(s, st)
+        minc = ip.spread_minc(s, st)
+        return ip.spread_mask_batched(s, st, cbn, minc).sum()
+    timed("spread_mask_batched (incl deps)", jax.jit(spread_m), snap)
+
+    # primitive costs
+    S = snap.sel_exprs.shape[0]
+    K = snap.node_domains.shape[1]
+    P, N = snap.P, snap.N
+
+    def gather_PN(s):
+        st = mk_state(s)
+        cbn = ip.counts_by_node(s, st)
+        sel = s.pod_anti_terms[:, 0, 0]
+        k = s.pod_anti_terms[:, 0, 1]
+        return ip._term_counts(s, cbn, sel, k).sum()
+    timed("one [P,N] row-gather from cbn", jax.jit(gather_PN), snap)
+
+    def matmul_PSN(s):
+        mp = ip.matched_pending(s)
+        st = mk_state(s)
+        return (mp.T.astype(jnp.float32) @ st.anti_presence.astype(jnp.float32)).sum()
+    timed("one [P,S]@[S,N] f32 matmul (incl deps)", jax.jit(matmul_PSN), snap)
+
+    def elemwise(s):
+        a = jnp.broadcast_to(s.node_valid[None, :], (s.P, s.N))
+        b = a & (s.pod_valid[:, None])
+        return (b & a).sum()
+    timed("two [P,N] bool elementwise", jax.jit(elemwise), snap)
+
+    def fit_mask(s):
+        free = s.node_allocatable - s.node_requested  # [N, R]
+        ok = jnp.all(s.pod_requested[:, None, :] <= free[None, :, :], axis=-1)
+        return ok.sum()
+    timed("resources fit [P,N,R] reduce", jax.jit(fit_mask), snap)
+
+    def argsort_P(s):
+        return jnp.argsort(jnp.where(s.pod_valid, s.pod_order, 2**31 - 1)).sum()
+    timed("argsort over [P]", jax.jit(argsort_P), snap)
+
+    def sort_guard(s):
+        L = 20 * s.P
+        keys = (s.pod_order[jnp.arange(L) % s.P]).astype(jnp.int32)
+        a, b = jax.lax.sort((keys, keys), num_keys=1)
+        return a.sum() + b.sum()
+    timed("lax.sort over [20P] pairs", jax.jit(sort_guard), snap)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Device-time of candidate primitive implementations at config-#4 scale.
+
+Run:  python scripts/profile_prims4.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench_suite import make_config_base, make_config_workload, _pad
+from devtime import report
+from k8s_scheduler_tpu.models import SnapshotEncoder
+from k8s_scheduler_tpu.ops import interpod as ip
+
+
+def main():
+    enc = SnapshotEncoder(pad_pods=_pad(10000), pad_nodes=_pad(5000))
+    base_nodes, base_existing = make_config_base(4)
+    _n, pods, _e, groups = make_config_workload(4, seed=1000)
+    snap = enc.encode(base_nodes, pods, base_existing, groups)
+    P, N = snap.P, snap.N
+    S = snap.sel_exprs.shape[0]
+    K = snap.node_domains.shape[1]
+    KS = K * S
+    D = snap.domain_key.shape[0]
+    print(f"P={P} N={N} S={S} K={K} D={D} E={snap.E}", flush=True)
+
+    key = jax.random.PRNGKey(0)
+    cbn = jax.random.uniform(key, (KS, N)) * 100  # stand-in counts table
+    rows = jax.random.randint(key, (P,), 0, KS)
+    cnts_sd = jax.random.uniform(key, (S, D)) * 100
+    m_pend = jax.random.uniform(key, (S, P)) < 0.01  # [S, P] sparse matches
+    anti_sn = jax.random.uniform(key, (S, N)) < 0.01
+
+    report("row-gather cbn[rows] -> [P,N]",
+           jax.jit(lambda c, r: c[r].sum()), cbn, rows)
+
+    def onehot_mm(c, r):
+        oh = (r[:, None] == jnp.arange(KS)[None, :]).astype(jnp.bfloat16)
+        return (oh @ c.astype(jnp.bfloat16)).astype(jnp.float32).sum()
+    report("one-hot [P,KS]@[KS,N] bf16", jax.jit(onehot_mm), cbn, rows)
+
+    def onehot_mm_f32(c, r):
+        oh = (r[:, None] == jnp.arange(KS)[None, :]).astype(jnp.float32)
+        return (oh @ c).sum()
+    report("one-hot [P,KS]@[KS,N] f32", jax.jit(onehot_mm_f32), cbn, rows)
+
+    sel = jax.random.randint(key, (P,), 0, S)
+    def onehot_S_mm(c, r):
+        oh = (r[:, None] == jnp.arange(S)[None, :]).astype(jnp.bfloat16)
+        return (oh @ c.astype(jnp.bfloat16)).astype(jnp.float32).sum()
+    report("one-hot [P,S]@[S,D] bf16 (domain space)",
+           jax.jit(onehot_S_mm), cnts_sd, sel)
+
+    nd0 = snap.node_domains[:, 0]
+    def col_gather(pd, nd):
+        return pd[:, jnp.clip(nd, 0, pd.shape[1] - 1)].sum()
+    pd = jax.random.uniform(key, (P, D))
+    report("column-gather [P,D]->[P,N]", jax.jit(col_gather), pd, nd0)
+
+    report("matmul [P,S]@[S,N] f32 (symmetric viol)",
+           jax.jit(lambda m, a: ((m.T.astype(jnp.float32)
+                                  @ a.astype(jnp.float32)) > 0).sum()),
+           m_pend, anti_sn)
+    report("matmul [P,S]@[S,N] bf16",
+           jax.jit(lambda m, a: ((m.T.astype(jnp.bfloat16)
+                                  @ a.astype(jnp.bfloat16)) > 0).sum()),
+           m_pend, anti_sn)
+
+    # matched tables candidates: current expr kernel vs matmul reformulation
+    report("matched_pending current [S,P]",
+           jax.jit(lambda s: ip.matched_pending(s).sum()), snap)
+    report("matched_existing current [S,E]",
+           jax.jit(lambda s: ip.matched_existing(s).sum()), snap)
+
+    def init_state_cur(s):
+        st = ip.initial_state(s, ip.matched_existing(s))
+        return (st.counts.sum() + st.total.sum() + st.anti_presence.sum()
+                + st.pref_sym.sum())
+    report("initial_state current", jax.jit(init_state_cur), snap)
+
+    # counts via matmul: m_exist [S,E] @ onehot(dom) [E,D]
+    def counts_mm(s):
+        me = ip.matched_existing(s).astype(jnp.bfloat16)
+        dom = ip._exist_domains(s)  # [E, K]
+        c = jnp.zeros((S, D), jnp.float32)
+        for k in range(K):
+            oh = (dom[:, k][:, None] == jnp.arange(D)[None, :])
+            c = c + (me @ oh.astype(jnp.bfloat16)).astype(jnp.float32)
+        return c.sum()
+    report("counts via [S,E]@[E,D] bf16 matmul", jax.jit(counts_mm), snap)
+
+    # guards-scale sort
+    L = 26 * 1280
+    kk = jax.random.randint(key, (L,), 0, 1 << 20)
+    def sort5(a):
+        outs = jax.lax.sort((a, a, a, a, a), num_keys=2)
+        return outs[0].sum()
+    report("lax.sort 5-tuple L=33k", jax.jit(sort5), kk)
+    L2 = 26 * 10112
+    kk2 = jax.random.randint(key, (L2,), 0, 1 << 20)
+    report("lax.sort 5-tuple L=263k", jax.jit(sort5), kk2)
+
+    report("argsort [P] i32",
+           jax.jit(lambda r: jnp.argsort(r).sum()), rows)
+    be = jax.random.uniform(key, (1280, N))
+    report("argmax [1280,N]", jax.jit(lambda x: jnp.argmax(x, 1).sum()), be)
+    bp = jax.random.uniform(key, (P, N))
+    report("argmax [P,N]", jax.jit(lambda x: jnp.argmax(x, 1).sum()), bp)
+    report("scatter dead [1280,N]",
+           jax.jit(lambda x, r: x.at[jnp.arange(1280), r[:1280]].max(True).sum()),
+           be < 0.5, rows)
+
+
+if __name__ == "__main__":
+    main()
