@@ -180,16 +180,45 @@ def build_cycle_fn(
                 max_rounds=max_rounds,
                 score_anchor_fn=lambda nr: fw.score_anchor(ctx, nr),
             )
-            # dynamic reject attribution vs the FINAL state, for the pods
-            # that never placed (same column convention as fw.static)
+            # Final-state work (dynamic reject attribution + the NodePorts
+            # part of the preemption gate) only matters for pods that never
+            # placed — computed on a COMPACTED view instead of a full
+            # [P, N] dyn pass. PREEMPTION-ELIGIBLE unplaced pods fill the
+            # window first (by rank), so the window can never be exhausted
+            # by preemptionPolicy:Never pods ahead of eligible preemptors
+            # (the window is >= the preemption budget, so every pod the
+            # PostFilter would consider gets real gate rows); other
+            # unplaced pods follow and get attribution on a best-effort
+            # basis — beyond the window: empty gate rows and zero dyn
+            # attribution, retried next cycle.
             unplaced = snap.pod_valid & (rres.assignment < 0)
+            B_attr = rounds_ops.compact_window(snap.P)
+            rank32 = snap.pod_order.astype(jnp.int32)
+            ucan = unplaced & snap.pod_can_preempt
+            ukey = jnp.where(
+                ucan, rank32,
+                jnp.where(unplaced, rank32 + jnp.int32(1 << 24),
+                          jnp.int32(2**31 - 1)),
+            )
+            ugid = jnp.argsort(ukey)[:B_attr].astype(jnp.int32)
+            uact = unplaced[ugid]
+            uvsnap = rounds_ops._pod_view(snap, ugid)
+            uvmp = ctx.matched_pending[:, ugid]
+            uvsmask = smask[ugid]
+            _um, _us, upf = dyn_batched_view_fn(
+                uvsnap, uvmp, rres.node_requested, rres.extra, uvsmask
+            )
+            urejects = fw.attribute_rejects(uvsmask, upf, rows=uact)
+            dyn_aux = (
+                jnp.zeros((snap.P, len(fw.filters)), jnp.int32)
+                .at[ugid]
+                .add(jnp.where(uact[:, None], urejects, 0))
+            )
             result = commit_ops.CommitResult(
                 assignment=rres.assignment,
                 node_requested=rres.node_requested,
                 extra=rres.extra,
-                dyn_aux=fw.attribute_rejects(
-                    smask, rres.final_per_filter, rows=unplaced
-                ),
+                dyn_aux=dyn_aux,
             )
             rounds_used = rres.rounds_used
             accepted_per_round = rres.accepted_per_round
@@ -239,19 +268,29 @@ def build_cycle_fn(
         unsched = snap.pod_valid & (result.assignment < 0)
 
         # PostFilter candidate gate (see CycleResult.preempt_gate): static
-        # without sampling, plus the final-state NodePorts dynamic mask
-        # (rounds mode computed the per-filter masks already; scan mode
-        # pays one batched pass — it targets small pending sets)
+        # without sampling, plus the final-state NodePorts dynamic mask.
+        # Rounds mode builds gate rows from the compacted unplaced view
+        # (placed pods are never preemption candidates, so their rows are
+        # simply False); scan mode pays one batched pass — it targets
+        # small pending sets.
         if commit_mode == "rounds":
-            per_filter_final = rres.final_per_filter
+            grows = smask_all_nodes[ugid]
+            for f, m in zip(fw.filters, upf):
+                if m is not None and f.name == "NodePorts":
+                    grows = grows & m
+            gate = (
+                jnp.zeros((snap.P, snap.N), bool)
+                .at[ugid]
+                .max(grows & uact[:, None])
+            )
         else:
             _m, _s, per_filter_final = fw.dyn_batched(
                 ctx, result.node_requested, result.extra, smask
             )
-        gate = smask_all_nodes
-        for f, m in zip(fw.filters, per_filter_final):
-            if m is not None and f.name == "NodePorts":
-                gate = gate & m
+            gate = smask_all_nodes
+            for f, m in zip(fw.filters, per_filter_final):
+                if m is not None and f.name == "NodePorts":
+                    gate = gate & m
 
         return CycleResult(
             result.assignment, result.node_requested, unsched, dropped, gate,

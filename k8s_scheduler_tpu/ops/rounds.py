@@ -116,8 +116,13 @@ class RoundsResult:
     diag_per_round: jnp.ndarray  # i32 [max_rounds, 3] summed over passes:
     # (live claims, capacity rejections, guard rejections) — convergence
     # diagnostics, negligible cost
-    final_mask: jnp.ndarray  # bool [P, N] dyn&static mask vs FINAL state
-    final_per_filter: Any  # list of [P,N] masks (None for maskless), final
+
+
+def compact_window(P: int, compact: int = 8) -> int:
+    """Row count of the compacted per-round view (also used by the
+    cycle's final attribution/preemption-gate view): the `P/compact`
+    lowest-rank actives, padded to a lane multiple."""
+    return min(P, max(256, -(-P // compact) // 128 * 128))
 
 
 def _tie_break(gid: jnp.ndarray, N: int) -> jnp.ndarray:
@@ -168,7 +173,11 @@ def _pod_view(snap, gid: jnp.ndarray):
 def _seg_scan_tables(keys, pods, counts):
     """Entries sorted by (key, rank): for each 0/1 indicator column,
     return the in-segment count strictly before each entry's POD (one
-    pod's own entries never block each other)."""
+    pod's own entries never block each other).
+
+    All indicator columns ride ONE stacked [L, C] cumsum and TWO stacked
+    row-gathers — per-column 1-D gathers are pathologically slow on this
+    backend (~2ms each at L=283k; 12 of them dominated the sweep)."""
     L = keys.shape[0]
     i = jnp.arange(L, dtype=jnp.int32)
     seg_start = jnp.concatenate(
@@ -179,12 +188,11 @@ def _seg_scan_tables(keys, pods, counts):
     )
     seg_first = jax.lax.cummax(jnp.where(seg_start, i, -1))
     run_first = jax.lax.cummax(jnp.where(run_start, i, -1))
-    out = {}
-    for name, x in counts.items():
-        c = jnp.cumsum(x)
-        before = c - x  # strictly before index j
-        out[name] = before[run_first] - before[seg_first]
-    return out
+    names = list(counts.keys())
+    x = jnp.stack([counts[n] for n in names], axis=1)  # [L, C]
+    before = jnp.cumsum(x, axis=0) - x  # strictly before index j
+    delta = before[run_first] - before[seg_first]  # [L, C]
+    return {n: delta[:, c] for c, n in enumerate(names)}
 
 
 def _owner_state(ext_state):
@@ -244,6 +252,13 @@ def rounds_commit(
     GK_INVALID = GK_PORT + N * Q + 1
 
     slack = _REL_EPS * snap.node_allocatable + _REL_EPS  # [N, R]
+    # static mask+score pre-combined; scores clamp to +-1e6 (far above any
+    # plugin-weight scale, far below |NEG_INF|/2) so an extreme extender
+    # score can never push a feasible node across the infeasible threshold
+    # the compacted rounds reconstruct the mask with (vsbase > NEG_INF/2)
+    sbase = jnp.where(
+        static_mask, jnp.clip(static_score, -1e6, 1e6), NEG_INF
+    )  # [P, N]
 
     def guards_ok(vsnap, vrank, vsels, choice, live, ext_state):
         """Participant-table sweep over the round's accepted claims;
@@ -381,8 +396,11 @@ def rounds_commit(
         B = gid.shape[0]
         vsnap = _pod_view(snap, gid)
         vmp = m_pending[:, gid]
-        vsmask = static_mask[gid]
-        vsscore = static_score[gid]
+        # static mask+score travel as ONE pre-combined f32 array (score
+        # where feasible, NEG_INF where not): compacted rounds pay a
+        # single [B, N] row-gather instead of two (~2ms each at 10k x 5k)
+        vsbase = sbase[gid]
+        vsmask = vsbase > NEG_INF * 0.5
         vrank = rank_g[gid]
         vsels = matched_sels_g[gid]
         vovf = overflow_g[gid]
@@ -391,7 +409,7 @@ def rounds_commit(
             vsnap, vmp, node_req, ext, vsmask
         )
         mask = mask & vsmask & act_v[:, None]
-        base = vsscore + score  # un-rounded; claim ranking re-rounds with
+        base = vsbase + score  # un-rounded; claim ranking re-rounds with
         # the per-pass anchor delta applied (see score_node_anchor)
         tie = _tie_break(gid, N)
         anchor0 = (
@@ -524,7 +542,7 @@ def rounds_commit(
     diag_hist = jnp.zeros((max_rounds, 3), jnp.int32).at[0].set(diag0)
 
     # ---- rounds 2+: compacted to the lowest-rank actives ----
-    B = min(P, max(256, -(-P // compact) // 128 * 128))
+    B = compact_window(P, compact)
 
     def body(carry):
         node_req, ext, placed, active, rnd, _, hist, dhist = carry
@@ -554,10 +572,6 @@ def rounds_commit(
         )
     )
 
-    # final-state masks for reject attribution of leftover pods
-    fmask, _fs, per_filter = dyn_batched_view_fn(
-        snap, m_pending, node_req, extra, static_mask
-    )
     return RoundsResult(
         assignment=placed,
         node_requested=node_req,
@@ -565,6 +579,4 @@ def rounds_commit(
         rounds_used=rounds_used,
         accepted_per_round=acc_hist,
         diag_per_round=diag_hist,
-        final_mask=fmask & static_mask,
-        final_per_filter=per_filter,
     )
