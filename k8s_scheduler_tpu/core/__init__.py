@@ -1,2 +1,8 @@
-from .cycle import CycleResult, build_cycle_fn, build_preemption_fn  # noqa: F401
+from .cycle import (  # noqa: F401
+    CycleResult,
+    build_cycle_fn,
+    build_packed_cycle_fn,
+    build_packed_preemption_fn,
+    build_preemption_fn,
+)
 from .scheduler import CycleStats, Scheduler  # noqa: F401

@@ -301,6 +301,38 @@ def build_cycle_fn(
     return cycle
 
 
+def build_packed_cycle_fn(spec, **kw):
+    """Packed-input variant of build_cycle_fn: takes the (u32, u8) buffers
+    of models.packing.pack instead of a ClusterSnapshot. On the tunneled
+    TPU rig, feeding a program ~80 freshly-assembled arrays costs a large
+    per-buffer first-use overhead every cycle; two packed buffers make it
+    negligible. The unpack is static slices + bitcasts, fused by XLA."""
+    from ..models import packing
+
+    cycle = build_cycle_fn(**kw)
+
+    @jax.jit
+    def packed(wbuf, bbuf):
+        return cycle(packing.unpack(wbuf, bbuf, spec))
+
+    return packed
+
+
+def build_packed_preemption_fn(spec, framework: Framework | None = None):
+    """Packed-input variant of build_preemption_fn (same motivation)."""
+    from ..models import packing
+
+    pre = build_preemption_fn(framework)
+    if pre is None:
+        return None
+
+    @jax.jit
+    def packed(wbuf, bbuf, result):
+        return pre(packing.unpack(wbuf, bbuf, spec), result)
+
+    return packed
+
+
 def build_preemption_fn(framework: Framework | None = None):
     """Compile the PostFilter (preemption) pass: called with the cycle's
     output when unschedulable pods remain. Kept as a separate jitted

@@ -1,0 +1,106 @@
+"""Snapshot packing: ship the whole ClusterSnapshot to the device as TWO
+buffers instead of ~80.
+
+Motivation (measured on the tunneled TPU rig): executing a program whose
+input buffers have never been used costs a large per-buffer first-use
+overhead — a cycle fed ~80 freshly-assembled numpy arrays spent 300-500ms
+more than the same program on warm buffers, even though the total payload
+is only ~8MB. Packing all numeric arrays into one u32 word buffer and all
+boolean arrays into one u8 buffer makes that per-cycle overhead ~2
+buffers' worth; the jitted program unpacks with STATIC slices + bitcasts
+that XLA fuses into the consumers.
+
+The PackSpec is static per padded-shape/dictionary-size regime: it pins
+every field's (dtype, shape, offset) plus the snapshot's non-array
+attributes (python ints/bools/tuples — trace-time constants). When the
+encoder's grow-only dimensions change, the spec changes and the packed
+program recompiles — same regime-bucketing contract as the unpacked path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from .encoding import ClusterSnapshot
+
+
+@dataclasses.dataclass(frozen=True)
+class PackSpec:
+    # (name, dtype_str, shape, word_offset) for u32-packed numeric fields
+    words: tuple[tuple[str, str, tuple[int, ...], int], ...]
+    # (name, shape, byte_offset) for bool fields in the u8 buffer
+    bools: tuple[tuple[str, tuple[int, ...], int], ...]
+    n_words: int
+    n_bytes: int
+    # non-array ClusterSnapshot attributes, captured as constants
+    aux: tuple[tuple[str, Any], ...]
+
+    def key(self):
+        return (self.words, self.bools, self.aux)
+
+
+def make_spec(snap: ClusterSnapshot) -> PackSpec:
+    words = []
+    bools = []
+    aux = []
+    wo = 0
+    bo = 0
+    for f in dataclasses.fields(snap):
+        v = getattr(snap, f.name)
+        if isinstance(v, np.ndarray) or hasattr(v, "dtype"):
+            a = np.asarray(v)
+            if a.dtype == np.bool_:
+                bools.append((f.name, tuple(a.shape), bo))
+                bo += int(a.size)
+            elif a.dtype in (np.int32, np.float32):
+                words.append((f.name, a.dtype.name, tuple(a.shape), wo))
+                wo += int(a.size)
+            else:
+                raise TypeError(
+                    f"unpackable dtype {a.dtype} for field {f.name}"
+                )
+        else:
+            aux.append((f.name, v))
+    return PackSpec(
+        words=tuple(words),
+        bools=tuple(bools),
+        n_words=wo,
+        n_bytes=max(bo, 1),
+        aux=tuple(aux),
+    )
+
+
+def pack(snap: ClusterSnapshot, spec: PackSpec):
+    """-> (u32 [n_words], u8 [n_bytes]) numpy buffers."""
+    wbuf = np.empty(spec.n_words, np.uint32)
+    bbuf = np.zeros(spec.n_bytes, np.uint8)
+    for name, _dt, _shape, off in spec.words:
+        a = np.ascontiguousarray(np.asarray(getattr(snap, name)))
+        wbuf[off:off + a.size] = a.view(np.uint32).ravel()
+    for name, _shape, off in spec.bools:
+        a = np.ascontiguousarray(np.asarray(getattr(snap, name)))
+        bbuf[off:off + a.size] = a.view(np.uint8).ravel()
+    return wbuf, bbuf
+
+
+def unpack(wbuf, bbuf, spec: PackSpec) -> ClusterSnapshot:
+    """Rebuild the snapshot inside a trace from the packed buffers."""
+    import jax
+    import jax.numpy as jnp
+
+    kw = dict(spec.aux)
+    for name, dt, shape, off in spec.words:
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        sl = jax.lax.slice(wbuf, (off,), (off + n,))
+        arr = jax.lax.bitcast_convert_type(
+            sl, jnp.int32 if dt == "int32" else jnp.float32
+        )
+        kw[name] = arr.reshape(shape)
+    for name, shape, off in spec.bools:
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        sl = jax.lax.slice(bbuf, (off,), (off + n,))
+        kw[name] = (sl != 0).reshape(shape)
+    return ClusterSnapshot(**kw)
