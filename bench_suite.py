@@ -132,7 +132,40 @@ def _draw_pending(cfg: int, i: int, prev: list | None, churn: float):
     stays coherent."""
     import numpy as np
 
-    if prev is None or churn >= 1.0 or cfg == 5:
+    if prev is not None and churn <= 0.0:
+        # fully-warm steady state: every pending object carries over
+        if cfg == 5:
+            from k8s_scheduler_tpu.models.api import PodGroup
+
+            return prev, [PodGroup(f"job-{g}", 8)
+                          for g in range(len(prev) // 8)]
+        return prev, []
+    if cfg == 5 and prev is not None and churn < 1.0:
+        # gang churn happens at JOB granularity: whole 8-replica jobs are
+        # redrawn (fresh objects, same job names/min_member) so group
+        # membership stays coherent while the row cache sees a realistic
+        # carry-over
+        from k8s_scheduler_tpu.models import MakePod
+
+        R = 8
+        G = len(prev) // R
+        k = max(1, int(G * churn))
+        rng = np.random.default_rng(7000 + i)
+        out = list(prev)
+        for g in rng.choice(G, size=k, replace=False):
+            for r in range(R):
+                out[g * R + r] = (
+                    MakePod(f"job-{g}-{r}")
+                    .req({"cpu": f"{int(rng.integers(2, 8)) * 500}m",
+                          "memory": "1Gi"})
+                    .group(f"job-{g}")
+                    .created(float(g * R + r))
+                    .obj()
+                )
+        from k8s_scheduler_tpu.models.api import PodGroup
+
+        return out, [PodGroup(f"job-{g}", R) for g in range(G)]
+    if prev is None or churn >= 1.0:
         pods, groups = make_config_pending(cfg, seed=1000 + i)
         return pods, groups
     k = max(1, int(len(prev) * churn))
@@ -165,9 +198,24 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     mode = os.environ.get("BENCH_COMMIT_MODE", "rounds")
     churn = float(os.environ.get("BENCH_CHURN", 0.2))
     # the packed path ships 2 input buffers per cycle instead of ~80 (a
-    # fresh buffer pays a large first-use overhead through the tunnel)
+    # fresh buffer pays a large first-use overhead through the tunnel);
+    # compiled programs memoize per spec regime so the throughput loop
+    # (which replays the same snapshot sequence) never compiles inside
+    # its timed window
     spec = None
     cycle = preempt = None
+    packed_memo: dict = {}
+
+    def packed_fns(sp):
+        key = sp.key()
+        hit = packed_memo.get(key)
+        if hit is None:
+            hit = (
+                build_packed_cycle_fn(sp, commit_mode=mode),
+                build_packed_preemption_fn(sp) if cfg == 4 else None,
+            )
+            packed_memo[key] = hit
+        return hit
 
     # one encoder across snapshots keeps the string/selector dictionaries
     # stable (what a long-lived serving process sees)
@@ -204,8 +252,7 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
             # new padded-shape/dictionary regime: (re)build + compile
             # (warmup, untimed as cycle latency — reported separately)
             spec = s2
-            cycle = build_packed_cycle_fn(spec, commit_mode=mode)
-            preempt = build_packed_preemption_fn(spec) if cfg == 4 else None
+            cycle, preempt = packed_fns(spec)
             wbuf, bbuf = packing.pack(snap, spec)
             encode_times.append(time.perf_counter() - t0)
             shape_keys.add(spec.key())
@@ -262,10 +309,13 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
         pending, groups = _draw_pending(cfg, i, pending, churn)
         snap = enc.encode(base_nodes, pending, base_existing, groups)
         s3 = packing.make_spec(snap)
-        if s3.key() != spec.key():  # dictionary regime grew: recompile
+        if s3.key() != spec.key():
+            # regime change mid-loop: memo hit for regimes the latency
+            # loop already compiled (the sequence replays); a genuinely
+            # new regime would compile here and pollute the window, but
+            # grow-only dims make that a one-off
             spec = s3
-            cycle = build_packed_cycle_fn(spec, commit_mode=mode)
-            preempt = build_packed_preemption_fn(spec) if cfg == 4 else None
+            cycle, preempt = packed_fns(spec)
         wbuf, bbuf = packing.pack(snap, spec)
         out = cycle(wbuf, bbuf)
         out_pre = preempt(wbuf, bbuf, out) if preempt is not None else None

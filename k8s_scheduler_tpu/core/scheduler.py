@@ -42,7 +42,12 @@ from ..internal.queue import (
 )
 from ..models.api import Node, Pod, PodGroup
 from ..models.encoding import SnapshotEncoder
-from .cycle import build_cycle_fn, build_preemption_fn
+from .cycle import (
+    build_cycle_fn,
+    build_packed_cycle_fn,
+    build_packed_preemption_fn,
+    build_preemption_fn,
+)
 from .events import EventRecorder, failed_scheduling_message
 
 # binder(pod, node_name) -> None; raise to signal bind failure
@@ -117,15 +122,39 @@ class Scheduler:
         # the resource-name axis stay stable across cycles (the encoder's
         # documented contract); only the pad sizes track the workload
         self._encoder = SnapshotEncoder()
-        self._cycle = build_cycle_fn(
-            self.framework,
+        self._cycle_kw = dict(
             gang_scheduling=self.config.gang_scheduling,
             commit_mode=self.config.commit_mode,
             percentage_of_nodes_to_score=(
                 self.config.percentage_of_nodes_to_score
             ),
         )
+        # the serving path runs the PACKED programs (two input buffers per
+        # cycle instead of ~80 — see models/packing.py), compiled lazily
+        # per packed-spec regime and memoized so regime flip-flops (pad
+        # bucket changes) reuse earlier compilations
+        self._packed: dict = {}
+        # unpacked fallbacks, kept for tests/tools poking at the scheduler
+        self._cycle = build_cycle_fn(self.framework, **self._cycle_kw)
         self._preempt = build_preemption_fn(self.framework)
+
+    def _packed_fns(self, spec):
+        key = spec.key()
+        hit = self._packed.get(key)
+        if hit is None:
+            hit = (
+                build_packed_cycle_fn(
+                    spec, framework=self.framework, **self._cycle_kw
+                ),
+                build_packed_preemption_fn(spec, self.framework),
+            )
+            self._packed[key] = hit
+            # bounded: grow-only interning dimensions make old regimes
+            # permanently dead — keep only the recent few (pad-bucket
+            # flip-flops) instead of leaking compiled executables forever
+            while len(self._packed) > 4:
+                self._packed.pop(next(iter(self._packed)))
+        return hit
 
     # ---- informer-style event handlers (SURVEY.md §3.3) ------------------
 
@@ -254,11 +283,16 @@ class Scheduler:
                     pod_extender_mask=full_mask,
                     pod_extender_score=full_score,
                 )
+        from ..models import packing
+
+        spec = packing.make_spec(snap)
+        pcycle, ppreempt = self._packed_fns(spec)
+        wbuf, bbuf = packing.pack(snap, spec)
         t_encode = self._now()
         self.metrics.cycle_duration.labels(phase="encode").observe(
             t_encode - t0
         )
-        result = self._cycle(snap)
+        result = pcycle(wbuf, bbuf)
         assignment = np.asarray(result.assignment)[: len(pending)]
         gang_dropped = np.asarray(result.gang_dropped)[: len(pending)]
         reject_counts = np.asarray(result.reject_counts)[: len(pending)]
@@ -271,9 +305,9 @@ class Scheduler:
         self.metrics.decisions.inc(len(pending) * len(nodes))
 
         nominated = victims = None
-        if self._preempt is not None and (assignment < 0).any():
+        if ppreempt is not None and (assignment < 0).any():
             self.metrics.preemption_attempts.inc()
-            pre = self._preempt(snap, result)
+            pre = ppreempt(wbuf, bbuf, result)
             nominated = np.asarray(pre.nominated)[: len(pending)]
             victims = np.asarray(pre.victims)[: len(existing)]
         t_post = self._now()

@@ -1009,7 +1009,6 @@ class SnapshotEncoder:
 
             # ---- topology domains (flat ids across keys) ----
             K = len(topo_keys)
-            topo_key_ids = [S.intern(k) for k in topo_keys]
             domain_map: dict[tuple[int, int], int] = {}
             node_domains = np.full((N, K), -1, np.int32)
             for i, nd in enumerate(nodes):

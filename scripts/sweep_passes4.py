@@ -1,0 +1,68 @@
+"""Sweep rounds-engine (passes_round0, passes) at config #4 on device.
+
+Run:  python scripts/sweep_passes4.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from bench_suite import make_config_base, make_config_workload, _pad
+from devtime import devtime
+from k8s_scheduler_tpu.core.cycle import build_cycle_fn
+from k8s_scheduler_tpu.models import SnapshotEncoder
+from k8s_scheduler_tpu.framework.runtime import Framework
+from k8s_scheduler_tpu.framework.interfaces import CycleContext
+from k8s_scheduler_tpu.ops import rounds as rounds_ops
+
+
+def main():
+    enc = SnapshotEncoder(pad_pods=_pad(10000), pad_nodes=_pad(5000))
+    bn, be = make_config_base(4)
+    _n, pods, _e, groups = make_config_workload(4, seed=1000)
+    snap = jax.device_put(enc.encode(bn, pods, be, groups))
+
+    for p0, p in [(16, 8), (10, 6), (8, 4), (20, 10)]:
+        fw = Framework.from_config()
+
+        def make_cycle(p0=p0, p=p):
+            import functools
+            orig = rounds_ops.rounds_commit
+
+            @functools.wraps(orig)
+            def patched(*a, **kw):
+                kw["passes_round0"] = p0
+                kw["passes"] = p
+                return orig(*a, **kw)
+
+            rounds_ops.rounds_commit = patched
+            try:
+                import k8s_scheduler_tpu.core.cycle as cyc
+                cyc.rounds_ops.rounds_commit = patched
+                return build_cycle_fn(framework=fw, commit_mode="rounds")
+            finally:
+                rounds_ops.rounds_commit = orig
+                import k8s_scheduler_tpu.core.cycle as cyc
+                cyc.rounds_ops.rounds_commit = orig
+
+        cycle = make_cycle()
+        t0 = time.perf_counter()
+        out = cycle(snap)
+        np.asarray(out.assignment)
+        comp = time.perf_counter() - t0
+        d = devtime(cycle, snap)
+        print(
+            f"passes0={p0:2d} passes={p:2d}: device {d*1e3:7.1f} ms  "
+            f"rounds={int(np.asarray(out.rounds_used))}  "
+            f"unsched={int(np.asarray(out.unschedulable).sum())}  "
+            f"(compile {comp:.0f}s)",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
