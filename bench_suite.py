@@ -189,6 +189,7 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     from k8s_scheduler_tpu.core import (
         build_packed_cycle_fn,
         build_packed_preemption_fn,
+        build_stable_state_fn,
     )
     from k8s_scheduler_tpu.models import packing
 
@@ -213,8 +214,22 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
             hit = (
                 build_packed_cycle_fn(sp, commit_mode=mode),
                 build_packed_preemption_fn(sp) if cfg == 4 else None,
+                build_stable_state_fn(sp),
             )
             packed_memo[key] = hit
+        return hit
+
+    stable_memo: dict = {}
+
+    def stable_state(sp, stable_fn, w, b):
+        # device-resident stable-side precomputes, rerun only when the
+        # encoder's stable side or the spec regime changes
+        key = (sp.key(), getattr(enc, "_stable_key", None))
+        hit = stable_memo.get(key)
+        if hit is None:
+            hit = stable_fn(w, b)
+            stable_memo.clear()
+            stable_memo[key] = hit
         return hit
 
     # one encoder across snapshots keeps the string/selector dictionaries
@@ -252,12 +267,12 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
             # new padded-shape/dictionary regime: (re)build + compile
             # (warmup, untimed as cycle latency — reported separately)
             spec = s2
-            cycle, preempt = packed_fns(spec)
+            cycle, preempt, stable_fn = packed_fns(spec)
             wbuf, bbuf = packing.pack(snap, spec)
             encode_times.append(time.perf_counter() - t0)
             shape_keys.add(spec.key())
             t0 = time.perf_counter()
-            out = cycle(wbuf, bbuf)
+            out = cycle(wbuf, bbuf, stable_state(spec, stable_fn, wbuf, bbuf))
             np.asarray(out.assignment)
             if preempt is not None:
                 pre = preempt(wbuf, bbuf, out)
@@ -268,8 +283,9 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
             encode_times.append(time.perf_counter() - t0)
         if first_bufs is None:
             first_bufs = (wbuf, bbuf)
+        stable = stable_state(spec, stable_fn, wbuf, bbuf)
         t0 = time.perf_counter()
-        out = cycle(wbuf, bbuf)
+        out = cycle(wbuf, bbuf, stable)
         pre = None
         if preempt is not None:
             # preemption chains on the cycle output device-side; one
@@ -315,9 +331,9 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
             # new regime would compile here and pollute the window, but
             # grow-only dims make that a one-off
             spec = s3
-            cycle, preempt = packed_fns(spec)
+            cycle, preempt, stable_fn = packed_fns(spec)
         wbuf, bbuf = packing.pack(snap, spec)
-        out = cycle(wbuf, bbuf)
+        out = cycle(wbuf, bbuf, stable_state(spec, stable_fn, wbuf, bbuf))
         out_pre = preempt(wbuf, bbuf, out) if preempt is not None else None
         last = (out, out_pre)
     np.asarray(last[0].assignment)
@@ -326,13 +342,16 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     pipelined = (time.perf_counter() - t0) / snapshots
 
     # device-only time: dispatch the same DEVICE-RESIDENT buffers
-    # repeatedly, force once (numpy args would add an upload per rep)
+    # repeatedly, force once (numpy args would add an upload per rep);
+    # stable state recomputed for the CURRENT spec — the throughput loop
+    # may have switched regimes, and a stale dict would shape-mismatch
     wbuf = jax.device_put(wbuf)
     bbuf = jax.device_put(bbuf)
+    stable = stable_state(spec, stable_fn, wbuf, bbuf)
     reps = 6
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = cycle(wbuf, bbuf)
+        out = cycle(wbuf, bbuf, stable)
         if preempt is not None:
             out_pre = preempt(wbuf, bbuf, out)
     np.asarray(out.assignment)

@@ -4,5 +4,6 @@ from .cycle import (  # noqa: F401
     build_packed_cycle_fn,
     build_packed_preemption_fn,
     build_preemption_fn,
+    build_stable_state_fn,
 )
 from .scheduler import CycleStats, Scheduler  # noqa: F401

@@ -47,6 +47,7 @@ from .cycle import (
     build_packed_cycle_fn,
     build_packed_preemption_fn,
     build_preemption_fn,
+    build_stable_state_fn,
 )
 from .events import EventRecorder, failed_scheduling_message
 
@@ -134,6 +135,7 @@ class Scheduler:
         # per packed-spec regime and memoized so regime flip-flops (pad
         # bucket changes) reuse earlier compilations
         self._packed: dict = {}
+        self._dev_stable: dict = {}
         # unpacked fallbacks, kept for tests/tools poking at the scheduler
         self._cycle = build_cycle_fn(self.framework, **self._cycle_kw)
         self._preempt = build_preemption_fn(self.framework)
@@ -147,6 +149,7 @@ class Scheduler:
                     spec, framework=self.framework, **self._cycle_kw
                 ),
                 build_packed_preemption_fn(spec, self.framework),
+                build_stable_state_fn(spec),
             )
             self._packed[key] = hit
             # bounded: grow-only interning dimensions make old regimes
@@ -154,6 +157,23 @@ class Scheduler:
             # flip-flops) instead of leaking compiled executables forever
             while len(self._packed) > 4:
                 self._packed.pop(next(iter(self._packed)))
+        return hit
+
+    def _stable_state(self, spec, stable_fn, wbuf, bbuf):
+        """Device-resident stable-side precomputes, rerun only when the
+        encoder's stable side (nodes / existing pods / dedup tables) or
+        the packed-spec regime changes. A miss costs one extra ASYNC
+        dispatch of a ~2ms device program (cheaper than the fused
+        in-cycle recompute it replaces), so even a bind-every-cycle
+        workload — whose existing-pod set changes every cycle — comes out
+        ahead; the memo is bounded like _packed for pad flip-flops."""
+        key = (spec.key(), getattr(self._encoder, "_stable_key", None))
+        hit = self._dev_stable.get(key)
+        if hit is None:
+            hit = stable_fn(wbuf, bbuf)
+            self._dev_stable[key] = hit
+            while len(self._dev_stable) > 4:
+                self._dev_stable.pop(next(iter(self._dev_stable)))
         return hit
 
     # ---- informer-style event handlers (SURVEY.md §3.3) ------------------
@@ -286,13 +306,14 @@ class Scheduler:
         from ..models import packing
 
         spec = packing.make_spec(snap)
-        pcycle, ppreempt = self._packed_fns(spec)
+        pcycle, ppreempt, stable_fn = self._packed_fns(spec)
         wbuf, bbuf = packing.pack(snap, spec)
+        stable = self._stable_state(spec, stable_fn, wbuf, bbuf)
         t_encode = self._now()
         self.metrics.cycle_duration.labels(phase="encode").observe(
             t_encode - t0
         )
-        result = pcycle(wbuf, bbuf)
+        result = pcycle(wbuf, bbuf, stable)
         assignment = np.asarray(result.assignment)[: len(pending)]
         gang_dropped = np.asarray(result.gang_dropped)[: len(pending)]
         reject_counts = np.asarray(result.reject_counts)[: len(pending)]

@@ -1,0 +1,48 @@
+"""Device time of the config-#4 cycle with and without injected stable
+state.
+
+Run:  python scripts/probe_stable4.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from bench_suite import make_config_base, make_config_workload, _pad
+from devtime import report
+from k8s_scheduler_tpu.core import (
+    build_packed_cycle_fn,
+    build_packed_preemption_fn,
+    build_stable_state_fn,
+)
+from k8s_scheduler_tpu.models import SnapshotEncoder, packing
+
+
+def main():
+    enc = SnapshotEncoder(pad_pods=_pad(10000), pad_nodes=_pad(5000))
+    bn, be = make_config_base(4)
+    _n, pods, _e, groups = make_config_workload(4, seed=1000)
+    snap = enc.encode(bn, pods, be, groups)
+    spec = packing.make_spec(snap)
+    w, b = packing.pack(snap, spec)
+    w = jax.device_put(w)
+    b = jax.device_put(b)
+
+    cycle = build_packed_cycle_fn(spec, commit_mode="rounds")
+    pre = build_packed_preemption_fn(spec)
+    st_fn = build_stable_state_fn(spec)
+    st = st_fn(w, b)
+    jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+
+    report("stable-state program", st_fn, w, b)
+    report("cycle (no injection)", cycle, w, b)
+    report("cycle (stable injected)", lambda w, b: cycle(w, b, st), w, b)
+    out = cycle(w, b, st)
+    report("preemption", pre, w, b, out)
+
+
+if __name__ == "__main__":
+    main()

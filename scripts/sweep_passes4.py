@@ -29,31 +29,30 @@ def main():
     for p0, p in [(16, 8), (10, 6), (8, 4), (20, 10)]:
         fw = Framework.from_config()
 
-        def make_cycle(p0=p0, p=p):
-            import functools
-            orig = rounds_ops.rounds_commit
+        # the patch must stay installed through the FIRST call (tracing
+        # happens at invocation, not at build_cycle_fn time — an earlier
+        # version of this script restored it too early and measured the
+        # default pass counts four times)
+        import functools
+        import k8s_scheduler_tpu.core.cycle as cyc
 
-            @functools.wraps(orig)
-            def patched(*a, **kw):
-                kw["passes_round0"] = p0
-                kw["passes"] = p
-                return orig(*a, **kw)
+        orig = rounds_ops.rounds_commit
 
-            rounds_ops.rounds_commit = patched
-            try:
-                import k8s_scheduler_tpu.core.cycle as cyc
-                cyc.rounds_ops.rounds_commit = patched
-                return build_cycle_fn(framework=fw, commit_mode="rounds")
-            finally:
-                rounds_ops.rounds_commit = orig
-                import k8s_scheduler_tpu.core.cycle as cyc
-                cyc.rounds_ops.rounds_commit = orig
+        @functools.wraps(orig)
+        def patched(*a, **kw):
+            kw["passes_round0"] = p0
+            kw["passes"] = p
+            return orig(*a, **kw)
 
-        cycle = make_cycle()
-        t0 = time.perf_counter()
-        out = cycle(snap)
-        np.asarray(out.assignment)
-        comp = time.perf_counter() - t0
+        cyc.rounds_ops.rounds_commit = patched
+        try:
+            cycle = build_cycle_fn(framework=fw, commit_mode="rounds")
+            t0 = time.perf_counter()
+            out = cycle(snap)
+            np.asarray(out.assignment)
+            comp = time.perf_counter() - t0
+        finally:
+            cyc.rounds_ops.rounds_commit = orig
         d = devtime(cycle, snap)
         print(
             f"passes0={p0:2d} passes={p:2d}: device {d*1e3:7.1f} ms  "

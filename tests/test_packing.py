@@ -61,3 +61,20 @@ def test_packed_cycle_matches_unpacked():
     )
     pre = build_packed_preemption_fn(spec)(w, b, out_p)
     assert np.asarray(pre.nominated).shape[0] == snap.P
+
+
+def test_stable_state_injection_matches():
+    from k8s_scheduler_tpu.core import build_stable_state_fn
+
+    snap = _snap()
+    spec = packing.make_spec(snap)
+    w, b = packing.pack(snap, spec)
+    out_u = build_cycle_fn(commit_mode="rounds")(snap)
+    st = build_stable_state_fn(spec)(w, b)
+    out_p = build_packed_cycle_fn(spec, commit_mode="rounds")(w, b, st)
+    assert np.array_equal(
+        np.asarray(out_u.assignment), np.asarray(out_p.assignment)
+    )
+    assert np.array_equal(
+        np.asarray(out_u.reject_counts), np.asarray(out_p.reject_counts)
+    )
