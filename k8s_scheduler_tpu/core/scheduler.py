@@ -167,14 +167,20 @@ class Scheduler:
         in-cycle recompute it replaces), so even a bind-every-cycle
         workload — whose existing-pod set changes every cycle — comes out
         ahead; the memo is bounded like _packed for pad flip-flops."""
-        key = (spec.key(), getattr(self._encoder, "_stable_key", None))
+        # keyed on the encoder's stable-cache dict IDENTITY, with a strong
+        # ref pinned in the entry: the encoder's _stable_key tuple contains
+        # raw id()s whose objects older memo entries would not pin, so a
+        # recycled address could otherwise produce a false hit on stale
+        # existing-pod tables
+        enc_st = getattr(self._encoder, "_stable", None)
+        key = (spec.key(), id(enc_st))
         hit = self._dev_stable.get(key)
-        if hit is None:
-            hit = stable_fn(wbuf, bbuf)
+        if hit is None or hit[0] is not enc_st:
+            hit = (enc_st, stable_fn(wbuf, bbuf))
             self._dev_stable[key] = hit
             while len(self._dev_stable) > 4:
                 self._dev_stable.pop(next(iter(self._dev_stable)))
-        return hit
+        return hit[1]
 
     # ---- informer-style event handlers (SURVEY.md §3.3) ------------------
 

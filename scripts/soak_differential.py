@@ -1,0 +1,115 @@
+"""Randomized differential soak: many random clusters through the device
+engines vs the sequential oracle.
+
+- scan engine: exact assignment match against oracle.schedule.
+- rounds engine: validity invariants (oracle.validate_rounds_assignment)
+  plus a placement-quality floor (rounds must place >= 90% of what the
+  sequential oracle places — catches convergence regressions).
+
+Run:  python scripts/soak_differential.py [minutes]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from k8s_scheduler_tpu import oracle
+from k8s_scheduler_tpu.core import build_cycle_fn
+from k8s_scheduler_tpu.models import SnapshotEncoder
+from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+
+def one_case(seed: int, scan_cycle, rounds_cycle, enc):
+    rng = np.random.default_rng(seed)
+    n_nodes = int(rng.integers(5, 40))
+    n_pods = int(rng.integers(5, 120))
+    nodes = make_cluster(
+        n_nodes,
+        taint_fraction=float(rng.uniform(0, 0.4)),
+        cpu_choices=(2, 4, 8),
+    )
+    pods = make_pods(
+        n_pods,
+        seed=seed,
+        affinity_fraction=float(rng.uniform(0, 0.4)),
+        anti_affinity_fraction=float(rng.uniform(0, 0.4)),
+        spread_fraction=float(rng.uniform(0, 0.3)),
+        selector_fraction=float(rng.uniform(0, 0.4)),
+        toleration_fraction=float(rng.uniform(0, 0.4)),
+        priorities=(0, 5, 10),
+        num_apps=int(rng.integers(2, 12)),
+    )
+    # existing pods must FIT where they are placed (a real cluster's bound
+    # pods passed admission) — small fixed requests, capped per node
+    from k8s_scheduler_tpu.models import MakePod
+
+    n_exist = int(rng.integers(0, 2 * n_nodes))
+    existing = [
+        (
+            MakePod(f"run-{i}")
+            .req({"cpu": "100m", "memory": "64Mi"})
+            .labels({"app": f"app-{i % 8}"})
+            .obj(),
+            f"node-{i % n_nodes}",
+        )
+        for i in range(n_exist)
+    ]
+    snap = enc.encode(nodes, pods, existing)
+
+    # scan vs oracle: exact
+    out_s = scan_cycle(snap)
+    a_s = np.asarray(out_s.assignment)[: len(pods)]
+    want = [d.node_index for d in oracle.schedule(nodes, pods, existing)]
+    got = [int(x) for x in a_s]
+    if got != want:
+        return f"seed {seed}: scan mismatch\n  got {got}\n  want {want}"
+
+    # rounds: validity + quality floor
+    out_r = rounds_cycle(snap)
+    a_r = np.asarray(out_r.assignment)[: len(pods)]
+    errs = oracle.validate_rounds_assignment(nodes, pods, a_r, existing)
+    if errs:
+        return f"seed {seed}: rounds violations: {errs[:3]}"
+    placed_r = int((a_r >= 0).sum())
+    placed_o = sum(1 for w in want if w is not None and w >= 0)
+    if placed_o > 0 and placed_r < int(0.9 * placed_o):
+        return (
+            f"seed {seed}: rounds quality {placed_r}/{placed_o} "
+            f"below 90% of sequential"
+        )
+    return None
+
+
+def main():
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
+    scan_cycle = build_cycle_fn(commit_mode="scan")
+    rounds_cycle = build_cycle_fn(commit_mode="rounds")
+    # ONE encoder + fixed padding: interning dims stabilize after the first
+    # few cases, so each engine compiles a handful of times, not per case
+    enc = SnapshotEncoder(pad_pods=128, pad_nodes=64)
+    deadline = time.time() + minutes * 60
+    seed = 10_000
+    failures = 0
+    while time.time() < deadline:
+        msg = one_case(seed, scan_cycle, rounds_cycle, enc)
+        if msg:
+            failures += 1
+            print("FAIL:", msg, flush=True)
+            if failures >= 5:
+                break
+        seed += 1
+        if (seed - 10_000) % 25 == 0:
+            print(f"  {seed - 10_000} cases, {failures} failures", flush=True)
+    print(f"done: {seed - 10_000} cases, {failures} failures")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -78,3 +78,45 @@ def test_stable_state_injection_matches():
     assert np.array_equal(
         np.asarray(out_u.reject_counts), np.asarray(out_p.reject_counts)
     )
+
+
+def test_stable_state_reused_across_pending_changes():
+    """The production contract: stable state computed from snapshot A is
+    valid for snapshot B when only the PENDING side changed — a stable_fn
+    entry that accidentally read pending-side data would fail this."""
+    from k8s_scheduler_tpu.core import build_stable_state_fn
+
+    nodes = make_cluster(20, taint_fraction=0.2, cpu_choices=(4,))
+    existing = [
+        (p, f"node-{i % 20}")
+        for i, p in enumerate(make_pods(40, seed=12, name_prefix="run"))
+    ]
+    enc = SnapshotEncoder()
+    pods_a = make_pods(
+        60, seed=21, affinity_fraction=0.3, anti_affinity_fraction=0.2,
+        spread_fraction=0.2, num_apps=6,
+    )
+    snap_a = enc.encode(nodes, pods_a, existing)
+    spec = packing.make_spec(snap_a)
+    wa, ba = packing.pack(snap_a, spec)
+    st_a = build_stable_state_fn(spec)(wa, ba)
+
+    pods_b = make_pods(
+        60, seed=22, affinity_fraction=0.3, anti_affinity_fraction=0.2,
+        spread_fraction=0.2, num_apps=6,
+    )
+    snap_b = enc.encode(nodes, pods_b, existing)
+    spec_b = packing.make_spec(snap_b)
+    assert spec_b.key() == spec.key(), "fixture must stay in one regime"
+    wb, bb = packing.pack(snap_b, spec_b)
+
+    cycle = build_packed_cycle_fn(spec, commit_mode="rounds")
+    out_fresh = cycle(wb, bb, build_stable_state_fn(spec)(wb, bb))
+    out_reused = cycle(wb, bb, st_a)  # snapshot A's stable state
+    assert np.array_equal(
+        np.asarray(out_fresh.assignment), np.asarray(out_reused.assignment)
+    )
+    assert np.array_equal(
+        np.asarray(out_fresh.reject_counts),
+        np.asarray(out_reused.reject_counts),
+    )
