@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from . import labels as labels_ops
+
 _MIN_IMG = 23.0 * 2**20  # minThreshold: images below this don't move the score
 _MAX_IMG = 1.0 * 2**30  # maxThreshold: cap per upstream maxContainerThreshold
 
@@ -34,4 +36,5 @@ def image_locality_score(snap) -> jnp.ndarray:  # f32 [P, N] in [0, 100]
     have = node_imgs @ weighted.T  # [N, Is]  (MXU)
     clipped = jnp.clip(have, _MIN_IMG, _MAX_IMG)
     table = (clipped - _MIN_IMG) / (_MAX_IMG - _MIN_IMG) * 100.0  # [N, Is]
-    return table.T[snap.pod_imageset]  # [P, N]
+    # per-pod pick as a one-hot MXU matmul (row-gathers are slow here)
+    return labels_ops.take_rows(table.T, snap.pod_imageset, 0.0)  # [P, N]

@@ -22,9 +22,38 @@ the key is absent; Gt/Lt require a numerically-parsable label value.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..models import encoding as enc
+
+
+def take_rows(table: jnp.ndarray, ids: jnp.ndarray, fill) -> jnp.ndarray:
+    """table[ids] for a [X, N] table and [P] ids (-1 -> `fill`), as a
+    one-hot [P, X] @ [X, N] matmul on the MXU.
+
+    Arbitrary-row gathers at [P, N] scale cost ~2.5ms each on this
+    backend where the equivalent one-hot matmul costs ~0.3ms (see the
+    measured numbers in ops/rounds.py's guard-table notes); X (distinct
+    table rows) is always small. Bool tables ride a DEFAULT-precision dot
+    (0/1 exact in bf16); f32 tables use Precision.HIGH (bf16_3x splits
+    represent any f32 exactly, and each one-hot row has a single nonzero,
+    so there is no accumulation error)."""
+    X = table.shape[0]
+    oh = (
+        jnp.clip(ids, 0, X - 1)[:, None]
+        == jnp.arange(X, dtype=ids.dtype)[None, :]
+    )
+    if table.dtype == jnp.bool_:
+        out = jax.lax.dot(
+            oh.astype(jnp.float32), table.astype(jnp.float32),
+            precision=jax.lax.Precision.DEFAULT,
+        ) > 0.5
+    else:
+        out = jax.lax.dot(
+            oh.astype(jnp.float32), table, precision=jax.lax.Precision.HIGH
+        )
+    return jnp.where((ids >= 0)[:, None], out, fill)
 
 
 def expr_match(
@@ -133,12 +162,9 @@ def pod_requirement_mask(snap, expr_mask: jnp.ndarray) -> jnp.ndarray:
     (NodeAffinity Filter + the separate nodeSelector field are ANDed,
     matching upstream.)"""
     req = requirement_mask(snap.rq_exprs, expr_mask)  # [Rq, N]
-
-    def per_pod(ids):
-        safe = jnp.clip(ids, 0, req.shape[0] - 1)
-        return jnp.where((ids >= 0)[:, None], req[safe], True)
-
-    return per_pod(snap.pod_req_id) & per_pod(snap.pod_sel_req_id)
+    return take_rows(req, snap.pod_req_id, True) & take_rows(
+        req, snap.pod_sel_req_id, True
+    )
 
 
 def preferred_score(snap, expr_mask: jnp.ndarray) -> jnp.ndarray:
@@ -157,7 +183,4 @@ def preferred_score(snap, expr_mask: jnp.ndarray) -> jnp.ndarray:
     matched = jnp.sum(w[:, :, None] * term_ok, axis=1)  # [Pf, N]
     total = jnp.maximum(jnp.sum(w, axis=1), 1e-9)[:, None]  # [Pf, 1]
     table = matched / total * 100.0  # [Pf, N]
-
-    ids = snap.pod_pref_id
-    safe = jnp.clip(ids, 0, table.shape[0] - 1)
-    return jnp.where((ids >= 0)[:, None], table[safe], 0.0)  # [P, N]
+    return take_rows(table, snap.pod_pref_id, 0.0)  # [P, N]

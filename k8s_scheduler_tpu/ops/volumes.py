@@ -42,8 +42,7 @@ def volume_mask(snap, expr_mask: jnp.ndarray) -> jnp.ndarray:  # bool [P, N]
     MVol = snap.pod_vol_mode.shape[1]
 
     def req_rows(ids):  # i32 [X] -> bool [X, N]; id < 0 -> all-True
-        r = req[jnp.clip(ids, 0, Rq - 1)]
-        return jnp.where((ids >= 0)[:, None], r, True)
+        return labels_ops.take_rows(req, ids, True)
 
     pv_node_ok = req_rows(snap.pv_req_id) & snap.pv_avail[:, None]  # [V, N]
 

@@ -1,0 +1,100 @@
+"""Trace the PRODUCTION config-#4 path: packed buffers + injected stable
+state + preemption chain — the same programs bench_suite times.
+
+Run:  python scripts/trace_packed4.py [cfg]
+"""
+
+import collections
+import glob
+import gzip
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
+from k8s_scheduler_tpu.core import (
+    build_packed_cycle_fn,
+    build_packed_preemption_fn,
+    build_stable_state_fn,
+)
+from k8s_scheduler_tpu.models import SnapshotEncoder, packing
+
+
+def main():
+    cfg = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    P_real, N_real = CONFIG_SHAPES[cfg]
+    enc = SnapshotEncoder(pad_pods=_pad(P_real), pad_nodes=_pad(N_real))
+    bn, be = make_config_base(cfg)
+    _n, pods, _e, groups = make_config_workload(cfg, seed=1000)
+    snap = enc.encode(bn, pods, be, groups)
+    spec = packing.make_spec(snap)
+    w, b = packing.pack(snap, spec)
+    w = jax.device_put(w)
+    b = jax.device_put(b)
+    cycle = build_packed_cycle_fn(spec, commit_mode="rounds")
+    pre = build_packed_preemption_fn(spec) if cfg == 4 else None
+    stable_fn = build_stable_state_fn(spec)
+    stable = stable_fn(w, b)
+    out = cycle(w, b, stable)
+    np.asarray(out.assignment)
+    if pre is not None:
+        np.asarray(pre(w, b, out).nominated)
+
+    import shutil
+
+    shutil.rmtree("/tmp/jaxtrace2", ignore_errors=True)
+    with jax.profiler.trace("/tmp/jaxtrace2"):
+        for _ in range(3):
+            out = cycle(w, b, stable)
+            if pre is not None:
+                pr = pre(w, b, out)
+        np.asarray(out.assignment)
+        if pre is not None:
+            np.asarray(pr.nominated)
+
+    hlo = cycle.lower(w, b, stable).compile().as_text()
+    src_of = {}
+    for line in hlo.splitlines():
+        line = line.strip()
+        if not line.startswith("%") or "metadata=" not in line:
+            continue
+        name = line.split(" ", 1)[0].lstrip("%")
+        m = ""
+        if 'op_name="' in line:
+            m = line.split('op_name="', 1)[1].split('"', 1)[0]
+        f = ""
+        if 'source_file="' in line:
+            f = line.split('source_file="', 1)[1].split('"', 1)[0].split("/")[-1]
+            if 'source_line=' in line:
+                f += ":" + line.split("source_line=", 1)[1].split(" ", 1)[0]
+        src_of[name] = f"{m} {f}"
+
+    tr = sorted(glob.glob("/tmp/jaxtrace2/plugins/profile/*/*.trace.json.gz"))[-1]
+    d = json.load(gzip.open(tr))
+    evs = d.get("traceEvents", [])
+    pids = {}
+    for e in evs:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e["pid"]] = e["args"].get("name", "")
+    agg = collections.Counter()
+    cnt = collections.Counter()
+    for e in evs:
+        if e.get("ph") == "X" and "dur" in e and "TPU" in pids.get(e["pid"], ""):
+            agg[e["name"]] += e["dur"]
+            cnt[e["name"]] += 1
+    total = 0
+    for n, v in agg.most_common(45):
+        if n.startswith("jit_"):
+            print(f"{v/3e3:9.2f} ms/rep x{cnt[n]//3:5d}  {n}")
+            continue
+        total += v
+        print(f"{v/3e3:9.2f} ms/rep x{cnt[n]//3:5d}  {n[:28]:28s} {src_of.get(n, '')[:80]}")
+    print(f"(sum of listed non-jit ops: {total/3e3:.1f} ms)")
+
+
+if __name__ == "__main__":
+    main()

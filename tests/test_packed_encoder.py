@@ -1,0 +1,191 @@
+"""Differential tests for SnapshotEncoder.encode_packed — the delta-arena
+fast path must be indistinguishable (field-for-field) from a full
+encode()+pack() for ANY snapshot sequence: churn, pending-count changes,
+dictionary growth, stable-side changes, in-place nomination updates.
+
+Methodology (SURVEY.md §4, build-side additions): two encoders consume the
+identical object sequence; encoder A uses encode_packed (exercising the
+delta path wherever its prechecks allow), encoder B always full-encodes.
+Unpacking A's arena buffers must reproduce B's snapshot exactly.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from k8s_scheduler_tpu.models import MakeNode, MakePod, SnapshotEncoder, packing
+from k8s_scheduler_tpu.models.api import PodGroup
+from k8s_scheduler_tpu.models.encoding import ClusterSnapshot
+from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+
+def assert_same_snapshot(got: ClusterSnapshot, ref: ClusterSnapshot):
+    for f in dataclasses.fields(ClusterSnapshot):
+        rv = getattr(ref, f.name)
+        gv = getattr(got, f.name)
+        if rv is None and gv is None:
+            continue
+        if isinstance(rv, np.ndarray) or hasattr(rv, "dtype"):
+            ga, ra = np.asarray(gv), np.asarray(rv)
+            assert ga.shape == ra.shape, f.name
+            eq = (
+                np.array_equal(ga, ra, equal_nan=True)
+                if ga.dtype.kind == "f"
+                else np.array_equal(ga, ra)
+            )
+            assert eq, f"field {f.name} differs"
+        else:
+            assert rv == gv, f"aux {f.name}: {gv!r} != {rv!r}"
+
+
+class Driver:
+    """Feeds the same objects to the packed and the reference encoder."""
+
+    def __init__(self, pad_pods=128, pad_nodes=16):
+        self.a = SnapshotEncoder(pad_pods=pad_pods, pad_nodes=pad_nodes)
+        self.b = SnapshotEncoder(pad_pods=pad_pods, pad_nodes=pad_nodes)
+
+    def step(self, nodes, pending, existing=(), groups=(), mutated=frozenset(),
+             **kw):
+        w, bb, spec, vsnap = self.a.encode_packed(
+            nodes, pending, existing, groups, mutated_ids=mutated, **kw
+        )
+        ref = self.b.encode(nodes, pending, existing, groups, **kw)
+        got = packing.unpack(np.asarray(w), np.asarray(bb), spec)
+        assert_same_snapshot(got, ref)
+        # the view snapshot must alias the arena (same data, same ids)
+        assert vsnap.pod_requested.base is not None
+        return spec
+
+
+def test_packed_equals_full_over_churned_sequence():
+    rng = np.random.default_rng(0)
+    nodes = make_cluster(10)
+    d = Driver()
+    pending = make_pods(
+        60, seed=1, affinity_fraction=0.3, anti_affinity_fraction=0.2,
+        spread_fraction=0.2, selector_fraction=0.3, num_apps=6,
+        priorities=(0, 10),
+    )
+    existing = [(p, f"node-{i % 10}") for i, p in enumerate(
+        make_pods(20, seed=2, name_prefix="run", affinity_fraction=0.2,
+                  num_apps=6)
+    )]
+    specs = set()
+    for i in range(8):
+        # churn ~25% with fresh objects (fresh names/apps grow dictionaries
+        # in early rounds -> full path; later rounds hit the delta path)
+        k = 15
+        idx = rng.choice(len(pending), size=k, replace=False)
+        fresh = make_pods(
+            k, seed=100 + i, name_prefix=f"p{i}-", affinity_fraction=0.3,
+            spread_fraction=0.2, selector_fraction=0.3, num_apps=6,
+            priorities=(0, 10),
+        )
+        for j, f in zip(idx, fresh):
+            pending[j] = f
+        specs.add(d.step(nodes, pending, existing).key())
+    assert len(specs) == 1  # sticky dims: no packed-regime churn
+
+
+def test_packed_pending_count_changes():
+    nodes = make_cluster(4)
+    d = Driver()
+    pods = make_pods(40, seed=3)
+    d.step(nodes, pods)
+    d.step(nodes, pods[:25])  # shrink
+    d.step(nodes, pods[:25] + make_pods(10, seed=4, name_prefix="n"))  # grow
+    d.step(nodes, [])  # empty pending
+
+
+def test_packed_detects_stable_change():
+    d = Driver()
+    nodes = make_cluster(4)
+    pods = make_pods(20, seed=5)
+    d.step(nodes, pods, [(pods[0], "node-0")])
+    # node list replaced -> full path, still exact
+    nodes2 = make_cluster(5)
+    d.step(nodes2, pods, [(pods[0], "node-0")])
+    # existing set changed -> full path, still exact
+    d.step(nodes2, pods, [(pods[0], "node-1"), (pods[1], "node-2")])
+
+
+def test_packed_nominated_mutation_reported():
+    d = Driver()
+    nodes = make_cluster(4)
+    pods = make_pods(20, seed=6)
+    d.step(nodes, pods)
+    # in-place nomination (what the serving driver does after preemption)
+    pods[3].nominated_node_name = "node-2"
+    d.step(nodes, pods, mutated=frozenset({id(pods[3])}))
+
+
+def test_packed_gangs_and_ports_and_pins():
+    d = Driver()
+    nodes = make_cluster(6)
+    pods = [
+        MakePod(f"g-{i}").req({"cpu": "500m"}).group("job-a")
+        .created(float(i)).obj()
+        for i in range(4)
+    ]
+    pods.append(
+        MakePod("portpod").req({"cpu": "100m"}).host_port(8080).obj()
+    )
+    pods.append(MakePod("pinned").req({"cpu": "100m"}).node("node-2").obj())
+    groups = [PodGroup("job-a", 3)]
+    d.step(nodes, pods, groups=groups)
+    # churn the port pod (new distinct port within the sticky Q pad)
+    pods[4] = MakePod("portpod2").req({"cpu": "100m"}).host_port(8081).obj()
+    d.step(nodes, pods, groups=groups)
+    # group min_member change flows through the delta path
+    d.step(nodes, pods, groups=[PodGroup("job-a", 4)])
+
+
+def test_arena_survives_async_dispatch_mutation():
+    """The arena contract: JAX copies host buffers synchronously at call
+    time, so rewriting the arena for cycle i+1 while cycle i is in flight
+    must not corrupt cycle i's inputs."""
+    import jax
+
+    d = SnapshotEncoder(pad_pods=64, pad_nodes=8)
+    nodes = make_cluster(4)
+    pods = make_pods(30, seed=7)
+    w, b, spec, _ = d.encode_packed(nodes, pods)
+
+    @jax.jit
+    def digest(wb, bb):
+        return (wb % 9973).sum(), (bb.astype("int32")).sum()
+
+    out = digest(w, b)
+    ref = (int(np.asarray(out[0])), int(np.asarray(out[1])))
+    for i in range(5):
+        out = digest(w, b)
+        # mutate immediately (the next cycle's delta writes)
+        pods2 = list(pods)
+        pods2[0] = MakePod(f"mut-{i}").req({"cpu": "250m"}).obj()
+        d.encode_packed(nodes, pods2)
+        got = (int(np.asarray(out[0])), int(np.asarray(out[1])))
+        assert got == ref  # the in-flight dispatch saw pre-mutation bytes
+        # restore and re-encode for the next iteration's baseline
+        w, b, spec, _ = d.encode_packed(nodes, pods)
+        out = digest(w, b)
+        ref = (int(np.asarray(out[0])), int(np.asarray(out[1])))
+
+
+def test_sticky_dims_do_not_shrink():
+    enc = SnapshotEncoder(pad_pods=32, pad_nodes=8)
+    nodes = make_cluster(2)
+    many_labels = MakePod("lab").labels(
+        {f"k{i}": f"v{i}" for i in range(12)}
+    ).req({"cpu": "1"}).obj()
+    s1 = enc.encode(nodes, [many_labels])
+    mpl = s1.pod_label_keys.shape[1]
+    s2 = enc.encode(nodes, [MakePod("tiny").req({"cpu": "1"}).obj()])
+    assert s2.pod_label_keys.shape[1] == mpl
+
+
+if __name__ == "__main__":
+    import sys
+
+    pytest.main([__file__, "-v"] + sys.argv[1:])
