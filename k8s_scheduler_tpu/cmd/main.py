@@ -63,6 +63,11 @@ def main(argv: list[str] | None = None) -> int:
     # multi-host (DCN) runtime: a no-op unless the launcher set the JAX
     # coordinator env vars (parallel/mesh.py initialize_distributed)
     from ..parallel.mesh import initialize_distributed
+    from ..utils.compilation_cache import enable_compilation_cache
+
+    # persistent XLA cache: a restarted (or failed-over) scheduler reuses
+    # compiled cycle programs instead of paying the 100s+ first compile
+    enable_compilation_cache()
 
     initialize_distributed()
 

@@ -1,6 +1,9 @@
 from .cycle import (  # noqa: F401
     CycleResult,
+    build_carry_fns,
     build_cycle_fn,
+    build_diagnosis_fn,
+    build_packed_cycle_carry_fn,
     build_packed_cycle_fn,
     build_packed_preemption_fn,
     build_preemption_fn,

@@ -39,15 +39,11 @@ class CycleResult:
     node_requested: jnp.ndarray  # f32 [N, R] post-cycle
     unschedulable: jnp.ndarray  # bool [P] valid pod that found no node
     gang_dropped: jnp.ndarray  # bool [P] placed, then unwound (group failed)
-    preempt_gate: jnp.ndarray  # bool [P, N]: the PostFilter candidate
-    # mask — static feasibility (WITHOUT the node-sampling window;
-    # preemption considers every node, as upstream findCandidates does)
-    # AND the NodePorts dynamic mask against the FINAL post-commit state.
-    # Ports gate because a port claimed by a this-cycle winner cannot be
-    # freed by evicting existing pods — nominating there wastes the
-    # eviction. Affinity/spread dynamic masks deliberately do NOT gate:
-    # evicting matching victims lowers the domain counts, so those
-    # constraints can genuinely clear by the next cycle.
+    # NOTE: the PostFilter candidate gate is no longer a cycle output —
+    # the preemption program computes its own per-candidate static gate
+    # (all static filters EXCEPT NodePorts, whose existing-pod conflicts
+    # eviction can free) and checks every evictable constraint per victim
+    # prefix itself (ops/preemption.py).
     reject_counts: jnp.ndarray  # i32 [P, F] nodes first-rejected per filter
     # (static + dynamic attribution summed; columns = Framework.filter_names)
     # — feeds FailedScheduling events and requeue queueing hints
@@ -92,6 +88,30 @@ def sampling_mask(snap: ClusterSnapshot, pct: int) -> jnp.ndarray:
     win = (col - off[:, None]) % jnp.maximum(n, 1)
     # clusters under the floor consider every node (win < k always)
     return win < k
+
+
+def _gang_unwind(snap: ClusterSnapshot, result):
+    """All-or-nothing gang rollback (Coscheduling analogue, SURVEY.md §2
+    C14): groups whose placed-this-cycle count plus already-running
+    members stays below minMember get every this-cycle placement
+    unwound. Returns (result, dropped bool [P])."""
+    placed = snap.pod_valid & (result.assignment >= 0)
+    G = snap.group_min_member.shape[0]
+    gid = jnp.clip(snap.pod_group, 0, G - 1)
+    in_group = snap.pod_group >= 0
+    # minMember counts this cycle's placements PLUS members already
+    # running (a gang member retried alone after a bind error must not
+    # be unwound while its siblings run)
+    counts = snap.group_existing_count + jnp.zeros(G, jnp.int32).at[
+        gid
+    ].add(jnp.where(in_group & placed, 1, 0))
+    # minMember defaults to 0 for undeclared groups -> never fails
+    fail = counts < snap.group_min_member
+    dropped = in_group & fail[gid] & placed
+    result = commit_ops.unwind_assignments(
+        result, dropped, snap.pod_requested
+    )
+    return result, dropped
 
 
 def build_cycle_fn(
@@ -255,51 +275,11 @@ def build_cycle_fn(
             )
         dropped = jnp.zeros_like(snap.pod_valid)
         if gang_scheduling:
-            placed = snap.pod_valid & (result.assignment >= 0)
-            G = snap.group_min_member.shape[0]
-            gid = jnp.clip(snap.pod_group, 0, G - 1)
-            in_group = snap.pod_group >= 0
-            # minMember counts this cycle's placements PLUS members already
-            # running (a gang member retried alone after a bind error must
-            # not be unwound while its siblings run)
-            counts = snap.group_existing_count + jnp.zeros(G, jnp.int32).at[
-                gid
-            ].add(jnp.where(in_group & placed, 1, 0))
-            # minMember defaults to 0 for undeclared groups -> never fails
-            fail = counts < snap.group_min_member
-            dropped = in_group & fail[gid] & placed
-            result = commit_ops.unwind_assignments(
-                result, dropped, snap.pod_requested
-            )
+            result, dropped = _gang_unwind(snap, result)
         unsched = snap.pod_valid & (result.assignment < 0)
 
-        # PostFilter candidate gate (see CycleResult.preempt_gate): static
-        # without sampling, plus the final-state NodePorts dynamic mask.
-        # Rounds mode builds gate rows from the compacted unplaced view
-        # (placed pods are never preemption candidates, so their rows are
-        # simply False); scan mode pays one batched pass — it targets
-        # small pending sets.
-        if commit_mode == "rounds":
-            grows = smask_all_nodes[ugid]
-            for f, m in zip(fw.filters, upf):
-                if m is not None and f.name == "NodePorts":
-                    grows = grows & m
-            gate = (
-                jnp.zeros((snap.P, snap.N), bool)
-                .at[ugid]
-                .max(grows & uact[:, None])
-            )
-        else:
-            _m, _s, per_filter_final = fw.dyn_batched(
-                ctx, result.node_requested, result.extra, smask
-            )
-            gate = smask_all_nodes
-            for f, m in zip(fw.filters, per_filter_final):
-                if m is not None and f.name == "NodePorts":
-                    gate = gate & m
-
         return CycleResult(
-            result.assignment, result.node_requested, unsched, dropped, gate,
+            result.assignment, result.node_requested, unsched, dropped,
             srejects + result.dyn_aux, rounds_used, accepted_per_round,
             diag_per_round,
         )
@@ -352,17 +332,338 @@ def build_stable_state_fn(spec):
     return stable
 
 
-def build_packed_preemption_fn(spec, framework: Framework | None = None):
-    """Packed-input variant of build_preemption_fn (same motivation)."""
+def build_carry_fns(spec, framework: Framework | None = None):
+    """Device-resident static-phase carry: the [P, N] combined static
+    base (score where feasible, NEG_INF where not) and the [S, P]
+    matched-pending table persist on device ACROSS cycles, and each cycle
+    only recomputes the rows whose pod object changed (the encoder's
+    delta path already tracks exactly that set).
+
+    Validity: both tables depend only on pod rows x node-side tables x
+    interning dictionaries — NOT on existing-pod state — so they stay
+    correct across cycles in real serving; any node/dict/stable change
+    runs the encoder's full path, and the host rebuilds the carry with
+    carry_init. Returns (carry_init, carry_update_for_bucket) where the
+    latter memoizes one jitted update program per dirty-count bucket."""
+    import functools
+
+    from ..models import packing
+    from ..ops import interpod as interpod_ops
+
+    fw = framework or Framework.from_config()
+
+    def _static_base(ctx):
+        mask, score = fw.static_lean(ctx)
+        return jnp.where(
+            mask, jnp.clip(score, -1e6, 1e6), rounds_ops.NEG_INF
+        )
+
+    @jax.jit
+    def carry_init(wbuf, bbuf, stable):
+        snap = packing.unpack(wbuf, bbuf, spec)
+        ctx = CycleContext(snap)
+        ctx._cache.update(stable)
+        return {
+            "sbase": _static_base(ctx),
+            "mp": ctx.matched_pending,
+        }
+
+    update_memo: dict[int, Callable] = {}
+
+    def carry_update_for_bucket(n_bucket: int):
+        hit = update_memo.get(n_bucket)
+        if hit is None:
+
+            @functools.partial(jax.jit, donate_argnums=(3,))
+            def carry_update(wbuf, bbuf, stable, carry, dirty):
+                # dirty: i32 [n_bucket] slot ids; pad entries repeat a
+                # real slot (identical rewrite, harmless)
+                snap = packing.unpack(wbuf, bbuf, spec)
+                vsnap = rounds_ops._pod_view(snap, dirty)
+                vctx = CycleContext(vsnap)
+                vctx._cache.update(stable)
+                rows = _static_base(vctx)  # [Bd, N]
+                cols = interpod_ops.matched_pending(vsnap)  # [S, Bd]
+                return {
+                    "sbase": carry["sbase"].at[dirty].set(rows),
+                    "mp": carry["mp"].at[:, dirty].set(cols),
+                }
+
+            update_memo[n_bucket] = carry_update
+            hit = carry_update
+        return hit
+
+    return carry_init, carry_update_for_bucket
+
+
+class CarryKeeper:
+    """Host-side carry maintenance shared by the bench and the serving
+    scheduler: one FIXED dirty-bucket size (so exactly one update program
+    compiles, warmable up front), full rebuild via carry_init whenever
+    the regime key changes, the encode was full, or the dirty set
+    exceeds the bucket."""
+
+    def __init__(self, spec, framework: Framework | None = None):
+        import numpy as np
+
+        self._np = np
+        self.spec = spec
+        self.ci, self._cu = build_carry_fns(spec, framework)
+        P = None
+        for name, _dt, shape, _off in spec.words:
+            if name == "pod_priority":
+                P = shape[0]
+                break
+        self.P = P
+        self.bucket = min(P, 1 << (max(256, P // 4) - 1).bit_length())
+        self.key = None
+        self.carry = None
+
+    def warm(self, wbuf, bbuf, stable):
+        """Compile both carry programs outside any timed window."""
+        c = self.ci(wbuf, bbuf, stable)
+        idx = self._np.zeros(self.bucket, self._np.int32)
+        self._cu(self.bucket)(wbuf, bbuf, stable, c, idx)
+        self.key = None  # force a clean rebuild on first real use
+
+    def state(self, wbuf, bbuf, stable, dirty, regime_key):
+        np = self._np
+        if (
+            self.key != regime_key
+            or dirty is None
+            or len(dirty) > self.bucket
+        ):
+            self.carry = self.ci(wbuf, bbuf, stable)
+            self.key = regime_key
+        elif len(dirty):
+            idx = np.full(self.bucket, dirty[0], np.int32)
+            idx[: len(dirty)] = dirty
+            self.carry = self._cu(self.bucket)(
+                wbuf, bbuf, stable, self.carry, idx
+            )
+        return self.carry
+
+
+def build_packed_cycle_carry_fn(
+    spec,
+    framework: Framework | None = None,
+    gang_scheduling: bool = True,
+    max_rounds: int = 64,
+    percentage_of_nodes_to_score: int = 0,
+    rounds_kw: dict | None = None,  # compact/passes/passes_round0 overrides
+):
+    """The LATENCY-PATH cycle: packed buffers in, carry (see
+    build_carry_fns) in, decisions out. Differences from build_cycle_fn:
+
+      - the static [P, N] base and matched-pending arrive precomputed in
+        the carry (delta-maintained across cycles) instead of being
+        rebuilt per cycle;
+      - no per-filter reject attribution and no final-state dynamic
+        attribution pass — FailedScheduling diagnosis moved OFF the
+        decision path into build_diagnosis_fn, which the driver runs
+        asynchronously after bindings go out (reject_counts is zeros
+        here);
+      - no preemption gate output: the preemption program computes its
+        own per-candidate static gate (_preemption_gate_rows) and
+        checks what eviction can actually free itself.
+
+    Rounds commit only (the scan engine keeps the classic path)."""
     from ..models import packing
 
-    pre = build_preemption_fn(framework)
-    if pre is None:
+    fw = framework or Framework.from_config()
+    fw.check_batched_parity()
+
+    @jax.jit
+    def cycle(wbuf, bbuf, stable, carry) -> CycleResult:
+        snap = packing.unpack(wbuf, bbuf, spec)
+        ctx = CycleContext(snap)
+        ctx._cache.update(stable)
+        ctx._cache["matched_pending"] = carry["mp"]
+        sbase_all = carry["sbase"]
+        if snap.has_extender:
+            sbase_all = jnp.where(
+                snap.pod_extender_mask,
+                sbase_all + snap.pod_extender_score,
+                rounds_ops.NEG_INF,
+            )
+        sbase = sbase_all
+        if percentage_of_nodes_to_score < 100:
+            sbase = jnp.where(
+                sampling_mask(snap, percentage_of_nodes_to_score),
+                sbase_all,
+                rounds_ops.NEG_INF,
+            )
+        extra = fw.extra_init(ctx)
+
+        def view_ctx(vsnap, vmp):
+            vctx = CycleContext(vsnap)
+            vctx._cache.update(ctx._cache)
+            vctx._cache["matched_pending"] = vmp
+            return vctx
+
+        rres = rounds_ops.rounds_commit(
+            snap=snap,
+            sbase=sbase,
+            m_pending=carry["mp"],
+            dyn_batched_view_fn=lambda vs, vmp, nr, ex, vsm: fw.dyn_batched(
+                view_ctx(vs, vmp), nr, ex, vsm
+            ),
+            update_batched_view_fn=lambda vs, vmp, ex, acc, nod: (
+                fw.extra_update_batched(view_ctx(vs, vmp), ex, acc, nod)
+            ),
+            extra=extra,
+            max_rounds=max_rounds,
+            score_anchor_fn=lambda nr: fw.score_anchor(ctx, nr),
+            **(rounds_kw or {}),
+        )
+        result = commit_ops.CommitResult(
+            assignment=rres.assignment,
+            node_requested=rres.node_requested,
+            extra=rres.extra,
+            dyn_aux=jnp.zeros((snap.P, len(fw.filters)), jnp.int32),
+        )
+        dropped = jnp.zeros_like(snap.pod_valid)
+        if gang_scheduling:
+            result, dropped = _gang_unwind(snap, result)
+        unsched = snap.pod_valid & (result.assignment < 0)
+        return CycleResult(
+            result.assignment, result.node_requested, unsched, dropped,
+            result.dyn_aux, rres.rounds_used,
+            rres.accepted_per_round, rres.diag_per_round,
+        )
+
+    return cycle
+
+
+def build_diagnosis_fn(spec, framework: Framework | None = None,
+                       window: int = 2048):
+    """The DIAGNOSIS program: full FailedScheduling attribution for every
+    unplaced pod, computed off the decision path (VERDICT r2 item 5 —
+    no pod ever gets blank reasons, regardless of how many are
+    unschedulable).
+
+    (wbuf, bbuf, stable, assignment, node_requested) -> i32 [P, F]
+    first-rejector counts (static + dynamic-vs-final-state), rows
+    nonzero only for valid unplaced pods. Iterates rank-ordered windows
+    of `window` pods under lax.while_loop, so cost scales with the
+    number of unplaced pods, not with P."""
+    from ..models import packing
+    from ..ops import rounds as r_ops
+
+    fw = framework or Framework.from_config()
+    F = len(fw.filters)
+
+    @jax.jit
+    def diagnose(wbuf, bbuf, stable, assignment, node_requested):
+        snap = packing.unpack(wbuf, bbuf, spec)
+        P = snap.P
+        B = min(window, P)
+        ctx = CycleContext(snap)
+        ctx._cache.update(stable)
+        mp = ctx.matched_pending
+        extra = fw.extra_init(ctx)
+        placed = snap.pod_valid & (assignment >= 0)
+        extra = fw.extra_update_batched(
+            ctx, extra, placed, jnp.where(placed, assignment, 0)
+        )
+        unplaced = snap.pod_valid & (assignment < 0)
+        n_un = jnp.sum(unplaced, dtype=jnp.int32)
+        order = jnp.argsort(
+            jnp.where(unplaced, snap.pod_order.astype(jnp.int32),
+                      jnp.int32(2**31 - 1))
+        ).astype(jnp.int32)
+
+        def body(carry):
+            rej, w = carry
+            start = jnp.minimum(w * B, P - B)
+            ids = jax.lax.dynamic_slice(order, (start,), (B,))
+            act = unplaced[ids]
+            vsnap = r_ops._pod_view(snap, ids)
+            vctx = CycleContext(vsnap)
+            vctx._cache.update(ctx._cache)
+            vctx._cache["matched_pending"] = mp[:, ids]
+            base = jnp.broadcast_to(
+                snap.node_valid[None, :], (B, snap.N)
+            )
+            per_static = [f.static_mask(vctx) for f in fw.filters]
+            srej = fw.attribute_rejects(base, per_static, rows=act)
+            smask_v = base
+            for m in per_static:
+                if m is not None:
+                    smask_v = smask_v & m
+            _m, _s, per_dyn = fw.dyn_batched(
+                vctx, node_requested, extra, smask_v
+            )
+            drej = fw.attribute_rejects(smask_v, per_dyn, rows=act)
+            # windows can overlap at the tail (dynamic_slice clamps);
+            # values are per-pod deterministic, so max() is idempotent
+            rej = rej.at[ids].max(
+                jnp.where(act[:, None], srej + drej, 0)
+            )
+            return rej, w + 1
+
+        def cond(carry):
+            _, w = carry
+            return w * B < n_un
+
+        rej, _ = jax.lax.while_loop(
+            cond, body,
+            (jnp.zeros((P, F), jnp.int32), jnp.int32(0)),
+        )
+        return rej
+
+    return diagnose
+
+
+def _preemption_gate_rows(fw: Framework, ctx: CycleContext):
+    """Per-candidate static gate for preemption: every static filter
+    EXCEPT NodePorts (conflicts with existing pods' ports are exactly
+    what eviction can free; the what-if kernel checks them per victim
+    prefix). Returns gate_rows(ids i32 [C]) -> bool [C, N]."""
+
+    def gate_rows(ids):
+        snap = ctx.snap
+        vsnap = rounds_ops._pod_view(snap, ids)
+        vctx = CycleContext(vsnap)
+        vctx._cache.update(ctx._cache)
+        base = jnp.broadcast_to(
+            snap.node_valid[None, :], (ids.shape[0], snap.N)
+        )
+        for f in fw.filters:
+            if f.name == "NodePorts":
+                continue
+            m = f.static_mask(vctx)
+            if m is not None:
+                base = base & m
+        return base
+
+    return gate_rows
+
+
+def build_packed_preemption_fn(spec, framework: Framework | None = None):
+    """Packed-input variant of build_preemption_fn (same motivation).
+    Accepts the optional device-resident stable dict: the what-if kernel
+    reads the matched-existing/affinity-state tables, and seeding them
+    avoids an in-program recompute of the stable side."""
+    from ..models import packing
+
+    fw = framework or Framework.from_config()
+    if not fw.post_filters:
         return None
 
     @jax.jit
-    def packed(wbuf, bbuf, result):
-        return pre(packing.unpack(wbuf, bbuf, spec), result)
+    def packed(wbuf, bbuf, result, stable=None):
+        snap = packing.unpack(wbuf, bbuf, spec)
+        ctx = CycleContext(snap)
+        if stable is not None:
+            ctx._cache.update(stable)
+        return fw.post_filter(
+            ctx,
+            result.assignment,
+            result.node_requested,
+            _preemption_gate_rows(fw, ctx),
+            excluded=result.gang_dropped,
+        )
 
     return packed
 
@@ -384,7 +685,7 @@ def build_preemption_fn(framework: Framework | None = None):
             ctx,
             result.assignment,
             result.node_requested,
-            result.preempt_gate,
+            _preemption_gate_rows(fw, ctx),
             excluded=result.gang_dropped,
         )
 

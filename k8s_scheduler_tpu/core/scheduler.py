@@ -87,7 +87,19 @@ class Scheduler:
         host_plugins: "list | None" = None,
     ) -> None:
         self.config = config or SchedulerConfiguration()
-        self.framework = Framework.from_config(self.config)
+        # one Framework per profile (SURVEY.md §2 C12 / §5.6: multiple
+        # schedulers by schedulerName); pods route by
+        # pod.spec.scheduler_name, unknown names are parked loudly
+        names = [p.scheduler_name for p in self.config.profiles]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate profile schedulerNames: {names}")
+        self.frameworks = {
+            n: Framework.from_config(self.config, scheduler_name=n)
+            for n in names
+        }
+        self._profile_order = names
+        # back-compat alias: the first profile (tests/tools poke at it)
+        self.framework = self.frameworks[names[0]]
         self.cache = SchedulerCache(now=now)
         self.metrics = metrics or SchedulerMetrics()
         self.queue = SchedulingQueue(
@@ -103,7 +115,7 @@ class Scheduler:
         self.events = events or EventRecorder()
         self._now = now
         self._pad_bucket = pad_bucket
-        self._profile_name = self.config.profiles[0].scheduler_name
+        self._profile_name = self.config.profiles[0].scheduler_name  # legacy alias
         self._groups: dict[str, PodGroup] = {}
         self._pvcs: dict[str, object] = {}  # "ns/name" -> PVC
         self._pvs: dict[str, object] = {}  # name -> PV
@@ -119,10 +131,12 @@ class Scheduler:
         # schedule_cycle nominated (preemptors) and evicted (victims)
         self.last_nominations: list[tuple[Pod, str]] = []
         self.last_evictions: list[tuple[Pod, str]] = []
-        # ONE encoder for the scheduler's lifetime: interned string ids and
-        # the resource-name axis stay stable across cycles (the encoder's
-        # documented contract); only the pad sizes track the workload
-        self._encoder = SnapshotEncoder()
+        # ONE encoder per profile for the scheduler's lifetime: interned
+        # string ids and the resource-name axis stay stable across cycles
+        # (the encoder's documented contract), and each profile keeps its
+        # own delta arena (its pending subset is what carries over)
+        self._encoders = {n: SnapshotEncoder() for n in names}
+        self._encoder = self._encoders[names[0]]
         self._cycle_kw = dict(
             gang_scheduling=self.config.gang_scheduling,
             commit_mode=self.config.commit_mode,
@@ -136,30 +150,68 @@ class Scheduler:
         # bucket changes) reuse earlier compilations
         self._packed: dict = {}
         self._dev_stable: dict = {}
+        # carry mode (rounds only; extender verdicts replace snapshot
+        # fields, which the arena spec does not carry): the [P,N] static
+        # base + [S,P] matched-pending persist on device and are updated
+        # for the encoder-reported dirty rows; FailedScheduling reasons
+        # come from the separate diagnosis program, forced only when a
+        # loser actually needs them (off the bind-latency path)
+        self._use_carry = (
+            self.config.commit_mode == "rounds" and not self.extenders
+        )
+        # per-profile in-place-mutation reports (the delta arena must
+        # re-read a nominated pod's slot): one set per profile, cleared
+        # only by THAT profile's encode — a shared set would let profile
+        # A's encode wipe ids recorded for profile B's pods
+        self._nominated_mut: dict[str, set[int]] = {
+            n: set() for n in names
+        }
         # unpacked fallbacks, kept for tests/tools poking at the scheduler
         self._cycle = build_cycle_fn(self.framework, **self._cycle_kw)
         self._preempt = build_preemption_fn(self.framework)
 
-    def _packed_fns(self, spec):
-        key = spec.key()
+    def _packed_fns(self, spec, profile: str):
+        fw = self.frameworks[profile]
+        key = (spec.key(), profile)
         hit = self._packed.get(key)
         if hit is None:
+            if self._use_carry:
+                from .cycle import (
+                    CarryKeeper,
+                    build_diagnosis_fn,
+                    build_packed_cycle_carry_fn,
+                )
+
+                cyc = build_packed_cycle_carry_fn(
+                    spec, framework=fw,
+                    gang_scheduling=self._cycle_kw["gang_scheduling"],
+                    percentage_of_nodes_to_score=self._cycle_kw[
+                        "percentage_of_nodes_to_score"
+                    ],
+                )
+                keeper = CarryKeeper(spec, fw)
+                diag = build_diagnosis_fn(spec, fw)
+            else:
+                cyc = build_packed_cycle_fn(
+                    spec, framework=fw, **self._cycle_kw
+                )
+                keeper = diag = None
             hit = (
-                build_packed_cycle_fn(
-                    spec, framework=self.framework, **self._cycle_kw
-                ),
-                build_packed_preemption_fn(spec, self.framework),
+                cyc,
+                build_packed_preemption_fn(spec, fw),
                 build_stable_state_fn(spec),
+                keeper, diag,
             )
             self._packed[key] = hit
             # bounded: grow-only interning dimensions make old regimes
             # permanently dead — keep only the recent few (pad-bucket
             # flip-flops) instead of leaking compiled executables forever
-            while len(self._packed) > 4:
+            while len(self._packed) > 4 * len(self.frameworks):
                 self._packed.pop(next(iter(self._packed)))
         return hit
 
-    def _stable_state(self, spec, stable_fn, wbuf, bbuf):
+
+    def _stable_state(self, spec, stable_fn, wbuf, bbuf, encoder=None):
         """Device-resident stable-side precomputes, rerun only when the
         encoder's stable side (nodes / existing pods / dedup tables) or
         the packed-spec regime changes. A miss costs one extra ASYNC
@@ -172,13 +224,13 @@ class Scheduler:
         # raw id()s whose objects older memo entries would not pin, so a
         # recycled address could otherwise produce a false hit on stale
         # existing-pod tables
-        enc_st = getattr(self._encoder, "_stable", None)
+        enc_st = getattr(encoder or self._encoder, "_stable", None)
         key = (spec.key(), id(enc_st))
         hit = self._dev_stable.get(key)
         if hit is None or hit[0] is not enc_st:
             hit = (enc_st, stable_fn(wbuf, bbuf))
             self._dev_stable[key] = hit
-            while len(self._dev_stable) > 4:
+            while len(self._dev_stable) > 4 * len(self.frameworks):
                 self._dev_stable.pop(next(iter(self._dev_stable)))
         return hit[1]
 
@@ -258,7 +310,11 @@ class Scheduler:
     # ---- the cycle -------------------------------------------------------
 
     def schedule_cycle(self) -> CycleStats:
-        """One batched scheduling cycle over everything ready to run."""
+        """One batched scheduling cycle over everything ready to run.
+        Pods route to their profile's framework by
+        `pod.spec.scheduler_name` (upstream: multiple schedulers by
+        schedulerName); profiles run in declaration order within the
+        cycle, each seeing the previous profiles' assumptions."""
         t0 = self._now()
         stats = CycleStats()
         self.last_nominations = []
@@ -267,64 +323,157 @@ class Scheduler:
             self.queue.requeue_backoff(pod, event="AssumeExpired")
         self.queue.flush_unschedulable_timeout()
 
-        pending = self.queue.pop_ready()
-        if not pending:
+        pending_all = self.queue.pop_ready()
+        if not pending_all:
             # gauges must track deletions/moves that happen between
             # non-empty cycles, so update them on the empty path too
             self._update_gauges()
             return stats
-        stats.attempted = len(pending)
-        self.metrics.cycle_pods.observe(len(pending))
+        stats.attempted = len(pending_all)
+        self.metrics.cycle_pods.observe(len(pending_all))
 
+        by_prof: dict[str, list[Pod]] = {
+            n: [] for n in self._profile_order
+        }
+        for pod in pending_all:
+            name = pod.spec.scheduler_name or self._profile_order[0]
+            lst = by_prof.get(name)
+            if lst is None:
+                # a pod naming a scheduler this process does not serve is
+                # not ours to place — park it loudly instead of silently
+                # scheduling it under the wrong profile
+                self.events.failed_scheduling(
+                    pod,
+                    f"no profile named {name!r} in this scheduler",
+                )
+                self.queue.requeue_unschedulable(
+                    pod, reasons=("UnknownSchedulerName",)
+                )
+                stats.unschedulable += 1
+                self.metrics.observe_attempt(
+                    "unschedulable", self._now() - t0, name
+                )
+                continue
+            lst.append(pod)
+
+        for name in self._profile_order:
+            if by_prof[name]:
+                self._schedule_profile(name, by_prof[name], stats, t0)
+
+        stats.cycle_seconds = self._now() - t0
+        self.metrics.cycle_duration.labels(phase="total").observe(
+            stats.cycle_seconds
+        )
+        self._update_gauges()
+        return stats
+
+    def _schedule_profile(
+        self, profile: str, pending: list[Pod], stats: CycleStats,
+        t0: float,
+    ) -> None:
+        framework = self.frameworks[profile]
+        encoder = self._encoders[profile]
         nodes = self.cache.nodes()
         existing = self.cache.existing_pods()
         # bucketed pod/node padding keeps jit caches warm across cycles
-        self._encoder.pad_pods = _pad(len(pending), self._pad_bucket)
-        self._encoder.pad_nodes = _pad(len(nodes), self._pad_bucket)
-        snap = self._encoder.encode(
-            nodes, pending, existing,
+        encoder.pad_pods = _pad(len(pending), self._pad_bucket)
+        encoder.pad_nodes = _pad(len(nodes), self._pad_bucket)
+        kw = dict(
             pod_groups=list(self._groups.values()),
             pvcs=list(self._pvcs.values()),
             pvs=list(self._pvs.values()),
             storage_classes=list(self._storage_classes.values()),
             pdbs=list(self._pdbs.values()),
         )
-        extender_errors: dict[int, str] = {}
-        if self.extenders:
-            from ..framework.host import run_extender_prepass
-
-            emask, escore, extender_errors = run_extender_prepass(
-                self.extenders, pending, nodes
-            )
-            if emask is not None:
-                import dataclasses as _dc
-
-                full_mask = np.ones((snap.P, snap.N), bool)
-                full_score = np.zeros((snap.P, snap.N), np.float32)
-                full_mask[: len(pending), : len(nodes)] = emask
-                full_score[: len(pending), : len(nodes)] = escore
-                snap = _dc.replace(
-                    snap,
-                    has_extender=True,
-                    pod_extender_mask=full_mask,
-                    pod_extender_score=full_score,
-                )
         from ..models import packing
 
-        spec = packing.make_spec(snap)
-        pcycle, ppreempt, stable_fn = self._packed_fns(spec)
-        wbuf, bbuf = packing.pack(snap, spec)
-        stable = self._stable_state(spec, stable_fn, wbuf, bbuf)
-        t_encode = self._now()
-        self.metrics.cycle_duration.labels(phase="encode").observe(
-            t_encode - t0
-        )
-        result = pcycle(wbuf, bbuf, stable)
+        extender_errors: dict[int, str] = {}
+        diag = None
+        t_start = self._now()
+        if self._use_carry:
+            mut = self._nominated_mut[profile]
+            wbuf, bbuf, spec, snap, dirty = encoder.encode_packed(
+                nodes, pending, existing,
+                mutated_ids=frozenset(mut), **kw
+            )
+            mut.clear()
+            pcycle, ppreempt, stable_fn, keeper, diag = self._packed_fns(
+                spec, profile
+            )
+            stable = self._stable_state(
+                spec, stable_fn, wbuf, bbuf, encoder
+            )
+            carry = keeper.state(
+                wbuf, bbuf, stable, dirty,
+                (spec.key(), id(getattr(encoder, "_stable", None))),
+            )
+            t_encode = self._now()
+            self.metrics.cycle_duration.labels(phase="encode").observe(
+                t_encode - t_start
+            )
+            result = pcycle(wbuf, bbuf, stable, carry)
+        else:
+            snap = encoder.encode(nodes, pending, existing, **kw)
+            if self.extenders:
+                from ..framework.host import run_extender_prepass
+
+                emask, escore, extender_errors = run_extender_prepass(
+                    self.extenders, pending, nodes
+                )
+                if emask is not None:
+                    import dataclasses as _dc
+
+                    full_mask = np.ones((snap.P, snap.N), bool)
+                    full_score = np.zeros((snap.P, snap.N), np.float32)
+                    full_mask[: len(pending), : len(nodes)] = emask
+                    full_score[: len(pending), : len(nodes)] = escore
+                    snap = _dc.replace(
+                        snap,
+                        has_extender=True,
+                        pod_extender_mask=full_mask,
+                        pod_extender_score=full_score,
+                    )
+            spec = packing.make_spec(snap)
+            pcycle, ppreempt, stable_fn, _keeper, diag = self._packed_fns(
+                spec, profile
+            )
+            wbuf, bbuf = packing.pack(snap, spec)
+            stable = self._stable_state(
+                spec, stable_fn, wbuf, bbuf, encoder
+            )
+            t_encode = self._now()
+            self.metrics.cycle_duration.labels(phase="encode").observe(
+                t_encode - t_start
+            )
+            result = pcycle(wbuf, bbuf, stable)
         assignment = np.asarray(result.assignment)[: len(pending)]
         gang_dropped = np.asarray(result.gang_dropped)[: len(pending)]
-        reject_counts = np.asarray(result.reject_counts)[: len(pending)]
-        filter_names = self.framework.filter_names
+        filter_names = framework.filter_names
         stats.gang_dropped = int(gang_dropped.sum())
+
+        # FailedScheduling attribution: under carry mode the cycle does
+        # not compute reject counts — the diagnosis program does, forced
+        # lazily the first time a loser needs reasons (its dispatch below
+        # overlaps the host-side bind loop)
+        diag_handle = None
+        if diag is not None and (assignment < 0).any():
+            diag_handle = diag(
+                wbuf, bbuf, stable, result.assignment,
+                result.node_requested,
+            )
+        _rej_box: list = []
+
+        def reject_counts_of(i: int):
+            if not _rej_box:
+                if diag_handle is not None:
+                    _rej_box.append(
+                        np.asarray(diag_handle)[: len(pending)]
+                    )
+                else:
+                    _rej_box.append(
+                        np.asarray(result.reject_counts)[: len(pending)]
+                    )
+            return _rej_box[0][i]
         t_device = self._now()
         self.metrics.cycle_duration.labels(phase="device").observe(
             t_device - t_encode
@@ -334,7 +483,7 @@ class Scheduler:
         nominated = victims = None
         if ppreempt is not None and (assignment < 0).any():
             self.metrics.preemption_attempts.inc()
-            pre = ppreempt(wbuf, bbuf, result)
+            pre = ppreempt(wbuf, bbuf, result, stable)
             nominated = np.asarray(pre.nominated)[: len(pending)]
             victims = np.asarray(pre.victims)[: len(existing)]
         t_post = self._now()
@@ -366,7 +515,7 @@ class Scheduler:
                 except ValueError:
                     stats.bind_errors += 1
                     self.metrics.observe_attempt(
-                        "error", per_pod_s(), self._profile_name
+                        "error", per_pod_s(), profile
                     )
                     continue
                 # Reserve -> Permit -> PreBind host extension points
@@ -381,7 +530,7 @@ class Scheduler:
                         self.queue.requeue_backoff(pod)
                         stats.bind_errors += 1
                         self.metrics.observe_attempt(
-                            "error", per_pod_s(), self._profile_name
+                            "error", per_pod_s(), profile
                         )
                     else:
                         # Reserve/Permit veto: unschedulable, attributed
@@ -395,7 +544,7 @@ class Scheduler:
                         )
                         stats.unschedulable += 1
                         self.metrics.observe_attempt(
-                            "unschedulable", per_pod_s(), self._profile_name
+                            "unschedulable", per_pod_s(), profile
                         )
                     continue
                 t_bind = self._now()
@@ -407,7 +556,7 @@ class Scheduler:
                     self.queue.requeue_backoff(pod)
                     stats.bind_errors += 1
                     self.metrics.observe_attempt(
-                        "error", per_pod_s(), self._profile_name
+                        "error", per_pod_s(), profile
                     )
                     continue
                 self.metrics.binding_duration.observe(self._now() - t_bind)
@@ -419,7 +568,7 @@ class Scheduler:
                     self.queue.attempts_of(pod.uid)
                 )
                 self.metrics.observe_attempt(
-                    "scheduled", per_pod_s(), self._profile_name
+                    "scheduled", per_pod_s(), profile
                 )
             else:
                 if i in extender_errors:
@@ -428,11 +577,14 @@ class Scheduler:
                     self.queue.requeue_backoff(pod)
                     stats.bind_errors += 1
                     self.metrics.observe_attempt(
-                        "error", per_pod_s(), self._profile_name
+                        "error", per_pod_s(), profile
                     )
                     continue
                 if nominated is not None and nominated[i] >= 0:
                     pod.nominated_node_name = nodes[int(nominated[i])].name
+                    # in-place mutation: the delta encoder must re-read
+                    # this pod's slot next cycle (arena contract)
+                    self._nominated_mut[profile].add(id(pod))
                     self.last_nominations.append(
                         (pod, pod.nominated_node_name)
                     )
@@ -444,7 +596,9 @@ class Scheduler:
                         "minMember; all-or-nothing placement rolled back"
                     )
                 else:
-                    per_plugin = list(zip(filter_names, reject_counts[i]))
+                    per_plugin = list(
+                        zip(filter_names, reject_counts_of(i))
+                    )
                     reasons = tuple(
                         name for name, n in per_plugin if n > 0
                     )
@@ -453,13 +607,13 @@ class Scheduler:
                     )
                 for r in reasons:
                     self.metrics.unschedulable_reasons.labels(
-                        plugin=r, profile=self._profile_name
+                        plugin=r, profile=profile
                     ).inc()
                 self.events.failed_scheduling(pod, message)
                 self.queue.requeue_unschedulable(pod, reasons=reasons)
                 stats.unschedulable += 1
                 self.metrics.observe_attempt(
-                    "unschedulable", per_pod_s(), self._profile_name
+                    "unschedulable", per_pod_s(), profile
                 )
 
         if victims is not None and victims.any():
@@ -467,6 +621,7 @@ class Scheduler:
             preemptor_by_node = {
                 node: pod.name for pod, node in self.last_nominations
             }
+            n_vict = 0
             for e in np.flatnonzero(victims):
                 vpod, vnode = existing[int(e)]
                 self.evictor(vpod, vnode)
@@ -474,18 +629,13 @@ class Scheduler:
                 self.events.preempted(
                     vpod, preemptor_by_node.get(vnode, "<pending>")
                 )
-                stats.victims += 1
-            self.metrics.preemption_victims.observe(stats.victims)
+                n_vict += 1
+            stats.victims += n_vict
+            self.metrics.preemption_victims.observe(n_vict)
 
-        stats.cycle_seconds = self._now() - t0
         self.metrics.cycle_duration.labels(phase="apply").observe(
-            stats.cycle_seconds - (t_post - t0)
+            self._now() - t_post
         )
-        self.metrics.cycle_duration.labels(phase="total").observe(
-            stats.cycle_seconds
-        )
-        self._update_gauges()
-        return stats
 
     def _bind(self, pod: Pod, node_name: str) -> None:
         """Bind, delegating to the first bind-verb extender (upstream: an

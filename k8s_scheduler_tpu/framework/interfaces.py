@@ -160,5 +160,5 @@ class PluginBase:
     # pods that found no node; returns a PreemptionResult or None.
     # `excluded` [P] marks pods that must not preempt (gang-dropped) ---
     def post_filter(self, ctx: CycleContext, assignment, node_requested,
-                    static_mask, excluded=None):
+                    gate_rows, excluded=None):
         return None

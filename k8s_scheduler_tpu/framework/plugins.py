@@ -372,14 +372,14 @@ class DefaultPreemption(PluginBase):
     name = "DefaultPreemption"
 
     def post_filter(self, ctx: CycleContext, assignment, node_requested,
-                    static_mask, excluded=None):
+                    gate_rows, excluded=None):
         from ..ops import preemption as preemption_ops
 
         return preemption_ops.run_preemption(
-            ctx.snap,
+            ctx,
             assignment=assignment,
             node_requested=node_requested,
-            static_mask=static_mask,
+            gate_rows=gate_rows,
             excluded=excluded,
         )
 

@@ -100,6 +100,26 @@ class Framework:
                 score = score + w * v
         return mask, score, rejects
 
+    def static_lean(
+        self, ctx: CycleContext
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """static() without per-filter reject attribution: one fused AND
+        chain (mask) + weighted sum (score). The latency-path cycle uses
+        this (attribution lives in the separate diagnosis program), and
+        the carry-update program runs it on dirty-row views."""
+        snap = ctx.snap
+        mask = jnp.broadcast_to(snap.node_valid[None, :], (snap.P, snap.N))
+        for f in self.filters:
+            m = f.static_mask(ctx)
+            if m is not None:
+                mask = mask & m
+        score = jnp.zeros((snap.P, snap.N), jnp.float32)
+        for s, w in self.scores:
+            v = s.static_score(ctx)
+            if v is not None:
+                score = score + w * v
+        return mask, score
+
     def _stateful_plugins(self) -> list[PluginBase]:
         # a plugin enabled at several points (e.g. InterPodAffinity filter +
         # score) owns ONE extra-state slot, keyed by name
@@ -241,11 +261,11 @@ class Framework:
         return out
 
     def post_filter(self, ctx: CycleContext, assignment, node_requested,
-                    static_mask, excluded=None):
+                    gate_rows, excluded=None):
         """Run PostFilter plugins in order; first non-None result wins
         (upstream RunPostFilterPlugins stops at the first nomination)."""
         for p in self.post_filters:
-            r = p.post_filter(ctx, assignment, node_requested, static_mask,
+            r = p.post_filter(ctx, assignment, node_requested, gate_rows,
                               excluded)
             if r is not None:
                 return r

@@ -160,6 +160,10 @@ class MakePod:
         self._pod.spec.pod_group = name
         return self
 
+    def scheduler(self, name: str) -> "MakePod":
+        self._pod.spec.scheduler_name = name
+        return self
+
     def nominated(self, node_name: str) -> "MakePod":
         self._pod.nominated_node_name = node_name
         return self

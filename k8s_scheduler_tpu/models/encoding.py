@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, field
-from typing import Any, Sequence
+from typing import Any, NamedTuple, Sequence
 
 import numpy as np
 
@@ -85,6 +85,20 @@ WHEN_SCHEDULE_ANYWAY = 1
 NAMESPACE_KEY = "__namespace__"
 _EMPTY_I32 = np.empty(0, np.int32)
 _EMPTY_F32 = np.empty(0, np.float32)
+
+
+class EncodedFrame(NamedTuple):
+    """encode_packed's result: the arena buffers + spec + a snapshot view
+    whose fields alias them, plus which pod slots this encode rewrote.
+    `dirty` is None after a full (re)build — every row changed — and an
+    i32 slot-id array after a delta encode (consumers maintaining device-
+    resident per-row state, e.g. the static carry, update those rows)."""
+
+    wbuf: np.ndarray
+    bbuf: np.ndarray
+    spec: Any
+    snap: "ClusterSnapshot"
+    dirty: np.ndarray | None
 
 
 def _i32(xs) -> np.ndarray:
@@ -298,6 +312,9 @@ class ClusterSnapshot:
     exist_requested: np.ndarray  # f32 [E, R]
     exist_label_keys: np.ndarray  # i32 [E, MPL]
     exist_label_vals: np.ndarray  # i32 [E, MPL]
+    exist_ports: np.ndarray  # i32 [E, MEP] their host ports (-1 pad) —
+    # preemption's what-if needs per-victim ports, not just the per-node
+    # aggregate, to know whether evicting a prefix frees a port
     exist_anti_terms: np.ndarray  # i32 [E, MA, 2] their required anti-affinity
     exist_pref_aff: np.ndarray  # i32 [E, MA, 2] their preferred (anti) affinity
     exist_pref_aff_w: np.ndarray  # f32 [E, MA] (anti negative)
@@ -416,6 +433,9 @@ class SnapshotEncoder:
         # state for the delta fast path; see encode_packed
         self._delta_state: dict | None = None
         self._arena_spec = None
+        # observability: how many encode_packed calls hit the delta path
+        self.delta_hits = 0
+        self.full_encodes = 0
 
     def _stick(self, key: str, val: int) -> int:
         cur = self._sticky_dims.get(key, 0)
@@ -966,6 +986,12 @@ class SnapshotEncoder:
             exist_req = np.zeros((E, R), np.float32)
             el_keys = np.full((E, MPL), -1, np.int32)
             el_vals = np.full((E, MPL), -1, np.int32)
+            MEP = self._stick(
+                "MEP",
+                _pad_dim(max([len(d["ports"]) for d in exist_rows] + [1]),
+                         4),
+            )
+            exist_ports_arr = np.full((E, MEP), -1, np.int32)
             exist_anti = np.full((E, MA, 2), -1, np.int32)
             exist_pref = np.full((E, MA, 2), -1, np.int32)
             exist_pref_w = np.zeros((E, MA), np.float32)
@@ -989,6 +1015,9 @@ class SnapshotEncoder:
             native.scatter_rows(exist_req, [d["reqvec"] for d in exist_rows])
             native.scatter_rows(el_keys, [d["lab_k"] for d in exist_rows])
             native.scatter_rows(el_vals, [d["lab_v"] for d in exist_rows])
+            native.scatter_rows(
+                exist_ports_arr, [d["ports"] for d in exist_rows]
+            )
             native.scatter_rows(
                 exist_anti.reshape(E, MA * 2), [d["anti"] for d in exist_rows]
             )
@@ -1175,6 +1204,7 @@ class SnapshotEncoder:
                 "exist_req": exist_req,
                 "el_keys": el_keys,
                 "el_vals": el_vals,
+                "exist_ports": exist_ports_arr,
                 "exist_anti": exist_anti,
                 "exist_pref": exist_pref,
                 "exist_pref_w": exist_pref_w,
@@ -1233,6 +1263,7 @@ class SnapshotEncoder:
         exist_req = st["exist_req"]
         el_keys = st["el_keys"]
         el_vals = st["el_vals"]
+        exist_ports_arr = st["exist_ports"]
         exist_anti = st["exist_anti"]
         exist_pref = st["exist_pref"]
         exist_pref_w = st["exist_pref_w"]
@@ -1473,6 +1504,7 @@ class SnapshotEncoder:
             pdb_allowed=pdb_allowed,
             exist_label_keys=el_keys,
             exist_label_vals=el_vals,
+            exist_ports=exist_ports_arr,
             exist_anti_terms=exist_anti,
             exist_pref_aff=exist_pref,
             exist_pref_aff_w=exist_pref_w,
@@ -1609,11 +1641,12 @@ class SnapshotEncoder:
         pdbs: Sequence[api.PodDisruptionBudget] = (),
         mutated_ids: frozenset | set = frozenset(),
     ):
-        """Encode + pack in one step: returns (wbuf, bbuf, spec, snap)
-        where wbuf/bbuf are the persistent arena buffers (valid until the
-        NEXT encode call — consumers must dispatch/copy before then; JAX
-        copies host arguments synchronously at call time) and `snap` is a
-        ClusterSnapshot whose array fields are views into them."""
+        """Encode + pack in one step: returns an EncodedFrame whose
+        wbuf/bbuf are the persistent arena buffers (valid until the NEXT
+        encode call — consumers must dispatch/copy before then; JAX
+        copies host arguments synchronously at call time), `snap` is a
+        ClusterSnapshot whose array fields are views into them, and
+        `dirty` names the rewritten pod slots (None = full rebuild)."""
         ds = self._delta_state
         if (
             ds is not None
@@ -1624,7 +1657,9 @@ class SnapshotEncoder:
         ):
             out = self._encode_delta(ds, pending, pod_groups, mutated_ids)
             if out is not None:
+                self.delta_hits += 1
                 return out
+        self.full_encodes += 1
         snap = self.encode(
             nodes, pending, existing, pod_groups, pvcs, pvs,
             storage_classes, pdbs,
@@ -1811,7 +1846,10 @@ class SnapshotEncoder:
 
         self._cycle_index += 1
         A["cycle_index"][...] = self._cycle_index
-        return self._arena_w, self._arena_b, self._arena_spec, self._arena_snap
+        return EncodedFrame(
+            self._arena_w, self._arena_b, self._arena_spec,
+            self._arena_snap, np.asarray(dirty, np.int32),
+        )
 
     def _install_arena(self, snap: ClusterSnapshot):
         """(Re)build the persistent packed arena from a fully-encoded
@@ -1845,7 +1883,10 @@ class SnapshotEncoder:
         for name, v in self._arena.items():
             v[...] = getattr(snap, name)
         self._arena_synced = True
-        return self._arena_w, self._arena_b, self._arena_spec, self._arena_snap
+        return EncodedFrame(
+            self._arena_w, self._arena_b, self._arena_spec,
+            self._arena_snap, None,
+        )
 
 
 def _aff(p: Pod) -> Affinity:

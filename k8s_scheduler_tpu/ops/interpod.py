@@ -522,6 +522,54 @@ def affinity_update_batched(snap, state: AffinityState, m_pending,
     return AffinityState(counts, total, anti, pref)
 
 
+def spread_min2(snap, counts):
+    """Per (key, selector): (min1, argmin-domain, min2) of the matching-
+    pod counts over eligible domains — each f32/i32 [K*S].
+
+    Preemption's what-if needs "min over domains EXCLUDING d" for the
+    candidate node's domain d (evicting on one node only lowers that
+    domain's count): min_excl(d) = min2 if argmin == d else min1. A
+    (key, selector) with a single eligible domain gets min2 = 1e9 so
+    min_after collapses to the domain's own post-eviction count."""
+    K = snap.node_domains.shape[1]
+    S, D = counts.shape
+    d_ids = jnp.arange(D, dtype=jnp.int32)[None, :]
+    m1s, aas, m2s = [], [], []
+    for k in range(K):
+        eligible = (snap.domain_key == k) & (snap.domain_node_count > 0)
+        vals = jnp.where(eligible[None, :], counts, jnp.inf)  # [S, D]
+        a1 = jnp.argmin(vals, axis=1).astype(jnp.int32)  # [S]
+        m1 = jnp.min(vals, axis=1)
+        vals2 = jnp.where(d_ids == a1[:, None], jnp.inf, vals)
+        m2 = jnp.min(vals2, axis=1)
+        m1s.append(jnp.where(jnp.isfinite(m1), m1, 0.0))
+        aas.append(a1)
+        m2s.append(jnp.where(jnp.isfinite(m2), m2, 1e9))
+    return (
+        jnp.concatenate(m1s), jnp.concatenate(aas), jnp.concatenate(m2s)
+    )
+
+
+def anti_owner_counts(snap, assignment) -> jnp.ndarray:
+    """f32 [S, D]: how many pods (existing + placed-this-cycle) OWN a
+    required anti-affinity term (sel, key) whose key-domain is d — the
+    COUNT version of AffinityState.anti_presence, which preemption needs
+    to know whether evicting a node's victim prefix removes the last
+    owner blocking a symmetric-anti candidate."""
+    S = snap.sel_exprs.shape[0]
+    D = snap.domain_key.shape[0]
+    dom_e = _exist_domains(snap)  # [E, K]
+    onesE = jnp.ones(snap.exist_anti_terms.shape[:2], jnp.float32)
+    cnt = _flat_table(snap.exist_anti_terms, onesE, dom_e, S, D)
+    placed = snap.pod_valid & (assignment >= 0)
+    node_dom = snap.node_domains[jnp.clip(assignment, 0, snap.N - 1)]
+    terms_p = jnp.where(
+        placed[:, None, None], snap.pod_anti_terms, -1
+    )
+    onesP = jnp.ones(terms_p.shape[:2], jnp.float32)
+    return cnt + _flat_table(terms_p, onesP, node_dom, S, D)
+
+
 def selector_activity(snap) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(anti_active [S], spread_active [S]): selectors referenced by any
     required anti-affinity term (pending or existing pods) / any topology

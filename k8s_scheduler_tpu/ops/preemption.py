@@ -44,13 +44,33 @@ priority, min victim priority sum, min victim count, then LATEST start
 time of the highest victim (prefer evicting younger pods), then lowest
 node index.
 
-Documented deviation from upstream: victim removal only relaxes RESOURCE
-constraints here. Upstream re-runs all filters with victims removed, so a
-pod blocked by (say) anti-affinity toward a victim can preempt it; this
-kernel requires `candidate_mask` (static + non-resource dynamic filters
-against the post-cycle state — CycleResult.preempt_gate) to pass with the
-victims still present — strictly conservative (never evicts where
-upstream would not).
+Victim removal relaxes NON-RESOURCE constraints too (upstream re-runs all
+filters with victims removed; SURVEY.md §3.4): per candidate (pod, node,
+prefix k) the scan phase checks, against the FINAL post-cycle state with
+the prefix's victims subtracted —
+  - the pod's required anti-affinity (count in the node's key-domain
+    minus evicted matching victims must reach zero),
+  - the pod's required affinity (must still have a matching pod left, or
+    bootstrap on itself),
+  - symmetric anti-affinity (every evictable OWNER of an anti term
+    matching the pod must be inside the prefix),
+  - DoNotSchedule topology spread (post-eviction skew, with the min-over-
+    domains recomputed via a min1/argmin/min2 table),
+  - hostPorts (every existing holder of a wanted port must be inside the
+    prefix; ports held by this cycle's winners or claimed by earlier
+    nominations in this pass never clear).
+`gate_rows` is accordingly the PURE STATIC candidate gate, computed on
+the budgeted candidate view and excluding NodePorts (see
+core.cycle._preemption_gate_rows). Remaining deviations: victims are
+priority-order PREFIXES per node (upstream's remove/re-add minimization
+is prefix-shaped too, but can skip PDB-protected pods where this kernel
+truncates); and within one batch pass, earlier candidates' victims are
+reflected in capacity (k_claimed / nominated_req) but not in the
+affinity/spread count tables later candidates read — stale counts are
+conservative for anti (never evict where upstream would not) and at
+worst waste a nomination elsewhere, which the next cycle's feasibility
+check heals (upstream nominates one pod per ScheduleOne iteration and
+re-lists, so the same information lag exists across its cycles).
 """
 
 from __future__ import annotations
@@ -60,7 +80,8 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from ..models.encoding import ClusterSnapshot
+from ..models import encoding as enc
+from . import interpod as interpod_ops
 
 _REL_EPS = 1e-5
 _BIG_I32 = jnp.int32(2**31 - 1)
@@ -75,12 +96,12 @@ class PreemptionResult:
 
 
 def run_preemption(
-    snap: ClusterSnapshot,
+    ctx,
     *,
     assignment: jnp.ndarray,  # i32 [P] from the commit scan (-1 = unsched)
     node_requested: jnp.ndarray,  # f32 [N, R] post-cycle running requests
-    static_mask: jnp.ndarray,  # bool [P, N] candidate gate: static + non-
-    # resource dynamic feasibility vs the final state (preempt_gate)
+    gate_rows,  # callable ids i32 [C] -> bool [C, N]: pure-static
+    # candidate gate (what eviction can never change), minus NodePorts
     excluded: jnp.ndarray | None = None,  # bool [P] never preempt (e.g.
     # gang-dropped members: they fit without eviction, their group is what
     # failed — upstream never runs PostFilter for Permit rejections)
@@ -94,9 +115,38 @@ def run_preemption(
     # to the next cycle — upstream nominates ONE pod per ScheduleOne
     # iteration, so 64 per cycle is still generous
 ) -> PreemptionResult:
-    P, N = static_mask.shape
+    snap = ctx.snap
+    P, N = snap.P, snap.N
     E = snap.E
     MPN = snap.node_pods.shape[1]
+    K = snap.node_domains.shape[1]
+
+    # ---- final-state affinity/spread tables (what-if baselines) ----
+    use_state = snap.has_inter_pod_affinity or snap.has_topology_spread
+    if use_state:
+        mp = ctx.matched_pending  # [S, P]
+        me = ctx.matched_existing  # [S, E]
+        state0 = ctx.initial_affinity_state()
+        placed = snap.pod_valid & (assignment >= 0)
+        node_of_placed = jnp.where(placed, assignment, 0)
+        state_f = interpod_ops.affinity_update_batched(
+            snap, state0, mp, placed, node_of_placed
+        )
+        counts_f = state_f.counts  # [S, D]
+        total_f = state_f.total  # [S]
+        S_, D_ = counts_f.shape
+    else:
+        placed = snap.pod_valid & (assignment >= 0)
+        node_of_placed = jnp.where(placed, assignment, 0)
+    if snap.has_inter_pod_affinity:
+        anti_cnt_sd = interpod_ops.anti_owner_counts(snap, assignment)
+    if snap.has_topology_spread:
+        sp_min1, sp_amin, sp_min2 = interpod_ops.spread_min2(
+            snap, counts_f
+        )
+    MA = snap.pod_anti_terms.shape[1]
+    MC = snap.pod_tsc.shape[1]
+    Q = snap.num_distinct_ports
 
     # ---- per-node victim tables (shared across all preemptors) ----
     vict_valid = snap.node_pods >= 0  # [N, MPN]
@@ -164,9 +214,10 @@ def run_preemption(
         <= free0[None, :, None, :] + prefix_freed[None, :, :, :],
         axis=-1,
     )  # [C, N, MPN+1]
+    gate_c = gate_rows(cand_ids)  # [C, N] pure-static candidate gate
     allowed0 = fits0 & (ks[None] >= 1) & (ks[None] <= elig0[:, :, None])
     feasible_any = jnp.any(
-        allowed0 & static_mask[cand_ids][:, :, None]
+        allowed0 & gate_c[:, :, None]
         & snap.node_valid[None, :, None],
         axis=(1, 2),
     ) & cand_ok  # [C]
@@ -176,10 +227,171 @@ def run_preemption(
     sel2 = jnp.argsort(key2)[:C2].astype(jnp.int32)
     cand_ids2 = cand_ids[sel2]  # [C2] global pod ids, rank order
     live2 = feasible_any[sel2]
+    gate2 = gate_c[sel2]  # [C2, N]
 
-    # ---- phase 2: exact rank-sequential claims over the survivors ----
+    # ---- batched non-resource what-if over the C2 scan candidates ----
+    # Everything here is independent of the scan carry (only claimed
+    # ports are not), so it runs ONCE as wide batched ops over
+    # [C2, N, MPN+1] instead of per scan step — per-step arbitrary
+    # gathers at [N, MPN, MA] scale are pathological on this backend.
+    def nonresource_ok_batched(cids):
+        """bool [C2, N, MPN+1]: for each scan candidate, node and victim
+        prefix — do ALL the candidate's evictable non-resource
+        constraints hold once the prefix is gone? (module docstring)"""
+        C2 = cids.shape[0]
+        ok = jnp.ones((C2, N, MPN + 1), bool)
+        s_ids = None
+
+        def cum3(x):  # [C2, N, MPN] f32 -> [C2, N, MPN+1] prefix sums
+            c = jnp.cumsum(x, axis=2)
+            return jnp.concatenate(
+                [jnp.zeros_like(c[:, :, :1]), c], axis=2
+            )
+
+        if use_state:
+            s_ids = jnp.arange(S_, dtype=jnp.int32)[None, :]
+            cbn_f = interpod_ops.counts_by_node(snap, state_f)  # [K*S, N]
+            me_vic = (
+                me[:, safe_idx.reshape(-1)].reshape(S_, N, MPN)
+                & vict_valid[None]
+            )
+            mvic_f = me_vic.astype(jnp.float32).reshape(S_, N * MPN)
+
+            def term_m_vic(sel_c):  # [C2] -> f32 [C2, N, MPN]
+                oh = (
+                    jnp.clip(sel_c, 0, S_ - 1)[:, None] == s_ids
+                ).astype(jnp.float32)
+                return jax.lax.dot(oh, mvic_f).reshape(C2, N, MPN)
+
+            def cnt_at(sel_c, key_c):  # [C2, N]; -1 marks "no domain"
+                return interpod_ops._term_pick(
+                    snap, cbn_f, sel_c, key_c, exact=True
+                )
+
+            if snap.has_inter_pod_affinity:
+                for a in range(MA):
+                    sel_c = snap.pod_anti_terms[cids, a, 0]  # [C2]
+                    key_c = snap.pod_anti_terms[cids, a, 1]
+                    cnt = cnt_at(sel_c, key_c)
+                    after = cnt[:, :, None] - cum3(term_m_vic(sel_c))
+                    ok &= (
+                        (sel_c < 0)[:, None, None]
+                        | (cnt < -0.5)[:, :, None]
+                        | (after <= 0.5)
+                    )
+                for a in range(MA):
+                    sel_c = snap.pod_aff_terms[cids, a, 0]
+                    key_c = snap.pod_aff_terms[cids, a, 1]
+                    scl = jnp.clip(sel_c, 0, S_ - 1)
+                    cnt = cnt_at(sel_c, key_c)
+                    cum = cum3(term_m_vic(sel_c))
+                    after = cnt[:, :, None] - cum
+                    tot_after = total_f[scl][:, None, None] - cum
+                    boot = (tot_after <= 0.5) & mp[scl, cids][
+                        :, None, None
+                    ]
+                    ok &= (
+                        (sel_c < 0)[:, None, None]
+                        | boot
+                        | ((cnt > -0.5)[:, :, None] & (after > 0.5))
+                    )
+                # symmetric: every evictable OWNER of an anti term
+                # matching the candidate must fall inside the prefix
+                mp_c = mp[:, cids].astype(jnp.float32)  # [S, C2]
+                row_d = jax.lax.dot(mp_c.T, anti_cnt_sd)  # [C2, D]
+                sym_tot = jnp.zeros((C2, N), jnp.float32)
+                for k in range(K):
+                    dn = snap.node_domains[:, k]  # [N]
+                    g = jnp.take(
+                        row_d, jnp.clip(dn, 0, D_ - 1), axis=1
+                    )  # [C2, N]
+                    sym_tot = sym_tot + jnp.where(dn >= 0, g, 0.0)
+                # per-victim owner weight table [S, N*MPN], candidate-
+                # independent: victim j on node n owning term (s, key)
+                # with a live domain contributes 1 at (s, n*MPN+j)
+                sel_v = snap.exist_anti_terms[safe_idx][..., 0]
+                key_v = snap.exist_anti_terms[safe_idx][..., 1]
+                domk = snap.node_domains[
+                    jnp.arange(N)[:, None, None],
+                    jnp.clip(key_v, 0, K - 1),
+                ]  # [N, MPN, MA]
+                valid_v = (
+                    (sel_v >= 0) & (domk >= 0) & vict_valid[:, :, None]
+                )
+                pos = jnp.broadcast_to(
+                    (jnp.arange(N)[:, None] * MPN
+                     + jnp.arange(MPN)[None, :])[:, :, None],
+                    valid_v.shape,
+                ).reshape(-1)
+                own_f = (
+                    jnp.zeros((S_, N * MPN), jnp.float32)
+                    .at[
+                        jnp.clip(sel_v, 0, S_ - 1).reshape(-1), pos
+                    ]
+                    .add(valid_v.reshape(-1).astype(jnp.float32))
+                )
+                w = jax.lax.dot(mp_c.T, own_f).reshape(C2, N, MPN)
+                ok &= (sym_tot[:, :, None] - cum3(w)) <= 0.5
+            if snap.has_topology_spread:
+                for c in range(MC):
+                    key_c = snap.pod_tsc[cids, c, 0]
+                    sel_c = snap.pod_tsc[cids, c, 1]
+                    when_c = snap.pod_tsc[cids, c, 2]
+                    skew_c = snap.pod_tsc_skew[cids, c].astype(
+                        jnp.float32
+                    )
+                    hard = (key_c >= 0) & (
+                        when_c == enc.WHEN_DO_NOT_SCHEDULE
+                    )
+                    scl = jnp.clip(sel_c, 0, S_ - 1)
+                    cnt = cnt_at(sel_c, key_c)
+                    after = cnt[:, :, None] - cum3(term_m_vic(sel_c))
+                    row = jnp.clip(key_c, 0, K - 1) * S_ + scl  # [C2]
+                    dnc = snap.node_domains.T[
+                        jnp.clip(key_c, 0, K - 1)
+                    ]  # [C2, N]
+                    mexcl = jnp.where(
+                        dnc == sp_amin[row][:, None],
+                        sp_min2[row][:, None],
+                        sp_min1[row][:, None],
+                    )
+                    min_after = jnp.minimum(mexcl[:, :, None], after)
+                    viol = (
+                        after + 1.0 - min_after > skew_c[:, None, None]
+                    ) | (cnt < -0.5)[:, :, None]
+                    ok &= jnp.where(hard[:, None, None], ~viol, True)
+        # hostPorts: every existing holder of a wanted port must be in
+        # the prefix; ports held by this cycle's winners never clear
+        pp_c = snap.pod_ports[cids]  # [C2, MPorts]
+        has_p = jnp.any(pp_c >= 0, axis=1)  # [C2]
+        vic_ports = snap.exist_ports[safe_idx]  # [N, MPN, MEP]
+        conf = (
+            (vic_ports[None, :, :, :, None] == pp_c[:, None, None, None])
+            & (pp_c >= 0)[:, None, None, None]
+        ).any((-2, -1)) & vict_valid[None]  # [C2, N, MPN]
+        cum_c = cum3(conf.astype(jnp.float32))
+        tot_c = cum_c[:, :, -1:]
+        conflict_pw = (
+            (snap.pod_ports[None, :, :, None] == pp_c[:, None, None])
+            & (pp_c >= 0)[:, None, None]
+        ).any((-2, -1)) & placed[None, :]  # [C2, P]
+        n_oh = (
+            node_of_placed[:, None]
+            == jnp.arange(N, dtype=jnp.int32)[None, :]
+        ) & placed[:, None]  # [P, N]
+        winner_conf = (
+            jax.lax.dot(
+                conflict_pw.astype(jnp.float32), n_oh.astype(jnp.float32)
+            ) > 0.5
+        )  # [C2, N]
+        ports_ok = (tot_c - cum_c <= 0.5) & ~winner_conf[:, :, None]
+        ok &= jnp.where(has_p[:, None, None], ports_ok, True)
+        return ok
+
+    ok_nr2 = nonresource_ok_batched(cand_ids2)  # [C2, N, MPN+1]
+
     def step(carry, rank):
-        k_claimed, nominated_req, victim_mask, pdb_used = carry
+        k_claimed, nominated_req, victim_mask, pdb_used, claimed_q = carry
         p = cand_ids2[rank]
         prio = snap.pod_priority[p]
 
@@ -205,13 +417,26 @@ def run_preemption(
             <= free_base[:, None, :] + prefix_freed,
             axis=-1,
         )  # [N, MPN+1]
-        allowed = fits & (ks >= k_claimed[:, None]) & (ks <= elig[:, None])
+        # the only carry-dependent non-resource check: ports claimed by
+        # earlier nominations in this pass never clear
+        qp = snap.pod_port_ids[p]  # [MPorts] -> Q ids
+        claimed_conf = jnp.any(
+            claimed_q[:, jnp.clip(qp, 0, Q - 1)] & (qp >= 0)[None, :],
+            axis=1,
+        )  # [N]
+        allowed = (
+            fits
+            & ok_nr2[rank]
+            & ~claimed_conf[:, None]
+            & (ks >= k_claimed[:, None])
+            & (ks <= elig[:, None])
+        )
         exists = jnp.any(allowed, axis=1)
         k_min = jnp.argmax(allowed, axis=1).astype(jnp.int32)  # first True
         # preemption must actually help: new victims >= 1 (a node feasible
         # with zero evictions would have been chosen by the main cycle)
         candidate = (
-            static_mask[p] & snap.node_valid & exists & (k_min > k_claimed)
+            gate2[rank] & snap.node_valid & exists & (k_min > k_claimed)
         )
 
         # ---- pickOneNodeForPreemption: lexicographic minimization ----
@@ -257,8 +482,14 @@ def run_preemption(
         nominated_req = nominated_req.at[b].add(
             jnp.where(do, snap.pod_requested[p], 0.0)
         )
+        # ports this nomination will occupy: later candidates in this
+        # pass must not count on evicting their way onto them
+        qp2 = snap.pod_port_ids[p]
+        claimed_q = claimed_q.at[b, jnp.clip(qp2, 0, Q - 1)].max(
+            do & (qp2 >= 0)
+        )
         return (
-            (k_claimed, nominated_req, victim_mask, pdb_used),
+            (k_claimed, nominated_req, victim_mask, pdb_used, claimed_q),
             (p, nominated_p),
         )
 
@@ -267,8 +498,9 @@ def run_preemption(
         jnp.zeros_like(node_requested),
         jnp.zeros(E, bool),
         jnp.zeros(GP, jnp.int32),
+        jnp.zeros((N, Q), bool),
     )
-    (_, _, victims, _), (pods, noms) = jax.lax.scan(
+    (_, _, victims, _, _), (pods, noms) = jax.lax.scan(
         step, init, jnp.arange(C2, dtype=jnp.int32)
     )
     nominated = jnp.full(P, -1, jnp.int32).at[pods].max(noms)

@@ -205,8 +205,11 @@ def _owner_state(ext_state):
 def rounds_commit(
     *,
     snap,
-    static_mask: jnp.ndarray,  # bool [P, N]
-    static_score: jnp.ndarray,  # f32 [P, N]
+    static_mask: jnp.ndarray = None,  # bool [P, N]
+    static_score: jnp.ndarray = None,  # f32 [P, N]
+    sbase: jnp.ndarray = None,  # f32 [P, N] pre-combined static score
+    # (NEG_INF where infeasible) — the carry path passes this directly
+    # instead of (static_mask, static_score)
     m_pending: jnp.ndarray,  # bool [S, P]
     dyn_batched_view_fn: Callable,  # (vsnap, vmp, node_req, ext, vsmask)
     #   -> (mask [B,N], score [B,N], per_filter)
@@ -219,7 +222,7 @@ def rounds_commit(
     score_anchor_fn: Callable | None = None,  # node_requested -> f32 [N]
     # capacity-sensitive node-local score component (Framework.score_anchor)
 ) -> RoundsResult:
-    P, N = static_mask.shape
+    P, N = (sbase if sbase is not None else static_mask).shape
     S = m_pending.shape[0]
     D = snap.domain_key.shape[0]
     K = snap.node_domains.shape[1]
@@ -256,9 +259,10 @@ def rounds_commit(
     # plugin-weight scale, far below |NEG_INF|/2) so an extreme extender
     # score can never push a feasible node across the infeasible threshold
     # the compacted rounds reconstruct the mask with (vsbase > NEG_INF/2)
-    sbase = jnp.where(
-        static_mask, jnp.clip(static_score, -1e6, 1e6), NEG_INF
-    )  # [P, N]
+    if sbase is None:
+        sbase = jnp.where(
+            static_mask, jnp.clip(static_score, -1e6, 1e6), NEG_INF
+        )  # [P, N]
 
     def guards_ok(vsnap, vrank, vsels, choice, live, ext_state):
         """Participant-table sweep over the round's accepted claims;

@@ -857,13 +857,13 @@ def validate_rounds_assignment(
 # --------------------------------------------------------------------------
 
 # The candidate gate the preemption pass uses — mirrors the kernel's
-# CycleResult.preempt_gate: victim removal only relaxes RESOURCE
-# constraints; the static filters (plus volumes) must pass with victims
-# present, and ports must pass against the POST-cycle state (a port
-# claimed by a this-cycle winner cannot be freed by eviction). Affinity/
-# spread do NOT gate candidates — evicting matching victims lowers the
-# counts, so those constraints can clear by the next cycle (see
-# CycleResult.preempt_gate).
+# Candidate-node gate: the static filters eviction can never satisfy
+# (volumes included — evicting a pod does not unbind a PersistentVolume).
+# Everything eviction CAN free — resources, hostPorts, inter-pod
+# (anti-)affinity, DoNotSchedule spread — is checked per victim PREFIX by
+# simulating the prefix's removal from the post-cycle state, mirroring
+# upstream's re-run-Filters-with-victims-removed and ops/preemption.py's
+# what-if kernel.
 PREEMPTION_STATIC_FILTERS = (
     filter_node_unschedulable,
     filter_node_name,
@@ -871,8 +871,11 @@ PREEMPTION_STATIC_FILTERS = (
     filter_node_affinity,
     filter_volume_binding,
 )
-PREEMPTION_POST_FILTERS = (
+# constraints re-checked with the victim prefix removed
+PREEMPTION_WHATIF_FILTERS = (
     filter_node_ports,
+    filter_inter_pod_affinity,
+    filter_topology_spread,
 )
 
 
@@ -997,6 +1000,7 @@ def preempt(
 
     k_claimed = [0] * len(nodes)
     nominated_req: list[dict[str, float]] = [{} for _ in nodes]
+    nominated_ports: list[set] = [set() for _ in nodes]
     out: list[OraclePreemption] = []
 
     unsched = [pi for pi in queue_order(pending)
@@ -1005,11 +1009,13 @@ def preempt(
     for pi in unsched:
         pod = pending[pi]
         req = pod.resource_requests()
+        pod_ports = {(pt, proto) for pt, proto, _ip in pod.host_ports()}
         candidates = []  # (max_prio, sum_prio, n_vict, -hi_start, node, k_min)
         for i in range(len(nodes)):
             if not all(f(pod, static_state, i) for f in PREEMPTION_STATIC_FILTERS):
                 continue
-            if not all(f(pod, post_state, i) for f in PREEMPTION_POST_FILTERS):
+            if pod_ports & nominated_ports[i]:
+                # an earlier nomination in this pass claims the port
                 continue
             victs = per_node[i]
             elig = sum(
@@ -1042,9 +1048,25 @@ def preempt(
                         return False
                 return True
 
+            def whatif_ok(k: int) -> bool:
+                """Re-run the evictable filters with victims[:k] removed
+                from the post-cycle state (upstream SelectVictimsOnNode
+                re-runs Filters on the modified NodeInfo)."""
+                removed = [existing[e][0] for e in victs[:k]]
+                for rp in removed:
+                    post_state.remove(i, rp)
+                try:
+                    return all(
+                        f(pod, post_state, i)
+                        for f in PREEMPTION_WHATIF_FILTERS
+                    )
+                finally:
+                    for rp in removed:
+                        post_state.add(i, rp)
+
             k_min = None
             for k in range(k_claimed[i], elig + 1):
-                if fits(k):
+                if fits(k) and whatif_ok(k):
                     k_min = k
                     break
             if k_min is None or k_min <= k_claimed[i]:
@@ -1069,6 +1091,7 @@ def preempt(
                 pdb_used[g] += 1
         for r, v in req.items():
             nominated_req[node][r] = nominated_req[node].get(r, 0.0) + v
+        nominated_ports[node] |= pod_ports
         out.append(OraclePreemption(pi, node, victims))
     return out
 

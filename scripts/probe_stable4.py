@@ -9,6 +9,10 @@ import sys
 sys.path.insert(0, ".")
 
 import jax
+
+from k8s_scheduler_tpu.utils.compilation_cache import enable_compilation_cache
+
+enable_compilation_cache()
 import numpy as np
 
 from bench_suite import make_config_base, make_config_workload, _pad

@@ -8,6 +8,10 @@ import sys
 sys.path.insert(0, ".")
 
 import jax
+
+from k8s_scheduler_tpu.utils.compilation_cache import enable_compilation_cache
+
+enable_compilation_cache()
 import jax.numpy as jnp
 import numpy as np
 

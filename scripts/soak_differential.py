@@ -2,9 +2,18 @@
 engines vs the sequential oracle.
 
 - scan engine: exact assignment match against oracle.schedule.
-- rounds engine: validity invariants (oracle.validate_rounds_assignment)
-  plus a placement-quality floor (rounds must place >= 90% of what the
-  sequential oracle places — catches convergence regressions).
+- rounds engine: validity invariants (oracle.validate_rounds_assignment),
+  a placement-quality floor (rounds must place >= 90% of what the
+  sequential oracle places), and a SCORE-REGRET bound: replaying the
+  rounds assignment through the oracle's sequential state, the average
+  deficit of the chosen node's score vs the best feasible score must stay
+  under REGRET_BOUND (the engine's integer rounding + hash tie-break make
+  some divergence by design — this measures its magnitude instead of only
+  bounding placement count).
+- preemption: whenever the scan pass leaves unschedulable pods, the
+  what-if kernel's nominations/victims must match
+  oracle.schedule_with_preemption exactly (covers eviction freeing
+  anti-affinity/ports/spread, VERDICT r2 item 3).
 
 Run:  python scripts/soak_differential.py [minutes]
 """
@@ -21,12 +30,38 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 
 from k8s_scheduler_tpu import oracle
-from k8s_scheduler_tpu.core import build_cycle_fn
+from k8s_scheduler_tpu.core import build_cycle_fn, build_preemption_fn
 from k8s_scheduler_tpu.models import SnapshotEncoder
 from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
 
+REGRET_BOUND = 60.0  # avg per-placed-pod score deficit (scale: ~1000)
 
-def one_case(seed: int, scan_cycle, rounds_cycle, enc):
+
+def rounds_regret(nodes, pods, existing, a_r) -> tuple[float, int]:
+    """Average oracle-score deficit of the rounds engine's choices,
+    replayed in rank order on the oracle's sequential state."""
+    w = oracle.OracleWeights()
+    state = oracle.OracleState.build(nodes, existing)
+    total, n = 0.0, 0
+    for pi in oracle.queue_order(pods):
+        node = int(a_r[pi])
+        if node < 0:
+            continue
+        pod = pods[pi]
+        feasible = oracle.feasible_nodes(pod, state, oracle.DEFAULT_FILTERS)
+        if node in feasible:
+            cn = oracle._CrossNodeRaws.compute(pod, state, feasible, w)
+            scores = {
+                i: oracle._score_pod(pod, state, i, w, cn)
+                for i in feasible
+            }
+            total += max(0.0, max(scores.values()) - scores[node])
+            n += 1
+        state.add(node, pod)
+    return total / max(n, 1), n
+
+
+def one_case(seed: int, scan_cycle, rounds_cycle, pre_fn, enc):
     rng = np.random.default_rng(seed)
     n_nodes = int(rng.integers(5, 40))
     n_pods = int(rng.integers(5, 120))
@@ -84,13 +119,47 @@ def one_case(seed: int, scan_cycle, rounds_cycle, enc):
             f"seed {seed}: rounds quality {placed_r}/{placed_o} "
             f"below 90% of sequential"
         )
+    regret, n_scored = rounds_regret(nodes, pods, existing, a_r)
+    one_case.regrets.append(regret)
+    if n_scored >= 5 and regret > REGRET_BOUND:
+        return (
+            f"seed {seed}: rounds avg score regret {regret:.1f} over "
+            f"{n_scored} pods exceeds {REGRET_BOUND}"
+        )
+
+    # preemption differential: kernel nominations/victims == oracle's
+    if (a_s < 0).any():
+        pre = pre_fn(snap, out_s)
+        nom = np.asarray(pre.nominated)[: len(pods)]
+        vic = np.asarray(pre.victims)[: len(existing)]
+        _dec, opre = oracle.schedule_with_preemption(
+            nodes, pods, existing
+        )
+        want_nom = np.full(len(pods), -1, np.int64)
+        want_vic = np.zeros(max(len(existing), 1), bool)[: len(existing)]
+        for o in opre:
+            want_nom[o.pod_index] = o.node_index
+            for e in o.victims:
+                want_vic[e] = True
+        if nom.tolist() != want_nom.tolist() or (
+            vic.tolist() != want_vic.tolist()
+        ):
+            return (
+                f"seed {seed}: preemption mismatch "
+                f"nom={nom.tolist()} want={want_nom.tolist()} "
+                f"vic={vic.tolist()} want={want_vic.tolist()}"
+            )
     return None
+
+
+one_case.regrets = []
 
 
 def main():
     minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
     scan_cycle = build_cycle_fn(commit_mode="scan")
     rounds_cycle = build_cycle_fn(commit_mode="rounds")
+    pre_fn = build_preemption_fn()
     # ONE encoder + fixed padding: interning dims stabilize after the first
     # few cases, so each engine compiles a handful of times, not per case
     enc = SnapshotEncoder(pad_pods=128, pad_nodes=64)
@@ -98,7 +167,7 @@ def main():
     seed = 10_000
     failures = 0
     while time.time() < deadline:
-        msg = one_case(seed, scan_cycle, rounds_cycle, enc)
+        msg = one_case(seed, scan_cycle, rounds_cycle, pre_fn, enc)
         if msg:
             failures += 1
             print("FAIL:", msg, flush=True)
@@ -106,8 +175,19 @@ def main():
                 break
         seed += 1
         if (seed - 10_000) % 25 == 0:
-            print(f"  {seed - 10_000} cases, {failures} failures", flush=True)
-    print(f"done: {seed - 10_000} cases, {failures} failures")
+            r = one_case.regrets
+            print(
+                f"  {seed - 10_000} cases, {failures} failures, "
+                f"avg regret {np.mean(r):.2f} p95 "
+                f"{np.percentile(r, 95):.2f}",
+                flush=True,
+            )
+    r = one_case.regrets or [0.0]
+    print(
+        f"done: {seed - 10_000} cases, {failures} failures, "
+        f"avg regret {np.mean(r):.2f} p95 {np.percentile(r, 95):.2f} "
+        f"max {np.max(r):.2f} (bound {REGRET_BOUND})"
+    )
     sys.exit(1 if failures else 0)
 
 

@@ -1,0 +1,48 @@
+"""Sweep rounds-engine geometry (compact, passes, passes_round0) on the
+carry-based config-#4 cycle. Usage: python scripts/sweep_carry4.py"""
+import sys, time
+sys.path.insert(0, ".")
+import jax
+
+from k8s_scheduler_tpu.utils.compilation_cache import enable_compilation_cache
+
+enable_compilation_cache()
+import numpy as np
+from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
+from k8s_scheduler_tpu.core import build_packed_cycle_carry_fn, build_stable_state_fn
+from k8s_scheduler_tpu.core.cycle import CarryKeeper
+from k8s_scheduler_tpu.models import SnapshotEncoder
+
+enc = SnapshotEncoder(pad_pods=_pad(10000), pad_nodes=_pad(5000))
+bn, be = make_config_base(4)
+_n, pods, _e, groups = make_config_workload(4, seed=1000)
+w, b, spec, snap, dirty = enc.encode_packed(bn, pods, be, groups)
+w = jax.device_put(np.asarray(w)); b = jax.device_put(np.asarray(b))
+stable = build_stable_state_fn(spec)(w, b)
+keeper = CarryKeeper(spec)
+carry = keeper.ci(w, b, stable)
+
+cases = [
+    dict(compact=8, passes=6, passes_round0=10),  # current default
+    dict(compact=8, passes=4, passes_round0=8),
+    dict(compact=4, passes=4, passes_round0=8),
+    dict(compact=4, passes=6, passes_round0=10),
+    dict(compact=6, passes=4, passes_round0=6),
+    dict(compact=3, passes=4, passes_round0=8),
+]
+for kw in cases:
+    t0 = time.perf_counter()
+    cyc = build_packed_cycle_carry_fn(spec, rounds_kw=kw)
+    out = cyc(w, b, stable, carry)
+    np.asarray(out.assignment)
+    comp = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(8):
+        out = cyc(w, b, stable, carry)
+    np.asarray(out.assignment)
+    dt = (time.perf_counter() - t0) / 8 * 1e3
+    used = int(np.asarray(out.rounds_used))
+    acc = np.asarray(out.accepted_per_round)[:used].tolist()
+    print(f"{kw} -> {dt:.1f} ms/rep rounds={used} unsched="
+          f"{int(np.asarray(out.unschedulable).sum())} acc={acc} "
+          f"(compile {comp:.0f}s)", flush=True)

@@ -1,31 +1,18 @@
-"""Trace the PRODUCTION config-#4 path: packed buffers + injected stable
-state + preemption chain — the same programs bench_suite times.
-
-Run:  python scripts/trace_packed4.py [cfg]
-"""
-
-import collections
-import glob
-import gzip
-import json
-import sys
-
+"""Trace the carry-based config-#4 latency path (cycle only)."""
+import collections, glob, gzip, json, sys
 sys.path.insert(0, ".")
-
 import jax
 
 from k8s_scheduler_tpu.utils.compilation_cache import enable_compilation_cache
 
 enable_compilation_cache()
 import numpy as np
-
 from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
 from k8s_scheduler_tpu.core import (
-    build_packed_cycle_fn,
-    build_packed_preemption_fn,
-    build_stable_state_fn,
+    build_packed_cycle_carry_fn, build_stable_state_fn,
 )
-from k8s_scheduler_tpu.models import SnapshotEncoder, packing
+from k8s_scheduler_tpu.core.cycle import CarryKeeper
+from k8s_scheduler_tpu.models import SnapshotEncoder
 
 
 def main():
@@ -34,33 +21,25 @@ def main():
     enc = SnapshotEncoder(pad_pods=_pad(P_real), pad_nodes=_pad(N_real))
     bn, be = make_config_base(cfg)
     _n, pods, _e, groups = make_config_workload(cfg, seed=1000)
-    snap = enc.encode(bn, pods, be, groups)
-    spec = packing.make_spec(snap)
-    w, b = packing.pack(snap, spec)
-    w = jax.device_put(w)
-    b = jax.device_put(b)
-    cycle = build_packed_cycle_fn(spec, commit_mode="rounds")
-    pre = build_packed_preemption_fn(spec) if cfg == 4 else None
-    stable_fn = build_stable_state_fn(spec)
-    stable = stable_fn(w, b)
-    out = cycle(w, b, stable)
+    w, b, spec, snap, dirty = enc.encode_packed(bn, pods, be, groups)
+    w = jax.device_put(np.asarray(w))
+    b = jax.device_put(np.asarray(b))
+    cycle = build_packed_cycle_carry_fn(spec)
+    stable = build_stable_state_fn(spec)(w, b)
+    keeper = CarryKeeper(spec)
+    carry = keeper.ci(w, b, stable)
+    out = cycle(w, b, stable, carry)
     np.asarray(out.assignment)
-    if pre is not None:
-        np.asarray(pre(w, b, out).nominated)
 
     import shutil
 
-    shutil.rmtree("/tmp/jaxtrace2", ignore_errors=True)
-    with jax.profiler.trace("/tmp/jaxtrace2"):
+    shutil.rmtree("/tmp/jaxtrace3", ignore_errors=True)
+    with jax.profiler.trace("/tmp/jaxtrace3"):
         for _ in range(3):
-            out = cycle(w, b, stable)
-            if pre is not None:
-                pr = pre(w, b, out)
+            out = cycle(w, b, stable, carry)
         np.asarray(out.assignment)
-        if pre is not None:
-            np.asarray(pr.nominated)
 
-    hlo = cycle.lower(w, b, stable).compile().as_text()
+    hlo = cycle.lower(w, b, stable, carry).compile().as_text()
     src_of = {}
     for line in hlo.splitlines():
         line = line.strip()
@@ -77,7 +56,7 @@ def main():
                 f += ":" + line.split("source_line=", 1)[1].split(" ", 1)[0]
         src_of[name] = f"{m} {f}"
 
-    tr = sorted(glob.glob("/tmp/jaxtrace2/plugins/profile/*/*.trace.json.gz"))[-1]
+    tr = sorted(glob.glob("/tmp/jaxtrace3/plugins/profile/*/*.trace.json.gz"))[-1]
     d = json.load(gzip.open(tr))
     evs = d.get("traceEvents", [])
     pids = {}
@@ -91,7 +70,7 @@ def main():
             agg[e["name"]] += e["dur"]
             cnt[e["name"]] += 1
     total = 0
-    for n, v in agg.most_common(45):
+    for n, v in agg.most_common(40):
         if n.startswith("jit_"):
             print(f"{v/3e3:9.2f} ms/rep x{cnt[n]//3:5d}  {n}")
             continue

@@ -20,3 +20,49 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+from k8s_scheduler_tpu.utils.compilation_cache import (  # noqa: E402
+    enable_compilation_cache,
+)
+
+enable_compilation_cache()
+
+import pytest  # noqa: E402
+
+# Tests measured >8s (compile-bound integration tests; `--durations`
+# re-survey when this list drifts). The fast tier skips them:
+#   python -m pytest tests/ -q -m "not slow"
+_SLOW_TESTS = {
+    "test_packed_cycle_matches_unpacked",
+    "test_carry_cycle_matches_plain_over_churn",
+    "test_stable_state_injection_matches",
+    "test_profile_cycle_fills_per_plugin_histograms",
+    "test_stable_state_reused_across_pending_changes",
+    "test_rounds_deterministic",
+    "test_extender_error_nonignorable_backoff",
+    "test_rounds_throughput_close_to_scan",
+    "test_bind_error_and_unschedulable_results",
+    "test_gang_drop_reason_is_coscheduling",
+    "test_rounds_validity_on_mixed_workload",
+    "test_dryrun_multichip_2",
+    "test_rounds_validity_with_existing_pods",
+    "test_profiles_place_identical_pods_differently",
+    "test_scheduled_event_and_reason_metric",
+    "test_extender_filter_and_bind_delegation",
+    "test_rounds_affinity_bootstrap_and_colocation",
+    "test_host_plugin_lifecycle_order",
+    "test_scheduler_sequential_cycles_respect_capacity",
+    "test_scheduler_end_to_end_bind",
+    "test_scheduler_preemption_flow",
+    "test_volume_binding_over_the_wire",
+    "test_scheduler_node_delete_requeues",
+    "test_scheduler_gang_requeue",
+}
+_SLOW_MODULES = {"tests.test_concurrency"}
+
+
+def pytest_collection_modifyitems(config, items):
+    for it in items:
+        base = it.name.split("[")[0]
+        if base in _SLOW_TESTS or it.module.__name__ in _SLOW_MODULES:
+            it.add_marker(pytest.mark.slow)

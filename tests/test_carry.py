@@ -1,0 +1,118 @@
+"""The carry-based latency path must schedule identically to the classic
+packed rounds cycle, and the diagnosis program must attribute reasons for
+EVERY unplaced pod (VERDICT r2 item 5 — no blank reasons, ever).
+"""
+
+import numpy as np
+import pytest
+
+from k8s_scheduler_tpu.core import (
+    build_carry_fns,
+    build_diagnosis_fn,
+    build_packed_cycle_carry_fn,
+    build_packed_cycle_fn,
+    build_stable_state_fn,
+)
+from k8s_scheduler_tpu.framework.runtime import Framework
+from k8s_scheduler_tpu.models import MakePod, SnapshotEncoder
+from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+
+def drive_carry(enc, nodes, pending, existing, carry_state):
+    w, b, spec, snap, dirty = enc.encode_packed(nodes, pending, existing)
+    key = spec.key()
+    if carry_state.get("key") != key:
+        carry_state.clear()
+        carry_state["key"] = key
+        carry_state["cycle"] = build_packed_cycle_carry_fn(spec)
+        carry_state["plain"] = build_packed_cycle_fn(
+            spec, commit_mode="rounds"
+        )
+        carry_state["stable_fn"] = build_stable_state_fn(spec)
+        ci, cu = build_carry_fns(spec)
+        carry_state["ci"], carry_state["cu"] = ci, cu
+        dirty = None
+    stable = carry_state["stable_fn"](w, b)
+    if dirty is None or "carry" not in carry_state:
+        carry_state["carry"] = carry_state["ci"](w, b, stable)
+    elif len(dirty):
+        bucket = max(8, 1 << int(len(dirty) - 1).bit_length())
+        idx = np.full(bucket, dirty[0], np.int32)
+        idx[: len(dirty)] = dirty
+        carry_state["carry"] = carry_state["cu"](bucket)(
+            w, b, stable, carry_state["carry"], idx
+        )
+    out_c = carry_state["cycle"](w, b, stable, carry_state["carry"])
+    out_p = carry_state["plain"](w, b, stable)
+    return w, b, spec, stable, out_c, out_p
+
+
+def test_carry_cycle_matches_plain_over_churn():
+    rng = np.random.default_rng(1)
+    nodes = make_cluster(10)
+    enc = SnapshotEncoder(pad_pods=128, pad_nodes=16)
+    pending = make_pods(
+        70, seed=1, affinity_fraction=0.3, anti_affinity_fraction=0.2,
+        spread_fraction=0.2, selector_fraction=0.3, num_apps=6,
+        priorities=(0, 10),
+    )
+    existing = [(p, f"node-{i % 10}") for i, p in enumerate(
+        make_pods(20, seed=2, name_prefix="run", affinity_fraction=0.2,
+                  num_apps=6)
+    )]
+    st = {}
+    for i in range(6):
+        idx = rng.choice(len(pending), size=18, replace=False)
+        fresh = make_pods(
+            18, seed=50 + i, name_prefix=f"c{i}-", affinity_fraction=0.3,
+            spread_fraction=0.2, selector_fraction=0.3, num_apps=6,
+            priorities=(0, 10),
+        )
+        for j, f in zip(idx, fresh):
+            pending[j] = f
+        _w, _b, _spec, _stable, out_c, out_p = drive_carry(
+            enc, nodes, pending, existing, st
+        )
+        assert np.array_equal(
+            np.asarray(out_c.assignment), np.asarray(out_p.assignment)
+        ), f"iteration {i}: carry assignment diverged"
+        assert np.array_equal(
+            np.asarray(out_c.unschedulable), np.asarray(out_p.unschedulable)
+        )
+
+
+def test_diagnosis_attributes_every_unplaced_pod():
+    # 50 pods demand a label no node has -> all unschedulable via
+    # NodeAffinity; window=8 forces the diagnosis loop to iterate
+    nodes = make_cluster(4)
+    enc = SnapshotEncoder(pad_pods=64, pad_nodes=8)
+    pods = [
+        MakePod(f"p{i}").req({"cpu": "100m"})
+        .node_selector({"no-such-label": "x"}).created(float(i)).obj()
+        for i in range(50)
+    ]
+    w, b, spec, snap, _ = enc.encode_packed(nodes, pods)
+    stable = build_stable_state_fn(spec)(w, b)
+    ci, _cu = build_carry_fns(spec)
+    carry = ci(w, b, stable)
+    out = build_packed_cycle_carry_fn(spec)(w, b, stable, carry)
+    assert int(np.asarray(out.unschedulable).sum()) == 50
+    diag = build_diagnosis_fn(spec, window=8)
+    rej = np.asarray(
+        diag(w, b, stable, out.assignment, out.node_requested)
+    )
+    fw = Framework.from_config()
+    col = fw.filter_names.index("NodeAffinity")
+    unplaced = np.asarray(out.unschedulable)
+    # EVERY unplaced pod gets a nonzero attribution row, and the
+    # first-rejector is NodeAffinity on all real nodes
+    assert (rej[unplaced].sum(axis=1) > 0).all()
+    assert (rej[unplaced][:, col] == 4).all()
+    # placed/padding rows stay zero
+    assert (rej[~unplaced] == 0).all()
+
+
+if __name__ == "__main__":
+    import sys
+
+    pytest.main([__file__, "-v"] + sys.argv[1:])

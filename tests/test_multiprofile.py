@@ -1,0 +1,163 @@
+"""Multi-profile serving (VERDICT r2 item 4; SURVEY.md §2 C12, §5.6):
+pods route to the framework of the profile named by
+`pod.spec.scheduler_name`; two profiles with different score weights
+produce different placements for identical pods in one process; unknown
+scheduler names are parked loudly, never silently scheduled under the
+wrong profile.
+"""
+
+import pytest
+
+from k8s_scheduler_tpu.config import (
+    PluginEntry,
+    Plugins,
+    PluginSet,
+    Profile,
+    SchedulerConfiguration,
+)
+from k8s_scheduler_tpu.core import Scheduler
+from k8s_scheduler_tpu.models import MakeNode, MakePod
+
+
+def two_profile_config() -> SchedulerConfiguration:
+    # profile A: ImageLocality massively upweighted; profile B: no
+    # ImageLocality at all — identical pods diverge on an image-warm node
+    return SchedulerConfiguration(profiles=[
+        Profile(
+            scheduler_name="image-lover",
+            plugins=Plugins(score=PluginSet(
+                disabled=["*"],
+                enabled=[PluginEntry("ImageLocality", weight=100)],
+            )),
+        ),
+        Profile(
+            scheduler_name="image-blind",
+            plugins=Plugins(score=PluginSet(
+                disabled=["*"],
+                enabled=[PluginEntry("NodeResourcesFit", weight=1)],
+            )),
+        ),
+    ])
+
+
+def make_cluster_and_scheduler():
+    binds = {}
+    sched = Scheduler(
+        config=two_profile_config(),
+        binder=lambda pod, node: binds.__setitem__(pod.name, node),
+    )
+    # node-1 holds the (huge, everywhere-counted) image but is slightly
+    # more loaded; node-0 is emptier. Image-driven scoring picks node-1,
+    # resource-driven scoring picks node-0.
+    sched.on_node_add(MakeNode("node-0").capacity({"cpu": "8"}).obj())
+    sched.on_node_add(
+        MakeNode("node-1").capacity({"cpu": "8"})
+        .image("big:v1", 2 * 2**30).obj()
+    )
+    filler = MakePod("filler").req({"cpu": "2"}).obj()
+    sched.on_pod_add(filler, node_name="node-1")
+    return sched, binds
+
+
+def test_profiles_place_identical_pods_differently():
+    sched, binds = make_cluster_and_scheduler()
+    a = (
+        MakePod("pod-a").req({"cpu": "1"}).image("big:v1")
+        .scheduler("image-lover").obj()
+    )
+    b = (
+        MakePod("pod-b").req({"cpu": "1"}).image("big:v1")
+        .scheduler("image-blind").obj()
+    )
+    sched.on_pod_add(a)
+    sched.on_pod_add(b)
+    stats = sched.schedule_cycle()
+    assert stats.scheduled == 2
+    assert binds["pod-a"] == "node-1"  # image gravity
+    assert binds["pod-b"] == "node-0"  # resource gravity
+
+
+def test_unknown_scheduler_name_is_parked_loudly():
+    sched, binds = make_cluster_and_scheduler()
+    ghost = (
+        MakePod("ghost").req({"cpu": "1"})
+        .scheduler("no-such-scheduler").obj()
+    )
+    sched.on_pod_add(ghost)
+    stats = sched.schedule_cycle()
+    assert stats.scheduled == 0
+    assert stats.unschedulable == 1
+    assert "ghost" not in binds
+    evs = [e for e in sched.events.events() if e.pod_name == "ghost"]
+    assert evs and "no profile named" in evs[-1].message
+
+
+def test_default_profile_name_still_routes():
+    # a single default-profile scheduler keeps working unchanged
+    binds = {}
+    sched = Scheduler(
+        binder=lambda pod, node: binds.__setitem__(pod.name, node)
+    )
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "4"}).obj())
+    sched.on_pod_add(MakePod("p").req({"cpu": "1"}).obj())
+    stats = sched.schedule_cycle()
+    assert stats.scheduled == 1 and binds["p"] == "n0"
+
+
+def test_nomination_survives_other_profiles_encode():
+    # profile B's preemption nominates in-place; profile A encoding
+    # first in the next cycle must NOT consume B's mutation report
+    # (per-profile mutation sets — the delta arena would otherwise keep
+    # pod_nominated=-1 for B's preemptor)
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    evicted = []
+    sched = Scheduler(
+        config=two_profile_config(),
+        evictor=lambda pod, node: evicted.append(pod.name),
+        now=clock,
+    )
+    sched.on_node_add(MakeNode("n0").capacity({"cpu": "2"}).obj())
+    victim = MakePod("victim").req({"cpu": "2"}).priority(0).obj()
+    sched.on_pod_add(victim, node_name="n0")
+    # keep profile A busy every cycle so its encode runs first
+    a_pod = (
+        MakePod("a-idle").req({"cpu": "100"})  # never fits; stays pending
+        .scheduler("image-lover").obj()
+    )
+    preemptor = (
+        MakePod("preemptor").req(ba := {"cpu": "2"}).priority(10)
+        .scheduler("image-blind").created(1.0).obj()
+    )
+    sched.on_pod_add(a_pod)
+    sched.on_pod_add(preemptor)
+    s1 = sched.schedule_cycle()
+    assert s1.preemptors == 1 and evicted == ["victim"]
+    assert preemptor.nominated_node_name == "n0"
+    # victim eviction observed; next cycles: the preemptor's nominated
+    # row must be present in profile B's arena (not wiped by A's encode)
+    clock.t += 30.0  # clear pod backoff
+    sched.on_pod_delete(victim.uid)
+    binds = {}
+    sched.binder = lambda pod, node: binds.__setitem__(pod.name, node)
+    s2 = sched.schedule_cycle()
+    assert binds.get("preemptor") == "n0", (s2, binds)
+
+
+def test_duplicate_profile_names_rejected():
+    cfg = SchedulerConfiguration(
+        profiles=[Profile("x"), Profile("x")]
+    )
+    with pytest.raises(ValueError):
+        Scheduler(config=cfg)
+
+
+if __name__ == "__main__":
+    import sys
+
+    pytest.main([__file__, "-v"] + sys.argv[1:])

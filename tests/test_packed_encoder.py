@@ -48,7 +48,7 @@ class Driver:
 
     def step(self, nodes, pending, existing=(), groups=(), mutated=frozenset(),
              **kw):
-        w, bb, spec, vsnap = self.a.encode_packed(
+        w, bb, spec, vsnap, _dirty = self.a.encode_packed(
             nodes, pending, existing, groups, mutated_ids=mutated, **kw
         )
         ref = self.b.encode(nodes, pending, existing, groups, **kw)
@@ -151,7 +151,7 @@ def test_arena_survives_async_dispatch_mutation():
     d = SnapshotEncoder(pad_pods=64, pad_nodes=8)
     nodes = make_cluster(4)
     pods = make_pods(30, seed=7)
-    w, b, spec, _ = d.encode_packed(nodes, pods)
+    w, b, spec, _, _ = d.encode_packed(nodes, pods)
 
     @jax.jit
     def digest(wb, bb):
@@ -168,7 +168,7 @@ def test_arena_survives_async_dispatch_mutation():
         got = (int(np.asarray(out[0])), int(np.asarray(out[1])))
         assert got == ref  # the in-flight dispatch saw pre-mutation bytes
         # restore and re-encode for the next iteration's baseline
-        w, b, spec, _ = d.encode_packed(nodes, pods)
+        w, b, spec, _, _ = d.encode_packed(nodes, pods)
         out = digest(w, b)
         ref = (int(np.asarray(out[0])), int(np.asarray(out[1])))
 
