@@ -90,6 +90,84 @@ def sampling_mask(snap: ClusterSnapshot, pct: int) -> jnp.ndarray:
     return win < k
 
 
+_BUILD_SALT = __import__("itertools").count()
+
+
+def _unique(fn, base: str):
+    """Give each built program a process-unique __name__ (and therefore a
+    distinct HLO module name) — keeps profiling/trace output legible when
+    several builders produce byte-identical programs."""
+    fn.__name__ = f"{base}{next(_BUILD_SALT)}"
+    fn.__qualname__ = fn.__name__
+    return fn
+
+
+class _Resilient:
+    """Retry-once wrapper for the built jitted programs.
+
+    Observed on this runtime (jax 0.9 + the platform plugin): when
+    several jits compile byte-identical programs in one process and one
+    of them has EXECUTED, another's SECOND call can fail with
+    'Execution supplied N buffers but compiled program expected N+1' —
+    same jit object, identical avals/shardings, no retrace (its cache
+    already holds the entry). `clear_cache()` + re-trace recovers
+    deterministically (verified by targeted reproduction), so this
+    wrapper does exactly that, once. The programs are pure, so the
+    retry is safe; anything else re-raises."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, *a, **k):
+        try:
+            return self._fn(*a, **k)
+        except ValueError as e:
+            if "compiled program expected" not in str(e):
+                raise
+            self._fn.clear_cache()
+            return self._fn(*a, **k)
+
+    def lower(self, *a, **k):
+        return self._fn.lower(*a, **k)
+
+    def clear_cache(self):
+        return self._fn.clear_cache()
+
+    def _cache_size(self):
+        return self._fn._cache_size()
+
+
+def _jit(fn, base: str, **jit_kw):
+    return _Resilient(jax.jit(_unique(fn, base), **jit_kw))
+
+
+def _make_pv_choice_fn(ctx: CycleContext):
+    """The rounds engine's static-PV guard hook: chosen PV per
+    (claimant, volume slot) against the live claim bitmap in the
+    VolumeBinding extra state. None when the snapshot has no volumes."""
+    if not ctx.snap.has_volumes:
+        return None
+    from ..ops import volumes as volumes_ops
+
+    def pv_choice_fn(vsnap, node_of, live, ext_state):
+        claimed = ext_state.get("VolumeBinding")
+        MVol = vsnap.pod_vol_mode.shape[1]
+        B = node_of.shape[0]
+        if claimed is None:  # plugin disabled in this profile
+            return jnp.full((B, MVol), -1, jnp.int32)
+        return jnp.stack(
+            [
+                volumes_ops.chosen_pv(
+                    vsnap, ctx.expr_node_mask, claimed, node_of, live, j
+                )
+                for j in range(MVol)
+            ],
+            axis=1,
+        )
+
+    return pv_choice_fn
+
+
 def _gang_unwind(snap: ClusterSnapshot, result):
     """All-or-nothing gang rollback (Coscheduling analogue, SURVEY.md §2
     C14): groups whose placed-this-cycle count plus already-running
@@ -146,7 +224,6 @@ def build_cycle_fn(
     if commit_mode == "rounds":
         fw.check_batched_parity()
 
-    @jax.jit
     def cycle(snap: ClusterSnapshot, stable=None) -> CycleResult:
         ctx = CycleContext(snap)
         if stable is not None:
@@ -205,6 +282,7 @@ def build_cycle_fn(
                 extra=extra,
                 max_rounds=max_rounds,
                 score_anchor_fn=lambda nr: fw.score_anchor(ctx, nr),
+                pv_choice_fn=_make_pv_choice_fn(ctx),
             )
             # Final-state work (dynamic reject attribution + the NodePorts
             # part of the preemption gate) only matters for pods that never
@@ -284,7 +362,7 @@ def build_cycle_fn(
             diag_per_round,
         )
 
-    return cycle
+    return _jit(cycle, "cycle")
 
 
 def build_packed_cycle_fn(spec, **kw):
@@ -302,11 +380,10 @@ def build_packed_cycle_fn(spec, **kw):
 
     cycle = build_cycle_fn(**kw)
 
-    @jax.jit
     def packed(wbuf, bbuf, stable=None):
         return cycle(packing.unpack(wbuf, bbuf, spec), stable)
 
-    return packed
+    return _jit(packed, "packed_cycle")
 
 
 def build_stable_state_fn(spec):
@@ -319,7 +396,6 @@ def build_stable_state_fn(spec):
     gates only on the snapshot's capability flags)."""
     from ..models import packing
 
-    @jax.jit
     def stable(wbuf, bbuf):
         snap = packing.unpack(wbuf, bbuf, spec)
         ctx = CycleContext(snap)
@@ -329,7 +405,7 @@ def build_stable_state_fn(spec):
             out["initial_affinity_state"] = ctx.initial_affinity_state()
         return out
 
-    return stable
+    return _jit(stable, "stable_state")
 
 
 def build_carry_fns(spec, framework: Framework | None = None):
@@ -358,7 +434,6 @@ def build_carry_fns(spec, framework: Framework | None = None):
             mask, jnp.clip(score, -1e6, 1e6), rounds_ops.NEG_INF
         )
 
-    @jax.jit
     def carry_init(wbuf, bbuf, stable):
         snap = packing.unpack(wbuf, bbuf, spec)
         ctx = CycleContext(snap)
@@ -368,13 +443,14 @@ def build_carry_fns(spec, framework: Framework | None = None):
             "mp": ctx.matched_pending,
         }
 
+    carry_init = _jit(carry_init, "carry_init")
+
     update_memo: dict[int, Callable] = {}
 
     def carry_update_for_bucket(n_bucket: int):
         hit = update_memo.get(n_bucket)
         if hit is None:
 
-            @functools.partial(jax.jit, donate_argnums=(3,))
             def carry_update(wbuf, bbuf, stable, carry, dirty):
                 # dirty: i32 [n_bucket] slot ids; pad entries repeat a
                 # real slot (identical rewrite, harmless)
@@ -389,6 +465,9 @@ def build_carry_fns(spec, framework: Framework | None = None):
                     "mp": carry["mp"].at[:, dirty].set(cols),
                 }
 
+            carry_update = _jit(
+                carry_update, "carry_update", donate_argnums=(3,)
+            )
             update_memo[n_bucket] = carry_update
             hit = carry_update
         return hit
@@ -473,7 +552,6 @@ def build_packed_cycle_carry_fn(
     fw = framework or Framework.from_config()
     fw.check_batched_parity()
 
-    @jax.jit
     def cycle(wbuf, bbuf, stable, carry) -> CycleResult:
         snap = packing.unpack(wbuf, bbuf, spec)
         ctx = CycleContext(snap)
@@ -514,6 +592,7 @@ def build_packed_cycle_carry_fn(
             extra=extra,
             max_rounds=max_rounds,
             score_anchor_fn=lambda nr: fw.score_anchor(ctx, nr),
+            pv_choice_fn=_make_pv_choice_fn(ctx),
             **(rounds_kw or {}),
         )
         result = commit_ops.CommitResult(
@@ -532,7 +611,7 @@ def build_packed_cycle_carry_fn(
             rres.accepted_per_round, rres.diag_per_round,
         )
 
-    return cycle
+    return _jit(cycle, "carry_cycle")
 
 
 def build_diagnosis_fn(spec, framework: Framework | None = None,
@@ -553,7 +632,6 @@ def build_diagnosis_fn(spec, framework: Framework | None = None,
     fw = framework or Framework.from_config()
     F = len(fw.filters)
 
-    @jax.jit
     def diagnose(wbuf, bbuf, stable, assignment, node_requested):
         snap = packing.unpack(wbuf, bbuf, spec)
         P = snap.P
@@ -612,7 +690,7 @@ def build_diagnosis_fn(spec, framework: Framework | None = None,
         )
         return rej
 
-    return diagnose
+    return _jit(diagnose, "diagnose")
 
 
 def _preemption_gate_rows(fw: Framework, ctx: CycleContext):
@@ -651,7 +729,6 @@ def build_packed_preemption_fn(spec, framework: Framework | None = None):
     if not fw.post_filters:
         return None
 
-    @jax.jit
     def packed(wbuf, bbuf, result, stable=None):
         snap = packing.unpack(wbuf, bbuf, spec)
         ctx = CycleContext(snap)
@@ -665,7 +742,7 @@ def build_packed_preemption_fn(spec, framework: Framework | None = None):
             excluded=result.gang_dropped,
         )
 
-    return packed
+    return _jit(packed, "packed_preempt")
 
 
 def build_preemption_fn(framework: Framework | None = None):
@@ -678,7 +755,6 @@ def build_preemption_fn(framework: Framework | None = None):
     if not fw.post_filters:
         return None
 
-    @jax.jit
     def post_filter(snap: ClusterSnapshot, result: CycleResult):
         ctx = CycleContext(snap)
         return fw.post_filter(
@@ -689,4 +765,4 @@ def build_preemption_fn(framework: Framework | None = None):
             excluded=result.gang_dropped,
         )
 
-    return post_filter
+    return _jit(post_filter, "post_filter")

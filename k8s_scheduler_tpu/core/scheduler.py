@@ -397,6 +397,16 @@ class Scheduler:
                 mutated_ids=frozenset(mut), **kw
             )
             mut.clear()
+            # ONE host->device upload per cycle (device_put copies the
+            # arena synchronously); numpy args would re-upload the packed
+            # buffers once per program in the chain below
+            import os as _os
+
+            if _os.environ.get("K8S_TPU_NO_DEVICE_PUT") != "1":
+                import jax as _jax
+
+                wbuf = _jax.device_put(wbuf)
+                bbuf = _jax.device_put(bbuf)
             pcycle, ppreempt, stable_fn, keeper, diag = self._packed_fns(
                 spec, profile
             )

@@ -221,8 +221,11 @@ class NodeAffinity(PluginBase):
 class VolumeBinding(PluginBase):
     """PVC/PV feasibility (ops/volumes.py): bound-PV node affinity,
     static-PV candidacy, and dynamic-provisioning topology for
-    WaitForFirstConsumer claims. Static (commitment-independent): volume
-    state only changes between cycles, via PVC/PV informer events."""
+    WaitForFirstConsumer claims. The static mask covers pre-cycle
+    availability; a `pv_claimed` bitmap in the commit engines' extra
+    state arbitrates SAME-CYCLE claimants of one static PV (a placed pod
+    claims its lowest-index compatible PV; later pods see it taken —
+    upstream resolves this one pod later at PreBind via bind failure)."""
 
     name = "VolumeBinding"
 
@@ -232,6 +235,74 @@ class VolumeBinding(PluginBase):
         if not ctx.snap.has_volumes:
             return None
         return volumes_ops.volume_mask(ctx.snap, ctx.expr_node_mask)
+
+    def _has_static_claims(self, snap) -> bool:
+        # claim tracking only matters when unbound WFC slots AND static
+        # PVs exist at all; otherwise the state is dead weight
+        return bool(snap.has_volumes and snap.pv_avail.shape[0] > 0)
+
+    def extra_init(self, ctx: CycleContext):
+        import jax.numpy as jnp
+
+        if not self._has_static_claims(ctx.snap):
+            return None
+        return jnp.zeros((ctx.snap.pv_avail.shape[0],), bool)
+
+    def dyn_mask(self, ctx: CycleContext, p, node_requested, extra):
+        from ..ops import volumes as volumes_ops
+
+        if not self._has_static_claims(ctx.snap):
+            return None
+        # per-pod ROW form: the scan calls this once per step, and the
+        # batched [P, N] form would redo full-set work P times
+        return volumes_ops.volume_mask_unbound_row(
+            ctx.snap, ctx.expr_node_mask, extra[self.name], p
+        )
+
+    def dyn_mask_batched(self, ctx: CycleContext, node_requested, extra,
+                         shared):
+        from ..ops import volumes as volumes_ops
+
+        if not self._has_static_claims(ctx.snap):
+            return None
+        return volumes_ops.volume_mask_unbound(
+            ctx.snap, ctx.expr_node_mask, extra[self.name]
+        )
+
+    def extra_update(self, ctx: CycleContext, extra, p, node, committed):
+        import jax.numpy as jnp
+
+        if extra is None:
+            return extra
+        from ..ops import volumes as volumes_ops
+
+        snap = ctx.snap
+        claimed = extra
+        for j in range(snap.pod_vol_mode.shape[1]):
+            ch = volumes_ops.chosen_pv_row(
+                snap, ctx.expr_node_mask, claimed, node, p, j
+            )
+            ch = jnp.where(committed, ch, -1)
+            claimed = claimed.at[jnp.clip(ch, 0, claimed.shape[0] - 1)].max(
+                ch >= 0
+            )
+        return claimed
+
+    def extra_update_batched(self, ctx: CycleContext, extra, accepted,
+                             node_of):
+        if extra is None:
+            return extra
+        from ..ops import volumes as volumes_ops
+
+        snap = ctx.snap
+        # fixed-point fold: exact for ANY batch (diagnosis replays a
+        # whole cycle's placements at once, where same-class claimants
+        # contend); under the rounds engine's _RB_PV guard the batch is
+        # claim-disjoint and the loop exits after one pass
+        return volumes_ops.fold_pv_claims(
+            snap, ctx.expr_node_mask, extra, accepted, node_of,
+            snap.pod_order.astype("int32"),
+        )
 
 
 class TaintToleration(PluginBase):

@@ -103,6 +103,7 @@ _RB_BOOT = 4
 _RB_GMATCH = 8
 _RB_SPREAD = 16
 _RB_PORT = 32
+_RB_PV = 64  # static-PV exclusivity: one claimant per PV per cycle
 
 
 @jax.tree_util.register_dataclass
@@ -221,6 +222,9 @@ def rounds_commit(
     passes_round0: int = 10,  # smaller counts compile ~30% faster
     score_anchor_fn: Callable | None = None,  # node_requested -> f32 [N]
     # capacity-sensitive node-local score component (Framework.score_anchor)
+    pv_choice_fn: Callable | None = None,  # (vsnap, node_of, live, ext)
+    # -> i32 [B, MVol] chosen static PV per claimant/slot (-1 none): the
+    # guard arbitrates same-round claimants of one PV by rank
 ) -> RoundsResult:
     P, N = (sbase if sbase is not None else static_mask).shape
     S = m_pending.shape[0]
@@ -249,10 +253,13 @@ def rounds_commit(
     has_port_guards = bool(Q > 0)
 
     # group-key space: domain groups, per-selector global groups,
-    # (node, port) groups, invalid
+    # (node, port) groups, static-PV groups, invalid
     GK_GLOBAL = S * (D + 1)
     GK_PORT = GK_GLOBAL + S
-    GK_INVALID = GK_PORT + N * Q + 1
+    GK_PV = GK_PORT + N * Q
+    V = snap.pv_avail.shape[0]
+    GK_INVALID = GK_PV + V + 1
+    has_pv_guards = bool(snap.has_volumes and pv_choice_fn is not None)
 
     slack = _REL_EPS * snap.node_allocatable + _REL_EPS  # [N, R]
     # static mask+score pre-combined; scores clamp to +-1e6 (far above any
@@ -271,7 +278,7 @@ def rounds_commit(
         the claims would produce."""
         B = vrank.shape[0]
         state = _owner_state(ext_state) if has_guards else None
-        if state is None and not has_port_guards:
+        if state is None and not has_port_guards and not has_pv_guards:
             return jnp.ones((B,), bool)
         nsafe = jnp.clip(choice, 0, N - 1)
         pid = jnp.arange(B, dtype=jnp.int32)
@@ -339,6 +346,13 @@ def rounds_commit(
                 ids = vsnap.pod_port_ids[:, j]
                 key = GK_PORT + nsafe * Q + jnp.clip(ids, 0, Q - 1)
                 emit(key, ids >= 0, _RB_PORT)
+        if has_pv_guards:
+            # one entry per (claimant, volume slot) naming the static PV
+            # the claim would bind; first rank per PV survives
+            pvc = pv_choice_fn(vsnap, nsafe, live, ext_state)  # [B, MVol]
+            for j in range(pvc.shape[1]):
+                ids = pvc[:, j]
+                emit(GK_PV + jnp.clip(ids, 0, V - 1), ids >= 0, _RB_PV)
 
         keys_c = jnp.concatenate(keys)
         roles_c = jnp.concatenate(roles)
@@ -382,6 +396,7 @@ def rounds_commit(
                 "boot": (role_s == _RB_BOOT).astype(jnp.int32),
                 "gmatch": (role_s == _RB_GMATCH).astype(jnp.int32),
                 "port": (role_s == _RB_PORT).astype(jnp.int32),
+                "pv": (role_s == _RB_PV).astype(jnp.int32),
                 "arrive": ((role_s == _RB_MATCH) | (role_s == _RB_SPREAD))
                 .astype(jnp.int32),
             },
@@ -398,6 +413,7 @@ def rounds_commit(
             role_s == _RB_SPREAD, before["arrive"] < cap_s, True
         )
         ok_e &= jnp.where(role_s == _RB_PORT, before["port"] == 0, True)
+        ok_e &= jnp.where(role_s == _RB_PV, before["pv"] == 0, True)
         ok_e |= keys_s == GK_INVALID
         ok_pod = (
             jnp.ones((B,), jnp.int32).at[pods_s].min(ok_e.astype(jnp.int32))

@@ -19,14 +19,19 @@ requirement table. The static-candidate test batches into one
 [P*MVol, V] x [V, N] matmul; everything is gated on the `has_volumes`
 capability flag, so volume-free clusters never trace any of it.
 
-Same-cycle contention for one static PV (two pods, one volume) is NOT
-arbitrated in-cycle: upstream binds volumes in PreBind and relies on
-bind-failure retry for the loser, and this kernel inherits that contract
-(the agent reports the failed bind; the pod requeues).
+Same-cycle contention for one static PV IS arbitrated in-cycle
+(VERDICT r2 item 8): the VolumeBinding plugin carries a `pv_claimed`
+bitmap through the commit engines' extra state — a placed pod claims its
+chosen PV (lowest-index compatible, upstream's deterministic binder
+choice), later pods in the cycle see the PV as unavailable, and the
+rounds engine's participant table additionally resolves SAME-ROUND
+claimants of one PV by rank (`_RB_PV`). Dynamic provisioning is
+unlimited and needs no arbitration.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from . import labels as labels_ops
@@ -34,35 +39,56 @@ from . import labels as labels_ops
 _CAP_EPS = 1e-3
 
 
-def volume_mask(snap, expr_mask: jnp.ndarray) -> jnp.ndarray:  # bool [P, N]
-    """Conjunction over each pod's PVC constraints (module docstring)."""
+def pv_node_table(snap, expr_mask):  # bool [V, N]
+    """Per-PV node admissibility (nodeAffinity through the shared
+    requirement table) AND pre-cycle availability."""
+    req = labels_ops.requirement_mask(snap.rq_exprs, expr_mask)  # [Rq, N]
+    return (
+        labels_ops.take_rows(req, snap.pv_req_id, True)
+        & snap.pv_avail[:, None]
+    )
+
+
+def pod_pv_cand(snap, j):  # bool [P, V] class+size candidacy for slot j
+    cls = snap.pod_vol_class[:, j]
+    size = snap.pod_vol_size[:, j]
+    return (
+        (snap.pv_class[None, :] == cls[:, None])
+        & (snap.pv_capacity[None, :] + _CAP_EPS >= size[:, None])
+        & (snap.pod_vol_mode[:, j] == 1)[:, None]
+    )
+
+
+def volume_mask(snap, expr_mask: jnp.ndarray,
+                pv_claimed: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Conjunction over each pod's PVC constraints -> bool [P, N].
+    `pv_claimed` (bool [V]) marks static PVs already claimed by this
+    cycle's placements; None = pre-cycle availability only (the static
+    phase — the commit engines re-run the unbound-slot part per round
+    with the live bitmap via VolumeBinding.dyn_mask*)."""
     P, N = snap.P, snap.N
     req = labels_ops.requirement_mask(snap.rq_exprs, expr_mask)  # [Rq, N]
-    Rq = req.shape[0]
-    MVol = snap.pod_vol_mode.shape[1]
 
     def req_rows(ids):  # i32 [X] -> bool [X, N]; id < 0 -> all-True
         return labels_ops.take_rows(req, ids, True)
 
-    pv_node_ok = req_rows(snap.pv_req_id) & snap.pv_avail[:, None]  # [V, N]
+    pv_ok = req_rows(snap.pv_req_id) & snap.pv_avail[:, None]  # [V, N]
+    if pv_claimed is not None:
+        pv_ok = pv_ok & ~pv_claimed[:, None]
+    MVol = snap.pod_vol_mode.shape[1]
 
     ok = jnp.ones((P, N), bool)
     for j in range(MVol):
         mode = snap.pod_vol_mode[:, j]  # [P]
         rid = snap.pod_vol_req[:, j]
-        cls = snap.pod_vol_class[:, j]
-        size = snap.pod_vol_size[:, j]
 
         rid_rows = req_rows(rid)  # [P, N] (bound PV affinity / dyn topology)
 
         # static candidates: available PVs of the right class and size,
         # usable on the node
-        cand = (
-            (snap.pv_class[None, :] == cls[:, None])
-            & (snap.pv_capacity[None, :] + _CAP_EPS >= size[:, None])
-        )  # [P, V] (availability folded into pv_node_ok)
+        cand = pod_pv_cand(snap, j)  # [P, V]
         static_ok = (
-            cand.astype(jnp.float32) @ pv_node_ok.astype(jnp.float32)
+            cand.astype(jnp.float32) @ pv_ok.astype(jnp.float32)
         ) > 0.0  # [P, N]
 
         dyn_ok = jnp.where(
@@ -75,3 +101,156 @@ def volume_mask(snap, expr_mask: jnp.ndarray) -> jnp.ndarray:  # bool [P, N]
         )
         ok &= jnp.where((mode >= 0)[:, None], row_ok, True)
     return ok
+
+
+def volume_mask_unbound(snap, expr_mask, pv_claimed) -> jnp.ndarray:
+    """The CLAIM-dependent residue of volume_mask: only unbound
+    WaitForFirstConsumer slots (mode==1) re-evaluate against the live
+    `pv_claimed` bitmap; everything else (bound-PV affinity, missing
+    PVCs) is claim-independent and already in the static mask."""
+    P, N = snap.P, snap.N
+    req = labels_ops.requirement_mask(snap.rq_exprs, expr_mask)
+    pv_ok = (
+        labels_ops.take_rows(req, snap.pv_req_id, True)
+        & snap.pv_avail[:, None]
+        & ~pv_claimed[:, None]
+    )  # [V, N]
+    MVol = snap.pod_vol_mode.shape[1]
+    ok = jnp.ones((P, N), bool)
+    for j in range(MVol):
+        mode = snap.pod_vol_mode[:, j]
+        rid = snap.pod_vol_req[:, j]
+        static_ok = (
+            pod_pv_cand(snap, j).astype(jnp.float32)
+            @ pv_ok.astype(jnp.float32)
+        ) > 0.0
+        dyn_ok = jnp.where(
+            (rid == -2)[:, None], False,
+            labels_ops.take_rows(req, rid, True),
+        )
+        ok &= jnp.where((mode == 1)[:, None], static_ok | dyn_ok, True)
+    return ok
+
+
+def volume_mask_unbound_row(snap, expr_mask, pv_claimed, p):
+    """Single-pod row of volume_mask_unbound (bool [N]) — the scan
+    engine's per-step hook; the batched form would redo [P, N] work at
+    every one of P scan steps."""
+    N = snap.N
+    req = labels_ops.requirement_mask(snap.rq_exprs, expr_mask)
+    pv_ok = (
+        labels_ops.take_rows(req, snap.pv_req_id, True)
+        & snap.pv_avail[:, None]
+        & ~pv_claimed[:, None]
+    )  # [V, N]
+    Rq = req.shape[0]
+    MVol = snap.pod_vol_mode.shape[1]
+    ok = jnp.ones((N,), bool)
+    for j in range(MVol):
+        mode = snap.pod_vol_mode[p, j]
+        rid = snap.pod_vol_req[p, j]
+        cand = (
+            (snap.pv_class == snap.pod_vol_class[p, j])
+            & (snap.pv_capacity + _CAP_EPS >= snap.pod_vol_size[p, j])
+            & (mode == 1)
+        )  # [V]
+        static_ok = jnp.any(cand[:, None] & pv_ok, axis=0)  # [N]
+        rid_row = jnp.where(
+            rid >= 0, req[jnp.clip(rid, 0, Rq - 1)], True
+        )
+        dyn_ok = jnp.where(rid == -2, False, rid_row)
+        ok &= jnp.where(mode == 1, static_ok | dyn_ok, True)
+    return ok
+
+
+def chosen_pv_row(snap, expr_mask, pv_claimed, node, p, j):
+    """Scalar chosen_pv for one pod at one node (the scan engine's
+    per-step claim): i32 [] PV index or -1."""
+    V = snap.pv_avail.shape[0]
+    pv_ok_n = (
+        pv_node_table(snap, expr_mask)[:, jnp.clip(node, 0, snap.N - 1)]
+        & ~pv_claimed
+    )  # [V]
+    cand = (
+        (snap.pv_class == snap.pod_vol_class[p, j])
+        & (snap.pv_capacity + _CAP_EPS >= snap.pod_vol_size[p, j])
+        & (snap.pod_vol_mode[p, j] == 1)
+        & pv_ok_n
+    )
+    idx = jnp.where(cand, jnp.arange(V, dtype=jnp.int32), V)
+    best = jnp.min(idx).astype(jnp.int32)
+    return jnp.where(best < V, best, -1)
+
+
+def fold_pv_claims(snap, expr_mask, pv_claimed, accepted, node_of,
+                   rank):
+    """Fold a BATCH of placements' static-PV claims into `pv_claimed`
+    exactly as a rank-ordered sequential pass would: iterate — each pass
+    every unresolved claimant picks its lowest-index compatible
+    unclaimed PV, and only the LOWEST-RANK claimant per contended PV
+    claims it; losers retry against the updated bitmap. Terminates in at
+    most V passes (each pass claims >= 1 PV or nothing changes); when
+    the batch is known claim-disjoint (the rounds engine's _RB_PV guard
+    guarantees it) the loop exits after one pass."""
+    V = snap.pv_avail.shape[0]
+    P = accepted.shape[0]
+    MVol = snap.pod_vol_mode.shape[1]
+    big = jnp.int32(2**31 - 1)
+
+    def body(carry):
+        claimed, pending_slots, _progress = carry
+        progress = jnp.zeros((), bool)
+        for j in range(MVol):
+            ch = chosen_pv(
+                snap, expr_mask, claimed, node_of,
+                pending_slots[:, j], j,
+            )  # [P]
+            has = ch >= 0
+            chc = jnp.clip(ch, 0, V - 1)
+            # lowest rank per chosen PV wins this pass
+            winner_rank = (
+                jnp.full((V,), big).at[chc].min(
+                    jnp.where(has, rank, big)
+                )
+            )
+            won = has & (rank == winner_rank[chc])
+            claimed = claimed.at[chc].max(won)
+            # winners' slots resolve; losers retry next pass
+            pending_slots = pending_slots.at[:, j].set(
+                pending_slots[:, j] & ~won & has
+            )
+            progress = progress | jnp.any(won)
+        return claimed, pending_slots, progress
+
+    def cond(carry):
+        _, pending_slots, progress = carry
+        return progress & jnp.any(pending_slots)
+
+    init_slots = jnp.broadcast_to(accepted[:, None], (P, MVol)) & (
+        snap.pod_vol_mode == 1
+    )
+    claimed, _, _ = jax.lax.while_loop(
+        cond,
+        body,
+        body((pv_claimed, init_slots, jnp.ones((), bool))),
+    )
+    return claimed
+
+
+def chosen_pv(snap, expr_mask, pv_claimed, node_of, active, j):
+    """i32 [P]: the PV each active pod would claim for volume slot j at
+    node `node_of` — the LOWEST-INDEX compatible available unclaimed PV
+    admissible on that node (the deterministic binder choice both
+    engines and the oracle share); -1 when the slot is not an unbound
+    static claim (incl. pods whose slot rides dynamic provisioning
+    because no static PV fits)."""
+    V = snap.pv_avail.shape[0]
+    pv_ok = (
+        pv_node_table(snap, expr_mask) & ~pv_claimed[:, None]
+    )  # [V, N]
+    nsafe = jnp.clip(node_of, 0, snap.N - 1)
+    at_node = pv_ok[:, nsafe].T  # [P, V]
+    cand = pod_pv_cand(snap, j) & at_node & active[:, None]
+    idx = jnp.where(cand, jnp.arange(V, dtype=jnp.int32)[None, :], V)
+    best = jnp.min(idx, axis=1).astype(jnp.int32)
+    return jnp.where(best < V, best, -1)

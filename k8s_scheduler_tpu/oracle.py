@@ -124,6 +124,13 @@ class OracleState:
     # derived volume indexes (built once; volume state is per-cycle input)
     pvs_by_class: dict = dataclasses.field(default_factory=dict)
     claimed_pv_names: set = dataclasses.field(default_factory=set)
+    # in-cycle static-PV claims (VERDICT r2 item 8): a committed pod with
+    # an unbound WaitForFirstConsumer claim takes the lowest-index
+    # compatible PV (the kernels' deterministic binder choice); later
+    # pods in the same cycle see it as unavailable
+    pv_list: list = dataclasses.field(default_factory=list)
+    claimed_static: set = dataclasses.field(default_factory=set)
+    pod_claims: dict = dataclasses.field(default_factory=dict)
 
     @staticmethod
     def build(
@@ -148,20 +155,60 @@ class OracleState:
             claimed_pv_names={
                 c.volume_name for c in pvcs if c.volume_name
             },
+            pv_list=list(pvs),
         )
         for pod, node_name in existing:
             i = idx.get(node_name)
             if i is None:
                 continue
-            st.add(i, pod)
+            # existing pods' volume usage is already reflected through
+            # their PVCs' volume_name (claimed_pv_names); no in-cycle
+            # claim (mirrors the encoder's pv_avail)
+            st.add(i, pod, claim_volumes=False)
         return st
 
-    def add(self, node_idx: int, pod: Pod) -> None:
+    def add(self, node_idx: int, pod: Pod,
+            claim_volumes: bool = True) -> None:
         for r, v in pod.resource_requests().items():
             self.requested[node_idx][r] = self.requested[node_idx].get(r, 0.0) + v
         self.pods_on_node[node_idx].append(pod)
         self._version += 1
         self._bootstrap.clear()  # keys embed _version; old entries are dead
+        if claim_volumes and pod.spec.volumes:
+            self._claim_static_pvs(node_idx, pod)
+
+    def _claim_static_pvs(self, node_idx: int, pod: Pod) -> None:
+        """Mirror of ops/volumes.chosen_pv: lowest-index compatible
+        available unclaimed PV per unbound WaitForFirstConsumer slot."""
+        claims = []
+        node = self.nodes[node_idx]
+        for claim in pod.spec.volumes:
+            pvc = self.pvcs.get(f"{pod.namespace}/{claim}")
+            if pvc is None or pvc.volume_name:
+                continue
+            cls = self.storage_classes.get(pvc.storage_class)
+            if cls is None or cls.volume_binding_mode != api.VOLUME_BINDING_WAIT:
+                continue
+            for pv in self.pv_list:
+                if pv.storage_class != pvc.storage_class:
+                    continue
+                if (
+                    pv.claim_ref
+                    or pv.name in self.claimed_pv_names
+                    or pv.name in self.claimed_static
+                ):
+                    continue
+                if pv.capacity + 1e-3 < pvc.request:
+                    continue
+                if pv.node_affinity and not any(
+                    _match_term(node, t) for t in pv.node_affinity
+                ):
+                    continue
+                self.claimed_static.add(pv.name)
+                claims.append(pv.name)
+                break
+        if claims:
+            self.pod_claims[id(pod)] = claims
 
     def remove(self, node_idx: int, pod: Pod) -> None:
         for r, v in pod.resource_requests().items():
@@ -169,6 +216,8 @@ class OracleState:
         self.pods_on_node[node_idx].remove(pod)
         self._version += 1
         self._bootstrap.clear()
+        for name in self.pod_claims.pop(id(pod), ()):
+            self.claimed_static.discard(name)
 
     def any_pod_matches(self, term: PodAffinityTerm, own_ns: str) -> bool:
         key = (self._version, id(term), own_ns)
@@ -336,7 +385,11 @@ def filter_volume_binding(pod: Pod, state: OracleState, i: int) -> bool:
             return False
         ok = False
         for pv in state.pvs_by_class.get(pvc.storage_class, ()):
-            if pv.claim_ref or pv.name in state.claimed_pv_names:
+            if (
+                pv.claim_ref
+                or pv.name in state.claimed_pv_names
+                or pv.name in state.claimed_static
+            ):
                 continue
             if pv.capacity + 1e-3 < pvc.request:
                 continue

@@ -17,16 +17,27 @@ import os
 
 def enable_compilation_cache(path: str | None = None) -> str:
     """Idempotently point JAX at a persistent on-disk compilation cache.
-    Honors JAX_COMPILATION_CACHE_DIR when set; returns the directory."""
+    Honors JAX_COMPILATION_CACHE_DIR when set; returns the directory.
+
+    The directory is scoped PER PRIMARY BACKEND: an accelerator-attached
+    process compiles its host-side XLA:CPU programs with the plugin's
+    CPU tuning flags (+prefer-no-scatter/-gather here), and a pure-CPU
+    process loading those entries gets machine-feature mismatches and,
+    worse, executables whose buffer layout disagrees with the fresh
+    trace ("supplied 6 buffers but compiled program expected 7").
+    Separate directories keep each backend's entries self-consistent."""
     import jax
 
-    d = (
+    if os.environ.get("K8S_TPU_DISABLE_COMPILE_CACHE") == "1":
+        return ""
+    base = (
         path
         or os.environ.get("JAX_COMPILATION_CACHE_DIR")
         or os.path.join(
             os.path.expanduser("~"), ".cache", "k8s_scheduler_tpu_jax"
         )
     )
+    d = os.path.join(base, jax.default_backend())
     os.makedirs(d, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", d)
     # cache everything that takes real time; tiny programs stay in-memory
