@@ -340,7 +340,7 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
             if diag is not None:
                 np.asarray(
                     diag(wD, bD, stable, out.assignment,
-                         out.node_requested)
+                         out.node_requested, out.pv_claimed)
                 )
             compile_s += time.perf_counter() - t0
             dirty = np.empty(0, np.int32)  # carry already current
@@ -364,7 +364,7 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
             # dispatched after decisions are read, overlapping the next
             # snapshot's host-side encode (forced at loop end)
             last_diag = diag(wD, bD, stable, out.assignment,
-                             out.node_requested)
+                             out.node_requested, out.pv_claimed)
         if os.environ.get("BENCH_DEBUG"):
             print(f"  iter={i} cycle={times[-1]:.4f}s", flush=True)
 
@@ -389,11 +389,17 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     # back-to-back, force once — encode overlaps device compute. The
     # pending objects are fresh instances (cold row-cache entries for the
     # churned fraction), the same steady-state the latency loop saw.
+    # Snapshot GENERATION is bench fixture work (~150ms/draw at config
+    # #4 — synthetic pod construction, not the system under test), so
+    # the whole sequence is drawn before the timed window.
     pending = None
-    last = None
-    t0 = time.perf_counter()
+    drawn = []
     for i in range(snapshots):
         pending, groups = _draw_pending(cfg, i, pending, churn)
+        drawn.append((list(pending), groups))
+    last = None
+    t0 = time.perf_counter()
+    for pending, groups in drawn:
         wbuf, bbuf, s3, _vsnap, dirty = enc.encode_packed(
             base_nodes, pending, base_existing, groups
         )
@@ -408,7 +414,8 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
             fns, wbuf, bbuf, dirty
         )
         if diag is not None:
-            diag(wD, bD, stable, out.assignment, out.node_requested)
+            diag(wD, bD, stable, out.assignment, out.node_requested,
+                 out.pv_claimed)
         last = (out, out_pre)
     np.asarray(last[0].assignment)
     if last[1] is not None:
@@ -429,30 +436,42 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     stable = stable_state(spec, stable_fn, wbuf, bbuf)
     reps = 6
     carry_now = keeper.carry if keeper is not None else None
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = (
-            cycle_c(wbuf, bbuf, stable, carry_now)
-            if use_carry else cycle_c(wbuf, bbuf, stable)
-        )
+
+    def time_device_block():
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = (
+                cycle_c(wbuf, bbuf, stable, carry_now)
+                if use_carry else cycle_c(wbuf, bbuf, stable)
+            )
+            if preempt is not None:
+                out_pre = preempt(wbuf, bbuf, out, stable)
+        np.asarray(out.assignment)
         if preempt is not None:
-            out_pre = preempt(wbuf, bbuf, out, stable)
-    np.asarray(out.assignment)
-    if preempt is not None:
-        np.asarray(out_pre.nominated)
-    device_s = max((time.perf_counter() - t0 - tunnel_rt) / reps, 0.0)
+            np.asarray(out_pre.nominated)
+        return max((time.perf_counter() - t0 - tunnel_rt) / reps, 0.0), out
+
+    # two blocks, take the min: a one-off executable-cache retry (see
+    # core.cycle._Resilient) re-traces inside the timed window and would
+    # otherwise report seconds of compile as device time
+    d1, out = time_device_block()
+    d2, out = time_device_block()
+    device_s = min(d1, d2)
 
     diag_ms = 0.0
     if diag is not None:
-        d = diag(wbuf, bbuf, stable, out.assignment, out.node_requested)
+        def time_diag_block():
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                d = diag(wbuf, bbuf, stable, out.assignment,
+                         out.node_requested, out.pv_claimed)
+            np.asarray(d)
+            return max((time.perf_counter() - t0 - tunnel_rt) / reps, 0.0)
+
+        d = diag(wbuf, bbuf, stable, out.assignment, out.node_requested,
+                 out.pv_claimed)
         np.asarray(d)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            d = diag(wbuf, bbuf, stable, out.assignment, out.node_requested)
-        np.asarray(d)
-        diag_ms = max(
-            (time.perf_counter() - t0 - tunnel_rt) / reps, 0.0
-        ) * 1e3
+        diag_ms = min(time_diag_block(), time_diag_block()) * 1e3
 
     p50 = _percentile(times, 50)
     p99 = _percentile(times, 99)

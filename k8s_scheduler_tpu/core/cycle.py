@@ -47,6 +47,11 @@ class CycleResult:
     reject_counts: jnp.ndarray  # i32 [P, F] nodes first-rejected per filter
     # (static + dynamic attribution summed; columns = Framework.filter_names)
     # — feeds FailedScheduling events and requeue queueing hints
+    pv_claimed: jnp.ndarray  # bool [V] static PVs claimed by this cycle's
+    # placements (all-False when VolumeBinding carries no state). The
+    # diagnosis program consumes the ENGINE's actual bitmap — a batched
+    # replay could reconstruct different claims when a pod was revoked
+    # and re-accepted across rounds.
     rounds_used: jnp.ndarray  # i32 [] commit rounds consumed (0 in scan mode)
     accepted_per_round: jnp.ndarray  # i32 [max_rounds] acceptance counts
     # per commit round (zeros in scan mode) — convergence diagnostics
@@ -90,15 +95,14 @@ def sampling_mask(snap: ClusterSnapshot, pct: int) -> jnp.ndarray:
     return win < k
 
 
-_BUILD_SALT = __import__("itertools").count()
-
-
 def _unique(fn, base: str):
-    """Give each built program a process-unique __name__ (and therefore a
-    distinct HLO module name) — keeps profiling/trace output legible when
-    several builders produce byte-identical programs."""
-    fn.__name__ = f"{base}{next(_BUILD_SALT)}"
-    fn.__qualname__ = fn.__name__
+    """Give each built program a STABLE descriptive __name__ (and
+    therefore HLO module name). Deliberately not salted with a process
+    counter: the name feeds the persistent compilation-cache key, and a
+    counter would shift with build order across restarts, forcing full
+    recompiles of byte-identical programs."""
+    fn.__name__ = base
+    fn.__qualname__ = base
     return fn
 
 
@@ -166,6 +170,16 @@ def _make_pv_choice_fn(ctx: CycleContext):
         )
 
     return pv_choice_fn
+
+
+def _pv_claimed_of(snap: ClusterSnapshot, extra) -> jnp.ndarray:
+    """The VolumeBinding claim bitmap out of a commit engine's final
+    extra state (all-False when the plugin carries no state)."""
+    pv = extra.get("VolumeBinding") if isinstance(extra, dict) else None
+    if pv is None:
+        return jnp.zeros((snap.pv_avail.shape[0],), bool)
+    return pv
+
 
 
 def _gang_unwind(snap: ClusterSnapshot, result):
@@ -358,8 +372,9 @@ def build_cycle_fn(
 
         return CycleResult(
             result.assignment, result.node_requested, unsched, dropped,
-            srejects + result.dyn_aux, rounds_used, accepted_per_round,
-            diag_per_round,
+            srejects + result.dyn_aux,
+            _pv_claimed_of(snap, result.extra),
+            rounds_used, accepted_per_round, diag_per_round,
         )
 
     return _jit(cycle, "cycle")
@@ -465,9 +480,11 @@ def build_carry_fns(spec, framework: Framework | None = None):
                     "mp": carry["mp"].at[:, dirty].set(cols),
                 }
 
-            carry_update = _jit(
-                carry_update, "carry_update", donate_argnums=(3,)
-            )
+            # NOT donated: the _Resilient retry re-invokes with the
+            # original arguments, and a donated carry consumed by a
+            # failed first call would make the recovery path itself
+            # crash; the un-aliased copy costs ~0.3ms of HBM traffic
+            carry_update = _jit(carry_update, "carry_update")
             update_memo[n_bucket] = carry_update
             hit = carry_update
         return hit
@@ -607,8 +624,8 @@ def build_packed_cycle_carry_fn(
         unsched = snap.pod_valid & (result.assignment < 0)
         return CycleResult(
             result.assignment, result.node_requested, unsched, dropped,
-            result.dyn_aux, rres.rounds_used,
-            rres.accepted_per_round, rres.diag_per_round,
+            result.dyn_aux, _pv_claimed_of(snap, rres.extra),
+            rres.rounds_used, rres.accepted_per_round, rres.diag_per_round,
         )
 
     return _jit(cycle, "carry_cycle")
@@ -632,7 +649,8 @@ def build_diagnosis_fn(spec, framework: Framework | None = None,
     fw = framework or Framework.from_config()
     F = len(fw.filters)
 
-    def diagnose(wbuf, bbuf, stable, assignment, node_requested):
+    def diagnose(wbuf, bbuf, stable, assignment, node_requested,
+                 pv_claimed=None):
         snap = packing.unpack(wbuf, bbuf, spec)
         P = snap.P
         B = min(window, P)
@@ -644,6 +662,12 @@ def build_diagnosis_fn(spec, framework: Framework | None = None,
         extra = fw.extra_update_batched(
             ctx, extra, placed, jnp.where(placed, assignment, 0)
         )
+        if pv_claimed is not None and "VolumeBinding" in extra:
+            # use the ENGINE's actual claim bitmap: a batched replay can
+            # reconstruct different claims when a pod was revoked and
+            # re-accepted across rounds (CycleResult.pv_claimed)
+            extra = dict(extra)
+            extra["VolumeBinding"] = pv_claimed
         unplaced = snap.pod_valid & (assignment < 0)
         n_un = jnp.sum(unplaced, dtype=jnp.int32)
         order = jnp.argsort(

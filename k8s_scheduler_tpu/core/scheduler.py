@@ -469,7 +469,7 @@ class Scheduler:
         if diag is not None and (assignment < 0).any():
             diag_handle = diag(
                 wbuf, bbuf, stable, result.assignment,
-                result.node_requested,
+                result.node_requested, result.pv_claimed,
             )
         _rej_box: list = []
 

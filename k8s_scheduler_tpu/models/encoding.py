@@ -411,6 +411,12 @@ class SnapshotEncoder:
         self._image_sizes: dict[int, float] = {}
         self._group_ids: dict[str, int] = {}
         self._topo_keys: list[str] = [HOSTNAME_LABEL]
+        # index mirrors of the list-shaped tables (shared with the
+        # native pod_row builder; kept in sync wherever the list grows)
+        self._topo_idx: dict[str, int] = {HOSTNAME_LABEL: 0}
+        self._rn_idx: dict[str, int] = {
+            n: i for i, n in enumerate(self.resource_names)
+        }
         self._domain_map: dict[tuple[int, int], int] = {}
         # per-object row caches, keyed by id(); the tuple holds a strong
         # reference so a live entry's id can never be reused. matchFields
@@ -464,13 +470,56 @@ class SnapshotEncoder:
     # -- small helpers -----------------------------------------------------
 
     def _resources_vec(self, req: dict[str, float]) -> np.ndarray:
+        idx = self._rn_idx
         for name in req:
-            if name not in self.resource_names:
+            if name not in idx:
+                idx[name] = len(self.resource_names)
                 self.resource_names.append(name)
         v = np.zeros(len(self.resource_names), np.float32)
         for name, val in req.items():
-            v[self.resource_names.index(name)] = val
+            v[idx[name]] = val
         return v
+
+    def _native_ctx(self) -> dict:
+        """The persistent interning structures handed to the native
+        pod_row builder (native/fastassemble.cc) — built once; every
+        entry is a live reference to a grow-only table, so the ctx never
+        staleness-invalidates."""
+        ctx = getattr(self, "_native_ctx_cache", None)
+        if ctx is None:
+            ctx = {
+                "str_ids": self.strings._ids,
+                "str_list": self.strings._strs,
+                "exprs_idx": self._exprs_t.index,
+                "exprs_rows": self._exprs_t.rows,
+                "sels_idx": self._sels_t.index,
+                "sels_rows": self._sels_t.rows,
+                "reqs_idx": self._reqs_t.index,
+                "reqs_rows": self._reqs_t.rows,
+                "tols_idx": self._tols_t.index,
+                "tols_rows": self._tols_t.rows,
+                "imgsets_idx": self._imgsets_t.index,
+                "imgsets_rows": self._imgsets_t.rows,
+                "image_ids": self._image_ids,
+                "group_ids": self._group_ids,
+                "topo_idx": self._topo_idx,
+                "topo_list": self._topo_keys,
+                "rn_idx": self._rn_idx,
+                "rn_list": self.resource_names,
+                "ns_key": NAMESPACE_KEY,
+                "pods_name": api.PODS,
+                "effect_codes": dict(_EFFECT_CODE),
+                "op_in": OP_IN,
+                "op_not_in": OP_NOT_IN,
+                "op_exists": OP_EXISTS,
+                "op_dne": OP_DOES_NOT_EXIST,
+                "tol_eq": TOL_OP_EQUAL,
+                "tol_exists": TOL_OP_EXISTS,
+                "when_dns": WHEN_DO_NOT_SCHEDULE,
+                "when_sa": WHEN_SCHEDULE_ANYWAY,
+            }
+            self._native_ctx_cache = ctx
+        return ctx
 
     def encode(
         self,
@@ -586,11 +635,15 @@ class SnapshotEncoder:
             )
 
         topo_keys = self._topo_keys
+        topo_idx = self._topo_idx
 
         def topo_key_idx(key: str) -> int:
-            if key not in topo_keys:
+            i = topo_idx.get(key)
+            if i is None:
+                i = len(topo_keys)
+                topo_idx[key] = i
                 topo_keys.append(key)
-            return topo_keys.index(key)
+            return i
 
         def compile_selector(sel: LabelSelector, namespaces: tuple[str, ...]) -> int:
             exprs = []
@@ -747,6 +800,11 @@ class SnapshotEncoder:
         node_rows = [node_rowdata(nd) for nd in nodes]
 
         # ---- per-pod row data (cached per object) ----
+        from .. import native as _native
+
+        native_pod_row = _native.pod_row
+        native_ctx = self._native_ctx() if native_pod_row else None
+
         def pod_rowdata(p: Pod) -> dict:
             hit = self._pod_cache.get(id(p))
             if hit is not None and hit[0] is p:
@@ -758,6 +816,14 @@ class SnapshotEncoder:
                     or data["vol_epoch"] == vol_epoch
                 ):
                     return data
+            if native_pod_row is not None:
+                # native fast path (~4x the Python walk); returns None
+                # for pods with features it does not cover (volumes,
+                # real nodeAffinity, exotic selector operators)
+                d = native_pod_row(p, native_ctx)
+                if d is not None:
+                    self._pod_cache[id(p)] = (p, d)
+                    return d
             a = _aff(p)
             req_id = -1
             pref_id = -1

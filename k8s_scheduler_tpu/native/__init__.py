@@ -18,10 +18,12 @@ HAVE_FASTASSEMBLE = False
 scatter_rows = None
 scatter_rows_at = None
 fill_scalars = None
+pod_row = None  # native pod_rowdata; None => Python path only
 
 
 def _try_import() -> bool:
     global HAVE_FASTASSEMBLE, scatter_rows, scatter_rows_at, fill_scalars
+    global pod_row
     try:
         from . import _fastassemble  # type: ignore[attr-defined]
     except ImportError:
@@ -30,6 +32,7 @@ def _try_import() -> bool:
     scatter_rows = _fastassemble.scatter_rows
     scatter_rows_at = _fastassemble.scatter_rows_at
     fill_scalars = _fastassemble.fill_scalars
+    pod_row = getattr(_fastassemble, "pod_row", None)
     return True
 
 

@@ -41,7 +41,8 @@ struct View {
 PyObject* scatter_impl(PyObject* dst_obj, PyObject* index_obj,
                        PyObject* rows_obj) {
   View dst;
-  if (!dst.acquire(dst_obj, PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE)) {
+  if (!dst.acquire(dst_obj,
+                   PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE | PyBUF_FORMAT)) {
     return nullptr;
   }
   if (dst.buf.ndim != 2) {
@@ -74,32 +75,79 @@ PyObject* scatter_impl(PyObject* dst_obj, PyObject* index_obj,
     return nullptr;
   }
 
+  // dst element kind for the plain-python-sequence row path
+  // (native pod_row emits rows as Python lists, not numpy arrays)
+  const char kind = dst.buf.format ? dst.buf.format[0] : 'i';
+
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject* row = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
     if (row == Py_None) continue;
     View rv;
-    if (!rv.acquire(row, PyBUF_C_CONTIGUOUS)) {
-      Py_DECREF(seq);
-      return nullptr;
+    if (rv.acquire(row, PyBUF_C_CONTIGUOUS)) {
+      if (rv.buf.itemsize != dst.buf.itemsize) {
+        Py_DECREF(seq);
+        PyErr_Format(PyExc_ValueError,
+                     "row %zd itemsize %zd != dst itemsize %zd", i,
+                     rv.buf.itemsize, dst.buf.itemsize);
+        return nullptr;
+      }
+      const Py_ssize_t target = idx ? idx[i] : i;
+      if (target < 0 || target >= n_rows) {
+        Py_DECREF(seq);
+        PyErr_Format(PyExc_IndexError, "row %zd target %zd out of range", i,
+                     target);
+        return nullptr;
+      }
+      Py_ssize_t bytes = rv.buf.len;
+      if (bytes > width_bytes) bytes = width_bytes;  // truncate to dst width
+      std::memcpy(base + target * width_bytes, rv.buf.buf,
+                  static_cast<size_t>(bytes));
+      continue;
     }
-    if (rv.buf.itemsize != dst.buf.itemsize) {
+    // not a buffer: accept a plain sequence of numbers
+    PyErr_Clear();
+    PyObject* rseq = PySequence_Fast(row, "row must be buffer or sequence");
+    if (rseq == nullptr) {
       Py_DECREF(seq);
-      PyErr_Format(PyExc_ValueError,
-                   "row %zd itemsize %zd != dst itemsize %zd", i,
-                   rv.buf.itemsize, dst.buf.itemsize);
       return nullptr;
     }
     const Py_ssize_t target = idx ? idx[i] : i;
     if (target < 0 || target >= n_rows) {
+      Py_DECREF(rseq);
       Py_DECREF(seq);
       PyErr_Format(PyExc_IndexError, "row %zd target %zd out of range", i,
                    target);
       return nullptr;
     }
-    Py_ssize_t bytes = rv.buf.len;
-    if (bytes > width_bytes) bytes = width_bytes;  // truncate to dst width
-    std::memcpy(base + target * width_bytes, rv.buf.buf,
-                static_cast<size_t>(bytes));
+    Py_ssize_t m = PySequence_Fast_GET_SIZE(rseq);
+    if (m * dst.buf.itemsize > width_bytes) m = width_bytes / dst.buf.itemsize;
+    char* out = base + target * width_bytes;
+    for (Py_ssize_t j = 0; j < m; ++j) {
+      PyObject* v = PySequence_Fast_GET_ITEM(rseq, j);
+      if (kind == 'f' && dst.buf.itemsize == 4) {
+        const double d = PyFloat_AsDouble(v);
+        if (d == -1.0 && PyErr_Occurred()) {
+          Py_DECREF(rseq);
+          Py_DECREF(seq);
+          return nullptr;
+        }
+        reinterpret_cast<float*>(out)[j] = static_cast<float>(d);
+      } else if (dst.buf.itemsize == 4) {
+        const long x = PyLong_AsLong(v);
+        if (x == -1 && PyErr_Occurred()) {
+          Py_DECREF(rseq);
+          Py_DECREF(seq);
+          return nullptr;
+        }
+        reinterpret_cast<int*>(out)[j] = static_cast<int>(x);
+      } else {
+        Py_DECREF(rseq);
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError, "unsupported dst dtype for list row");
+        return nullptr;
+      }
+    }
+    Py_DECREF(rseq);
   }
   Py_DECREF(seq);
   Py_RETURN_NONE;
@@ -176,6 +224,863 @@ PyObject* fill_scalars(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// ---------------------------------------------------------------------------
+// pod_row(pod, ctx) -> dict | None
+//
+// Native fast path for SnapshotEncoder.pod_rowdata (the per-fresh-pod
+// Python walk is the steady-state encode bottleneck: ~18us/pod in
+// Python, ~3-5us here). The ctx dict hands in the encoder's PERSISTENT
+// interning structures (string/expr/selector/toleration/requirement/
+// imageset tables as {index: dict, rows: list} pairs, plus id/index
+// mirrors), and this function grows them with EXACTLY the same keys the
+// Python path would, so both paths are interchangeable per pod.
+//
+// Returns None (not an error) for pods using features the native path
+// does not cover — real nodeAffinity blocks, volumes, or selector
+// operators beyond In/NotIn/Exists/DoesNotExist — and the Python path
+// handles those pods. Differentially tested against the Python rows.
+// ---------------------------------------------------------------------------
+
+struct Ctx {
+  PyObject *str_ids, *str_list;          // StringInterner internals
+  PyObject *exprs_idx, *exprs_rows;      // expression table
+  PyObject *sels_idx, *sels_rows;        // selector table
+  PyObject *reqs_idx, *reqs_rows;        // requirement table
+  PyObject *tols_idx, *tols_rows;        // toleration-set table
+  PyObject *imgsets_idx, *imgsets_rows;  // image-set table
+  PyObject *image_ids;                   // image name -> id
+  PyObject *group_ids;                   // group name -> id
+  PyObject *topo_idx, *topo_list;        // topology keys
+  PyObject *rn_idx, *rn_list;            // resource names
+  PyObject *ns_key;                      // "__namespace__"
+  PyObject *pods_name;                   // "pods"
+  long op_in, op_not_in, op_exists, op_dne;
+  long tol_eq, tol_exists;
+  long when_dns, when_sa;
+  PyObject *effect_codes;                // effect str -> int dict
+};
+
+static bool ctx_get(PyObject* d, const char* k, PyObject** out) {
+  *out = PyDict_GetItemString(d, k);  // borrowed
+  if (*out == nullptr) {
+    PyErr_Format(PyExc_KeyError, "pod_row ctx missing %s", k);
+    return false;
+  }
+  return true;
+}
+
+static bool ctx_long(PyObject* d, const char* k, long* out) {
+  PyObject* v;
+  if (!ctx_get(d, k, &v)) return false;
+  *out = PyLong_AsLong(v);
+  return !(*out == -1 && PyErr_Occurred());
+}
+
+// str -> dense id, growing the interner (mirrors StringInterner.intern)
+static long intern_str(const Ctx& c, PyObject* s) {
+  PyObject* hit = PyDict_GetItemWithError(c.str_ids, s);
+  if (hit != nullptr) return PyLong_AsLong(hit);
+  if (PyErr_Occurred()) return -2;
+  const long n = static_cast<long>(PyList_GET_SIZE(c.str_list));
+  PyObject* num = PyLong_FromLong(n);
+  if (num == nullptr) return -2;
+  if (PyDict_SetItem(c.str_ids, s, num) != 0 ||
+      PyList_Append(c.str_list, s) != 0) {
+    Py_DECREF(num);
+    return -2;
+  }
+  Py_DECREF(num);
+  return n;
+}
+
+// hashable row -> dense index, growing the table (mirrors _InternTable)
+// steals nothing; `row` is borrowed
+static long intern_row(PyObject* idx, PyObject* rows, PyObject* row) {
+  PyObject* hit = PyDict_GetItemWithError(idx, row);
+  if (hit != nullptr) return PyLong_AsLong(hit);
+  if (PyErr_Occurred()) return -2;
+  const long n = static_cast<long>(PyList_GET_SIZE(rows));
+  PyObject* num = PyLong_FromLong(n);
+  if (num == nullptr) return -2;
+  if (PyDict_SetItem(idx, row, num) != 0 || PyList_Append(rows, row) != 0) {
+    Py_DECREF(num);
+    return -2;
+  }
+  Py_DECREF(num);
+  return n;
+}
+
+// intern (key, op, (vals...), num) into the expression table
+static long intern_expr(const Ctx& c, long key, long op, PyObject* vals,
+                        double num) {
+  PyObject* row = Py_BuildValue("(llOd)", key, op, vals, num);
+  if (row == nullptr) return -2;
+  const long r = intern_row(c.exprs_idx, c.exprs_rows, row);
+  Py_DECREF(row);
+  return r;
+}
+
+static PyObject* getattr_b(PyObject* o, const char* name) {
+  return PyObject_GetAttrString(o, name);  // new ref
+}
+
+// compile a LabelSelector + namespaces -> selector id; -2 on error,
+// -3 on unsupported operator (caller falls back)
+static long compile_selector(const Ctx& c, PyObject* sel, PyObject* ns) {
+  long ns_id = intern_str(c, ns);
+  if (ns_id < 0) return -2;
+  PyObject* exprs = PyList_New(0);
+  if (!exprs) return -2;
+  long ns_key_id = intern_str(c, c.ns_key);
+  PyObject* vals = Py_BuildValue("(l)", ns_id);
+  long e = vals ? intern_expr(c, ns_key_id, c.op_in, vals, 0.0) : -2;
+  Py_XDECREF(vals);
+  long status = 0;
+  PyObject* ml = nullptr;
+  PyObject* items = nullptr;
+  PyObject* mex = nullptr;
+  do {
+    if (e < 0) { status = -2; break; }
+    PyObject* en = PyLong_FromLong(e);
+    if (!en || PyList_Append(exprs, en) != 0) { Py_XDECREF(en); status = -2; break; }
+    Py_DECREF(en);
+    ml = getattr_b(sel, "match_labels");
+    if (!ml) { status = -2; break; }
+    items = PyDict_Items(ml);
+    if (!items || PyList_Sort(items) != 0) { status = -2; break; }
+    for (Py_ssize_t i = 0; i < PyList_GET_SIZE(items); ++i) {
+      PyObject* kv = PyList_GET_ITEM(items, i);
+      long k = intern_str(c, PyTuple_GET_ITEM(kv, 0));
+      long v = intern_str(c, PyTuple_GET_ITEM(kv, 1));
+      if (k < 0 || v < 0) { status = -2; break; }
+      PyObject* vv = Py_BuildValue("(l)", v);
+      long ei = vv ? intern_expr(c, k, c.op_in, vv, 0.0) : -2;
+      Py_XDECREF(vv);
+      if (ei < 0) { status = -2; break; }
+      PyObject* eo = PyLong_FromLong(ei);
+      if (!eo || PyList_Append(exprs, eo) != 0) { Py_XDECREF(eo); status = -2; break; }
+      Py_DECREF(eo);
+    }
+    if (status) break;
+    mex = getattr_b(sel, "match_expressions");
+    if (!mex) { status = -2; break; }
+    PyObject* mseq = PySequence_Fast(mex, "match_expressions");
+    if (!mseq) { status = -2; break; }
+    for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(mseq); ++i) {
+      PyObject* r = PySequence_Fast_GET_ITEM(mseq, i);
+      PyObject* opo = getattr_b(r, "operator");
+      PyObject* keyo = getattr_b(r, "key");
+      PyObject* valso = getattr_b(r, "values");
+      if (!opo || !keyo || !valso) {
+        Py_XDECREF(opo); Py_XDECREF(keyo); Py_XDECREF(valso);
+        status = -2; break;
+      }
+      long op = -1;
+      const char* ops = PyUnicode_AsUTF8(opo);
+      if (ops == nullptr) { status = -2; }
+      else if (!strcmp(ops, "In")) op = c.op_in;
+      else if (!strcmp(ops, "NotIn")) op = c.op_not_in;
+      else if (!strcmp(ops, "Exists")) op = c.op_exists;
+      else if (!strcmp(ops, "DoesNotExist")) op = c.op_dne;
+      else status = -3;  // Gt/Lt on pod selectors: fall back
+      long ei = -2;
+      if (!status) {
+        PyObject* vseq = PySequence_Fast(valso, "values");
+        if (!vseq) { status = -2; }
+        else {
+          const Py_ssize_t nv = PySequence_Fast_GET_SIZE(vseq);
+          PyObject* ids = PyList_New(0);
+          if (!ids) status = -2;
+          for (Py_ssize_t j = 0; !status && j < nv; ++j) {
+            long vid = intern_str(c, PySequence_Fast_GET_ITEM(vseq, j));
+            if (vid < 0) { status = -2; break; }
+            PyObject* vo = PyLong_FromLong(vid);
+            if (!vo || PyList_Append(ids, vo) != 0) { Py_XDECREF(vo); status = -2; break; }
+            Py_DECREF(vo);
+          }
+          if (!status) {
+            if (PyList_Sort(ids) != 0) status = -2;
+          }
+          if (!status) {
+            // key interned AFTER the values (Python evaluation order)
+            long k = intern_str(c, keyo);
+            PyObject* vt = (k >= 0) ? PyList_AsTuple(ids) : nullptr;
+            if (!vt) status = -2;
+            else {
+              ei = intern_expr(c, k, op, vt, 0.0);
+              Py_DECREF(vt);
+              if (ei < 0) status = -2;
+            }
+          }
+          Py_XDECREF(ids);
+        }
+        Py_XDECREF(vseq);
+      }
+      Py_DECREF(opo); Py_DECREF(keyo); Py_DECREF(valso);
+      if (status) break;
+      PyObject* eo = PyLong_FromLong(ei);
+      if (!eo || PyList_Append(exprs, eo) != 0) { Py_XDECREF(eo); status = -2; break; }
+      Py_DECREF(eo);
+    }
+    Py_DECREF(mseq);
+  } while (false);
+  Py_XDECREF(ml); Py_XDECREF(items); Py_XDECREF(mex);
+  long out = status;
+  if (!status) {
+    PyObject* t = PyList_AsTuple(exprs);
+    out = t ? intern_row(c.sels_idx, c.sels_rows, t) : -2;
+    Py_XDECREF(t);
+  }
+  Py_DECREF(exprs);
+  return out;
+}
+
+static long topo_key_id(const Ctx& c, PyObject* key) {
+  PyObject* hit = PyDict_GetItemWithError(c.topo_idx, key);
+  if (hit != nullptr) return PyLong_AsLong(hit);
+  if (PyErr_Occurred()) return -2;
+  const long n = static_cast<long>(PyList_GET_SIZE(c.topo_list));
+  PyObject* num = PyLong_FromLong(n);
+  if (!num) return -2;
+  if (PyDict_SetItem(c.topo_idx, key, num) != 0 ||
+      PyList_Append(c.topo_list, key) != 0) {
+    Py_DECREF(num);
+    return -2;
+  }
+  Py_DECREF(num);
+  return n;
+}
+
+// append a long to a Python list; true on success
+static bool lappend(PyObject* lst, long v) {
+  PyObject* o = PyLong_FromLong(v);
+  if (!o) return false;
+  const bool ok = PyList_Append(lst, o) == 0;
+  Py_DECREF(o);
+  return ok;
+}
+
+static bool lappendf(PyObject* lst, double v) {
+  PyObject* o = PyFloat_FromDouble(v);
+  if (!o) return false;
+  const bool ok = PyList_Append(lst, o) == 0;
+  Py_DECREF(o);
+  return ok;
+}
+
+// compile pod-affinity terms into (sel, topo) pairs appended FLAT to
+// `flat`; returns term count, -2 error, -3 unsupported
+static long compile_aff_terms(const Ctx& c, PyObject* terms, PyObject* ns,
+                              PyObject* flat) {
+  PyObject* seq = PySequence_Fast(terms, "terms");
+  if (!seq) return -2;
+  long count = 0;
+  long status = 0;
+  for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(seq); ++i) {
+    PyObject* t = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject* nss = getattr_b(t, "namespaces");
+    if (!nss) { status = -2; break; }
+    bool has_ns = PyObject_IsTrue(nss) == 1;
+    if (has_ns) {
+      // multi-namespace terms: supported only for a single namespace
+      // equal to... keep simple: fall back
+      Py_DECREF(nss);
+      status = -3;
+      break;
+    }
+    Py_DECREF(nss);
+    PyObject* ls = getattr_b(t, "label_selector");
+    PyObject* tk = getattr_b(t, "topology_key");
+    if (!ls || !tk) { Py_XDECREF(ls); Py_XDECREF(tk); status = -2; break; }
+    long sid = compile_selector(c, ls, ns);
+    long kid = (sid >= 0) ? topo_key_id(c, tk) : -1;
+    Py_DECREF(ls); Py_DECREF(tk);
+    if (sid == -3) { status = -3; break; }
+    if (sid < 0 || kid < 0) { status = -2; break; }
+    if (!lappend(flat, sid) || !lappend(flat, kid)) { status = -2; break; }
+    ++count;
+  }
+  Py_DECREF(seq);
+  return status ? status : count;
+}
+
+PyObject* pod_row(PyObject*, PyObject* args) {
+  PyObject *pod, *ctxd;
+  if (!PyArg_ParseTuple(args, "OO", &pod, &ctxd)) return nullptr;
+  Ctx c{};
+  if (!ctx_get(ctxd, "str_ids", &c.str_ids) ||
+      !ctx_get(ctxd, "str_list", &c.str_list) ||
+      !ctx_get(ctxd, "exprs_idx", &c.exprs_idx) ||
+      !ctx_get(ctxd, "exprs_rows", &c.exprs_rows) ||
+      !ctx_get(ctxd, "sels_idx", &c.sels_idx) ||
+      !ctx_get(ctxd, "sels_rows", &c.sels_rows) ||
+      !ctx_get(ctxd, "reqs_idx", &c.reqs_idx) ||
+      !ctx_get(ctxd, "reqs_rows", &c.reqs_rows) ||
+      !ctx_get(ctxd, "tols_idx", &c.tols_idx) ||
+      !ctx_get(ctxd, "tols_rows", &c.tols_rows) ||
+      !ctx_get(ctxd, "imgsets_idx", &c.imgsets_idx) ||
+      !ctx_get(ctxd, "imgsets_rows", &c.imgsets_rows) ||
+      !ctx_get(ctxd, "image_ids", &c.image_ids) ||
+      !ctx_get(ctxd, "group_ids", &c.group_ids) ||
+      !ctx_get(ctxd, "topo_idx", &c.topo_idx) ||
+      !ctx_get(ctxd, "topo_list", &c.topo_list) ||
+      !ctx_get(ctxd, "rn_idx", &c.rn_idx) ||
+      !ctx_get(ctxd, "rn_list", &c.rn_list) ||
+      !ctx_get(ctxd, "ns_key", &c.ns_key) ||
+      !ctx_get(ctxd, "pods_name", &c.pods_name) ||
+      !ctx_get(ctxd, "effect_codes", &c.effect_codes) ||
+      !ctx_long(ctxd, "op_in", &c.op_in) ||
+      !ctx_long(ctxd, "op_not_in", &c.op_not_in) ||
+      !ctx_long(ctxd, "op_exists", &c.op_exists) ||
+      !ctx_long(ctxd, "op_dne", &c.op_dne) ||
+      !ctx_long(ctxd, "tol_eq", &c.tol_eq) ||
+      !ctx_long(ctxd, "tol_exists", &c.tol_exists) ||
+      !ctx_long(ctxd, "when_dns", &c.when_dns) ||
+      !ctx_long(ctxd, "when_sa", &c.when_sa)) {
+    return nullptr;
+  }
+
+  PyObject *spec = nullptr, *meta = nullptr;
+  PyObject* out = nullptr;  // the rowdata dict (returned on success)
+  // long-lived temporaries released at the end
+  PyObject *lab_k = nullptr, *lab_v = nullptr, *ports = nullptr,
+           *aff = nullptr, *anti = nullptr, *pref = nullptr,
+           *pref_w = nullptr, *tsc = nullptr, *tsc_skew = nullptr,
+           *reqvec = nullptr, *empty = nullptr, *image_names = nullptr;
+  long status = 0;  // 0 ok, -2 error, -3 fallback
+
+  do {
+    spec = getattr_b(pod, "spec");
+    meta = getattr_b(pod, "metadata");
+    if (!spec || !meta) { status = -2; break; }
+
+    // ---- fallbacks first (cheap attribute probes) ----
+    PyObject* vols = getattr_b(spec, "volumes");
+    if (!vols) { status = -2; break; }
+    const bool has_vols = PyObject_IsTrue(vols) == 1;
+    Py_DECREF(vols);
+    if (has_vols) { status = -3; break; }
+    PyObject* affin = getattr_b(spec, "affinity");
+    if (!affin) { status = -2; break; }
+    PyObject *pa = nullptr, *paa = nullptr;
+    if (affin != Py_None) {
+      PyObject* na = getattr_b(affin, "node_affinity");
+      if (!na) { Py_DECREF(affin); status = -2; break; }
+      const bool has_na = na != Py_None;
+      Py_DECREF(na);
+      if (has_na) { Py_DECREF(affin); status = -3; break; }
+      pa = getattr_b(affin, "pod_affinity");
+      paa = getattr_b(affin, "pod_anti_affinity");
+      if (!pa || !paa) {
+        Py_XDECREF(pa); Py_XDECREF(paa); Py_DECREF(affin);
+        status = -2; break;
+      }
+    }
+    Py_DECREF(affin);
+
+    PyObject* ns = getattr_b(pod, "namespace");
+    if (!ns) { Py_XDECREF(pa); Py_XDECREF(paa); status = -2; break; }
+
+    // ---- node_selector -> sel_req_id ----
+    long sel_req_id = -1;
+    {
+      PyObject* nsel = getattr_b(spec, "node_selector");
+      if (!nsel) status = -2;
+      else if (PyObject_IsTrue(nsel) == 1) {
+        PyObject* items = PyDict_Items(nsel);
+        if (!items || PyList_Sort(items) != 0) status = -2;
+        PyObject* exprs = status ? nullptr : PyList_New(0);
+        if (!status && !exprs) status = -2;
+        for (Py_ssize_t i = 0; !status && i < PyList_GET_SIZE(items); ++i) {
+          PyObject* kv = PyList_GET_ITEM(items, i);
+          // Python's compile_req interns VALUES before the key
+          long v = intern_str(c, PyTuple_GET_ITEM(kv, 1));
+          long k = intern_str(c, PyTuple_GET_ITEM(kv, 0));
+          if (k < 0 || v < 0) { status = -2; break; }
+          PyObject* vt = Py_BuildValue("(l)", v);
+          long e = vt ? intern_expr(c, k, c.op_in, vt, 0.0) : -2;
+          Py_XDECREF(vt);
+          if (e < 0 || !lappend(exprs, e)) { status = -2; break; }
+        }
+        if (!status) {
+          PyObject* et = PyList_AsTuple(exprs);
+          PyObject* terms = et ? Py_BuildValue("(O)", et) : nullptr;
+          if (!terms) status = -2;
+          else {
+            sel_req_id = intern_row(c.reqs_idx, c.reqs_rows, terms);
+            if (sel_req_id < 0) status = -2;
+            Py_DECREF(terms);
+          }
+          Py_XDECREF(et);
+        }
+        Py_XDECREF(exprs);
+        Py_XDECREF(items);
+      }
+      Py_XDECREF(nsel);
+    }
+    if (status) { Py_XDECREF(pa); Py_XDECREF(paa); Py_DECREF(ns); break; }
+
+    // ---- pod (anti-)affinity ----
+    aff = PyList_New(0);
+    anti = PyList_New(0);
+    pref = PyList_New(0);
+    pref_w = PyList_New(0);
+    long n_aff_terms = 0, n_anti_terms = 0, n_pref_terms = 0;
+    if (!aff || !anti || !pref || !pref_w) status = -2;
+    if (!status && pa && pa != Py_None) {
+      PyObject* reqt = getattr_b(pa, "required");
+      long n1 = reqt ? compile_aff_terms(c, reqt, ns, aff) : -2;
+      Py_XDECREF(reqt);
+      if (n1 < 0) status = n1;
+      else n_aff_terms = n1;
+      if (!status) {
+        PyObject* pt = getattr_b(pa, "preferred");
+        PyObject* seq = pt ? PySequence_Fast(pt, "preferred") : nullptr;
+        if (!seq) status = -2;
+        for (Py_ssize_t i = 0;
+             !status && seq && i < PySequence_Fast_GET_SIZE(seq); ++i) {
+          PyObject* wt = PySequence_Fast_GET_ITEM(seq, i);
+          PyObject* term = getattr_b(wt, "term");
+          PyObject* w = getattr_b(wt, "weight");
+          PyObject* one = term ? PyList_New(0) : nullptr;
+          if (!term || !w || !one) status = -2;
+          if (!status) {
+            PyObject* tt = PyTuple_Pack(1, term);
+            long n2 = tt ? compile_aff_terms(c, tt, ns, one) : -2;
+            Py_XDECREF(tt);
+            if (n2 < 0) status = n2;
+            else {
+              // one holds [sel, k]
+              const double wv = PyFloat_AsDouble(w);
+              if (wv == -1.0 && PyErr_Occurred()) status = -2;
+              else if (PyList_GET_SIZE(one) >= 2) {
+                long s = PyLong_AsLong(PyList_GET_ITEM(one, 0));
+                long k = PyLong_AsLong(PyList_GET_ITEM(one, 1));
+                if (!lappend(pref, s) || !lappend(pref, k) ||
+                    !lappendf(pref_w, wv)) {
+                  status = -2;
+                } else {
+                  ++n_pref_terms;
+                }
+              }
+            }
+          }
+          Py_XDECREF(one); Py_XDECREF(term); Py_XDECREF(w);
+        }
+        Py_XDECREF(seq); Py_XDECREF(pt);
+      }
+    }
+    if (!status && paa && paa != Py_None) {
+      PyObject* reqt = getattr_b(paa, "required");
+      long n1 = reqt ? compile_aff_terms(c, reqt, ns, anti) : -2;
+      Py_XDECREF(reqt);
+      if (n1 < 0) status = n1;
+      else n_anti_terms = n1;
+      if (!status) {
+        PyObject* pt = getattr_b(paa, "preferred");
+        PyObject* seq = pt ? PySequence_Fast(pt, "preferred") : nullptr;
+        if (!seq) status = -2;
+        for (Py_ssize_t i = 0;
+             !status && seq && i < PySequence_Fast_GET_SIZE(seq); ++i) {
+          PyObject* wt = PySequence_Fast_GET_ITEM(seq, i);
+          PyObject* term = getattr_b(wt, "term");
+          PyObject* w = getattr_b(wt, "weight");
+          PyObject* one = term ? PyList_New(0) : nullptr;
+          if (!term || !w || !one) status = -2;
+          if (!status) {
+            PyObject* tt = PyTuple_Pack(1, term);
+            long n2 = tt ? compile_aff_terms(c, tt, ns, one) : -2;
+            Py_XDECREF(tt);
+            if (n2 < 0) status = n2;
+            else {
+              const double wv = PyFloat_AsDouble(w);
+              if (wv == -1.0 && PyErr_Occurred()) status = -2;
+              else if (PyList_GET_SIZE(one) >= 2) {
+                long s = PyLong_AsLong(PyList_GET_ITEM(one, 0));
+                long k = PyLong_AsLong(PyList_GET_ITEM(one, 1));
+                if (!lappend(pref, s) || !lappend(pref, k) ||
+                    !lappendf(pref_w, -wv)) {
+                  status = -2;
+                } else {
+                  ++n_pref_terms;
+                }
+              }
+            }
+          }
+          Py_XDECREF(one); Py_XDECREF(term); Py_XDECREF(w);
+        }
+        Py_XDECREF(seq); Py_XDECREF(pt);
+      }
+    }
+    Py_XDECREF(pa); Py_XDECREF(paa);
+    pa = paa = nullptr;
+    if (status) { Py_DECREF(ns); break; }
+
+    // ---- topology spread constraints ----
+    tsc = PyList_New(0);
+    tsc_skew = PyList_New(0);
+    if (!tsc || !tsc_skew) { status = -2; Py_DECREF(ns); break; }
+    {
+      PyObject* tscs = getattr_b(spec, "topology_spread_constraints");
+      PyObject* seq = tscs ? PySequence_Fast(tscs, "tsc") : nullptr;
+      if (!seq) status = -2;
+      for (Py_ssize_t i = 0;
+           !status && seq && i < PySequence_Fast_GET_SIZE(seq); ++i) {
+        PyObject* cns = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject* tk = getattr_b(cns, "topology_key");
+        PyObject* ls = getattr_b(cns, "label_selector");
+        PyObject* wu = getattr_b(cns, "when_unsatisfiable");
+        PyObject* sk = getattr_b(cns, "max_skew");
+        if (!tk || !ls || !wu || !sk) status = -2;
+        if (!status) {
+          long kid = topo_key_id(c, tk);
+          long sid = compile_selector(c, ls, ns);
+          if (sid == -3) status = -3;
+          else if (kid < 0 || sid < 0) status = -2;
+          else {
+            const char* wus = PyUnicode_AsUTF8(wu);
+            long when = (wus && !strcmp(wus, "DoNotSchedule")) ? c.when_dns
+                                                               : c.when_sa;
+            const long skew = PyLong_AsLong(sk);
+            if (skew == -1 && PyErr_Occurred()) status = -2;
+            else if (!lappend(tsc, kid) || !lappend(tsc, sid) ||
+                     !lappend(tsc, when) || !lappend(tsc_skew, skew)) {
+              status = -2;
+            }
+          }
+        }
+        Py_XDECREF(tk); Py_XDECREF(ls); Py_XDECREF(wu); Py_XDECREF(sk);
+      }
+      Py_XDECREF(seq); Py_XDECREF(tscs);
+    }
+    if (status) { Py_DECREF(ns); break; }
+
+    // ---- labels (namespace marker first, then sorted) ----
+    lab_k = PyList_New(0);
+    lab_v = PyList_New(0);
+    if (!lab_k || !lab_v) { status = -2; }
+    if (!status) {
+      long nk = intern_str(c, c.ns_key);
+      long nv = intern_str(c, ns);
+      if (nk < 0 || nv < 0 || !lappend(lab_k, nk) || !lappend(lab_v, nv)) {
+        status = -2;
+      }
+    }
+    if (!status) {
+      PyObject* labels = getattr_b(meta, "labels");
+      PyObject* items = labels ? PyDict_Items(labels) : nullptr;
+      if (!items || PyList_Sort(items) != 0) status = -2;
+      for (Py_ssize_t i = 0; !status && items && i < PyList_GET_SIZE(items);
+           ++i) {
+        PyObject* kv = PyList_GET_ITEM(items, i);
+        long k = intern_str(c, PyTuple_GET_ITEM(kv, 0));
+        long v = intern_str(c, PyTuple_GET_ITEM(kv, 1));
+        if (k < 0 || v < 0 || !lappend(lab_k, k) || !lappend(lab_v, v)) {
+          status = -2;
+        }
+      }
+      Py_XDECREF(items);
+      Py_XDECREF(labels);
+    }
+    if (status) { Py_XDECREF(pa); Py_XDECREF(paa); Py_DECREF(ns); break; }
+
+    // ---- requests -> reqvec (grow rn as needed), plus ports/images
+    // collected in the same container walk (mirrors
+    // Pod.resource_requests/host_ports/images without re-entering
+    // Python bytecode per pod) ----
+    reqvec = nullptr;
+    ports = PyList_New(0);
+    image_names = PyList_New(0);
+    {
+      // effective request dict, preserving Python's insertion order
+      PyObject* req = PyDict_New();
+      PyObject* conts = getattr_b(spec, "containers");
+      PyObject* cseq = conts ? PySequence_Fast(conts, "containers") : nullptr;
+      if (!req || !ports || !image_names || !cseq) status = -2;
+      for (Py_ssize_t i = 0;
+           !status && cseq && i < PySequence_Fast_GET_SIZE(cseq); ++i) {
+        PyObject* ct = PySequence_Fast_GET_ITEM(cseq, i);
+        PyObject* creq = getattr_b(ct, "requests");
+        if (!creq) { status = -2; break; }
+        PyObject *key, *val;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(creq, &pos, &key, &val)) {
+          PyObject* cur = PyDict_GetItemWithError(req, key);
+          const double add = PyFloat_AsDouble(val);
+          const double base = cur ? PyFloat_AsDouble(cur) : 0.0;
+          PyObject* nv = PyFloat_FromDouble(base + add);
+          if (!nv || PyDict_SetItem(req, key, nv) != 0) {
+            Py_XDECREF(nv); status = -2; break;
+          }
+          Py_DECREF(nv);
+        }
+        Py_DECREF(creq);
+        if (status) break;
+        PyObject* cports = getattr_b(ct, "ports");
+        PyObject* pseq = cports ? PySequence_Fast(cports, "ports") : nullptr;
+        if (!pseq) { Py_XDECREF(cports); status = -2; break; }
+        for (Py_ssize_t j = 0; j < PySequence_Fast_GET_SIZE(pseq); ++j) {
+          PyObject* po = PySequence_Fast_GET_ITEM(pseq, j);
+          PyObject* hp = getattr_b(po, "host_port");
+          if (!hp) { status = -2; break; }
+          const long port = PyLong_AsLong(hp);
+          Py_DECREF(hp);
+          if (port == 0) continue;
+          PyObject* pr = getattr_b(po, "protocol");
+          const char* ps = pr ? PyUnicode_AsUTF8(pr) : nullptr;
+          long pc = 3;
+          if (ps) {
+            if (!strcmp(ps, "TCP")) pc = 0;
+            else if (!strcmp(ps, "UDP")) pc = 1;
+            else if (!strcmp(ps, "SCTP")) pc = 2;
+          }
+          Py_XDECREF(pr);
+          if (!lappend(ports, port * 4 + pc)) { status = -2; break; }
+        }
+        Py_DECREF(pseq); Py_DECREF(cports);
+        if (status) break;
+        PyObject* img = getattr_b(ct, "image");
+        if (!img) { status = -2; break; }
+        if (PyObject_IsTrue(img) == 1 &&
+            PyList_Append(image_names, img) != 0) {
+          status = -2;
+        }
+        Py_DECREF(img);
+      }
+      Py_XDECREF(cseq); Py_XDECREF(conts);
+      if (!status) {
+        PyObject* ovh = getattr_b(spec, "overhead");
+        if (!ovh) status = -2;
+        else {
+          PyObject *key, *val;
+          Py_ssize_t pos = 0;
+          while (PyDict_Next(ovh, &pos, &key, &val)) {
+            PyObject* cur = PyDict_GetItemWithError(req, key);
+            const double base = cur ? PyFloat_AsDouble(cur) : 0.0;
+            PyObject* nv = PyFloat_FromDouble(base + PyFloat_AsDouble(val));
+            if (!nv || PyDict_SetItem(req, key, nv) != 0) {
+              Py_XDECREF(nv); status = -2; break;
+            }
+            Py_DECREF(nv);
+          }
+          Py_DECREF(ovh);
+        }
+      }
+      if (!status) {
+        // the implicit one-"pods"-slot request
+        PyObject* cur = PyDict_GetItemWithError(req, c.pods_name);
+        const double base = cur ? PyFloat_AsDouble(cur) : 0.0;
+        PyObject* nv = PyFloat_FromDouble(base + 1.0);
+        if (!nv || PyDict_SetItem(req, c.pods_name, nv) != 0) {
+          Py_XDECREF(nv); status = -2;
+        } else {
+          Py_DECREF(nv);
+        }
+      }
+      if (!status) {
+        // ensure every name is in rn (insertion order = Python path's)
+        PyObject *key, *val;
+        Py_ssize_t pos = 0;
+        while (!status && PyDict_Next(req, &pos, &key, &val)) {
+          if (PyDict_GetItemWithError(c.rn_idx, key) == nullptr) {
+            if (PyErr_Occurred()) { status = -2; break; }
+            const long n = static_cast<long>(PyList_GET_SIZE(c.rn_list));
+            PyObject* num = PyLong_FromLong(n);
+            if (!num || PyDict_SetItem(c.rn_idx, key, num) != 0 ||
+                PyList_Append(c.rn_list, key) != 0) {
+              Py_XDECREF(num); status = -2; break;
+            }
+            Py_DECREF(num);
+          }
+        }
+        if (!status) {
+          const Py_ssize_t R = PyList_GET_SIZE(c.rn_list);
+          reqvec = PyList_New(R);
+          if (!reqvec) status = -2;
+          for (Py_ssize_t i = 0; !status && i < R; ++i) {
+            PyObject* z = PyFloat_FromDouble(0.0);
+            if (!z) { status = -2; break; }
+            PyList_SET_ITEM(reqvec, i, z);
+          }
+          pos = 0;
+          while (!status && PyDict_Next(req, &pos, &key, &val)) {
+            PyObject* io = PyDict_GetItemWithError(c.rn_idx, key);
+            if (!io) { status = -2; break; }
+            const long i = PyLong_AsLong(io);
+            const double d = PyFloat_AsDouble(val);
+            if (d == -1.0 && PyErr_Occurred()) { status = -2; break; }
+            PyObject* f = PyFloat_FromDouble(d);
+            if (!f) { status = -2; break; }
+            PyList_SetItem(reqvec, i, f);  // steals
+          }
+        }
+      }
+      Py_XDECREF(req);
+    }
+    if (status) { Py_DECREF(ns); break; }
+
+    // ---- tolerations ----
+    long tolset = -1;
+    {
+      PyObject* tols = getattr_b(spec, "tolerations");
+      PyObject* seq = tols ? PySequence_Fast(tols, "tolerations") : nullptr;
+      PyObject* rows = seq ? PyList_New(0) : nullptr;
+      if (!seq || !rows) status = -2;
+      for (Py_ssize_t i = 0;
+           !status && seq && i < PySequence_Fast_GET_SIZE(seq); ++i) {
+        PyObject* t = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject* keyo = getattr_b(t, "key");
+        PyObject* opo = getattr_b(t, "operator");
+        PyObject* valo = getattr_b(t, "value");
+        PyObject* effo = getattr_b(t, "effect");
+        if (!keyo || !opo || !valo || !effo) status = -2;
+        if (!status) {
+          long key = (PyObject_IsTrue(keyo) == 1) ? intern_str(c, keyo) : -1;
+          const char* ops = PyUnicode_AsUTF8(opo);
+          long op = (ops && !strcmp(ops, "Exists")) ? c.tol_exists : c.tol_eq;
+          long val = intern_str(c, valo);
+          long eff = -1;
+          if (PyObject_IsTrue(effo) == 1) {
+            PyObject* eo = PyDict_GetItemWithError(c.effect_codes, effo);
+            if (!eo) { status = -2; }
+            else eff = PyLong_AsLong(eo);
+          }
+          if (key == -2 || val < 0) status = -2;
+          if (!status) {
+            PyObject* row = Py_BuildValue("(llll)", key, op, val, eff);
+            if (!row || PyList_Append(rows, row) != 0) status = -2;
+            Py_XDECREF(row);
+          }
+        }
+        Py_XDECREF(keyo); Py_XDECREF(opo); Py_XDECREF(valo); Py_XDECREF(effo);
+      }
+      if (!status && PyList_Sort(rows) != 0) status = -2;
+      if (!status) {
+        PyObject* rt = PyList_AsTuple(rows);
+        tolset = rt ? intern_row(c.tols_idx, c.tols_rows, rt) : -2;
+        Py_XDECREF(rt);
+        if (tolset < 0) status = -2;
+      }
+      Py_XDECREF(rows); Py_XDECREF(seq); Py_XDECREF(tols);
+    }
+    if (status) { Py_DECREF(ns); break; }
+
+    // ---- image set, group, scalars (ports/images collected above) ----
+    long imageset = -1;
+    if (!status) {
+      PyObject* ids = PyList_New(0);
+      if (!ids) status = -2;
+      for (Py_ssize_t i = 0;
+           !status && ids && i < PyList_GET_SIZE(image_names); ++i) {
+        PyObject* nm = PyList_GET_ITEM(image_names, i);
+        PyObject* hit = PyDict_GetItemWithError(c.image_ids, nm);
+        long iid;
+        if (hit) iid = PyLong_AsLong(hit);
+        else if (PyErr_Occurred()) { status = -2; break; }
+        else {
+          iid = static_cast<long>(PyDict_Size(c.image_ids));
+          PyObject* num = PyLong_FromLong(iid);
+          if (!num || PyDict_SetItem(c.image_ids, nm, num) != 0) {
+            Py_XDECREF(num); status = -2; break;
+          }
+          Py_DECREF(num);
+        }
+        if (!lappend(ids, iid)) { status = -2; break; }
+      }
+      if (!status) {
+        if (PyList_Sort(ids) != 0) status = -2;
+        else {
+          PyObject* it = PyList_AsTuple(ids);
+          imageset = it ? intern_row(c.imgsets_idx, c.imgsets_rows, it) : -2;
+          Py_XDECREF(it);
+          if (imageset < 0) status = -2;
+        }
+      }
+      Py_XDECREF(ids);
+    }
+    long gid = -1;
+    if (!status) {
+      PyObject* g = getattr_b(spec, "pod_group");
+      if (!g) status = -2;
+      else if (PyObject_IsTrue(g) == 1) {
+        PyObject* hit = PyDict_GetItemWithError(c.group_ids, g);
+        if (hit) gid = PyLong_AsLong(hit);
+        else if (PyErr_Occurred()) status = -2;
+        else {
+          gid = static_cast<long>(PyDict_Size(c.group_ids));
+          PyObject* num = PyLong_FromLong(gid);
+          if (!num || PyDict_SetItem(c.group_ids, g, num) != 0) {
+            Py_XDECREF(num); status = -2;
+          } else {
+            Py_DECREF(num);
+          }
+        }
+      }
+      Py_XDECREF(g);
+    }
+    Py_DECREF(ns);
+    if (status) break;
+
+    long prio = 0;
+    double creation = 0.0;
+    bool can_preempt = true;
+    {
+      PyObject* p = getattr_b(spec, "priority");
+      PyObject* ct = getattr_b(meta, "creation_timestamp");
+      PyObject* pp = getattr_b(spec, "preemption_policy");
+      if (!p || !ct || !pp) status = -2;
+      else {
+        prio = PyLong_AsLong(p);
+        creation = PyFloat_AsDouble(ct);
+        const char* pps = PyUnicode_AsUTF8(pp);
+        can_preempt = !(pps && !strcmp(pps, "Never"));
+        if ((prio == -1 || creation == -1.0) && PyErr_Occurred()) status = -2;
+      }
+      Py_XDECREF(p); Py_XDECREF(ct); Py_XDECREF(pp);
+    }
+    if (status) break;
+
+    long n_aff = n_aff_terms;
+    if (n_anti_terms > n_aff) n_aff = n_anti_terms;
+    if (n_pref_terms > n_aff) n_aff = n_pref_terms;
+
+    empty = PyList_New(0);
+    if (!empty) { status = -2; break; }
+    out = Py_BuildValue(
+        "{s:O,s:l,s:d,s:l,s:l,s:l,s:l,s:O,s:O,s:O,s:O,s:O,s:O,s:O,s:O,s:O,"
+        "s:l,s:l,s:l,s:O,s:O,s:O,s:O,s:O,s:O,s:O}",
+        "reqvec", reqvec, "prio", prio, "creation", creation,
+        "req_id", static_cast<long>(-1), "pref_id", static_cast<long>(-1),
+        "sel_req_id", sel_req_id, "tolset", tolset,
+        "lab_k", lab_k, "lab_v", lab_v, "ports", ports,
+        "aff", aff, "anti", anti, "pref", pref, "pref_w", pref_w,
+        "tsc", tsc, "tsc_skew", tsc_skew,
+        "n_aff", n_aff, "gid", gid, "imageset", imageset,
+        "can_preempt", can_preempt ? Py_True : Py_False,
+        "vol_mode", empty, "vol_req", empty, "vol_cls", empty,
+        "vol_size", empty, "vol_epoch", Py_None, "epoch", Py_None);
+    if (!out) status = -2;
+  } while (false);
+
+  Py_XDECREF(spec); Py_XDECREF(meta);
+  Py_XDECREF(lab_k); Py_XDECREF(lab_v); Py_XDECREF(ports);
+  Py_XDECREF(aff); Py_XDECREF(anti); Py_XDECREF(pref); Py_XDECREF(pref_w);
+  Py_XDECREF(tsc); Py_XDECREF(tsc_skew); Py_XDECREF(reqvec);
+  Py_XDECREF(empty); Py_XDECREF(image_names);
+  if (status == -3) {
+    PyErr_Clear();
+    Py_RETURN_NONE;  // unsupported feature: caller uses the Python path
+  }
+  if (status == -2 || out == nullptr) {
+    if (!PyErr_Occurred()) {
+      PyErr_SetString(PyExc_RuntimeError, "pod_row internal error");
+    }
+    Py_XDECREF(out);
+    return nullptr;
+  }
+  return out;
+}
+
 PyMethodDef methods[] = {
     {"scatter_rows", scatter_rows, METH_VARARGS,
      "scatter_rows(dst2d, rows): dst[i, :len(rows[i])] = rows[i]"},
@@ -183,6 +1088,8 @@ PyMethodDef methods[] = {
      "scatter_rows_at(dst2d, index_i64, rows): dst[index[i], :] = rows[i]"},
     {"fill_scalars", fill_scalars, METH_VARARGS,
      "fill_scalars(dst1d, values): dst[i] = values[i]"},
+    {"pod_row", pod_row, METH_VARARGS,
+     "pod_row(pod, ctx): native pod_rowdata (None = fall back to Python)"},
     {nullptr, nullptr, 0, nullptr},
 };
 
