@@ -192,17 +192,7 @@ class OracleState:
             for pv in self.pv_list:
                 if pv.storage_class != pvc.storage_class:
                     continue
-                if (
-                    pv.claim_ref
-                    or pv.name in self.claimed_pv_names
-                    or pv.name in self.claimed_static
-                ):
-                    continue
-                if pv.capacity + 1e-3 < pvc.request:
-                    continue
-                if pv.node_affinity and not any(
-                    _match_term(node, t) for t in pv.node_affinity
-                ):
+                if not _pv_usable(self, pv, pvc, node):
                     continue
                 self.claimed_static.add(pv.name)
                 claims.append(pv.name)
@@ -359,6 +349,25 @@ def filter_inter_pod_affinity(pod: Pod, state: OracleState, i: int) -> bool:
     return True
 
 
+def _pv_usable(state: OracleState, pv, pvc, node) -> bool:
+    """ONE eligibility rule shared by the VolumeBinding filter (any-fit)
+    and the claim step (first-fit over pv_list): available, unclaimed
+    (pre-cycle AND in-cycle), big enough, admissible on the node."""
+    if (
+        pv.claim_ref
+        or pv.name in state.claimed_pv_names
+        or pv.name in state.claimed_static
+    ):
+        return False
+    if pv.capacity + 1e-3 < pvc.request:
+        return False
+    if pv.node_affinity and not any(
+        _match_term(node, t) for t in pv.node_affinity
+    ):
+        return False
+    return True
+
+
 def filter_volume_binding(pod: Pod, state: OracleState, i: int) -> bool:
     """Mirror of ops/volumes.py: bound-PV node affinity; unbound
     WaitForFirstConsumer claims need a static candidate PV or dynamic
@@ -383,22 +392,10 @@ def filter_volume_binding(pod: Pod, state: OracleState, i: int) -> bool:
         cls = state.storage_classes.get(pvc.storage_class)
         if cls is None or cls.volume_binding_mode != api.VOLUME_BINDING_WAIT:
             return False
-        ok = False
-        for pv in state.pvs_by_class.get(pvc.storage_class, ()):
-            if (
-                pv.claim_ref
-                or pv.name in state.claimed_pv_names
-                or pv.name in state.claimed_static
-            ):
-                continue
-            if pv.capacity + 1e-3 < pvc.request:
-                continue
-            if pv.node_affinity and not any(
-                _match_term(node, t) for t in pv.node_affinity
-            ):
-                continue
-            ok = True
-            break
+        ok = any(
+            _pv_usable(state, pv, pvc, node)
+            for pv in state.pvs_by_class.get(pvc.storage_class, ())
+        )
         if not ok and cls.provisioner:
             ok = not cls.allowed_topologies or any(
                 _match_term(node, t) for t in cls.allowed_topologies
