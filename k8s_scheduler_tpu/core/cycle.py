@@ -95,41 +95,58 @@ def sampling_mask(snap: ClusterSnapshot, pct: int) -> jnp.ndarray:
     return win < k
 
 
-def _unique(fn, base: str):
-    """Give each built program a STABLE descriptive __name__ (and
-    therefore HLO module name). Deliberately not salted with a process
-    counter: the name feeds the persistent compilation-cache key, and a
-    counter would shift with build order across restarts, forcing full
-    recompiles of byte-identical programs."""
+def _unique(fn, base: str, disc: str = ""):
+    """Give each built program a DETERMINISTIC distinctive __name__ (and
+    therefore HLO module name): stable across process restarts (the
+    name feeds the persistent compilation-cache key, so a process
+    counter would force full recompiles after every restart), yet
+    distinct between builders with different inputs (a discriminator
+    hash) — two in-process jits with byte-identical programs are the
+    trigger for the executable-cache corruption _Resilient heals."""
+    if disc:
+        import hashlib
+
+        base = f"{base}_{hashlib.sha1(disc.encode()).hexdigest()[:8]}"
     fn.__name__ = base
     fn.__qualname__ = base
     return fn
 
 
-class _Resilient:
-    """Retry-once wrapper for the built jitted programs.
+# runtime executable-cache corruption signatures (see _Resilient)
+_CORRUPT_MARKERS = (
+    "compiled program expected",   # supplied N buffers, expected N+1
+    "buffer with incompatible size",  # stale entry from another regime
+    "Executable expected parameter",
+)
 
-    Observed on this runtime (jax 0.9 + the platform plugin): when
-    several jits compile byte-identical programs in one process and one
-    of them has EXECUTED, another's SECOND call can fail with
-    'Execution supplied N buffers but compiled program expected N+1' —
-    same jit object, identical avals/shardings, no retrace (its cache
-    already holds the entry). `clear_cache()` + re-trace recovers
-    deterministically (verified by targeted reproduction), so this
-    wrapper does exactly that, once. The programs are pure, so the
-    retry is safe; anything else re-raises."""
+
+class _Resilient:
+    """Retry wrapper for the built jitted programs.
+
+    Observed on this runtime (jax 0.9 + the platform plugin): a jit's
+    SECOND call can execute a corrupted/mismatched cached executable —
+    'Execution supplied N buffers but compiled program expected N+1' or
+    'Executable expected parameter I of size X but got buffer with
+    incompatible size Y' — with identical avals/shardings and no
+    retrace. `clear_cache()` + re-trace recovers (verified by targeted
+    reproduction); the corruption can strike the retry too, so up to
+    three attempts. The programs are pure, so retries are safe;
+    anything else re-raises."""
 
     def __init__(self, fn):
         self._fn = fn
 
     def __call__(self, *a, **k):
-        try:
-            return self._fn(*a, **k)
-        except ValueError as e:
-            if "compiled program expected" not in str(e):
-                raise
-            self._fn.clear_cache()
-            return self._fn(*a, **k)
+        for attempt in range(3):
+            try:
+                return self._fn(*a, **k)
+            except ValueError as e:
+                msg = str(e)
+                if attempt == 2 or not any(
+                    m in msg for m in _CORRUPT_MARKERS
+                ):
+                    raise
+                self._fn.clear_cache()
 
     def lower(self, *a, **k):
         return self._fn.lower(*a, **k)
@@ -141,8 +158,26 @@ class _Resilient:
         return self._fn._cache_size()
 
 
-def _jit(fn, base: str, **jit_kw):
-    return _Resilient(jax.jit(_unique(fn, base), **jit_kw))
+def _jit(fn, base: str, disc: str = "", **jit_kw):
+    return _Resilient(jax.jit(_unique(fn, base, disc), **jit_kw))
+
+
+def _fw_disc(fw: Framework | None) -> str:
+    """Deterministic framework discriminator for program names: plugin
+    names, score weights, AND per-plugin config args (two profiles with
+    the same plugin set but different args compile different programs
+    and must not share a name)."""
+    if fw is None:
+        return "defaultfw"
+
+    def pa(p):
+        return f"{p.name}({sorted(p.args.items())!r})"
+
+    return ",".join(
+        [pa(f) for f in fw.filters]
+        + [f"{pa(s)}:{w}" for s, w in fw.scores]
+        + [pa(p) for p in fw.post_filters]
+    )
 
 
 def _make_pv_choice_fn(ctx: CycleContext):
@@ -377,7 +412,13 @@ def build_cycle_fn(
             rounds_used, accepted_per_round, diag_per_round,
         )
 
-    return _jit(cycle, "cycle")
+    return _jit(
+        cycle, "cycle",
+        disc=(
+            f"{commit_mode}|{gang_scheduling}|{max_rounds}|"
+            f"{percentage_of_nodes_to_score}|{_fw_disc(fw)}"
+        ),
+    )
 
 
 def build_packed_cycle_fn(spec, **kw):
@@ -398,7 +439,14 @@ def build_packed_cycle_fn(spec, **kw):
     def packed(wbuf, bbuf, stable=None):
         return cycle(packing.unpack(wbuf, bbuf, spec), stable)
 
-    return _jit(packed, "packed_cycle")
+    scalars = {k: v for k, v in kw.items() if k != "framework"}
+    return _jit(
+        packed, "packed_cycle",
+        disc=(
+            repr(spec.key()) + repr(sorted(scalars.items()))
+            + _fw_disc(kw.get("framework"))
+        ),
+    )
 
 
 def build_stable_state_fn(spec):
@@ -420,7 +468,7 @@ def build_stable_state_fn(spec):
             out["initial_affinity_state"] = ctx.initial_affinity_state()
         return out
 
-    return _jit(stable, "stable_state")
+    return _jit(stable, "stable_state", disc=repr(spec.key()))
 
 
 def build_carry_fns(spec, framework: Framework | None = None):
@@ -458,7 +506,10 @@ def build_carry_fns(spec, framework: Framework | None = None):
             "mp": ctx.matched_pending,
         }
 
-    carry_init = _jit(carry_init, "carry_init")
+    carry_init = _jit(
+        carry_init, "carry_init",
+        disc=repr(spec.key()) + _fw_disc(fw),
+    )
 
     update_memo: dict[int, Callable] = {}
 
@@ -484,7 +535,10 @@ def build_carry_fns(spec, framework: Framework | None = None):
             # original arguments, and a donated carry consumed by a
             # failed first call would make the recovery path itself
             # crash; the un-aliased copy costs ~0.3ms of HBM traffic
-            carry_update = _jit(carry_update, "carry_update")
+            carry_update = _jit(
+                carry_update, "carry_update",
+                disc=f"{n_bucket}|" + repr(spec.key()) + _fw_disc(fw),
+            )
             update_memo[n_bucket] = carry_update
             hit = carry_update
         return hit
@@ -628,7 +682,14 @@ def build_packed_cycle_carry_fn(
             rres.rounds_used, rres.accepted_per_round, rres.diag_per_round,
         )
 
-    return _jit(cycle, "carry_cycle")
+    return _jit(
+        cycle, "carry_cycle",
+        disc=(
+            f"{gang_scheduling}|{percentage_of_nodes_to_score}|"
+            f"{max_rounds}|{sorted((rounds_kw or {}).items())!r}|"
+            + repr(spec.key()) + _fw_disc(fw)
+        ),
+    )
 
 
 def build_diagnosis_fn(spec, framework: Framework | None = None,
@@ -714,7 +775,10 @@ def build_diagnosis_fn(spec, framework: Framework | None = None,
         )
         return rej
 
-    return _jit(diagnose, "diagnose")
+    return _jit(
+        diagnose, "diagnose",
+        disc=f"{window}|" + repr(spec.key()) + _fw_disc(fw),
+    )
 
 
 def _preemption_gate_rows(fw: Framework, ctx: CycleContext):
@@ -766,7 +830,10 @@ def build_packed_preemption_fn(spec, framework: Framework | None = None):
             excluded=result.gang_dropped,
         )
 
-    return _jit(packed, "packed_preempt")
+    return _jit(
+        packed, "packed_preempt",
+        disc=repr(spec.key()) + _fw_disc(fw),
+    )
 
 
 def build_preemption_fn(framework: Framework | None = None):
@@ -789,4 +856,4 @@ def build_preemption_fn(framework: Framework | None = None):
             excluded=result.gang_dropped,
         )
 
-    return _jit(post_filter, "post_filter")
+    return _jit(post_filter, "post_filter", disc=_fw_disc(fw))
