@@ -32,6 +32,41 @@ TARGET_DECISIONS_PER_SEC = 50_000.0
 DEFAULT_SNAPSHOTS = {1: 50, 2: 50, 3: 50, 4: 30, 5: 30}
 
 
+def _run_one(run_config, c: int, n: int):
+    """Run one config with per-config fault isolation: transport-class
+    rig flakes (the tunnel's `remote_compile: response body closed`
+    killed round 3's entire official bench run) get ONE retry; any
+    failure is captured as an error record instead of propagating, so a
+    single bad config can never zero the whole round's evidence.
+    Returns (result_or_None, error_or_None)."""
+    import traceback
+
+    from k8s_scheduler_tpu.core.cycle import is_transport_error
+
+    last_err = None
+    for attempt in range(2):
+        try:
+            return run_config(c, snapshots=n), last_err
+        except Exception as e:  # noqa: BLE001 — isolation is the point
+            err = {
+                "config": c,
+                "attempt": attempt,
+                "transport": is_transport_error(e),
+                "error": f"{type(e).__name__}: {e}",
+            }
+            print(
+                f"bench: config {c} attempt {attempt} failed: "
+                f"{err['error']}\n{traceback.format_exc()}",
+                file=sys.stderr,
+                flush=True,
+            )
+            last_err = err  # keep the final failure
+            if attempt == 0 and is_transport_error(e):
+                continue  # one retry for rig flakes only
+            return None, last_err
+    return None, last_err
+
+
 def main() -> None:
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         import jax
@@ -47,12 +82,38 @@ def main() -> None:
     ]
     override = os.environ.get("BENCH_SNAPSHOTS")
     results = []
+    errors = []
     for c in configs:
         n = int(override) if override else DEFAULT_SNAPSHOTS[c]
-        results.append(bench_suite.run_config(c, snapshots=n))
+        r, err = _run_one(bench_suite.run_config, c, n)
+        if r is not None:
+            results.append(r)
+        if err is not None:
+            errors.append(err)
 
-    head = next((r for r in results if r["config"] == 4), results[-1])
-    dps = head["decisions_per_sec"]
+    from k8s_scheduler_tpu.core.cycle import RESILIENT_STRIKES
+
+    detail = {
+        "device": str(jax.devices()[0].platform),
+        "configs": results,
+    }
+    if errors:
+        detail["errors"] = errors
+    if RESILIENT_STRIKES:
+        detail["resilient_strikes"] = {
+            f"{prog}:{kind}": n
+            for (prog, kind), n in sorted(RESILIENT_STRIKES.items())
+        }
+    if results:
+        head = next((r for r in results if r["config"] == 4), results[-1])
+        dps = head["decisions_per_sec"]
+        detail.update(
+            headline_config=head["config"],
+            p50_ms=head["p50_ms"],
+            p99_ms=head["p99_ms"],
+        )
+    else:
+        dps = 0.0  # every config failed: still emit a parseable line
     print(
         json.dumps(
             {
@@ -60,13 +121,7 @@ def main() -> None:
                 "value": dps,
                 "unit": "decisions/s",
                 "vs_baseline": round(dps / TARGET_DECISIONS_PER_SEC, 4),
-                "detail": {
-                    "headline_config": head["config"],
-                    "p50_ms": head["p50_ms"],
-                    "p99_ms": head["p99_ms"],
-                    "device": str(jax.devices()[0].platform),
-                    "configs": results,
-                },
+                "detail": detail,
             }
         )
     )

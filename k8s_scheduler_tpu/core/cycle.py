@@ -119,19 +119,72 @@ _CORRUPT_MARKERS = (
     "Executable expected parameter",
 )
 
+# tunneled-rig transport flake signatures: the compile/execute RPC dies
+# mid-flight (BENCH_r03's `remote_compile: read body: response body
+# closed`). Nothing device-side is corrupted — the request never
+# completed — so a plain re-invoke (no clear_cache) recovers; matched
+# case-insensitively and kept narrow so real errors re-raise.
+_TRANSPORT_MARKERS = (
+    "remote_compile",
+    "remote_execute",
+    "response body closed",
+    "read body",
+    "connection reset",
+    "broken pipe",
+    "connection refused",
+    "unexpected eof",
+)
+
+
+def is_transport_error(e: BaseException) -> bool:
+    """True when `e` looks like a tunnel/RPC transport flake (retryable
+    without clearing compiled state) rather than a program error."""
+    msg = str(e).lower()
+    return any(m in msg for m in _TRANSPORT_MARKERS)
+
+
+# per-process strike log: (program name, kind) -> count. Mirrored into
+# the prometheus counter (scheduler_program_retry_strikes_total) so
+# operators can see how often serving pays a retry; kept as a plain
+# dict too so tests and the bench can read it without a registry scrape.
+RESILIENT_STRIKES: dict[tuple[str, str], int] = {}
+
+
+def _record_strike(program: str, kind: str) -> None:
+    key = (program, kind)
+    RESILIENT_STRIKES[key] = RESILIENT_STRIKES.get(key, 0) + 1
+    try:
+        from ..metrics.metrics import global_metrics
+
+        global_metrics().program_retry_strikes.labels(
+            program=program, kind=kind
+        ).inc()
+    except Exception:
+        pass  # metrics must never break the serving path
+
 
 class _Resilient:
     """Retry wrapper for the built jitted programs.
 
-    Observed on this runtime (jax 0.9 + the platform plugin): a jit's
-    SECOND call can execute a corrupted/mismatched cached executable —
-    'Execution supplied N buffers but compiled program expected N+1' or
-    'Executable expected parameter I of size X but got buffer with
-    incompatible size Y' — with identical avals/shardings and no
-    retrace. `clear_cache()` + re-trace recovers (verified by targeted
-    reproduction); the corruption can strike the retry too, so up to
-    three attempts. The programs are pure, so retries are safe;
-    anything else re-raises."""
+    Two observed failure classes, both recoverable because the programs
+    are pure:
+
+    - executable-cache corruption (jax 0.9 + the platform plugin): a
+      jit's SECOND call can execute a corrupted/mismatched cached
+      executable — 'Execution supplied N buffers but compiled program
+      expected N+1' or 'Executable expected parameter I of size X but
+      got buffer with incompatible size Y' — with identical avals and
+      no retrace. `clear_cache()` + re-trace recovers (verified by
+      targeted reproduction); the corruption can strike the retry too,
+      so up to three attempts.
+    - transport flakes through the tunnel (`remote_compile: response
+      body closed` killed round 3's official bench): the RPC died
+      mid-flight, nothing is corrupted; re-invoke WITHOUT clearing the
+      cache after a short backoff.
+
+    Every retry is recorded in RESILIENT_STRIKES and the
+    scheduler_program_retry_strikes_total metric (kind =
+    executable_cache | transport). Anything else re-raises."""
 
     def __init__(self, fn):
         self._fn = fn
@@ -146,7 +199,15 @@ class _Resilient:
                     m in msg for m in _CORRUPT_MARKERS
                 ):
                     raise
+                _record_strike(self._fn.__name__, "executable_cache")
                 self._fn.clear_cache()
+            except Exception as e:
+                if attempt == 2 or not is_transport_error(e):
+                    raise
+                _record_strike(self._fn.__name__, "transport")
+                import time
+
+                time.sleep(0.5 * (attempt + 1))
 
     def lower(self, *a, **k):
         return self._fn.lower(*a, **k)
@@ -214,6 +275,43 @@ def _pv_claimed_of(snap: ClusterSnapshot, extra) -> jnp.ndarray:
     if pv is None:
         return jnp.zeros((snap.pv_avail.shape[0],), bool)
     return pv
+
+
+def _pv_claimed_after_unwind(snap, ctx, extra, assignment, dropped):
+    """pv_claimed for CycleResult, with gang-unwound pods' static-PV
+    claims released (ADVICE r3 #2: the engine folded claims for pods
+    _gang_unwind later dropped, and the diagnosis program would treat
+    those PVs as unavailable, misattributing VolumeBinding rejections).
+
+    When any pod was dropped, the bitmap is refolded rank-ordered over
+    the SURVIVING accepted set from empty. Residual inaccuracy (reason
+    strings only, placements unaffected): the replay can pick different
+    PVs than the engine's incremental in-round claims — e.g. a survivor
+    who really bound via dynamic provisioning can be re-assigned the
+    unwound pod's freed static PV, or two same-class survivors can swap
+    identities. Exactness would need per-pod chosen-PV tracking through
+    the engines' extra state; the refold keeps the claimed COUNT per
+    (class, topology) pool right for survivors, which is what the
+    diagnosis program's VolumeBinding attribution keys on. lax.cond
+    skips the refold entirely in the no-drop common case."""
+    pv = _pv_claimed_of(snap, extra)
+    if not isinstance(extra, dict) or "VolumeBinding" not in extra:
+        return pv
+    if not snap.has_volumes:
+        return pv
+    from ..ops import volumes as volumes_ops
+
+    def refold(_):
+        accepted = snap.pod_valid & (assignment >= 0)  # post-unwind
+        return volumes_ops.fold_pv_claims(
+            snap, ctx.expr_node_mask, jnp.zeros_like(pv), accepted,
+            jnp.maximum(assignment, 0),
+            snap.pod_order.astype(jnp.int32),
+        )
+
+    return jax.lax.cond(
+        jnp.any(dropped), refold, lambda _: pv, None
+    )
 
 
 
@@ -408,7 +506,9 @@ def build_cycle_fn(
         return CycleResult(
             result.assignment, result.node_requested, unsched, dropped,
             srejects + result.dyn_aux,
-            _pv_claimed_of(snap, result.extra),
+            _pv_claimed_after_unwind(
+                snap, ctx, result.extra, result.assignment, dropped
+            ),
             rounds_used, accepted_per_round, diag_per_round,
         )
 
@@ -678,7 +778,10 @@ def build_packed_cycle_carry_fn(
         unsched = snap.pod_valid & (result.assignment < 0)
         return CycleResult(
             result.assignment, result.node_requested, unsched, dropped,
-            result.dyn_aux, _pv_claimed_of(snap, rres.extra),
+            result.dyn_aux,
+            _pv_claimed_after_unwind(
+                snap, ctx, rres.extra, result.assignment, dropped
+            ),
             rres.rounds_used, rres.accepted_per_round, rres.diag_per_round,
         )
 

@@ -165,6 +165,14 @@ class SchedulerMetrics:
             ["plugin", "profile"],
             registry=r,
         )
+        self.program_retry_strikes = Counter(
+            "scheduler_program_retry_strikes_total",
+            "Compiled-program retries absorbed by the resilience wrapper "
+            "(kind=executable_cache pays clear_cache+retrace in-cycle; "
+            "kind=transport pays a backoff re-invoke).",
+            ["program", "kind"],
+            registry=r,
+        )
 
     # ---- convenience recorders ------------------------------------------
 

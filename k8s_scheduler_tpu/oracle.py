@@ -1112,7 +1112,12 @@ def preempt(
                     )
                 finally:
                     for rp in removed:
-                        post_state.add(i, rp)
+                        # existing pods entered the state with
+                        # claim_volumes=False; restoring with the default
+                        # True would permanently add claimed_static
+                        # entries and pollute later candidates' volume
+                        # checks within this pass
+                        post_state.add(i, rp, claim_volumes=False)
 
             k_min = None
             for k in range(k_claimed[i], elig + 1):

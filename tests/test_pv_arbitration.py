@@ -131,3 +131,88 @@ if __name__ == "__main__":
     import sys
 
     pytest.main([__file__, "-v"] + sys.argv[1:])
+
+
+@pytest.mark.parametrize("mode", ["scan", "rounds"])
+def test_gang_unwind_releases_pv_claims(mode):
+    """ADVICE r3 #2: a gang member that places and claims a static PV,
+    then gets unwound because its group missed minMember, must not leave
+    a phantom claim in CycleResult.pv_claimed (the diagnosis program
+    would misattribute VolumeBinding rejections for other pods)."""
+    from k8s_scheduler_tpu.models.api import PodGroup
+
+    # one 1-cpu node: only one of the two 1-cpu gang members can place,
+    # so minMember=2 fails and the placed member (holding the PV) unwinds
+    nodes = [MakeNode("n0").capacity({"cpu": "1"}).obj()]
+    classes = [
+        StorageClass("local", VOLUME_BINDING_WAIT, provisioner=False)
+    ]
+    pvs = [PersistentVolume("pv-0", capacity=10 * GiB,
+                            storage_class="local")]
+    pvcs = [
+        PersistentVolumeClaim(f"claim-{p}", storage_class="local",
+                              request=5 * GiB)
+        for p in range(2)
+    ]
+    pods = [
+        MakePod(f"g-{p}").req({"cpu": "1"}).volume(f"claim-{p}")
+        .group("job").created(float(p)).obj()
+        for p in range(2)
+    ]
+    enc = SnapshotEncoder(pad_pods=16, pad_nodes=4)
+    snap = enc.encode(nodes, pods, pod_groups=[PodGroup("job", 2)],
+                      pvcs=pvcs, pvs=pvs, storage_classes=classes)
+    out = build_cycle_fn(commit_mode=mode)(snap)
+    a = np.asarray(out.assignment)[: len(pods)]
+    assert (a < 0).all(), a  # gang unwound entirely
+    assert np.asarray(out.gang_dropped).sum() == 1
+    assert not np.asarray(out.pv_claimed).any(), (
+        "unwound gang member left a phantom PV claim"
+    )
+
+
+@pytest.mark.parametrize("mode", ["scan", "rounds"])
+def test_surviving_placements_keep_pv_claims_after_unwind(mode):
+    """The refold after a gang unwind must keep claims of pods that
+    actually survived the cycle."""
+    from k8s_scheduler_tpu.models.api import PodGroup
+
+    # node n0 fits exactly one 1-cpu pod; the solo claimant places and
+    # keeps its PV while the 2-member gang (needing 2 cpu total on the
+    # remaining 1-cpu node) fails and unwinds
+    nodes = [
+        MakeNode("n0").capacity({"cpu": "1"}).obj(),
+        MakeNode("n1").capacity({"cpu": "1"}).obj(),
+    ]
+    classes = [
+        StorageClass("local", VOLUME_BINDING_WAIT, provisioner=False)
+    ]
+    pvs = [
+        PersistentVolume(f"pv-{v}", capacity=10 * GiB,
+                         storage_class="local")
+        for v in range(3)
+    ]
+    pvcs = [
+        PersistentVolumeClaim("claim-solo", storage_class="local",
+                              request=5 * GiB),
+        PersistentVolumeClaim("claim-g0", storage_class="local",
+                              request=5 * GiB),
+        PersistentVolumeClaim("claim-g1", storage_class="local",
+                              request=5 * GiB),
+    ]
+    pods = [
+        MakePod("solo").req({"cpu": "1"}).volume("claim-solo")
+        .created(0.0).obj(),
+        MakePod("g-0").req({"cpu": "1"}).volume("claim-g0")
+        .group("job").created(1.0).obj(),
+        MakePod("g-1").req({"cpu": "1"}).volume("claim-g1")
+        .group("job").created(2.0).obj(),
+    ]
+    enc = SnapshotEncoder(pad_pods=16, pad_nodes=4)
+    snap = enc.encode(nodes, pods, pod_groups=[PodGroup("job", 2)],
+                      pvcs=pvcs, pvs=pvs, storage_classes=classes)
+    out = build_cycle_fn(commit_mode=mode)(snap)
+    a = np.asarray(out.assignment)[: len(pods)]
+    assert a[0] >= 0  # solo placed
+    assert (a[1:] < 0).all()  # gang unwound
+    assert np.asarray(out.pv_claimed).sum() == 1  # solo's claim kept
