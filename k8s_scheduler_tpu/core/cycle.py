@@ -345,6 +345,7 @@ def build_cycle_fn(
     commit_mode: str = "scan",
     max_rounds: int = 64,
     percentage_of_nodes_to_score: int = 0,  # 0 = adaptive (upstream default)
+    rounds_kw: dict | None = None,  # compact/passes/shortlist overrides
 ) -> Callable[[ClusterSnapshot], CycleResult]:
     """Compile the cycle for a framework (default: the default plugin set).
     The returned callable is jitted; snapshots with identical padded shapes
@@ -430,6 +431,7 @@ def build_cycle_fn(
                 max_rounds=max_rounds,
                 score_anchor_fn=lambda nr: fw.score_anchor(ctx, nr),
                 pv_choice_fn=_make_pv_choice_fn(ctx),
+                **(rounds_kw or {}),
             )
             # Final-state work (dynamic reject attribution + the NodePorts
             # part of the preemption gate) only matters for pods that never
@@ -516,7 +518,8 @@ def build_cycle_fn(
         cycle, "cycle",
         disc=(
             f"{commit_mode}|{gang_scheduling}|{max_rounds}|"
-            f"{percentage_of_nodes_to_score}|{_fw_disc(fw)}"
+            f"{percentage_of_nodes_to_score}|"
+            f"{sorted((rounds_kw or {}).items())!r}|{_fw_disc(fw)}"
         ),
     )
 

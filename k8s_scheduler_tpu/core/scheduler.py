@@ -134,8 +134,16 @@ class Scheduler:
         # ONE encoder per profile for the scheduler's lifetime: interned
         # string ids and the resource-name axis stay stable across cycles
         # (the encoder's documented contract), and each profile keeps its
-        # own delta arena (its pending subset is what carries over)
-        self._encoders = {n: SnapshotEncoder() for n in names}
+        # own delta arena (its pending subset is what carries over). The
+        # profile's queueSort plugin owns each encoder's pod_order rank.
+        from ..framework.queuesort import queue_sort_for_profile
+
+        self._encoders = {
+            n: SnapshotEncoder(
+                queue_sort=queue_sort_for_profile(self.config.profile(n))
+            )
+            for n in names
+        }
         self._encoder = self._encoders[names[0]]
         self._cycle_kw = dict(
             gang_scheduling=self.config.gang_scheduling,

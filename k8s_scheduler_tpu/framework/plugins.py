@@ -278,9 +278,20 @@ class VolumeBinding(PluginBase):
 
         snap = ctx.snap
         claimed = extra
-        for j in range(snap.pod_vol_mode.shape[1]):
+        MVol = snap.pod_vol_mode.shape[1]
+        if MVol >= 2 and snap.has_multi_volume:
+            # constrained slots claim first (greedy lowest-index claiming
+            # processed permissive-first can dead-end; exact for 2 slots
+            # — see ops/volumes.fold_pv_claims)
+            counts = volumes_ops.slot_candidate_counts_row(
+                snap, ctx.expr_node_mask, claimed, node, p
+            )
+            perm = jnp.argsort(counts)
+        else:
+            perm = jnp.arange(MVol)
+        for t in range(MVol):
             ch = volumes_ops.chosen_pv_row(
-                snap, ctx.expr_node_mask, claimed, node, p, j
+                snap, ctx.expr_node_mask, claimed, node, p, perm[t]
             )
             ch = jnp.where(committed, ch, -1)
             claimed = claimed.at[jnp.clip(ch, 0, claimed.shape[0] - 1)].max(
@@ -438,7 +449,11 @@ class InterPodAffinity(PluginBase):
 
 
 class DefaultPreemption(PluginBase):
-    """PostFilter: batched what-if preemption (ops/preemption.py)."""
+    """PostFilter: batched what-if preemption (ops/preemption.py).
+
+    Config args: `budget` (candidates prefiltered per cycle, default
+    256) and `scan_budget` (nominations per cycle, default 64) — the
+    per-cycle latency budgets; pods beyond them retry next cycle."""
 
     name = "DefaultPreemption"
 
@@ -446,12 +461,18 @@ class DefaultPreemption(PluginBase):
                     gate_rows, excluded=None):
         from ..ops import preemption as preemption_ops
 
+        kw = {}
+        if "budget" in self.args:
+            kw["budget"] = int(self.args["budget"])
+        if "scan_budget" in self.args:
+            kw["scan_budget"] = int(self.args["scan_budget"])
         return preemption_ops.run_preemption(
             ctx,
             assignment=assignment,
             node_requested=node_requested,
             gate_rows=gate_rows,
             excluded=excluded,
+            **kw,
         )
 
 

@@ -203,6 +203,11 @@ class ClusterSnapshot:
     has_inter_pod_affinity: bool
     has_topology_spread: bool
     has_volumes: bool
+    # some pod really mounts >= 2 PVCs: gates the multi-volume joint-
+    # admission machinery (Hall subset matmuls, claim-order permutation)
+    # — MVol is a sticky PAD dim (bucket 2), so the dim alone would run
+    # that machinery as guaranteed identity work on 1-PVC clusters
+    has_multi_volume: bool
 
     # --- real (unpadded) counts: 0-d arrays, NOT static — a changed pod
     # count must not recompile the cycle (only padded shapes are static) ---
@@ -394,11 +399,19 @@ class SnapshotEncoder:
         resource_names: Sequence[str] = api.DEFAULT_RESOURCES,
         pad_pods: int | None = None,
         pad_nodes: int | None = None,
+        queue_sort=None,  # QueueSortPlugin; None = PrioritySort
     ) -> None:
         self.strings = StringInterner()
         self.resource_names = list(resource_names)
         self.pad_pods = pad_pods
         self.pad_nodes = pad_nodes
+        # the profile's queueSort plugin (SURVEY §2 C11): owns the
+        # pod_order rank both encode paths bake into the snapshot
+        if queue_sort is None:
+            from ..framework.queuesort import PrioritySort
+
+            queue_sort = PrioritySort()
+        self.queue_sort = queue_sort
         # persistent intern tables (grow-only; ids stable across encodes)
         self._exprs_t = _InternTable()  # rows: (key, op, vals, num)
         self._reqs_t = _InternTable()  # rows: tuple of terms (expr-id tuples)
@@ -1462,16 +1475,16 @@ class SnapshotEncoder:
                 for j, enc_port in enumerate(d["ports"]):
                     pod_port_ids[i, j] = port_ids_t.intern(int(enc_port))
 
-        # Pod ordering rank: priority desc, then creation ts asc, then index.
+        # Pod ordering rank via the profile's queueSort plugin (default
+        # PrioritySort: priority desc, creation ts asc, index).
         pod_order = np.full(P, np.iinfo(np.int32).max, np.int32)
         if p_real:
             creation = np.array(
                 [d["creation"] for d in pend_rows], np.float64
             )
-            order_key = np.lexsort(
-                (np.arange(p_real), creation, -pod_prio[:p_real])
+            pod_order[:p_real] = self.queue_sort.rank(
+                pending, pod_prio[:p_real], creation
             )
-            pod_order[order_key] = np.arange(p_real, dtype=np.int32)
 
         snap = ClusterSnapshot(
             resource_names=tuple(rn),
@@ -1540,6 +1553,10 @@ class SnapshotEncoder:
             ),
             has_volumes=self._stick_flag(
                 "vol", bool((pod_vol_mode >= 0).any())
+            ),
+            has_multi_volume=self._stick_flag(
+                "mvol",
+                bool(((pod_vol_mode >= 0).sum(axis=1) >= 2).any()),
             ),
             pod_vol_mode=pod_vol_mode,
             pod_vol_req=pod_vol_req,
@@ -1617,7 +1634,7 @@ class SnapshotEncoder:
             "pdb_elems": (tuple(id(b) for b in pdbs),
                           tuple(b.disruptions_allowed for b in pdbs)),
             "flags": (snap.has_inter_pod_affinity, snap.has_topology_spread,
-                      snap.has_volumes),
+                      snap.has_volumes, snap.has_multi_volume),
         }
         # a direct encode() call leaves the arena holding the PREVIOUS
         # snapshot's bytes; mark it stale so the next encode_packed takes
@@ -1793,7 +1810,7 @@ class SnapshotEncoder:
         ]
         rowdata = ds["pod_rowdata"]
         lens0 = self._table_lens()
-        flag_aff, flag_tsc, flag_vol = ds["flags"]
+        flag_aff, flag_tsc, flag_vol, flag_mvol = ds["flags"]
         new_rows = []
         for i in dirty:
             p = pending[i]
@@ -1819,6 +1836,10 @@ class SnapshotEncoder:
             if not flag_tsc and len(d["tsc_skew"]) > 0:
                 return None
             if not flag_vol and len(d["vol_mode"]) > 0:
+                return None
+            if not flag_mvol and len(d["vol_mode"]) >= 2:
+                # a first multi-PVC pod flips the joint-admission
+                # capability: full path recompiles with the flag on
                 return None
         # distinct-port axis: re-intern over every slot that has ports
         # (matches the full path's slot-order interning exactly)
@@ -1895,10 +1916,9 @@ class SnapshotEncoder:
         po = A["pod_order"]
         po[:] = np.iinfo(np.int32).max
         if p_real:
-            order_key = np.lexsort((
-                np.arange(p_real), creation[:p_real], -prio[:p_real]
-            ))
-            po[order_key] = np.arange(p_real, dtype=np.int32)
+            po[:p_real] = self.queue_sort.rank(
+                pending, prio[:p_real], creation[:p_real]
+            )
 
         gm = A["group_min_member"]
         gm[:] = 0

@@ -220,6 +220,27 @@ def rounds_commit(
     compact: int = 8,
     passes: int = 6,  # device-time flat across 4..10 at config-#4 scale;
     passes_round0: int = 10,  # smaller counts compile ~30% faster
+    shortlist: int = 0,  # >0: acceptance passes run on a per-pod top-k
+    # candidate shortlist [B, shortlist] instead of [B, N], with a
+    # rescue pass preserving the "unplaced => infeasible vs final
+    # state" invariant (see one_round). MEASURED (sweep_shortlist4, real
+    # TPU, config #4 10k x 5k): the shortlist LOSES at this geometry —
+    # 212 ms vs 158 ms wide — because the per-pass saving (~0.5 ms; the
+    # [B,N] pass chain is bandwidth-cheap at N=5k) is smaller than the
+    # added per-round top_k (~6.5 ms at [10k,5k]) and per-pass [B,k]
+    # anchor-delta gathers (~1.7 ms). Default therefore 0 (wide). The
+    # path is kept, tested, for geometries where N dwarfs the pass
+    # count's bandwidth economics (N >> 5k).
+    anchor_stride: int = 1,  # re-anchor every pass (the spread signal
+    # is load-bearing: stride 2 cost ~19% of round-0 acceptance in the
+    # same sweep)
+    compact_gather: str = "rows",  # how compacted rounds fetch the
+    # active rows of the [P, N] static base: "rows" = row-gather (fast
+    # single-chip; under GSPMD it makes XLA all-gather the FULL [P, N]
+    # sbase per round — 200 MB at config #4); "onehot" = one-hot [B, P]
+    # matmul (exact: one 1.0 per row, f32) whose contraction runs over
+    # the sharded pods axis, so the mesh path pays one small [B, N]
+    # all-reduce instead. The sharded build selects "onehot".
     score_anchor_fn: Callable | None = None,  # node_requested -> f32 [N]
     # capacity-sensitive node-local score component (Framework.score_anchor)
     pv_choice_fn: Callable | None = None,  # (vsnap, node_of, live, ext)
@@ -420,7 +441,8 @@ def rounds_commit(
         )
         return ok_pod > 0
 
-    def one_round(gid, act_v, node_req, ext, passes: int):
+    def one_round(gid, act_v, node_req, ext, passes: int,
+                  identity_gid: bool = False):
         """One round over the pods in `gid` (global ids; `act_v` marks
         which rows are genuinely active).
 
@@ -434,18 +456,44 @@ def rounds_commit(
         ONE guard sweep at round end checks every capacity-accepted claim
         for mutual consistency (original ranks decide within a group) and
         REVOKES violators, who retry next round against refreshed
-        masks."""
+        masks.
+
+        With `shortlist` > 0 the passes run over a per-pod top-k
+        candidate SHORTLIST of the round-start scores ([B, k] — top_k is
+        one bandwidth-bound read of the scored array, while each wide
+        pass re-materialized several [B, N] arrays plus a [B, N]
+        dead-scatter). A pod whose entire shortlist dies in-round waits
+        for the RESCUE pass: one wide pass, entered via lax.cond only
+        when some active pod is mask-feasible but shortlist-exhausted,
+        which restores the engine's invariant that a round accepts at
+        least one claim whenever any active pod is feasible — so loop
+        termination still implies every unplaced pod is infeasible
+        against the final state (oracle.validate_rounds_assignment)."""
         B = gid.shape[0]
-        vsnap = _pod_view(snap, gid)
-        vmp = m_pending[:, gid]
-        # static mask+score travel as ONE pre-combined f32 array (score
-        # where feasible, NEG_INF where not): compacted rounds pay a
-        # single [B, N] row-gather instead of two (~2ms each at 10k x 5k)
-        vsbase = sbase[gid]
+        if identity_gid:
+            # round 0: gid is the identity permutation — indexing with
+            # it is not always elided by XLA, and under GSPMD the
+            # residual gather all-gathers the full sharded [P, N] base
+            vsnap, vmp, vsbase = snap, m_pending, sbase
+            vrank, vsels, vovf = rank_g, matched_sels_g, overflow_g
+        else:
+            vsnap = _pod_view(snap, gid)
+            vmp = m_pending[:, gid]
+            # static mask+score travel as ONE pre-combined f32 array
+            # (score where feasible, NEG_INF where not): compacted
+            # rounds pay a single [B, N] row-gather instead of two
+            # (~2ms each at 10k x 5k)
+            if compact_gather == "onehot":
+                oh = jax.nn.one_hot(gid, P, dtype=jnp.float32)  # [B, P]
+                vsbase = jnp.matmul(
+                    oh, sbase, precision=jax.lax.Precision.HIGHEST
+                )
+            else:
+                vsbase = sbase[gid]
+            vrank = rank_g[gid]
+            vsels = matched_sels_g[gid]
+            vovf = overflow_g[gid]
         vsmask = vsbase > NEG_INF * 0.5
-        vrank = rank_g[gid]
-        vsels = matched_sels_g[gid]
-        vovf = overflow_g[gid]
 
         mask, score, _pf = dyn_batched_view_fn(
             vsnap, vmp, node_req, ext, vsmask
@@ -459,53 +507,23 @@ def rounds_commit(
         )
         pid = jnp.arange(B, dtype=jnp.int32)
         i = jnp.arange(B, dtype=jnp.int32)
+        nom = jnp.clip(vsnap.pod_nominated, 0, N - 1)
+        has_nom = vsnap.pod_nominated >= 0
 
-        acc = jnp.zeros((B,), bool)
-        acc_node = jnp.full((B,), -1, jnp.int32)
-        dead = jnp.zeros((B, N), bool)
-        diag = jnp.zeros((3,), jnp.int32)
-        for t in range(passes):
-            avail = mask & ~dead & ~acc[:, None]
-            if anchor0 is not None and t > 0:
-                # nodes that filled this round lose attractiveness NOW —
-                # the spread mechanism that sequential scheduling gets
-                # from per-pod score freshness
-                delta = score_anchor_fn(node_req) - anchor0  # [N]
-                scored = jnp.round(base + delta[None, :]) + tie
-            else:
-                scored = jnp.round(base) + tie
-            eff_t = jnp.where(avail, scored, NEG_INF)
-            nom = jnp.clip(vsnap.pod_nominated, 0, N - 1)
-            nom_ok = (vsnap.pod_nominated >= 0) & avail[pid, nom]
-            best = jnp.where(nom_ok, nom, jnp.argmax(eff_t, axis=1)).astype(
-                jnp.int32
-            )
-            has = avail[pid, best] & act_v & vsnap.pod_valid & ~acc
-
-            # Overflow claimants (matching more guard-active selectors than
-            # the MS_MATCH table tracks) are invisible to other claims'
-            # guard checks, so one may only be accepted in a round that
-            # accepts NOTHING else: the final pass goes overflow-exclusive
-            # (lowest rank, alone) iff the round is still empty-handed.
-            normal = has & ~vovf
-            if t == passes - 1:
-                allow_ovf = ~jnp.any(acc) & ~jnp.any(normal)
-                ovf_rank = jnp.min(jnp.where(has & vovf, vrank, _BIG))
-                ovf_pick = has & vovf & (vrank == ovf_rank) & allow_ovf
-            else:
-                ovf_pick = jnp.zeros_like(normal)
-            live = normal | ovf_pick
-
-            # ---- capacity (sorted segmented prefix vs in-round state) ----
-            # Passes accept on capacity ONLY; the guard sweep runs once at
-            # round end over all capacity-accepted claims and revokes
-            # violators (see below) — guards are ~5% of rejections but the
-            # table sort is the dominant per-pass cost, so it must not run
-            # per pass.
+        def resolve_capacity(live, best, node_req):
+            """Rank-ordered capacity resolution of one pass's claims
+            (sorted segmented prefix vs in-round state): returns
+            (accepted bool [B], node_req'). Passes accept on capacity
+            ONLY; the guard sweep runs once at round end over all
+            capacity-accepted claims and revokes violators — guards are
+            ~5% of rejections but the table sort is the dominant
+            per-pass cost, so it must not run per pass."""
             sort_key = jnp.where(live, best * P + vrank, _BIG)
             order = jnp.argsort(sort_key)
             s_node = jnp.where(live, best, N)[order]
-            s_req = jnp.where(live[:, None], vsnap.pod_requested, 0.0)[order]
+            s_req = jnp.where(live[:, None], vsnap.pod_requested, 0.0)[
+                order
+            ]
             s_live = live[order]
             cum = jnp.cumsum(s_req, axis=0)
             before = cum - s_req
@@ -516,36 +534,201 @@ def rounds_commit(
             seg_before = before - before[seg_first]
             nsafe = jnp.clip(s_node, 0, N - 1)
             free = (
-                snap.node_allocatable[nsafe] - node_req[nsafe] + slack[nsafe]
+                snap.node_allocatable[nsafe] - node_req[nsafe]
+                + slack[nsafe]
             )
             fits = jnp.all(seg_before + s_req <= free, axis=1) & s_live
             accepted_t = jnp.zeros((B,), bool).at[order].set(fits)
-
             node_of_t = jnp.where(accepted_t, best, 0)
-            req_add = jnp.where(accepted_t[:, None], vsnap.pod_requested, 0.0)
-            node_req = node_req.at[node_of_t].add(req_add)
-            acc = acc | accepted_t
-            acc_node = jnp.where(accepted_t, best, acc_node)
-            # A capacity loser keeps the node alive if it still fits ALONE
-            # in the node's post-pass free space: the segmented prefix
-            # charges REJECTED earlier-rank claims too (a huge non-fitting
-            # claim shadows smaller ones behind it), so such losers retry
-            # next pass once the contenders have settled elsewhere.
+            req_add = jnp.where(
+                accepted_t[:, None], vsnap.pod_requested, 0.0
+            )
+            # one-hot matmul instead of scatter-add: 0.27 vs 1.14 ms at
+            # B=10k (probe_shortlist_prims) and this runs once per pass;
+            # 0/1 x f32 products are exact, accumulation order differs
+            # from a sequential scatter only in fp summation order
+            oh = jax.nn.one_hot(node_of_t, N, dtype=jnp.float32)
+            node_req = node_req + jnp.matmul(
+                oh.T, req_add, precision=jax.lax.Precision.HIGHEST
+            )
+            return accepted_t, node_req
+
+        def fits_alone_at(best, node_req):
+            # A capacity loser keeps the node alive if it still fits
+            # ALONE in the node's post-pass free space: the segmented
+            # prefix charges REJECTED earlier-rank claims too (a huge
+            # non-fitting claim shadows smaller ones behind it), so such
+            # losers retry next pass once the contenders settle.
             bsafe = jnp.clip(best, 0, N - 1)
-            fits_alone = jnp.all(
+            return jnp.all(
                 vsnap.pod_requested
                 <= snap.node_allocatable[bsafe] - node_req[bsafe]
                 + slack[bsafe],
                 axis=1,
             )
-            dead = dead.at[pid, best].max(
-                live & ~accepted_t & ~fits_alone
+
+        def pick_overflow(has, acc, normal):
+            # Overflow claimants (matching more guard-active selectors
+            # than the MS_MATCH table tracks) are invisible to other
+            # claims' guard checks, so one may only be accepted in a
+            # round that accepts NOTHING else: lowest rank, alone, iff
+            # the round is still empty-handed.
+            allow_ovf = ~jnp.any(acc) & ~jnp.any(normal)
+            ovf_rank = jnp.min(jnp.where(has & vovf, vrank, _BIG))
+            return has & vovf & (vrank == ovf_rank) & allow_ovf
+
+        acc = jnp.zeros((B,), bool)
+        acc_node = jnp.full((B,), -1, jnp.int32)
+        diag = jnp.zeros((3,), jnp.int32)
+        use_sl = 0 < shortlist < N
+
+        if use_sl:
+            k = shortlist
+            scored0 = jnp.where(mask, jnp.round(base) + tie, NEG_INF)
+            vals, sl = jax.lax.top_k(scored0, k)  # [B, k]
+            # the nominated node (post-preemption) must be claimable even
+            # when outside the top-k: force it into the last column (and
+            # NEG_INF any earlier duplicate so a dead node is not offered
+            # twice)
+            nom_val = jnp.take_along_axis(scored0, nom[:, None], 1)[:, 0]
+            vals = jnp.where(
+                has_nom[:, None] & (sl == nom[:, None]), NEG_INF, vals
             )
-            diag = diag + jnp.stack([
-                jnp.sum(live, dtype=jnp.int32),
-                jnp.sum(live & ~accepted_t, dtype=jnp.int32),
-                jnp.zeros((), jnp.int32),
-            ])
+            sl = sl.at[:, k - 1].set(jnp.where(has_nom, nom, sl[:, k - 1]))
+            vals = vals.at[:, k - 1].set(
+                jnp.where(has_nom, nom_val, vals[:, k - 1])
+            )
+            sl_ok = vals > NEG_INF * 0.5
+            dead = jnp.zeros((B, k), bool)
+            # the [B*k] anchor-delta gather is ~1.7 ms at B=10k;
+            # anchor_stride > 1 trades acceptance for that gather (one
+            # pass of staleness ages the spread signal — measured -19%
+            # round-0 acceptance at stride 2)
+            delta_stride = max(1, anchor_stride)
+            dsl = jnp.zeros((B, k), jnp.float32)
+            for t in range(passes):
+                avail = sl_ok & ~dead & ~acc[:, None]
+                if anchor0 is not None and t > 0:
+                    # nodes that filled this round lose attractiveness
+                    # NOW — the spread mechanism sequential scheduling
+                    # gets from per-pod score freshness; the delta rides
+                    # a [B*k] gather from the [N] anchor vector
+                    if (t - 1) % delta_stride == 0:
+                        delta = jnp.round(
+                            score_anchor_fn(node_req) - anchor0
+                        )
+                        dsl = delta[sl.reshape(-1)].reshape(B, k)
+                    eff = jnp.where(avail, vals + dsl, NEG_INF)
+                else:
+                    eff = jnp.where(avail, vals, NEG_INF)
+                bj = jnp.argmax(eff, axis=1).astype(jnp.int32)
+                nom_ok = has_nom & avail[:, k - 1]
+                bj = jnp.where(nom_ok, k - 1, bj)
+                best = jnp.take_along_axis(sl, bj[:, None], 1)[:, 0]
+                has = (
+                    jnp.take_along_axis(avail, bj[:, None], 1)[:, 0]
+                    & act_v & vsnap.pod_valid & ~acc
+                )
+                normal = has & ~vovf
+                ovf_pick = (
+                    pick_overflow(has, acc, normal)
+                    if t == passes - 1
+                    else jnp.zeros_like(normal)
+                )
+                live = normal | ovf_pick
+                accepted_t, node_req = resolve_capacity(live, best,
+                                                        node_req)
+                acc = acc | accepted_t
+                acc_node = jnp.where(accepted_t, best, acc_node)
+                dead = dead.at[pid, bj].max(
+                    live & ~accepted_t & ~fits_alone_at(best, node_req)
+                )
+                diag = diag + jnp.stack([
+                    jnp.sum(live, dtype=jnp.int32),
+                    jnp.sum(live & ~accepted_t, dtype=jnp.int32),
+                    jnp.zeros((), jnp.int32),
+                ])
+
+            # ---- rescue pass (shortlist-exhaustion escape hatch) ----
+            # Runs only when some active pod is feasible by this round's
+            # mask yet has no live shortlist entry left; one wide pass
+            # over the full mask for exactly those pods. Guarantees a
+            # zero-accept round implies every active pod's mask was
+            # empty — the invariant the validity checker relies on.
+            feas0 = jnp.any(mask, axis=1)
+            exhausted = (
+                act_v & vsnap.pod_valid & ~acc & feas0
+                & ~jnp.any(sl_ok & ~dead, axis=1)
+            )
+
+            def rescue(op):
+                acc, acc_node, node_req, diag = op
+                if anchor0 is not None:
+                    delta = score_anchor_fn(node_req) - anchor0
+                    scored = jnp.round(base + delta[None, :]) + tie
+                else:
+                    scored = jnp.round(base) + tie
+                avail = mask & ~acc[:, None]
+                eff = jnp.where(avail, scored, NEG_INF)
+                best = jnp.argmax(eff, axis=1).astype(jnp.int32)
+                r_nom_ok = has_nom & avail[pid, nom]
+                best = jnp.where(r_nom_ok, nom, best)
+                has = avail[pid, best] & exhausted
+                normal = has & ~vovf
+                ovf_pick = pick_overflow(has, acc, normal)
+                live = normal | ovf_pick
+                accepted_t, node_req = resolve_capacity(live, best,
+                                                        node_req)
+                acc = acc | accepted_t
+                acc_node = jnp.where(accepted_t, best, acc_node)
+                diag = diag + jnp.stack([
+                    jnp.sum(live, dtype=jnp.int32),
+                    jnp.sum(live & ~accepted_t, dtype=jnp.int32),
+                    jnp.zeros((), jnp.int32),
+                ])
+                return acc, acc_node, node_req, diag
+
+            acc, acc_node, node_req, diag = jax.lax.cond(
+                jnp.any(exhausted), rescue, lambda op: op,
+                (acc, acc_node, node_req, diag),
+            )
+        else:
+            dead = jnp.zeros((B, N), bool)
+            for t in range(passes):
+                avail = mask & ~dead & ~acc[:, None]
+                if anchor0 is not None and t > 0:
+                    # nodes that filled this round lose attractiveness
+                    # NOW — the spread mechanism sequential scheduling
+                    # gets from per-pod score freshness
+                    delta = score_anchor_fn(node_req) - anchor0  # [N]
+                    scored = jnp.round(base + delta[None, :]) + tie
+                else:
+                    scored = jnp.round(base) + tie
+                eff_t = jnp.where(avail, scored, NEG_INF)
+                nom_ok = has_nom & avail[pid, nom]
+                best = jnp.where(
+                    nom_ok, nom, jnp.argmax(eff_t, axis=1)
+                ).astype(jnp.int32)
+                has = avail[pid, best] & act_v & vsnap.pod_valid & ~acc
+                normal = has & ~vovf
+                ovf_pick = (
+                    pick_overflow(has, acc, normal)
+                    if t == passes - 1
+                    else jnp.zeros_like(normal)
+                )
+                live = normal | ovf_pick
+                accepted_t, node_req = resolve_capacity(live, best,
+                                                        node_req)
+                acc = acc | accepted_t
+                acc_node = jnp.where(accepted_t, best, acc_node)
+                dead = dead.at[pid, best].max(
+                    live & ~accepted_t & ~fits_alone_at(best, node_req)
+                )
+                diag = diag + jnp.stack([
+                    jnp.sum(live, dtype=jnp.int32),
+                    jnp.sum(live & ~accepted_t, dtype=jnp.int32),
+                    jnp.zeros((), jnp.int32),
+                ])
 
         # ---- round-end guard sweep over ALL capacity-accepted claims ----
         # Revoking a violator leaves node_req slightly over-charged for
@@ -574,7 +757,8 @@ def rounds_commit(
     # ---- round 1: full pending set ----
     gid0 = jnp.arange(P, dtype=jnp.int32)
     acc0, node0, node_req, extra, diag0 = one_round(
-        gid0, snap.pod_valid, snap.node_requested, extra, passes_round0
+        gid0, snap.pod_valid, snap.node_requested, extra, passes_round0,
+        identity_gid=True,
     )
     placed = jnp.where(acc0, node0, -1)
     active = snap.pod_valid & ~acc0
@@ -584,12 +768,27 @@ def rounds_commit(
     diag_hist = jnp.zeros((max_rounds, 3), jnp.int32).at[0].set(diag0)
 
     # ---- rounds 2+: compacted to the lowest-rank actives ----
+    # The window holds the B lowest-rank actives. A zero-accept round
+    # must NOT terminate the loop while actives remain beyond the
+    # window (they may be feasible — the windowed pods can all be
+    # stuck on constraints while a higher-rank pod would place; caught
+    # by the 500x100 mid-size differential, invisible to <=B-pod toy
+    # cases): instead the window ADVANCES by B over the rank order
+    # (`skip`). State provably does not change during a zero-accept
+    # round (no accepts => no node_req/extra updates, and revocations
+    # only touch same-round accepts), so a full zero-accept sweep gives
+    # every active pod a genuine full-mask check against what is then
+    # the final state — the validity invariant "unplaced => infeasible"
+    # holds exactly. Any acceptance resets the sweep to the lowest
+    # ranks.
     B = compact_window(P, compact)
 
     def body(carry):
-        node_req, ext, placed, active, rnd, _, hist, dhist = carry
+        node_req, ext, placed, active, rnd, skip, hist, dhist = carry
         key = jnp.where(active, rank_g, _BIG)
-        gid = jnp.argsort(key)[:B].astype(jnp.int32)
+        order = jnp.argsort(key).astype(jnp.int32)
+        start = jnp.minimum(skip, jnp.maximum(P - B, 0))
+        gid = jax.lax.dynamic_slice(order, (start,), (B,))
         act_v = active[gid]
         accepted, node_of, node_req, ext, diag = one_round(
             gid, act_v, node_req, ext, passes
@@ -599,17 +798,22 @@ def rounds_commit(
         n_acc = jnp.sum(accepted, dtype=jnp.int32)
         hist = hist.at[jnp.minimum(rnd, max_rounds - 1)].set(n_acc)
         dhist = dhist.at[jnp.minimum(rnd, max_rounds - 1)].set(diag)
-        return (node_req, ext, placed, active, rnd + 1, n_acc > 0, hist,
+        skip = jnp.where(n_acc > 0, jnp.int32(0), skip + jnp.int32(B))
+        return (node_req, ext, placed, active, rnd + 1, skip, hist,
                 dhist)
 
     def cond(carry):
-        _, _, _, active, rnd, progressed, _, _ = carry
-        return progressed & jnp.any(active) & (rnd < max_rounds)
+        _, _, _, active, rnd, skip, _, _ = carry
+        n_act = jnp.sum(active, dtype=jnp.int32)
+        return (skip < n_act) & (rnd < max_rounds)
 
+    # round 0 was full-width: if it accepted nothing, every pod already
+    # had its full-mask check and the sweep is complete (skip = P)
+    skip0 = jnp.where(jnp.any(acc0), jnp.int32(0), jnp.int32(P))
     node_req, extra, placed, active, rounds_used, _, acc_hist, diag_hist = (
         jax.lax.while_loop(
             cond, body,
-            (node_req, extra, placed, active, jnp.int32(1), jnp.any(acc0),
+            (node_req, extra, placed, active, jnp.int32(1), skip0,
              acc_hist, diag_hist),
         )
     )

@@ -59,6 +59,55 @@ def pod_pv_cand(snap, j):  # bool [P, V] class+size candidacy for slot j
     )
 
 
+def _hall_subsets(MVol: int):
+    """Slot subsets (size >= 2) whose Hall condition the joint-admission
+    check enumerates. Exact (all subsets) up to MVol=6; beyond that the
+    2^MVol matmul count would explode compile and device time, so only
+    pairs + the full set are checked — necessary conditions that keep
+    the common two-way conflicts exact, with >=3-way-nested residual
+    over-admission documented in PARITY #8. MVol is a sticky pad dim
+    with bucket 2; real pods rarely mount > 4 PVCs."""
+    import itertools
+
+    if MVol <= 6:
+        sizes = range(2, MVol + 1)
+    else:
+        return [
+            *itertools.combinations(range(MVol), 2),
+            tuple(range(MVol)),
+        ]
+    return [
+        s for r in sizes for s in itertools.combinations(range(MVol), r)
+    ]
+
+
+def _hall_ok(pv_ok_f, cands, dyn_oks, modes, ok):
+    """Joint feasibility across a pod's unbound volume slots (PARITY #8
+    closure): the per-slot static_ok tests admit a pod whose two PVCs
+    are satisfiable only by the SAME single PV; binding then fails at
+    the agent. Exact fix via Hall's theorem: an assignment of DISTINCT
+    PVs to the pod's static-required slots exists iff for every subset
+    of slots, the union of their candidate PV sets (restricted to PVs
+    usable on the node) has at least |subset| members. Slots that can
+    ride dynamic provisioning on the node never constrain (their
+    subsets are dominated by the pure-static sub-subsets, enumerated
+    too). Singletons are the existing per-slot test, so only subsets of
+    size >= 2 are added — one [P,V]x[V,N] count matmul each. The
+    single-pod [N]-scale twin lives in volume_mask_unbound_row; keep
+    the two in lockstep."""
+    for s in _hall_subsets(len(cands)):
+        u = cands[s[0]]
+        for j in s[1:]:
+            u = u | cands[j]
+        avail = u.astype(jnp.float32) @ pv_ok_f  # [P, N] counts
+        need = sum(
+            ((modes[j] == 1)[:, None] & ~dyn_oks[j]).astype(jnp.int32)
+            for j in s
+        )
+        ok &= avail + 0.5 >= need.astype(jnp.float32)
+    return ok
+
+
 def volume_mask(snap, expr_mask: jnp.ndarray,
                 pv_claimed: jnp.ndarray | None = None) -> jnp.ndarray:
     """Conjunction over each pod's PVC constraints -> bool [P, N].
@@ -75,9 +124,11 @@ def volume_mask(snap, expr_mask: jnp.ndarray,
     pv_ok = req_rows(snap.pv_req_id) & snap.pv_avail[:, None]  # [V, N]
     if pv_claimed is not None:
         pv_ok = pv_ok & ~pv_claimed[:, None]
+    pv_ok_f = pv_ok.astype(jnp.float32)
     MVol = snap.pod_vol_mode.shape[1]
 
     ok = jnp.ones((P, N), bool)
+    cands, dyn_oks, modes = [], [], []
     for j in range(MVol):
         mode = snap.pod_vol_mode[:, j]  # [P]
         rid = snap.pod_vol_req[:, j]
@@ -87,9 +138,7 @@ def volume_mask(snap, expr_mask: jnp.ndarray,
         # static candidates: available PVs of the right class and size,
         # usable on the node
         cand = pod_pv_cand(snap, j)  # [P, V]
-        static_ok = (
-            cand.astype(jnp.float32) @ pv_ok.astype(jnp.float32)
-        ) > 0.0  # [P, N]
+        static_ok = (cand.astype(jnp.float32) @ pv_ok_f) > 0.0  # [P, N]
 
         dyn_ok = jnp.where(
             (rid == -2)[:, None], False, rid_rows
@@ -100,6 +149,11 @@ def volume_mask(snap, expr_mask: jnp.ndarray,
             jnp.where((mode == 1)[:, None], static_ok | dyn_ok, False),
         )
         ok &= jnp.where((mode >= 0)[:, None], row_ok, True)
+        cands.append(cand)
+        dyn_oks.append(dyn_ok)
+        modes.append(mode)
+    if MVol >= 2 and snap.has_multi_volume:
+        ok = _hall_ok(pv_ok_f, cands, dyn_oks, modes, ok)
     return ok
 
 
@@ -115,20 +169,25 @@ def volume_mask_unbound(snap, expr_mask, pv_claimed) -> jnp.ndarray:
         & snap.pv_avail[:, None]
         & ~pv_claimed[:, None]
     )  # [V, N]
+    pv_ok_f = pv_ok.astype(jnp.float32)
     MVol = snap.pod_vol_mode.shape[1]
     ok = jnp.ones((P, N), bool)
+    cands, dyn_oks, modes = [], [], []
     for j in range(MVol):
         mode = snap.pod_vol_mode[:, j]
         rid = snap.pod_vol_req[:, j]
-        static_ok = (
-            pod_pv_cand(snap, j).astype(jnp.float32)
-            @ pv_ok.astype(jnp.float32)
-        ) > 0.0
+        cand = pod_pv_cand(snap, j)
+        static_ok = (cand.astype(jnp.float32) @ pv_ok_f) > 0.0
         dyn_ok = jnp.where(
             (rid == -2)[:, None], False,
             labels_ops.take_rows(req, rid, True),
         )
         ok &= jnp.where((mode == 1)[:, None], static_ok | dyn_ok, True)
+        cands.append(cand)
+        dyn_oks.append(dyn_ok)
+        modes.append(mode)
+    if MVol >= 2 and snap.has_multi_volume:
+        ok = _hall_ok(pv_ok_f, cands, dyn_oks, modes, ok)
     return ok
 
 
@@ -146,6 +205,7 @@ def volume_mask_unbound_row(snap, expr_mask, pv_claimed, p):
     Rq = req.shape[0]
     MVol = snap.pod_vol_mode.shape[1]
     ok = jnp.ones((N,), bool)
+    cands, dyn_oks, modes = [], [], []
     for j in range(MVol):
         mode = snap.pod_vol_mode[p, j]
         rid = snap.pod_vol_req[p, j]
@@ -160,7 +220,49 @@ def volume_mask_unbound_row(snap, expr_mask, pv_claimed, p):
         )
         dyn_ok = jnp.where(rid == -2, False, rid_row)
         ok &= jnp.where(mode == 1, static_ok | dyn_ok, True)
+        cands.append(cand)
+        dyn_oks.append(dyn_ok)
+        modes.append(mode)
+    if MVol >= 2 and snap.has_multi_volume:
+        # Hall's condition over this pod's slots — the single-pod
+        # [N]-scale twin of _hall_ok (same subsets via _hall_subsets;
+        # keep in lockstep)
+        for sub in _hall_subsets(MVol):
+            u = cands[sub[0]]
+            for j in sub[1:]:
+                u = u | cands[j]
+            avail = jnp.sum(
+                u[:, None] & pv_ok, axis=0, dtype=jnp.int32
+            )  # [N]
+            need = sum(
+                ((modes[j] == 1) & ~dyn_oks[j]).astype(jnp.int32)
+                for j in sub
+            )
+            ok &= avail >= need
     return ok
+
+
+def slot_candidate_counts_row(snap, expr_mask, pv_claimed, node, p):
+    """i32 [MVol]: per-slot count of compatible available unclaimed PVs
+    usable at `node` for pod `p` — the scan engine's claim-order key
+    (constrained slots claim first; see fold_pv_claims)."""
+    MVol = snap.pod_vol_mode.shape[1]
+    at_node = (
+        pv_node_table(snap, expr_mask)[:, jnp.clip(node, 0, snap.N - 1)]
+        & ~pv_claimed
+    )  # [V]
+    return jnp.stack(
+        [
+            jnp.sum(
+                (snap.pv_class == snap.pod_vol_class[p, j])
+                & (snap.pv_capacity + _CAP_EPS >= snap.pod_vol_size[p, j])
+                & (snap.pod_vol_mode[p, j] == 1)
+                & at_node,
+                dtype=jnp.int32,
+            )
+            for j in range(MVol)
+        ]
+    )
 
 
 def chosen_pv_row(snap, expr_mask, pv_claimed, node, p, j):
@@ -191,11 +293,43 @@ def fold_pv_claims(snap, expr_mask, pv_claimed, accepted, node_of,
     claims it; losers retry against the updated bitmap. Terminates in at
     most V passes (each pass claims >= 1 PV or nothing changes); when
     the batch is known claim-disjoint (the rounds engine's _RB_PV guard
-    guarantees it) the loop exits after one pass."""
+    guarantees it) the loop exits after one pass.
+
+    Within a pod, slots claim in ASCENDING candidate-count order (slot
+    order inside a pod carries no meaning, so the slot axis is permuted
+    per pod): greedy lowest-index claiming processed permissive-first
+    can dead-end — slot A {pv0, pv1} takes pv0 before slot B {pv0} —
+    even though the Hall-condition mask admitted the pod because a
+    distinct assignment exists. Constrained-first is exact for 2 slots;
+    a >=3-slot adversarial chain remains a documented PARITY residual."""
     V = snap.pv_avail.shape[0]
     P = accepted.shape[0]
     MVol = snap.pod_vol_mode.shape[1]
     big = jnp.int32(2**31 - 1)
+    if MVol >= 2 and snap.has_multi_volume:
+        import dataclasses
+
+        pvt = pv_node_table(snap, expr_mask) & ~pv_claimed[:, None]
+        nsafe = jnp.clip(node_of, 0, snap.N - 1)
+        at_node = pvt[:, nsafe].T  # [P, V]
+        counts = jnp.stack(
+            [
+                jnp.sum(
+                    pod_pv_cand(snap, j) & at_node, axis=1,
+                    dtype=jnp.int32,
+                )
+                for j in range(MVol)
+            ],
+            axis=1,
+        )  # [P, MVol]
+        perm = jnp.argsort(counts, axis=1).astype(jnp.int32)
+        snap = dataclasses.replace(
+            snap,
+            pod_vol_mode=jnp.take_along_axis(snap.pod_vol_mode, perm, 1),
+            pod_vol_req=jnp.take_along_axis(snap.pod_vol_req, perm, 1),
+            pod_vol_class=jnp.take_along_axis(snap.pod_vol_class, perm, 1),
+            pod_vol_size=jnp.take_along_axis(snap.pod_vol_size, perm, 1),
+        )
 
     def body(carry):
         claimed, pending_slots, _progress = carry

@@ -178,10 +178,15 @@ class OracleState:
             self._claim_static_pvs(node_idx, pod)
 
     def _claim_static_pvs(self, node_idx: int, pod: Pod) -> None:
-        """Mirror of ops/volumes.chosen_pv: lowest-index compatible
-        available unclaimed PV per unbound WaitForFirstConsumer slot."""
+        """Mirror of ops/volumes.chosen_pv + fold_pv_claims: lowest-index
+        compatible available unclaimed PV per unbound
+        WaitForFirstConsumer slot, slots claimed in ASCENDING
+        candidate-count order (constrained-first — greedy permissive-
+        first claiming can dead-end even when a distinct assignment
+        exists; exact for 2 slots, like the kernel)."""
         claims = []
         node = self.nodes[node_idx]
+        slots = []
         for claim in pod.spec.volumes:
             pvc = self.pvcs.get(f"{pod.namespace}/{claim}")
             if pvc is None or pvc.volume_name:
@@ -189,11 +194,18 @@ class OracleState:
             cls = self.storage_classes.get(pvc.storage_class)
             if cls is None or cls.volume_binding_mode != api.VOLUME_BINDING_WAIT:
                 continue
-            for pv in self.pv_list:
-                if pv.storage_class != pvc.storage_class:
-                    continue
-                if not _pv_usable(self, pv, pvc, node):
-                    continue
+            cand = [
+                pv
+                for pv in self.pv_list
+                if pv.storage_class == pvc.storage_class
+                and _pv_usable(self, pv, pvc, node)
+            ]
+            slots.append((len(cand), len(slots), cand))
+        slots.sort(key=lambda s: (s[0], s[1]))
+        for _cnt, _order, cand in slots:
+            for pv in cand:
+                if pv.name in self.claimed_static:
+                    continue  # taken by an earlier slot of this pod
                 self.claimed_static.add(pv.name)
                 claims.append(pv.name)
                 break
@@ -376,6 +388,7 @@ def filter_volume_binding(pod: Pod, state: OracleState, i: int) -> bool:
     if not pod.spec.volumes:
         return True
     node = state.nodes[i]
+    static_required: list[set] = []
     for claim in pod.spec.volumes:
         pvc = state.pvcs.get(f"{pod.namespace}/{claim}")
         if pvc is None:
@@ -392,16 +405,29 @@ def filter_volume_binding(pod: Pod, state: OracleState, i: int) -> bool:
         cls = state.storage_classes.get(pvc.storage_class)
         if cls is None or cls.volume_binding_mode != api.VOLUME_BINDING_WAIT:
             return False
-        ok = any(
-            _pv_usable(state, pv, pvc, node)
+        cand = {
+            pv.name
             for pv in state.pvs_by_class.get(pvc.storage_class, ())
+            if _pv_usable(state, pv, pvc, node)
+        }
+        dyn = bool(cls.provisioner) and (
+            not cls.allowed_topologies
+            or any(_match_term(node, t) for t in cls.allowed_topologies)
         )
-        if not ok and cls.provisioner:
-            ok = not cls.allowed_topologies or any(
-                _match_term(node, t) for t in cls.allowed_topologies
-            )
-        if not ok:
+        if not cand and not dyn:
             return False
+        if not dyn:
+            static_required.append(cand)
+    # Hall's condition across the pod's static-required slots (PARITY #8
+    # closure, mirrors ops/volumes._hall_ok): DISTINCT PVs must exist —
+    # a pod whose two PVCs are satisfiable only by one PV is infeasible
+    if len(static_required) >= 2:
+        import itertools
+
+        for r in range(2, len(static_required) + 1):
+            for s in itertools.combinations(static_required, r):
+                if len(set().union(*s)) < r:
+                    return False
     return True
 
 
@@ -779,6 +805,9 @@ def validate_rounds_assignment(
     existing: Sequence[tuple[Pod, str]] = (),
     round_cap_hit: bool = False,
     allow_feasible_unplaced: Sequence[int] = (),
+    pvcs: Sequence = (),
+    pvs: Sequence = (),
+    storage_classes: Sequence = (),
 ) -> list[str]:
     """Validity invariants for the round-based commit (ops/rounds.py).
 
@@ -795,13 +824,16 @@ def validate_rounds_assignment(
     Unplaced pods must be infeasible against the final state, unless the
     round cap was hit or they are listed in `allow_feasible_unplaced`
     (gang-dropped pods). Returns human-readable violations."""
-    final = OracleState.build(nodes, existing)
+    final = OracleState.build(nodes, existing, pvcs, pvs, storage_classes)
     placed: list[tuple[Pod, int]] = []
-    for pi, pod in enumerate(pending):
+    # placed pods enter in QUEUE ORDER so their static-PV claims fold
+    # rank-ordered (the shared binder-choice rule); unplaced-but-feasible
+    # checks below then see the claimed bitmap
+    for pi in queue_order(pending):
         node = assignment[pi]
         if node >= 0:
-            final.add(node, pod)
-            placed.append((pod, node))
+            final.add(node, pending[pi])
+            placed.append((pending[pi], node))
 
     errors: list[str] = []
     # per-node aggregates: capacity + hostPort uniqueness

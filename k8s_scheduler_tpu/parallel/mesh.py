@@ -78,6 +78,22 @@ def shard_snapshot(snap, mesh):
     pods_size = mesh.shape["pods"]
     nodes_size = mesh.shape.get("nodes", 1)
 
+    # multi-host meshes contain devices this process cannot address:
+    # device_put of host data is single-process-only, so each process
+    # contributes its local shards from the (replicated) host array —
+    # the DCN path proven by tests/test_distributed.py
+    me = jax.process_index()
+    multiproc = any(
+        d.process_index != me for d in np.asarray(mesh.devices).flat
+    )
+
+    def put(v, ns):
+        if multiproc:
+            return jax.make_array_from_callback(
+                v.shape, ns, lambda idx: v[idx]
+            )
+        return jax.device_put(v, ns)
+
     out = {}
     for f in dataclasses.fields(snap):
         v = getattr(snap, f.name)
@@ -98,7 +114,5 @@ def shard_snapshot(snap, mesh):
             and v.shape[0] % nodes_size == 0
         ):
             spec[0] = "nodes"
-        out[f.name] = jax.device_put(
-            v, NamedSharding(mesh, PartitionSpec(*spec))
-        )
+        out[f.name] = put(v, NamedSharding(mesh, PartitionSpec(*spec)))
     return dataclasses.replace(snap, **{k: v for k, v in out.items()})

@@ -1,0 +1,132 @@
+"""Shortlist-engine primitive costs on the real TPU (round-4 design
+probe): is per-round top-k + [B,k] passes actually cheaper than the
+[B,N] pass chain, and which top-k flavor / gather shape to use?
+
+Run:  python scripts/probe_shortlist_prims.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scripts.devtime import devtime
+
+P, N, K = 10112, 5120, 32
+
+
+def main():
+    print("platform:", jax.devices()[0].platform, flush=True)
+    rng = np.random.default_rng(0)
+    base = jnp.asarray(rng.standard_normal((P, N)), jnp.float32)
+    mask = jnp.asarray(rng.random((P, N)) < 0.5)
+    delta = jnp.asarray(rng.standard_normal((N,)), jnp.float32)
+    sl = jnp.asarray(rng.integers(0, N, (P, K)), jnp.int32)
+    ranks = jnp.asarray(rng.permutation(P), jnp.int32)
+    req = jnp.asarray(rng.random((P, 5)), jnp.float32)
+
+    def t(name, fn, *a):
+        d = devtime(jax.jit(fn), *a, reps=8)
+        print(f"{name:44s} {d*1e3:8.3f} ms", flush=True)
+        return d
+
+    scored = jnp.where(mask, base, -1e9)
+
+    t("top_k k=32 [P,N]", lambda s: jax.lax.top_k(s, K), scored)
+    t("approx_max_k k=32 [P,N]",
+      lambda s: jax.lax.approx_max_k(s, K), scored)
+    t("approx_max_k k=32 recall .99",
+      lambda s: jax.lax.approx_max_k(s, K, recall_target=0.99), scored)
+    t("mask+where only [P,N]", lambda b, m: jnp.where(m, b, -1e9),
+      base, mask)
+    t("argmax [P,N]", lambda s: jnp.argmax(s, axis=1), scored)
+
+    t("delta gather [P,K] from [N]",
+      lambda d, s: d[s.reshape(-1)].reshape(P, K), delta, sl)
+    t("take_along_axis [P,K] from [P,N]",
+      lambda b, s: jnp.take_along_axis(b, s, axis=1), base, sl)
+    t("onehot matmul delta: [P,K]",
+      lambda d, s: (jax.nn.one_hot(s, N, dtype=jnp.bfloat16)
+                    @ d.astype(jnp.bfloat16)),
+      delta, sl)
+
+    t("argsort [P] i32", lambda k: jnp.argsort(k), ranks)
+    packed = ranks.astype(jnp.uint32)
+    t("lax.sort packed u32+iota [P]",
+      lambda p: jax.lax.sort((p, jnp.arange(P, dtype=jnp.int32)),
+                             num_keys=1), packed)
+
+    # one wide pass (the current engine's per-pass chain) vs one
+    # shortlist pass
+    def wide_pass(scored, mask, dead, acc, delta):
+        avail = mask & ~dead & ~acc[:, None]
+        eff = jnp.where(avail, jnp.round(scored + delta[None, :]), -1e9)
+        best = jnp.argmax(eff, axis=1).astype(jnp.int32)
+        pid = jnp.arange(P, dtype=jnp.int32)
+        has = avail[pid, best]
+        dead = dead.at[pid, best].max(has)
+        return best, dead
+
+    dead0 = jnp.zeros((P, N), bool)
+    acc0 = jnp.zeros((P,), bool)
+    t("WIDE pass (avail+round+argmax+deadscatter)",
+      wide_pass, scored, mask, dead0, acc0, delta)
+
+    def sl_pass(vals, sl, dead_sl, acc, delta):
+        avail = (vals > -5e8) & ~dead_sl & ~acc[:, None]
+        dsl = delta[sl.reshape(-1)].reshape(P, K)
+        eff = jnp.where(avail, vals + jnp.round(dsl), -1e9)
+        bj = jnp.argmax(eff, axis=1).astype(jnp.int32)
+        pid = jnp.arange(P, dtype=jnp.int32)
+        best = sl[pid, bj]
+        dead_sl = dead_sl.at[pid, bj].max(avail[pid, bj])
+        return best, dead_sl
+
+    vals = jnp.take_along_axis(scored, sl, axis=1)
+    dead_sl0 = jnp.zeros((P, K), bool)
+    t("SL pass (gather+argmax_k+deadscatter)",
+      sl_pass, vals, sl, dead_sl0, acc0, delta)
+
+    # capacity resolution per pass: claim sort + segmented prefix
+    def cap_resolve(best, rank, req, node_req):
+        live = best >= 0
+        sort_key = jnp.where(live, best * P + rank, jnp.int32(2**31 - 1))
+        order = jnp.argsort(sort_key)
+        s_node = jnp.where(live, best, N)[order]
+        s_req = jnp.where(live[:, None], req, 0.0)[order]
+        cum = jnp.cumsum(s_req, axis=0)
+        before = cum - s_req
+        i = jnp.arange(P, dtype=jnp.int32)
+        seg_start = jnp.concatenate(
+            [jnp.ones((1,), bool), s_node[1:] != s_node[:-1]])
+        seg_first = jax.lax.cummax(jnp.where(seg_start, i, -1))
+        seg_before = before - before[seg_first]
+        nsafe = jnp.clip(s_node, 0, N - 1)
+        fits = jnp.all(seg_before + s_req <= node_req[nsafe], axis=1)
+        acc = jnp.zeros((P,), bool).at[order].set(fits & (s_node < N))
+        return acc
+
+    node_req = jnp.asarray(rng.random((N, 5)) + 4.0, jnp.float32)
+    best0 = jnp.asarray(rng.integers(0, N, (P,)), jnp.int32)
+    t("capacity resolve (sort+segprefix) [P]",
+      cap_resolve, best0, ranks, req, node_req)
+
+    # node_req scatter-add vs one-hot matmul
+    def nr_scatter(node_req, best, req):
+        return node_req.at[best].add(req)
+
+    def nr_onehot(node_req, best, req):
+        oh = jax.nn.one_hot(best, N, dtype=jnp.float32)  # [P,N]
+        return node_req + oh.T @ req
+
+    t("node_req scatter-add [P]->[N,R]", nr_scatter, node_req, best0, req)
+    t("node_req one-hot matmul [P]->[N,R]", nr_onehot, node_req, best0,
+      req)
+
+
+if __name__ == "__main__":
+    main()

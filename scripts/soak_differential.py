@@ -155,17 +155,173 @@ def one_case(seed: int, scan_cycle, rounds_cycle, pre_fn, enc):
 one_case.regrets = []
 
 
+def mid_case(seed: int, scan_cycle, rounds_cycle, pre_fn, enc):
+    """MID-SIZE differential class (VERDICT r3 item 5): 500 pods x 100
+    nodes with real preemption pressure (low-priority existing workload
+    filling most capacity, high-priority pending) and static-PV
+    contention — the window/bucket/overflow boundaries live between the
+    toy range and config-4 scale. Same assertions as one_case."""
+    import numpy as np
+
+    from k8s_scheduler_tpu.models import MakePod
+    from k8s_scheduler_tpu.models.api import (
+        VOLUME_BINDING_WAIT,
+        PersistentVolume,
+        PersistentVolumeClaim,
+        StorageClass,
+    )
+
+    rng = np.random.default_rng(seed)
+    n_nodes, n_pods = 100, 500
+    nodes = make_cluster(
+        n_nodes, taint_fraction=0.15, cpu_choices=(4, 8)
+    )
+    # low-priority existing workload occupying most capacity: pending
+    # high-priority pods must preempt, low-priority ones go unschedulable
+    existing = [
+        (
+            MakePod(f"run-{i}")
+            .req({"cpu": "1", "memory": "512Mi"})
+            .labels({"app": f"app-{i % 16}"})
+            .priority(0)
+            .created(float(i))
+            .obj(),
+            f"node-{i % n_nodes}",
+        )
+        for i in range(3 * n_nodes)
+    ]
+    pods = make_pods(
+        n_pods,
+        seed=seed,
+        affinity_fraction=0.2,
+        anti_affinity_fraction=0.15,
+        spread_fraction=0.15,
+        selector_fraction=0.25,
+        toleration_fraction=0.2,
+        priorities=(0, 10, 100),
+        num_apps=24,
+    )
+    # static-PV contention: fewer PVs than claimants of one WFC class
+    classes = [
+        StorageClass("local", VOLUME_BINDING_WAIT, provisioner=False)
+    ]
+    GiB = 2**30
+    pvs = [
+        PersistentVolume(f"pv-{v}", capacity=10 * GiB,
+                         storage_class="local")
+        for v in range(20)
+    ]
+    pvcs = [
+        PersistentVolumeClaim(f"claim-{j}", storage_class="local",
+                              request=5 * GiB)
+        for j in range(40)
+    ]
+    pods = list(pods)
+    vol_ids = rng.choice(n_pods, size=40, replace=False)
+    for j, pi in enumerate(vol_ids):
+        p = pods[pi]
+        p.spec.volumes = tuple(p.spec.volumes) + (f"claim-{j}",)
+
+    snap = enc.encode(nodes, pods, existing, pvcs=pvcs, pvs=pvs,
+                      storage_classes=classes)
+
+    out_s = scan_cycle(snap)
+    a_s = np.asarray(out_s.assignment)[: len(pods)]
+    want = [
+        d.node_index
+        for d in oracle.schedule(nodes, pods, existing, pvcs=pvcs,
+                                 pvs=pvs, storage_classes=classes)
+    ]
+    got = [int(x) for x in a_s]
+    if got != want:
+        diff = [i for i, (g, w) in enumerate(zip(got, want)) if g != w]
+        return (
+            f"mid seed {seed}: scan mismatch at {diff[:6]} "
+            f"got {[got[i] for i in diff[:6]]} "
+            f"want {[want[i] for i in diff[:6]]}"
+        )
+
+    out_r = rounds_cycle(snap)
+    a_r = np.asarray(out_r.assignment)[: len(pods)]
+    errs = oracle.validate_rounds_assignment(
+        nodes, pods, a_r, existing, pvcs=pvcs, pvs=pvs,
+        storage_classes=classes,
+    )
+    if errs:
+        return f"mid seed {seed}: rounds violations: {errs[:3]}"
+    placed_r = int((a_r >= 0).sum())
+    placed_o = sum(1 for w in want if w is not None and w >= 0)
+    if placed_o > 0 and placed_r < int(0.9 * placed_o):
+        return (
+            f"mid seed {seed}: rounds quality {placed_r}/{placed_o} "
+            f"below 90% of sequential"
+        )
+    regret, n_scored = rounds_regret(nodes, pods, existing, a_r)
+    one_case.regrets.append(regret)
+    if n_scored >= 5 and regret > REGRET_BOUND:
+        return (
+            f"mid seed {seed}: rounds avg score regret {regret:.1f} "
+            f"over {n_scored} pods exceeds {REGRET_BOUND}"
+        )
+
+    if (a_s < 0).any():
+        pre = pre_fn(snap, out_s)
+        nom = np.asarray(pre.nominated)[: len(pods)]
+        vic = np.asarray(pre.victims)[: len(existing)]
+        _dec, opre = oracle.schedule_with_preemption(
+            nodes, pods, existing, pvcs=pvcs, pvs=pvs,
+            storage_classes=classes,
+        )
+        # pre_fn here is built with budget/scan_budget >= the case size
+        # (see main), so the kernel nominates every pod the oracle does
+        # and the comparison is exact, untruncated
+        opre_k = opre
+        want_nom = np.full(len(pods), -1, np.int64)
+        want_vic = np.zeros(max(len(existing), 1), bool)[: len(existing)]
+        for o in opre_k:
+            want_nom[o.pod_index] = o.node_index
+            for e in o.victims:
+                want_vic[e] = True
+        n_prem = int((want_nom >= 0).sum())
+        if nom.tolist() != want_nom.tolist() or (
+            vic.tolist() != want_vic.tolist()
+        ):
+            d = [i for i in range(len(pods)) if nom[i] != want_nom[i]]
+            return (
+                f"mid seed {seed}: preemption mismatch at pods {d[:6]} "
+                f"({n_prem} oracle preemptors)"
+            )
+        print(f"  mid seed {seed}: ok ({n_prem} preemptors, "
+              f"{placed_r}/{n_pods} placed)", flush=True)
+    return None
+
+
 def main():
     minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
     scan_cycle = build_cycle_fn(commit_mode="scan")
     rounds_cycle = build_cycle_fn(commit_mode="rounds")
     pre_fn = build_preemption_fn()
+    # mid-size cases exceed the production per-cycle nomination budget
+    # (scan_budget=64); an unbudgeted build keeps the oracle comparison
+    # exact
+    from k8s_scheduler_tpu.config import load_config
+    from k8s_scheduler_tpu.framework.runtime import Framework
+
+    fw_mid = Framework.from_config(load_config({
+        "profiles": [{"pluginConfig": [{
+            "name": "DefaultPreemption",
+            "args": {"budget": 512, "scan_budget": 512},
+        }]}],
+    }))
+    pre_mid = build_preemption_fn(fw_mid)
     # ONE encoder + fixed padding: interning dims stabilize after the first
     # few cases, so each engine compiles a handful of times, not per case
     enc = SnapshotEncoder(pad_pods=128, pad_nodes=64)
+    enc_mid = SnapshotEncoder(pad_pods=512, pad_nodes=128)
     deadline = time.time() + minutes * 60
     seed = 10_000
     failures = 0
+    mids = 0
     while time.time() < deadline:
         msg = one_case(seed, scan_cycle, rounds_cycle, pre_fn, enc)
         if msg:
@@ -173,6 +329,17 @@ def main():
             print("FAIL:", msg, flush=True)
             if failures >= 5:
                 break
+        if (seed - 10_000) % 15 == 5:
+            # a mid-size case (500x100, preemption + PV pressure) every
+            # ~15 toy cases — the scale band the toy range cannot reach
+            msg = mid_case(seed, scan_cycle, rounds_cycle, pre_mid,
+                           enc_mid)
+            mids += 1
+            if msg:
+                failures += 1
+                print("FAIL:", msg, flush=True)
+                if failures >= 5:
+                    break
         seed += 1
         if (seed - 10_000) % 25 == 0:
             r = one_case.regrets
@@ -184,7 +351,8 @@ def main():
             )
     r = one_case.regrets or [0.0]
     print(
-        f"done: {seed - 10_000} cases, {failures} failures, "
+        f"done: {seed - 10_000} cases ({mids} mid-size), "
+        f"{failures} failures, "
         f"avg regret {np.mean(r):.2f} p95 {np.percentile(r, 95):.2f} "
         f"max {np.max(r):.2f} (bound {REGRET_BOUND})"
     )

@@ -1,0 +1,72 @@
+"""Compare rounds-engine shortlist settings on the carry config-#4
+cycle (real TPU). Usage: python scripts/sweep_shortlist4.py [k1 k2 ...]
+Each case prints amortized device ms, rounds used, and acceptance
+history — convergence changes show up as extra rounds."""
+import sys
+import time
+
+sys.path.insert(0, ".")
+import jax
+
+from k8s_scheduler_tpu.utils.compilation_cache import (
+    enable_compilation_cache,
+)
+
+enable_compilation_cache()
+import numpy as np
+
+from bench_suite import make_config_base, make_config_workload, _pad
+from k8s_scheduler_tpu.core import (
+    build_packed_cycle_carry_fn,
+    build_stable_state_fn,
+)
+from k8s_scheduler_tpu.core.cycle import CarryKeeper
+from k8s_scheduler_tpu.models import SnapshotEncoder
+
+enc = SnapshotEncoder(pad_pods=_pad(10000), pad_nodes=_pad(5000))
+bn, be = make_config_base(4)
+_n, pods, _e, groups = make_config_workload(4, seed=1000)
+w, b, spec, snap, dirty = enc.encode_packed(bn, pods, be, groups)
+w = jax.device_put(np.asarray(w))
+b = jax.device_put(np.asarray(b))
+stable = build_stable_state_fn(spec)(w, b)
+keeper = CarryKeeper(spec)
+carry = keeper.ci(w, b, stable)
+
+cases = [
+    dict(shortlist=0),                      # wide engine (the DEFAULT:
+    # measured faster at config-#4 geometry, see PERF.md round 4)
+    dict(shortlist=32),
+    dict(shortlist=16),
+    dict(shortlist=64),
+    dict(shortlist=32, passes=8, passes_round0=14),
+    dict(shortlist=32, compact=4),
+]
+if len(sys.argv) > 1:
+    cases = [dict(shortlist=int(a)) for a in sys.argv[1:]]
+
+REPS = 24
+for kw in cases:
+    t0 = time.perf_counter()
+    cyc = build_packed_cycle_carry_fn(spec, rounds_kw=kw)
+    out = cyc(w, b, stable, carry)
+    np.asarray(out.assignment)
+    comp = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = cyc(w, b, stable, carry)
+    np.asarray(out.assignment)
+    single = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = cyc(w, b, stable, carry)
+    np.asarray(out.assignment)
+    total = time.perf_counter() - t0
+    dt = (total - single) / (REPS - 1) * 1e3
+    used = int(np.asarray(out.rounds_used))
+    acc = np.asarray(out.accepted_per_round)[:used].tolist()
+    print(
+        f"{kw} -> {dt:.1f} ms/rep rounds={used} "
+        f"unsched={int(np.asarray(out.unschedulable).sum())} acc={acc} "
+        f"(compile {comp:.0f}s)",
+        flush=True,
+    )

@@ -1,0 +1,122 @@
+"""Pluggable QueueSort (SURVEY.md §2 C11, VERDICT r3 item 8): the
+profile-selected ordering plugin owns the encoder's pod_order rank, and
+a swapped ordering changes placement under contention in BOTH commit
+engines."""
+
+import numpy as np
+import pytest
+
+from k8s_scheduler_tpu.core import build_cycle_fn
+from k8s_scheduler_tpu.core.scheduler import Scheduler
+from k8s_scheduler_tpu.config import load_config
+from k8s_scheduler_tpu.framework.queuesort import (
+    CreationSort,
+    PrioritySort,
+    QueueSortPlugin,
+    make_queue_sort,
+    queue_sort_for_profile,
+    register_queue_sort,
+)
+from k8s_scheduler_tpu.models import MakeNode, MakePod, SnapshotEncoder
+
+
+def one_slot_fixture():
+    """One node that fits exactly one pod; two equal-priority claimants
+    where `old` was created first."""
+    nodes = [MakeNode("n0").capacity({"cpu": "1"}).obj()]
+    pods = [
+        MakePod("old").req({"cpu": "1"}).created(0.0).obj(),
+        MakePod("new").req({"cpu": "1"}).created(100.0).obj(),
+    ]
+    return nodes, pods
+
+
+def place(nodes, pods, queue_sort=None, mode="scan"):
+    enc = SnapshotEncoder(pad_pods=8, pad_nodes=4, queue_sort=queue_sort)
+    snap = enc.encode(nodes, pods)
+    out = build_cycle_fn(commit_mode=mode)(snap)
+    return np.asarray(out.assignment)[: len(pods)]
+
+
+def test_priority_sort_rank_orders_by_priority_then_creation():
+    prio = np.array([0, 10, 0], np.int32)
+    creation = np.array([5.0, 9.0, 1.0])
+    r = PrioritySort().rank([None] * 3, prio, creation)
+    # pod 1 (highest priority) first, then pod 2 (earlier), then pod 0
+    assert list(r) == [2, 0, 1]
+
+
+def test_creation_sort_ignores_priority():
+    prio = np.array([0, 10, 0], np.int32)
+    creation = np.array([5.0, 9.0, 1.0])
+    r = CreationSort().rank([None] * 3, prio, creation)
+    assert list(r) == [1, 2, 0]
+    r2 = CreationSort({"newest_first": True}).rank([None] * 3, prio,
+                                                   creation)
+    assert list(r2) == [1, 0, 2]
+
+
+@pytest.mark.parametrize("mode", ["scan", "rounds"])
+def test_custom_queuesort_flips_contention_winner(mode):
+    nodes, pods = one_slot_fixture()
+    a_default = place(nodes, pods, mode=mode)
+    assert a_default[0] >= 0 and a_default[1] < 0  # older pod wins
+
+    lifo = make_queue_sort("CreationSort", {"newest_first": True})
+    a_lifo = place(nodes, pods, queue_sort=lifo, mode=mode)
+    assert a_lifo[1] >= 0 and a_lifo[0] < 0  # newest-first flips it
+
+
+def test_profile_config_selects_queuesort():
+    cfg = load_config(
+        """
+profiles:
+- schedulerName: default-scheduler
+  plugins:
+    queueSort:
+      enabled:
+      - name: CreationSort
+  pluginConfig:
+  - name: CreationSort
+    args:
+      newest_first: true
+- schedulerName: fifo-scheduler
+"""
+    )
+    qs = queue_sort_for_profile(cfg.profile("default-scheduler"))
+    assert qs.name == "CreationSort" and qs.args == {"newest_first": True}
+    assert (
+        queue_sort_for_profile(cfg.profile("fifo-scheduler")).name
+        == "PrioritySort"
+    )
+    # the scheduler hands each profile's plugin to that profile's encoder
+    sched = Scheduler(config=cfg)
+    assert (
+        sched._encoders["default-scheduler"].queue_sort.name
+        == "CreationSort"
+    )
+    assert (
+        sched._encoders["fifo-scheduler"].queue_sort.name == "PrioritySort"
+    )
+
+
+def test_register_custom_queuesort():
+    @register_queue_sort
+    class NameSort(QueueSortPlugin):
+        name = "NameSort"
+
+        def rank(self, pods, priorities, creation):
+            order = np.argsort([p.name for p in pods], kind="stable")
+            out = np.empty(len(pods), np.int32)
+            out[order] = np.arange(len(pods), dtype=np.int32)
+            return out
+
+    nodes, pods = one_slot_fixture()
+    # alphabetical: "new" < "old", so the newer pod wins the slot
+    a = place(nodes, pods, queue_sort=make_queue_sort("NameSort"))
+    assert a[1] >= 0 and a[0] < 0
+
+
+def test_unknown_queuesort_fails_loudly():
+    with pytest.raises(KeyError, match="unknown queueSort"):
+        make_queue_sort("TypoSort")

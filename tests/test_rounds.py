@@ -223,3 +223,41 @@ def test_rounds_priority_dominance():
     ]
     _, out, a = run_rounds(nodes, pods)
     assert a[1] == 0 and a[0] == -1
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("shortlist", [2, 8, 32])
+def test_shortlist_rounds_validity_on_mixed_workload(seed, shortlist):
+    """The shortlist pass chain (incl. shortlist=2, which forces the
+    rescue pass: up to `passes` in-round deaths exceed k) must keep the
+    engine's defining invariants: final-state validity and
+    unplaced => infeasible."""
+    nodes = make_cluster(40, taint_fraction=0.2)
+    pods = make_pods(
+        250,
+        seed=seed,
+        affinity_fraction=0.3,
+        anti_affinity_fraction=0.2,
+        spread_fraction=0.2,
+        selector_fraction=0.3,
+        toleration_fraction=0.2,
+        priorities=(0, 10, 100),
+        num_apps=25,
+    )
+    _, out, a = run_rounds(nodes, pods,
+                           rounds_kw={"shortlist": shortlist})
+    errors = oracle.validate_rounds_assignment(nodes, pods, a)
+    assert errors == [], errors[:10]
+
+
+def test_shortlist_placement_quality_close_to_wide():
+    """Shortlist placements must not collapse vs the wide engine: same
+    cluster, placed-count within 3%."""
+    nodes = make_cluster(30, cpu_choices=(2, 4))
+    pods = make_pods(200, seed=5, selector_fraction=0.2,
+                     priorities=(0, 10))
+    _, _, a_wide = run_rounds(nodes, pods)
+    _, _, a_sl = run_rounds(nodes, pods, rounds_kw={"shortlist": 8})
+    placed_wide = int((a_wide >= 0).sum())
+    placed_sl = int((a_sl >= 0).sum())
+    assert placed_sl >= placed_wide * 0.97, (placed_wide, placed_sl)

@@ -226,3 +226,131 @@ def test_end_to_end_cycle_respects_volume_zone():
         out = build_cycle_fn(commit_mode=mode)(snap)
         a = int(np.asarray(out.assignment)[0])
         assert a in (4, 5), f"{mode}: pod landed outside z2 (node {a})"
+
+
+# ---- multi-volume joint claim (PARITY #8 closure, VERDICT r3 item 9) ----
+
+
+def _joint_fixture(n_pvs, sizes=(5, 5), pv_caps=None, provisioner=False):
+    """One pod with len(sizes) PVCs of class 'local'; n_pvs PVs."""
+    nodes = [MakeNode("n0").capacity({"cpu": "8"}).obj()]
+    classes = [
+        StorageClass("local", VOLUME_BINDING_WAIT, provisioner=provisioner)
+    ]
+    caps = pv_caps or [10] * n_pvs
+    pvs = [
+        PersistentVolume(f"pv-{v}", capacity=caps[v] * GiB,
+                         storage_class="local")
+        for v in range(n_pvs)
+    ]
+    pvcs = [
+        PersistentVolumeClaim(f"c{j}", storage_class="local",
+                              request=sizes[j] * GiB)
+        for j in range(len(sizes))
+    ]
+    mk = MakePod("w").req({"cpu": "1"})
+    for j in range(len(sizes)):
+        mk = mk.volume(f"c{j}")
+    return nodes, [mk.obj()], pvcs, pvs, classes
+
+
+def test_two_pvcs_one_pv_is_infeasible():
+    """A pod whose two PVCs are satisfiable only by the SAME single PV
+    must be masked out (it used to be over-admitted and fail at bind)."""
+    nodes, pods, pvcs, pvs, classes = _joint_fixture(n_pvs=1)
+    assert_differential(nodes, pods, pvcs, pvs, classes)
+    got, _ = kernel_mask(nodes, pods, pvcs, pvs, classes)
+    assert not got[0, 0]
+
+
+def test_two_pvcs_two_pvs_is_feasible_and_claims_both():
+    nodes, pods, pvcs, pvs, classes = _joint_fixture(n_pvs=2)
+    assert_differential(nodes, pods, pvcs, pvs, classes)
+    from k8s_scheduler_tpu.core import build_cycle_fn
+
+    enc = SnapshotEncoder(pad_pods=8, pad_nodes=4)
+    snap = enc.encode(nodes, pods, pvcs=pvcs, pvs=pvs,
+                      storage_classes=classes)
+    out = build_cycle_fn(commit_mode="scan")(snap)
+    assert np.asarray(out.assignment)[0] == 0
+    assert np.asarray(out.pv_claimed).sum() == 2  # distinct PVs claimed
+
+
+def test_two_pvcs_one_pv_plus_provisioner_is_feasible():
+    """A dynamic-capable class means one slot can ride provisioning, so
+    a single static PV suffices for the other slot."""
+    nodes, pods, pvcs, pvs, classes = _joint_fixture(
+        n_pvs=1, provisioner=True
+    )
+    assert_differential(nodes, pods, pvcs, pvs, classes)
+    got, _ = kernel_mask(nodes, pods, pvcs, pvs, classes)
+    assert got[0, 0]
+
+
+@pytest.mark.parametrize("mode", ["scan", "rounds"])
+def test_constrained_slot_claims_first_no_deadend(mode):
+    """Greedy dead-end case: slot c0 (1 GiB) fits pv-0 (10 GiB) and
+    pv-1 (2 GiB); slot c1 (8 GiB) fits ONLY pv-0. Claiming c0 first
+    with lowest-index choice would take pv-0 and strand c1 — the
+    constrained-first ordering must assign c1=pv-0, c0=pv-1."""
+    nodes, pods, pvcs, pvs, classes = _joint_fixture(
+        n_pvs=2, sizes=(1, 8), pv_caps=[10, 2]
+    )
+    assert_differential(nodes, pods, pvcs, pvs, classes)
+    from k8s_scheduler_tpu.core import build_cycle_fn
+
+    enc = SnapshotEncoder(pad_pods=8, pad_nodes=4)
+    snap = enc.encode(nodes, pods, pvcs=pvcs, pvs=pvs,
+                      storage_classes=classes)
+    out = build_cycle_fn(commit_mode=mode)(snap)
+    assert np.asarray(out.assignment)[0] == 0
+    assert np.asarray(out.pv_claimed).sum() == 2
+
+    # oracle agrees and assigns distinct PVs
+    state = oracle.OracleState.build(nodes, (), pvcs, pvs, classes)
+    assert oracle.filter_volume_binding(pods[0], state, 0)
+    state.add(0, pods[0])
+    assert state.claimed_static == {"pv-0", "pv-1"}
+
+
+def test_two_pods_two_pvcs_each_contending():
+    """Differential under contention: two 2-PVC pods over 3 PVs — only
+    one pod can satisfy both claims; the loser must not place."""
+    nodes = [MakeNode("n0").capacity({"cpu": "8"}).obj()]
+    classes = [
+        StorageClass("local", VOLUME_BINDING_WAIT, provisioner=False)
+    ]
+    pvs = [
+        PersistentVolume(f"pv-{v}", capacity=10 * GiB,
+                         storage_class="local")
+        for v in range(3)
+    ]
+    pvcs = [
+        PersistentVolumeClaim(f"c{j}", storage_class="local",
+                              request=5 * GiB)
+        for j in range(4)
+    ]
+    pods = [
+        MakePod("a").req({"cpu": "1"}).volume("c0").volume("c1")
+        .created(0.0).obj(),
+        MakePod("b").req({"cpu": "1"}).volume("c2").volume("c3")
+        .created(1.0).obj(),
+    ]
+    from k8s_scheduler_tpu.core import build_cycle_fn
+
+    enc = SnapshotEncoder(pad_pods=8, pad_nodes=4)
+    snap = enc.encode(nodes, pods, pvcs=pvcs, pvs=pvs,
+                      storage_classes=classes)
+    for mode in ("scan", "rounds"):
+        out = build_cycle_fn(commit_mode=mode)(snap)
+        a = np.asarray(out.assignment)[:2]
+        assert a[0] == 0 and a[1] < 0, (mode, a)
+
+    # scan == oracle end to end
+    want = [
+        d.node_index
+        for d in oracle.schedule(nodes, pods, pvcs=pvcs, pvs=pvs,
+                                 storage_classes=classes)
+    ]
+    out = build_cycle_fn(commit_mode="scan")(snap)
+    assert list(np.asarray(out.assignment)[:2]) == want
