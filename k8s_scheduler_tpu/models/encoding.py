@@ -455,6 +455,8 @@ class SnapshotEncoder:
         # observability: how many encode_packed calls hit the delta path
         self.delta_hits = 0
         self.full_encodes = 0
+        # per-segment ms of the LAST delta encode (see _encode_delta)
+        self.delta_profile: dict[str, float] = {}
 
     def _stick(self, key: str, val: int) -> int:
         cur = self._sticky_dims.get(key, 0)
@@ -1611,6 +1613,12 @@ class SnapshotEncoder:
             "pend_ids": [id(p) for p in pending],
             "pend_refs": list(pending),
             "pend_rows": list(pend_rows),
+            # slots whose row carries host ports, maintained
+            # incrementally: the delta path's port re-interning only
+            # walks THESE instead of scanning all P slots per encode
+            "port_set": {
+                i for i, d in enumerate(pend_rows) if len(d["ports"])
+            },
             "creation": creation_full,
             "p_real": p_real,
             "dims": {"R": R, "MPL": MPL, "MA": MA, "MPorts": MPorts,
@@ -1788,8 +1796,23 @@ class SnapshotEncoder:
     def _encode_delta(self, ds, pending, pod_groups, mutated_ids):
         """The fast path: rewrite only changed pod slots in the arena.
         Returns None to request a full encode (any partial bookkeeping it
-        did is simply superseded — the full path rebuilds everything)."""
+        did is simply superseded — the full path rebuilds everything).
+
+        `self.delta_profile` records per-segment milliseconds of the last
+        delta encode (detect/rows/ports/apply/order) — the encode-budget
+        attribution tool (scripts/profile_encode4.py)."""
+        import time as _time
+
         from .. import native
+
+        _t0 = _time.perf_counter()
+        _prof = self.delta_profile = {}
+
+        def _mark(name):
+            nonlocal _t0
+            t = _time.perf_counter()
+            _prof[name] = _prof.get(name, 0.0) + (t - _t0) * 1e3
+            _t0 = t
 
         dims = ds["dims"]
         P = ds["pads"][2]
@@ -1808,10 +1831,12 @@ class SnapshotEncoder:
             i for i in range(p_real)
             if ids[i] != id(pending[i]) or ids[i] in mutated_ids
         ]
+        _mark("detect")
         rowdata = ds["pod_rowdata"]
         lens0 = self._table_lens()
         flag_aff, flag_tsc, flag_vol, flag_mvol = ds["flags"]
         new_rows = []
+        port_set = ds["port_set"]
         for i in dirty:
             p = pending[i]
             d = rowdata(p)
@@ -1819,6 +1844,11 @@ class SnapshotEncoder:
             ids[i] = id(p)
             rows[i] = d
             refs[i] = p
+            if len(d["ports"]):
+                port_set.add(i)
+            else:
+                port_set.discard(i)
+        _mark("rows")
         if self._table_lens() != lens0:
             return None  # interning grew: stable tables need new entries
         for d in new_rows:
@@ -1842,11 +1872,10 @@ class SnapshotEncoder:
                 # capability: full path recompiles with the flag on
                 return None
         # distinct-port axis: re-intern over every slot that has ports
-        # (matches the full path's slot-order interning exactly)
-        port_slots = [
-            i for i in range(p_real) if rows[i] is not None
-            and len(rows[i]["ports"])
-        ]
+        # (matches the full path's slot-order interning exactly); the
+        # slot set is maintained incrementally, sorted here so interning
+        # order equals the full path's slot order
+        port_slots = sorted(i for i in port_set if i < p_real)
         port_tab: dict[int, int] = {}
         port_id_rows = []
         for i in port_slots:
@@ -1861,24 +1890,27 @@ class SnapshotEncoder:
             port_id_rows.append(np.array(pr, np.int32))
         if _pad_dim(len(port_tab), 4) > dims["Q"]:
             return None
+        _mark("ports")
 
         # ---- all checks passed: write the arena ----
         A = self._arena
         creation = ds["creation"]
         if dirty:
             idx = np.asarray(dirty, np.int64)
-            for name, key, pad in self._PEND_2D:
-                v = A[name]
-                v[idx] = pad
-                native.scatter_rows_at(v, idx, [d[key] for d in new_rows])
-            for name, key, pad in self._PEND_3D:
-                v = A[name]
-                v[idx] = pad
-                native.scatter_rows_at(
-                    v.reshape(P, -1), idx, [d[key] for d in new_rows]
+            specs = ds.get("apply_specs")
+            if specs is None:
+                # one (view, key, pad, mode) spec list built per arena:
+                # the whole write pass is a single native call instead of
+                # a per-field pad fancy-fill + list comp + scatter
+                specs = (
+                    [(A[n], k, p, 0) for n, k, p in self._PEND_2D]
+                    + [(A[n].reshape(P, -1), k, p, 0)
+                       for n, k, p in self._PEND_3D]
+                    + [(A[n], k, self._PEND_SCALAR_PAD[n], 1)
+                       for n, k in self._PEND_SCALAR]
                 )
-            for name, key in self._PEND_SCALAR:
-                A[name][idx] = [d[key] for d in new_rows]
+                ds["apply_specs"] = specs
+            native.apply_rows(specs, idx, new_rows)
             nidx = ds["node_index"]
             A["pod_node_name"][idx] = [
                 nidx.get(pending[i].spec.node_name, -2)
@@ -1892,6 +1924,7 @@ class SnapshotEncoder:
             ]
             creation[idx] = [d["creation"] for d in new_rows]
 
+        _mark("apply")
         if p_real != ds["p_real"]:
             pv = A["pod_valid"]
             pv[:] = False
@@ -1899,6 +1932,8 @@ class SnapshotEncoder:
             if p_real < ds["p_real"]:
                 self._clear_slots(slice(p_real, ds["p_real"]))
                 creation[p_real:ds["p_real"]] = 0.0
+                for i in range(p_real, ds["p_real"]):
+                    port_set.discard(i)
             del ids[p_real:]
             del rows[p_real:]
             del refs[p_real:]
@@ -1930,6 +1965,7 @@ class SnapshotEncoder:
                     if mm:
                         gm[gi] = mm
 
+        _mark("order")
         self._cycle_index += 1
         A["cycle_index"][...] = self._cycle_index
         return EncodedFrame(

@@ -18,12 +18,13 @@ HAVE_FASTASSEMBLE = False
 scatter_rows = None
 scatter_rows_at = None
 fill_scalars = None
+apply_rows = None
 pod_row = None  # native pod_rowdata; None => Python path only
 
 
 def _try_import() -> bool:
     global HAVE_FASTASSEMBLE, scatter_rows, scatter_rows_at, fill_scalars
-    global pod_row
+    global pod_row, apply_rows
     try:
         from . import _fastassemble  # type: ignore[attr-defined]
     except ImportError:
@@ -33,6 +34,9 @@ def _try_import() -> bool:
     scatter_rows_at = _fastassemble.scatter_rows_at
     fill_scalars = _fastassemble.fill_scalars
     pod_row = getattr(_fastassemble, "pod_row", None)
+    # a stale prebuilt .so may predate newer symbols: fall back to the
+    # numpy mirror per symbol, never to None (callers invoke unguarded)
+    apply_rows = getattr(_fastassemble, "apply_rows", None) or _py_apply_rows
     return True
 
 
@@ -73,9 +77,20 @@ def _py_fill_scalars(dst, values):
     dst[:n] = values[:n]
 
 
+def _py_apply_rows(specs, index, rows):
+    """numpy mirror of the native batched delta arena write."""
+    for dst, key, pad, mode in specs:
+        if mode == 1:
+            dst[index] = [d[key] for d in rows]
+        else:
+            dst[index] = pad
+            _py_scatter_rows_at(dst, index, [d[key] for d in rows])
+
+
 if not _try_import():
     _try_build()
     if not _try_import():
         scatter_rows = _py_scatter_rows
         scatter_rows_at = _py_scatter_rows_at
         fill_scalars = _py_fill_scalars
+        apply_rows = _py_apply_rows

@@ -1,0 +1,41 @@
+"""Host-encode budget attribution at config #4 (10k pods, 20% churn):
+prints per-iteration delta-encode segment times from
+SnapshotEncoder.delta_profile (detect / rows / ports / apply / order).
+
+Run:  python scripts/profile_encode4.py [iters]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+
+    from bench_suite import _draw_pending, _pad, make_config_base
+    from k8s_scheduler_tpu.models import SnapshotEncoder
+
+    enc = SnapshotEncoder(pad_pods=_pad(10000), pad_nodes=_pad(5000))
+    bn, be = make_config_base(4)
+    pending = None
+    for i in range(iters):
+        pending, groups = _draw_pending(4, i, pending, 0.2)
+        t0 = time.perf_counter()
+        enc.encode_packed(bn, pending, be, groups)
+        dt = (time.perf_counter() - t0) * 1e3
+        segs = " ".join(
+            f"{k}={v:.1f}" for k, v in enc.delta_profile.items()
+        )
+        kind = "delta" if enc.delta_profile else "full"
+        print(f"iter {i}: {dt:.1f} ms ({kind})  {segs}", flush=True)
+        enc.delta_profile = {}
+
+
+if __name__ == "__main__":
+    main()
