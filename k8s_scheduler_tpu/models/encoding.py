@@ -1751,6 +1751,9 @@ class SnapshotEncoder:
                 self.delta_hits += 1
                 return out
         self.full_encodes += 1
+        # a bailed delta leaves partial segment marks behind; an empty
+        # profile is the "this encode took the full path" signal
+        self.delta_profile = {}
         snap = self.encode(
             nodes, pending, existing, pod_groups, pvcs, pvs,
             storage_classes, pdbs,
