@@ -19,6 +19,7 @@ handleBindingCycleError).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time as _time
 from typing import Callable, Sequence
 
@@ -28,6 +29,7 @@ from ..config import SchedulerConfiguration
 from ..framework.runtime import Framework
 from ..internal.cache import SchedulerCache
 from ..metrics import SchedulerMetrics
+from ..metrics.metrics import global_metrics
 from ..internal.queue import (
     EVENT_NODE_ADD,
     EVENT_NODE_DELETE,
@@ -101,7 +103,13 @@ class Scheduler:
         # back-compat alias: the first profile (tests/tools poke at it)
         self.framework = self.frameworks[names[0]]
         self.cache = SchedulerCache(now=now)
-        self.metrics = metrics or SchedulerMetrics()
+        # default to the process-wide instance (not a fresh registry):
+        # process-level counters that cannot reach a Scheduler handle —
+        # notably scheduler_program_retry_strikes_total from the
+        # _Resilient program wrapper — land in global_metrics(), and the
+        # CLI serves THIS object's registry on /metrics; tests that need
+        # isolation pass their own SchedulerMetrics
+        self.metrics = metrics or global_metrics()
         self.queue = SchedulingQueue(
             initial_backoff_seconds=self.config.pod_initial_backoff_seconds,
             max_backoff_seconds=self.config.pod_max_backoff_seconds,
@@ -175,8 +183,6 @@ class Scheduler:
             # deployments that reach for extenders are often the ones
             # that also care about cycle latency (VERDICT r3 weak #6) —
             # measured ~+60 ms device + full re-encode at 10k x 5k.
-            import logging
-
             logging.getLogger(__name__).warning(
                 "scheduler: %d HTTP extender(s) configured - the "
                 "device-carry latency path is DISABLED; cycles take the "

@@ -26,9 +26,13 @@ the whole pending set per cycle):
 - scheduler_pod_node_decisions_total — P*N decisions evaluated (the
   north-star throughput numerator)
 
-Each `SchedulerMetrics` owns its own `CollectorRegistry` so tests and
-multi-scheduler processes never collide; `global_metrics()` returns a
-process-wide default instance.
+Each `SchedulerMetrics` owns its own `CollectorRegistry`;
+`global_metrics()` returns the process-wide default instance, which is
+also what a Scheduler constructed without an explicit `metrics=` serves
+on /metrics (process-level counters like
+scheduler_program_retry_strikes_total land there). Tests or
+multi-scheduler processes that need isolated registries pass their own
+`SchedulerMetrics`.
 """
 
 from __future__ import annotations
