@@ -114,17 +114,42 @@ def main() -> None:
         )
     else:
         dps = 0.0  # every config failed: still emit a parseable line
-    print(
-        json.dumps(
-            {
-                "metric": "pod_node_scoring_decisions_per_sec",
-                "value": dps,
-                "unit": "decisions/s",
-                "vs_baseline": round(dps / TARGET_DECISIONS_PER_SEC, 4),
-                "detail": detail,
-            }
-        )
-    )
+
+    # Full detail is NOT printed to stdout: the driver records only a
+    # ~2000-char stdout tail, and rounds 2-4's ~2.4 kB single line came
+    # back truncated and unparseable (`parsed: null` in BENCH_r0{2,4}).
+    # Detail goes to a file + stderr; stdout's LAST line is a compact
+    # headline summary that fits the tail whole.
+    with open("BENCH_DETAIL.json", "w") as f:
+        json.dump(detail, f, indent=1)
+    print(json.dumps(detail), file=sys.stderr, flush=True)
+
+    def _c(r):  # compact per-config row, short keys, rounded
+        return {
+            "c": r["config"],
+            "dps": round(r["decisions_per_sec"]),
+            "p50": round(r["p50_ms"], 1),
+            "p99": round(r["p99_ms"], 1),
+            "dev": round(r["device_ms"], 1),
+            "enc": round(r["encode_p50_ms"], 1),
+            "sched": r["scheduled"],
+            "unsched": r["unschedulable"],
+        }
+
+    line = {
+        "metric": "pod_node_scoring_decisions_per_sec",
+        "value": dps,
+        "unit": "decisions/s",
+        "vs_baseline": round(dps / TARGET_DECISIONS_PER_SEC, 4),
+        "device": detail["device"],
+        "configs": [_c(r) for r in results],
+        "failed_configs": [e["config"] for e in errors],
+    }
+    out = json.dumps(line)
+    if len(out) > 1900:  # belt-and-braces: never exceed the tail window
+        line.pop("configs")
+        out = json.dumps(line)
+    print(out, flush=True)
 
 
 if __name__ == "__main__":

@@ -85,8 +85,17 @@ def main(argv: list[str] | None = None) -> int:
         lease.start_renewing()
         print("became leader", flush=True)
 
+    # serve the PROCESS-WIDE registry: process-level counters that never
+    # reach a Scheduler handle (program retry strikes from _Resilient)
+    # must appear on /metrics. Library/test constructions get a fresh
+    # registry by default — only the CLI opts into the global one.
+    from ..metrics.metrics import global_metrics
+
     server, service, port = serve(
-        args.address, config=config, profile_every=args.profile_every
+        args.address,
+        config=config,
+        profile_every=args.profile_every,
+        metrics=global_metrics(),
     )
     print(f"scheduler shim listening on port {port}", flush=True)
 

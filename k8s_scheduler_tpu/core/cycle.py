@@ -190,24 +190,30 @@ class _Resilient:
         self._fn = fn
 
     def __call__(self, *a, **k):
+        # classify by MESSAGE, not exception type: a transport flake can
+        # surface as a wrapped ValueError and a corruption marker can ride
+        # a non-ValueError (advisor r4) — one except block, two recoveries
         for attempt in range(3):
             try:
                 return self._fn(*a, **k)
-            except ValueError as e:
-                msg = str(e)
-                if attempt == 2 or not any(
-                    m in msg for m in _CORRUPT_MARKERS
-                ):
-                    raise
-                _record_strike(self._fn.__name__, "executable_cache")
-                self._fn.clear_cache()
             except Exception as e:
-                if attempt == 2 or not is_transport_error(e):
+                msg = str(e)
+                if attempt == 2:
                     raise
-                _record_strike(self._fn.__name__, "transport")
-                import time
+                # transport FIRST: a proxied RPC error can embed remote
+                # text matching a corrupt marker; the flake recovery
+                # (backoff, cache preserved) is right for that case and
+                # clear_cache would pay a needless ~100s retrace
+                if is_transport_error(e):
+                    _record_strike(self._fn.__name__, "transport")
+                    import time
 
-                time.sleep(0.5 * (attempt + 1))
+                    time.sleep(0.5 * (attempt + 1))
+                elif any(m in msg for m in _CORRUPT_MARKERS):
+                    _record_strike(self._fn.__name__, "executable_cache")
+                    self._fn.clear_cache()
+                else:
+                    raise
 
     def lower(self, *a, **k):
         return self._fn.lower(*a, **k)

@@ -29,7 +29,6 @@ from ..config import SchedulerConfiguration
 from ..framework.runtime import Framework
 from ..internal.cache import SchedulerCache
 from ..metrics import SchedulerMetrics
-from ..metrics.metrics import global_metrics
 from ..internal.queue import (
     EVENT_NODE_ADD,
     EVENT_NODE_DELETE,
@@ -103,13 +102,13 @@ class Scheduler:
         # back-compat alias: the first profile (tests/tools poke at it)
         self.framework = self.frameworks[names[0]]
         self.cache = SchedulerCache(now=now)
-        # default to the process-wide instance (not a fresh registry):
-        # process-level counters that cannot reach a Scheduler handle —
-        # notably scheduler_program_retry_strikes_total from the
-        # _Resilient program wrapper — land in global_metrics(), and the
-        # CLI serves THIS object's registry on /metrics; tests that need
-        # isolation pass their own SchedulerMetrics
-        self.metrics = metrics or global_metrics()
+        # default to a FRESH registry: two Schedulers in one process must
+        # not cross-count (r4 regression — tests/test_reasons.py). The
+        # process-level counters that cannot reach a Scheduler handle
+        # (scheduler_program_retry_strikes_total from _Resilient) always
+        # land in global_metrics(); the CLI passes metrics=global_metrics()
+        # explicitly so the SERVED /metrics registry includes them.
+        self.metrics = metrics or SchedulerMetrics()
         self.queue = SchedulingQueue(
             initial_backoff_seconds=self.config.pod_initial_backoff_seconds,
             max_backoff_seconds=self.config.pod_max_backoff_seconds,

@@ -230,7 +230,12 @@ def rounds_commit(
     # added per-round top_k (~6.5 ms at [10k,5k]) and per-pass [B,k]
     # anchor-delta gathers (~1.7 ms). Default therefore 0 (wide). The
     # path is kept, tested, for geometries where N dwarfs the pass
-    # count's bandwidth economics (N >> 5k).
+    # count's bandwidth economics (N >> 5k). ROUNDING CAVEAT (advisor
+    # r4): the shortlist scores round(base)+tie+round(delta) while the
+    # wide path scores round(base+delta)+tie — the two roundings can
+    # differ by 1, so node CHOICES may diverge from the wide engine
+    # beyond the top-k approximation itself (heuristic-only; the
+    # unplaced=>infeasible invariant is unaffected).
     anchor_stride: int = 1,  # re-anchor every pass (the spread signal
     # is load-bearing: stride 2 cost ~19% of round-0 acceptance in the
     # same sweep)

@@ -28,6 +28,7 @@ import grpc
 
 from ..config import SchedulerConfiguration
 from ..core.scheduler import Scheduler
+from ..metrics import SchedulerMetrics
 from ..models.api import PodGroup
 from . import convert
 from . import scheduler_pb2 as pb
@@ -40,15 +41,20 @@ class SchedulerService:
 
     def __init__(self, config: SchedulerConfiguration | None = None,
                  scheduler: Scheduler | None = None,
-                 profile_every: int = 0) -> None:
+                 profile_every: int = 0,
+                 metrics: SchedulerMetrics | None = None) -> None:
         # the injectable binder collects into the in-progress response;
         # one cycle at a time (serialized by _cycle_lock)
         self._bindings: list[pb.Binding] = []
         self.scheduler = scheduler or Scheduler(
-            config=config, binder=self._collect_binding
+            config=config, binder=self._collect_binding, metrics=metrics
         )
         if scheduler is not None:
             scheduler.binder = self._collect_binding
+            if metrics is not None:
+                # rebind like the binder above: an injected scheduler must
+                # still report into the registry the caller will serve
+                scheduler.metrics = metrics
         self._cycle_lock = threading.Lock()
         self._uid_index: dict[str, object] = {}  # uid -> last seen Pod
         # incarnation id: a restarted shim at the same address must be
@@ -201,9 +207,12 @@ def serve(
     config: SchedulerConfiguration | None = None,
     max_workers: int = 4,
     profile_every: int = 0,
+    metrics: SchedulerMetrics | None = None,
 ) -> tuple[grpc.Server, SchedulerService, int]:
     """Start the shim; returns (server, servicer, bound_port)."""
-    service = SchedulerService(config=config, profile_every=profile_every)
+    service = SchedulerService(
+        config=config, profile_every=profile_every, metrics=metrics
+    )
     # no SO_REUSEPORT: a second shim on the same address must fail loudly,
     # not silently split the accept queue with the first
     server = grpc.server(

@@ -74,9 +74,12 @@ def main():
     comp = cyc.lower(w_r, b_r, stable_r, carry_sh).compile()
     hlo = comp.as_text()
 
+    # lazy (.*?) so TUPLE result types (async '-start' pairs, variadic
+    # collectives) match too — '(f32[a,b], f32[c,d]) all-gather-start('
+    # has a space inside the result type
     colls = re.findall(
-        r"^\s*\S+ = (\S+) (all-reduce|all-gather|reduce-scatter|"
-        r"all-to-all|collective-permute)\(", hlo, re.M)
+        r"^\s*\S+ = (.*?) ((?:all-reduce|all-gather|reduce-scatter|"
+        r"all-to-all|collective-permute)(?:-start)?)\(", hlo, re.M)
     from collections import Counter
 
     hist = Counter((op, shape) for shape, op in colls)

@@ -169,8 +169,9 @@ def test_resilient_reraises_non_retryable():
 
 def test_strike_metric_reaches_served_registry():
     """VERDICT r3 item 7 end-to-end: a _Resilient strike must appear in
-    the registry a default-constructed Scheduler serves on /metrics
-    (strikes land in global_metrics(); the Scheduler defaults to it)."""
+    the registry the CLI serves on /metrics. Strikes land in
+    global_metrics(); the CLI constructs its Scheduler with
+    metrics=global_metrics() (cmd/main.py), mirrored here."""
     from k8s_scheduler_tpu.core.scheduler import Scheduler
     from k8s_scheduler_tpu.metrics.metrics import global_metrics
 
@@ -189,8 +190,32 @@ def test_strike_metric_reaches_served_registry():
     fn.clear_cache = lambda: None
     assert _Resilient(fn)(5) == 5
 
-    sched = Scheduler()
+    sched = Scheduler(metrics=global_metrics())
     assert sched.metrics is global_metrics()
     payload = sched.metrics.expose().decode()
     assert "scheduler_program_retry_strikes_total" in payload
     assert 'program="fake_served"' in payload
+
+
+def test_two_schedulers_do_not_cross_count():
+    """r4 regression (VERDICT r4 weak #2): default-constructed Schedulers
+    must each get a FRESH registry — metric increments on one must not
+    appear in the other's served payload, and neither must write the
+    process-wide registry."""
+    from k8s_scheduler_tpu.core.scheduler import Scheduler
+    from k8s_scheduler_tpu.metrics.metrics import global_metrics
+
+    a, b = Scheduler(), Scheduler()
+    assert a.metrics is not b.metrics
+    assert a.metrics is not global_metrics()
+
+    a.metrics.schedule_attempts.labels(
+        result="isolation-probe", profile="isolation-probe"
+    ).inc()
+    val = lambda m: m.registry.get_sample_value(
+        "scheduler_schedule_attempts_total",
+        {"result": "isolation-probe", "profile": "isolation-probe"},
+    )
+    assert val(a.metrics) == 1.0
+    assert val(b.metrics) is None
+    assert val(global_metrics()) is None
