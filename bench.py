@@ -130,10 +130,10 @@ def main() -> None:
             "dps": round(r["decisions_per_sec"]),
             "p50": round(r["p50_ms"], 1),
             "p99": round(r["p99_ms"], 1),
-            "dev": round(r["device_ms"], 1),
-            "enc": round(r["encode_p50_ms"], 1),
-            "sched": r["scheduled"],
-            "unsched": r["unschedulable"],
+            "dev": round(r.get("device_ms", 0.0), 1),
+            "enc": round(r.get("encode_p50_ms", 0.0), 1),
+            "sched": r.get("scheduled", 0),
+            "unsched": r.get("unschedulable", 0),
         }
 
     line = {
@@ -143,7 +143,14 @@ def main() -> None:
         "vs_baseline": round(dps / TARGET_DECISIONS_PER_SEC, 4),
         "device": detail["device"],
         "configs": [_c(r) for r in results],
-        "failed_configs": [e["config"] for e in errors],
+        "errors": [
+            {
+                "config": e["config"],
+                "transport": e["transport"],
+                "attempt": e["attempt"],
+            }
+            for e in errors
+        ],
     }
     out = json.dumps(line)
     if len(out) > 1900:  # belt-and-braces: never exceed the tail window

@@ -1708,6 +1708,24 @@ class SnapshotEncoder:
         "pod_node_name": -1, "pod_nominated": -1,
     }
 
+    def _apply_specs(self, ds) -> list:
+        """The (view, key, pad, mode) spec list for the delta arena's
+        pending-side fields — built once per arena; shared by apply_rows
+        (dict path) and pod_rows_into (fused path)."""
+        specs = ds.get("apply_specs")
+        if specs is None:
+            A = self._arena
+            P = ds["pads"][2]
+            specs = (
+                [(A[n], k, p, 0) for n, k, p in self._PEND_2D]
+                + [(A[n].reshape(P, -1), k, p, 0)
+                   for n, k, p in self._PEND_3D]
+                + [(A[n], k, self._PEND_SCALAR_PAD[n], 1)
+                   for n, k in self._PEND_SCALAR]
+            )
+            ds["apply_specs"] = specs
+        return specs
+
     def _clear_slots(self, sl) -> None:
         """Reset pending-side arena rows to the full path's pad values —
         applied to slots that stop being backed by a pod (pending-set
@@ -1838,16 +1856,57 @@ class SnapshotEncoder:
         rowdata = ds["pod_rowdata"]
         lens0 = self._table_lens()
         flag_aff, flag_tsc, flag_vol, flag_mvol = ds["flags"]
-        new_rows = []
+        new_rows = []  # dict-interchange rows (fallback pods only)
+        fb_slots = []  # their arena slots
         port_set = ds["port_set"]
-        for i in dirty:
+        creation = ds["creation"]
+        fused = native.pod_rows_into
+        fused_res = None
+        if fused is not None and dirty:
+            # fused fast path (PERF.md round-5): ONE native call parses
+            # every dirty pod and writes its arena row + creation column
+            # directly — no 26-key rowdata dict, no apply_rows re-read.
+            # Pods the native parser does not cover (volumes /
+            # nodeAffinity / exotic operators) come back as None and take
+            # the dict path below; a guard_ok=False return means a pod
+            # overflowed the arena dims, so the whole delta bails to the
+            # full encode (partially written rows are rebuilt there).
+            specs2 = ds.get("into_specs")
+            if specs2 is None:
+                specs2 = self._apply_specs(ds) + [
+                    (creation, "creation", 0.0, 1)
+                ]
+                ds["into_specs"] = specs2
+            limits = ds.get("into_limits")
+            if limits is None:
+                limits = {
+                    "MPL": dims["MPL"], "MA": dims["MA"],
+                    "MPorts": dims["MPorts"], "MC": dims["MC"],
+                    "R": dims["R"], "flag_aff": int(flag_aff),
+                    "flag_tsc": int(flag_tsc),
+                }
+                ds["into_limits"] = limits
+            guard_ok, fused_res = fused(
+                [pending[i] for i in dirty], self._native_ctx(),
+                np.asarray(dirty, np.int64), specs2, limits,
+            )
+            if not guard_ok:
+                return None  # arena dims too small: full re-encode
+        for j, i in enumerate(dirty):
             p = pending[i]
-            d = rowdata(p)
-            new_rows.append(d)
             ids[i] = id(p)
-            rows[i] = d
             refs[i] = p
-            if len(d["ports"]):
+            r = fused_res[j] if fused_res is not None else None
+            if r is None:  # no native builder, or pod needs dict path
+                d = rowdata(p)
+                new_rows.append(d)
+                fb_slots.append(i)
+                rows[i] = d
+                r = d["ports"]
+            else:
+                # only "ports" is ever read back from delta rows
+                rows[i] = {"ports": r}
+            if len(r):
                 port_set.add(i)
             else:
                 port_set.discard(i)
@@ -1896,24 +1955,15 @@ class SnapshotEncoder:
         _mark("ports")
 
         # ---- all checks passed: write the arena ----
+        # fused-path rows are already in place; only fallback dict rows
+        # need the batched apply + creation write here
         A = self._arena
-        creation = ds["creation"]
+        if fb_slots:
+            idx = np.asarray(fb_slots, np.int64)
+            native.apply_rows(self._apply_specs(ds), idx, new_rows)
+            creation[idx] = [d["creation"] for d in new_rows]
         if dirty:
             idx = np.asarray(dirty, np.int64)
-            specs = ds.get("apply_specs")
-            if specs is None:
-                # one (view, key, pad, mode) spec list built per arena:
-                # the whole write pass is a single native call instead of
-                # a per-field pad fancy-fill + list comp + scatter
-                specs = (
-                    [(A[n], k, p, 0) for n, k, p in self._PEND_2D]
-                    + [(A[n].reshape(P, -1), k, p, 0)
-                       for n, k, p in self._PEND_3D]
-                    + [(A[n], k, self._PEND_SCALAR_PAD[n], 1)
-                       for n, k in self._PEND_SCALAR]
-                )
-                ds["apply_specs"] = specs
-            native.apply_rows(specs, idx, new_rows)
             nidx = ds["node_index"]
             A["pod_node_name"][idx] = [
                 nidx.get(pending[i].spec.node_name, -2)
@@ -1925,7 +1975,6 @@ class SnapshotEncoder:
                 if pending[i].nominated_node_name else -1
                 for i in dirty
             ]
-            creation[idx] = [d["creation"] for d in new_rows]
 
         _mark("apply")
         if p_real != ds["p_real"]:

@@ -20,11 +20,12 @@ scatter_rows_at = None
 fill_scalars = None
 apply_rows = None
 pod_row = None  # native pod_rowdata; None => Python path only
+pod_rows_into = None  # fused delta-path writer; None => dict interchange
 
 
 def _try_import() -> bool:
     global HAVE_FASTASSEMBLE, scatter_rows, scatter_rows_at, fill_scalars
-    global pod_row, apply_rows
+    global pod_row, apply_rows, pod_rows_into
     try:
         from . import _fastassemble  # type: ignore[attr-defined]
     except ImportError:
@@ -34,6 +35,7 @@ def _try_import() -> bool:
     scatter_rows_at = _fastassemble.scatter_rows_at
     fill_scalars = _fastassemble.fill_scalars
     pod_row = getattr(_fastassemble, "pod_row", None)
+    pod_rows_into = getattr(_fastassemble, "pod_rows_into", None)
     # a stale prebuilt .so may predate newer symbols: fall back to the
     # numpy mirror per symbol, never to None (callers invoke unguarded)
     apply_rows = getattr(_fastassemble, "apply_rows", None) or _py_apply_rows

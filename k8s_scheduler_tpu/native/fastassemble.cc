@@ -21,6 +21,8 @@
 #include <Python.h>
 
 #include <cstring>
+#include <utility>
+#include <vector>
 
 namespace {
 
@@ -267,19 +269,17 @@ struct Ctx {
 // getattrs + 24 ctx lookups per pod row that was several µs/pod).
 // GIL-protected like every other C-API call here.
 static PyObject* interned_name(const char* name) {
-  enum { CAP = 128 };
-  static const char* keys[CAP];
-  static PyObject* vals[CAP];
-  static int used = 0;
-  for (int i = 0; i < used; ++i) {
-    if (keys[i] == name) return vals[i];
+  // growable, never evicts (advisor r4: the old fixed CAP=128 leaked a
+  // ref per call once full — and decref'ing a fresh MORTAL-interned
+  // string before the borrowed use would be a use-after-free). The key
+  // set is bounded at compile time by the number of distinct C literal
+  // call sites in this file, so process-lifetime refs are the contract.
+  static std::vector<std::pair<const char*, PyObject*>> cache;
+  for (auto& kv : cache) {
+    if (kv.first == name) return kv.second;
   }
   PyObject* u = PyUnicode_InternFromString(name);
-  if (u && used < CAP) {
-    keys[used] = name;
-    vals[used] = u;  // holds the ref for process lifetime
-    ++used;
-  }
+  if (u) cache.emplace_back(name, u);  // holds the ref for process lifetime
   return u;
 }
 
@@ -498,7 +498,7 @@ static bool lappendf(PyObject* lst, double v) {
 // compile pod-affinity terms into (sel, topo) pairs appended FLAT to
 // `flat`; returns term count, -2 error, -3 unsupported
 static long compile_aff_terms(const Ctx& c, PyObject* terms, PyObject* ns,
-                              PyObject* flat) {
+                              std::vector<long>& flat) {
   PyObject* seq = PySequence_Fast(terms, "terms");
   if (!seq) return -2;
   long count = 0;
@@ -524,17 +524,55 @@ static long compile_aff_terms(const Ctx& c, PyObject* terms, PyObject* ns,
     Py_DECREF(ls); Py_DECREF(tk);
     if (sid == -3) { status = -3; break; }
     if (sid < 0 || kid < 0) { status = -2; break; }
-    if (!lappend(flat, sid) || !lappend(flat, kid)) { status = -2; break; }
+    flat.push_back(sid);
+    flat.push_back(kid);
     ++count;
   }
   Py_DECREF(seq);
   return status ? status : count;
 }
 
-PyObject* pod_row(PyObject*, PyObject* args) {
-  PyObject *pod, *ctxd;
-  if (!PyArg_ParseTuple(args, "OO", &pod, &ctxd)) return nullptr;
-  Ctx c{};
+// ---------------------------------------------------------------------------
+// Parsed: one pod row as plain C data. parse_pod fills it in a single
+// attribute walk; pod_row boxes it into the rowdata dict (fallback /
+// full-path interchange), while pod_rows_into (the delta fast path)
+// writes it straight into the arena with no Python containers at all
+// (PERF.md round-4 close-out: the dict build + apply re-read were ~39
+// of the ~45 ms warm encode at config #4).
+// ---------------------------------------------------------------------------
+struct Parsed {
+  std::vector<double> reqvec;
+  std::vector<long> lab_k, lab_v, ports, aff, anti, pref, tsc, tsc_skew;
+  std::vector<double> pref_w;
+  long prio = 0, sel_req_id = -1, tolset = -1, gid = -1, imageset = -1,
+       n_aff = 0;
+  bool can_preempt = true;
+  double creation = 0.0;
+};
+
+static PyObject* list_from(const std::vector<long>& v) {
+  PyObject* l = PyList_New(static_cast<Py_ssize_t>(v.size()));
+  if (!l) return nullptr;
+  for (size_t i = 0; i < v.size(); ++i) {
+    PyObject* o = PyLong_FromLong(v[i]);
+    if (!o) { Py_DECREF(l); return nullptr; }
+    PyList_SET_ITEM(l, static_cast<Py_ssize_t>(i), o);
+  }
+  return l;
+}
+
+static PyObject* list_fromf(const std::vector<double>& v) {
+  PyObject* l = PyList_New(static_cast<Py_ssize_t>(v.size()));
+  if (!l) return nullptr;
+  for (size_t i = 0; i < v.size(); ++i) {
+    PyObject* o = PyFloat_FromDouble(v[i]);
+    if (!o) { Py_DECREF(l); return nullptr; }
+    PyList_SET_ITEM(l, static_cast<Py_ssize_t>(i), o);
+  }
+  return l;
+}
+
+static bool load_ctx(PyObject* ctxd, Ctx& c) {
   if (!ctx_get(ctxd, "str_ids", &c.str_ids) ||
       !ctx_get(ctxd, "str_list", &c.str_list) ||
       !ctx_get(ctxd, "exprs_idx", &c.exprs_idx) ||
@@ -564,16 +602,16 @@ PyObject* pod_row(PyObject*, PyObject* args) {
       !ctx_long(ctxd, "tol_exists", &c.tol_exists) ||
       !ctx_long(ctxd, "when_dns", &c.when_dns) ||
       !ctx_long(ctxd, "when_sa", &c.when_sa)) {
-    return nullptr;
+    return false;
   }
+  return true;
+}
 
+// Parse one pod into `P`. Returns 0 ok, -2 error (Python error set),
+// -3 unsupported feature (caller falls back to the Python rowdata path).
+static long parse_pod(const Ctx& c, PyObject* pod, Parsed& P) {
   PyObject *spec = nullptr, *meta = nullptr;
-  PyObject* out = nullptr;  // the rowdata dict (returned on success)
-  // long-lived temporaries released at the end
-  PyObject *lab_k = nullptr, *lab_v = nullptr, *ports = nullptr,
-           *aff = nullptr, *anti = nullptr, *pref = nullptr,
-           *pref_w = nullptr, *tsc = nullptr, *tsc_skew = nullptr,
-           *reqvec = nullptr, *empty = nullptr, *image_names = nullptr;
+  PyObject* image_names = nullptr;  // strong-ref image name objects
   long status = 0;  // 0 ok, -2 error, -3 fallback
 
   do {
@@ -609,7 +647,6 @@ PyObject* pod_row(PyObject*, PyObject* args) {
     if (!ns) { Py_XDECREF(pa); Py_XDECREF(paa); status = -2; break; }
 
     // ---- node_selector -> sel_req_id ----
-    long sel_req_id = -1;
     {
       PyObject* nsel = getattr_b(spec, "node_selector");
       if (!nsel) status = -2;
@@ -634,8 +671,8 @@ PyObject* pod_row(PyObject*, PyObject* args) {
           PyObject* terms = et ? Py_BuildValue("(O)", et) : nullptr;
           if (!terms) status = -2;
           else {
-            sel_req_id = intern_row(c.reqs_idx, c.reqs_rows, terms);
-            if (sel_req_id < 0) status = -2;
+            P.sel_req_id = intern_row(c.reqs_idx, c.reqs_rows, terms);
+            if (P.sel_req_id < 0) status = -2;
             Py_DECREF(terms);
           }
           Py_XDECREF(et);
@@ -648,105 +685,53 @@ PyObject* pod_row(PyObject*, PyObject* args) {
     if (status) { Py_XDECREF(pa); Py_XDECREF(paa); Py_DECREF(ns); break; }
 
     // ---- pod (anti-)affinity ----
-    aff = PyList_New(0);
-    anti = PyList_New(0);
-    pref = PyList_New(0);
-    pref_w = PyList_New(0);
     long n_aff_terms = 0, n_anti_terms = 0, n_pref_terms = 0;
-    if (!aff || !anti || !pref || !pref_w) status = -2;
-    if (!status && pa && pa != Py_None) {
-      PyObject* reqt = getattr_b(pa, "required");
-      long n1 = reqt ? compile_aff_terms(c, reqt, ns, aff) : -2;
+    // preferred terms of BOTH polarities land flat in P.pref with a
+    // signed weight in P.pref_w (anti-affinity preference = -w)
+    for (int pol = 0; !status && pol < 2; ++pol) {
+      PyObject* src = pol == 0 ? pa : paa;
+      if (!src || src == Py_None) continue;
+      PyObject* reqt = getattr_b(src, "required");
+      long n1 = reqt ? compile_aff_terms(c, reqt, ns, pol == 0 ? P.aff : P.anti)
+                     : -2;
       Py_XDECREF(reqt);
-      if (n1 < 0) status = n1;
-      else n_aff_terms = n1;
-      if (!status) {
-        PyObject* pt = getattr_b(pa, "preferred");
-        PyObject* seq = pt ? PySequence_Fast(pt, "preferred") : nullptr;
-        if (!seq) status = -2;
-        for (Py_ssize_t i = 0;
-             !status && seq && i < PySequence_Fast_GET_SIZE(seq); ++i) {
-          PyObject* wt = PySequence_Fast_GET_ITEM(seq, i);
-          PyObject* term = getattr_b(wt, "term");
-          PyObject* w = getattr_b(wt, "weight");
-          PyObject* one = term ? PyList_New(0) : nullptr;
-          if (!term || !w || !one) status = -2;
-          if (!status) {
-            PyObject* tt = PyTuple_Pack(1, term);
-            long n2 = tt ? compile_aff_terms(c, tt, ns, one) : -2;
-            Py_XDECREF(tt);
-            if (n2 < 0) status = n2;
-            else {
-              // one holds [sel, k]
-              const double wv = PyFloat_AsDouble(w);
-              if (wv == -1.0 && PyErr_Occurred()) status = -2;
-              else if (PyList_GET_SIZE(one) >= 2) {
-                long s = PyLong_AsLong(PyList_GET_ITEM(one, 0));
-                long k = PyLong_AsLong(PyList_GET_ITEM(one, 1));
-                if (!lappend(pref, s) || !lappend(pref, k) ||
-                    !lappendf(pref_w, wv)) {
-                  status = -2;
-                } else {
-                  ++n_pref_terms;
-                }
-              }
+      if (n1 < 0) { status = n1; break; }
+      (pol == 0 ? n_aff_terms : n_anti_terms) = n1;
+      PyObject* pt = getattr_b(src, "preferred");
+      PyObject* seq = pt ? PySequence_Fast(pt, "preferred") : nullptr;
+      if (!seq) status = -2;
+      for (Py_ssize_t i = 0;
+           !status && seq && i < PySequence_Fast_GET_SIZE(seq); ++i) {
+        PyObject* wt = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject* term = getattr_b(wt, "term");
+        PyObject* w = getattr_b(wt, "weight");
+        if (!term || !w) status = -2;
+        if (!status) {
+          std::vector<long> one;
+          PyObject* tt = PyTuple_Pack(1, term);
+          long n2 = tt ? compile_aff_terms(c, tt, ns, one) : -2;
+          Py_XDECREF(tt);
+          if (n2 < 0) status = n2;
+          else {
+            const double wv = PyFloat_AsDouble(w);
+            if (wv == -1.0 && PyErr_Occurred()) status = -2;
+            else if (one.size() >= 2) {
+              P.pref.push_back(one[0]);
+              P.pref.push_back(one[1]);
+              P.pref_w.push_back(pol == 0 ? wv : -wv);
+              ++n_pref_terms;
             }
           }
-          Py_XDECREF(one); Py_XDECREF(term); Py_XDECREF(w);
         }
-        Py_XDECREF(seq); Py_XDECREF(pt);
+        Py_XDECREF(term); Py_XDECREF(w);
       }
-    }
-    if (!status && paa && paa != Py_None) {
-      PyObject* reqt = getattr_b(paa, "required");
-      long n1 = reqt ? compile_aff_terms(c, reqt, ns, anti) : -2;
-      Py_XDECREF(reqt);
-      if (n1 < 0) status = n1;
-      else n_anti_terms = n1;
-      if (!status) {
-        PyObject* pt = getattr_b(paa, "preferred");
-        PyObject* seq = pt ? PySequence_Fast(pt, "preferred") : nullptr;
-        if (!seq) status = -2;
-        for (Py_ssize_t i = 0;
-             !status && seq && i < PySequence_Fast_GET_SIZE(seq); ++i) {
-          PyObject* wt = PySequence_Fast_GET_ITEM(seq, i);
-          PyObject* term = getattr_b(wt, "term");
-          PyObject* w = getattr_b(wt, "weight");
-          PyObject* one = term ? PyList_New(0) : nullptr;
-          if (!term || !w || !one) status = -2;
-          if (!status) {
-            PyObject* tt = PyTuple_Pack(1, term);
-            long n2 = tt ? compile_aff_terms(c, tt, ns, one) : -2;
-            Py_XDECREF(tt);
-            if (n2 < 0) status = n2;
-            else {
-              const double wv = PyFloat_AsDouble(w);
-              if (wv == -1.0 && PyErr_Occurred()) status = -2;
-              else if (PyList_GET_SIZE(one) >= 2) {
-                long s = PyLong_AsLong(PyList_GET_ITEM(one, 0));
-                long k = PyLong_AsLong(PyList_GET_ITEM(one, 1));
-                if (!lappend(pref, s) || !lappend(pref, k) ||
-                    !lappendf(pref_w, -wv)) {
-                  status = -2;
-                } else {
-                  ++n_pref_terms;
-                }
-              }
-            }
-          }
-          Py_XDECREF(one); Py_XDECREF(term); Py_XDECREF(w);
-        }
-        Py_XDECREF(seq); Py_XDECREF(pt);
-      }
+      Py_XDECREF(seq); Py_XDECREF(pt);
     }
     Py_XDECREF(pa); Py_XDECREF(paa);
     pa = paa = nullptr;
     if (status) { Py_DECREF(ns); break; }
 
     // ---- topology spread constraints ----
-    tsc = PyList_New(0);
-    tsc_skew = PyList_New(0);
-    if (!tsc || !tsc_skew) { status = -2; Py_DECREF(ns); break; }
     {
       PyObject* tscs = getattr_b(spec, "topology_spread_constraints");
       PyObject* seq = tscs ? PySequence_Fast(tscs, "tsc") : nullptr;
@@ -770,9 +755,11 @@ PyObject* pod_row(PyObject*, PyObject* args) {
                                                                : c.when_sa;
             const long skew = PyLong_AsLong(sk);
             if (skew == -1 && PyErr_Occurred()) status = -2;
-            else if (!lappend(tsc, kid) || !lappend(tsc, sid) ||
-                     !lappend(tsc, when) || !lappend(tsc_skew, skew)) {
-              status = -2;
+            else {
+              P.tsc.push_back(kid);
+              P.tsc.push_back(sid);
+              P.tsc.push_back(when);
+              P.tsc_skew.push_back(skew);
             }
           }
         }
@@ -783,14 +770,13 @@ PyObject* pod_row(PyObject*, PyObject* args) {
     if (status) { Py_DECREF(ns); break; }
 
     // ---- labels (namespace marker first, then sorted) ----
-    lab_k = PyList_New(0);
-    lab_v = PyList_New(0);
-    if (!lab_k || !lab_v) { status = -2; }
-    if (!status) {
+    {
       long nk = intern_str(c, c.ns_key);
       long nv = intern_str(c, ns);
-      if (nk < 0 || nv < 0 || !lappend(lab_k, nk) || !lappend(lab_v, nv)) {
-        status = -2;
+      if (nk < 0 || nv < 0) status = -2;
+      else {
+        P.lab_k.push_back(nk);
+        P.lab_v.push_back(nv);
       }
     }
     if (!status) {
@@ -802,28 +788,28 @@ PyObject* pod_row(PyObject*, PyObject* args) {
         PyObject* kv = PyList_GET_ITEM(items, i);
         long k = intern_str(c, PyTuple_GET_ITEM(kv, 0));
         long v = intern_str(c, PyTuple_GET_ITEM(kv, 1));
-        if (k < 0 || v < 0 || !lappend(lab_k, k) || !lappend(lab_v, v)) {
-          status = -2;
+        if (k < 0 || v < 0) status = -2;
+        else {
+          P.lab_k.push_back(k);
+          P.lab_v.push_back(v);
         }
       }
       Py_XDECREF(items);
       Py_XDECREF(labels);
     }
-    if (status) { Py_XDECREF(pa); Py_XDECREF(paa); Py_DECREF(ns); break; }
+    if (status) { Py_DECREF(ns); break; }
 
     // ---- requests -> reqvec (grow rn as needed), plus ports/images
     // collected in the same container walk (mirrors
     // Pod.resource_requests/host_ports/images without re-entering
     // Python bytecode per pod) ----
-    reqvec = nullptr;
-    ports = PyList_New(0);
     image_names = PyList_New(0);
     {
       // effective request dict, preserving Python's insertion order
       PyObject* req = PyDict_New();
       PyObject* conts = getattr_b(spec, "containers");
       PyObject* cseq = conts ? PySequence_Fast(conts, "containers") : nullptr;
-      if (!req || !ports || !image_names || !cseq) status = -2;
+      if (!req || !image_names || !cseq) status = -2;
       for (Py_ssize_t i = 0;
            !status && cseq && i < PySequence_Fast_GET_SIZE(cseq); ++i) {
         PyObject* ct = PySequence_Fast_GET_ITEM(cseq, i);
@@ -862,7 +848,7 @@ PyObject* pod_row(PyObject*, PyObject* args) {
             else if (!strcmp(ps, "SCTP")) pc = 2;
           }
           Py_XDECREF(pr);
-          if (!lappend(ports, port * 4 + pc)) { status = -2; break; }
+          P.ports.push_back(port * 4 + pc);
         }
         Py_DECREF(pseq); Py_DECREF(cports);
         if (status) break;
@@ -922,13 +908,7 @@ PyObject* pod_row(PyObject*, PyObject* args) {
         }
         if (!status) {
           const Py_ssize_t R = PyList_GET_SIZE(c.rn_list);
-          reqvec = PyList_New(R);
-          if (!reqvec) status = -2;
-          for (Py_ssize_t i = 0; !status && i < R; ++i) {
-            PyObject* z = PyFloat_FromDouble(0.0);
-            if (!z) { status = -2; break; }
-            PyList_SET_ITEM(reqvec, i, z);
-          }
+          P.reqvec.assign(static_cast<size_t>(R), 0.0);
           pos = 0;
           while (!status && PyDict_Next(req, &pos, &key, &val)) {
             PyObject* io = PyDict_GetItemWithError(c.rn_idx, key);
@@ -936,9 +916,7 @@ PyObject* pod_row(PyObject*, PyObject* args) {
             const long i = PyLong_AsLong(io);
             const double d = PyFloat_AsDouble(val);
             if (d == -1.0 && PyErr_Occurred()) { status = -2; break; }
-            PyObject* f = PyFloat_FromDouble(d);
-            if (!f) { status = -2; break; }
-            PyList_SetItem(reqvec, i, f);  // steals
+            P.reqvec[static_cast<size_t>(i)] = d;
           }
         }
       }
@@ -947,7 +925,6 @@ PyObject* pod_row(PyObject*, PyObject* args) {
     if (status) { Py_DECREF(ns); break; }
 
     // ---- tolerations ----
-    long tolset = -1;
     {
       PyObject* tols = getattr_b(spec, "tolerations");
       PyObject* seq = tols ? PySequence_Fast(tols, "tolerations") : nullptr;
@@ -984,16 +961,15 @@ PyObject* pod_row(PyObject*, PyObject* args) {
       if (!status && PyList_Sort(rows) != 0) status = -2;
       if (!status) {
         PyObject* rt = PyList_AsTuple(rows);
-        tolset = rt ? intern_row(c.tols_idx, c.tols_rows, rt) : -2;
+        P.tolset = rt ? intern_row(c.tols_idx, c.tols_rows, rt) : -2;
         Py_XDECREF(rt);
-        if (tolset < 0) status = -2;
+        if (P.tolset < 0) status = -2;
       }
       Py_XDECREF(rows); Py_XDECREF(seq); Py_XDECREF(tols);
     }
     if (status) { Py_DECREF(ns); break; }
 
     // ---- image set, group, scalars (ports/images collected above) ----
-    long imageset = -1;
     if (!status) {
       PyObject* ids = PyList_New(0);
       if (!ids) status = -2;
@@ -1018,24 +994,24 @@ PyObject* pod_row(PyObject*, PyObject* args) {
         if (PyList_Sort(ids) != 0) status = -2;
         else {
           PyObject* it = PyList_AsTuple(ids);
-          imageset = it ? intern_row(c.imgsets_idx, c.imgsets_rows, it) : -2;
+          P.imageset =
+              it ? intern_row(c.imgsets_idx, c.imgsets_rows, it) : -2;
           Py_XDECREF(it);
-          if (imageset < 0) status = -2;
+          if (P.imageset < 0) status = -2;
         }
       }
       Py_XDECREF(ids);
     }
-    long gid = -1;
     if (!status) {
       PyObject* g = getattr_b(spec, "pod_group");
       if (!g) status = -2;
       else if (PyObject_IsTrue(g) == 1) {
         PyObject* hit = PyDict_GetItemWithError(c.group_ids, g);
-        if (hit) gid = PyLong_AsLong(hit);
+        if (hit) P.gid = PyLong_AsLong(hit);
         else if (PyErr_Occurred()) status = -2;
         else {
-          gid = static_cast<long>(PyDict_Size(c.group_ids));
-          PyObject* num = PyLong_FromLong(gid);
+          P.gid = static_cast<long>(PyDict_Size(c.group_ids));
+          PyObject* num = PyLong_FromLong(P.gid);
           if (!num || PyDict_SetItem(c.group_ids, g, num) != 0) {
             Py_XDECREF(num); status = -2;
           } else {
@@ -1048,62 +1024,80 @@ PyObject* pod_row(PyObject*, PyObject* args) {
     Py_DECREF(ns);
     if (status) break;
 
-    long prio = 0;
-    double creation = 0.0;
-    bool can_preempt = true;
     {
       PyObject* p = getattr_b(spec, "priority");
       PyObject* ct = getattr_b(meta, "creation_timestamp");
       PyObject* pp = getattr_b(spec, "preemption_policy");
       if (!p || !ct || !pp) status = -2;
       else {
-        prio = PyLong_AsLong(p);
-        creation = PyFloat_AsDouble(ct);
+        P.prio = PyLong_AsLong(p);
+        P.creation = PyFloat_AsDouble(ct);
         const char* pps = PyUnicode_AsUTF8(pp);
-        can_preempt = !(pps && !strcmp(pps, "Never"));
-        if ((prio == -1 || creation == -1.0) && PyErr_Occurred()) status = -2;
+        P.can_preempt = !(pps && !strcmp(pps, "Never"));
+        if ((P.prio == -1 || P.creation == -1.0) && PyErr_Occurred()) {
+          status = -2;
+        }
       }
       Py_XDECREF(p); Py_XDECREF(ct); Py_XDECREF(pp);
     }
     if (status) break;
 
-    long n_aff = n_aff_terms;
-    if (n_anti_terms > n_aff) n_aff = n_anti_terms;
-    if (n_pref_terms > n_aff) n_aff = n_pref_terms;
-
-    empty = PyList_New(0);
-    if (!empty) { status = -2; break; }
-    out = Py_BuildValue(
-        "{s:O,s:l,s:d,s:l,s:l,s:l,s:l,s:O,s:O,s:O,s:O,s:O,s:O,s:O,s:O,s:O,"
-        "s:l,s:l,s:l,s:O,s:O,s:O,s:O,s:O,s:O,s:O}",
-        "reqvec", reqvec, "prio", prio, "creation", creation,
-        "req_id", static_cast<long>(-1), "pref_id", static_cast<long>(-1),
-        "sel_req_id", sel_req_id, "tolset", tolset,
-        "lab_k", lab_k, "lab_v", lab_v, "ports", ports,
-        "aff", aff, "anti", anti, "pref", pref, "pref_w", pref_w,
-        "tsc", tsc, "tsc_skew", tsc_skew,
-        "n_aff", n_aff, "gid", gid, "imageset", imageset,
-        "can_preempt", can_preempt ? Py_True : Py_False,
-        "vol_mode", empty, "vol_req", empty, "vol_cls", empty,
-        "vol_size", empty, "vol_epoch", Py_None, "epoch", Py_None);
-    if (!out) status = -2;
+    P.n_aff = n_aff_terms;
+    if (n_anti_terms > P.n_aff) P.n_aff = n_anti_terms;
+    if (n_pref_terms > P.n_aff) P.n_aff = n_pref_terms;
   } while (false);
 
   Py_XDECREF(spec); Py_XDECREF(meta);
-  Py_XDECREF(lab_k); Py_XDECREF(lab_v); Py_XDECREF(ports);
-  Py_XDECREF(aff); Py_XDECREF(anti); Py_XDECREF(pref); Py_XDECREF(pref_w);
-  Py_XDECREF(tsc); Py_XDECREF(tsc_skew); Py_XDECREF(reqvec);
-  Py_XDECREF(empty); Py_XDECREF(image_names);
+  Py_XDECREF(image_names);
+  return status;
+}
+
+PyObject* pod_row(PyObject*, PyObject* args) {
+  PyObject *pod, *ctxd;
+  if (!PyArg_ParseTuple(args, "OO", &pod, &ctxd)) return nullptr;
+  Ctx c{};
+  if (!load_ctx(ctxd, c)) return nullptr;
+  Parsed P;
+  const long status = parse_pod(c, pod, P);
   if (status == -3) {
     PyErr_Clear();
     Py_RETURN_NONE;  // unsupported feature: caller uses the Python path
   }
-  if (status == -2 || out == nullptr) {
+  if (status) {
     if (!PyErr_Occurred()) {
       PyErr_SetString(PyExc_RuntimeError, "pod_row internal error");
     }
-    Py_XDECREF(out);
     return nullptr;
+  }
+  PyObject *reqvec = list_fromf(P.reqvec), *lab_k = list_from(P.lab_k),
+           *lab_v = list_from(P.lab_v), *ports = list_from(P.ports),
+           *aff = list_from(P.aff), *anti = list_from(P.anti),
+           *pref = list_from(P.pref), *pref_w = list_fromf(P.pref_w),
+           *tsc = list_from(P.tsc), *tsc_skew = list_from(P.tsc_skew),
+           *empty = PyList_New(0);
+  PyObject* out = nullptr;
+  if (reqvec && lab_k && lab_v && ports && aff && anti && pref && pref_w &&
+      tsc && tsc_skew && empty) {
+    out = Py_BuildValue(
+        "{s:O,s:l,s:d,s:l,s:l,s:l,s:l,s:O,s:O,s:O,s:O,s:O,s:O,s:O,s:O,s:O,"
+        "s:l,s:l,s:l,s:O,s:O,s:O,s:O,s:O,s:O,s:O}",
+        "reqvec", reqvec, "prio", P.prio, "creation", P.creation,
+        "req_id", static_cast<long>(-1), "pref_id", static_cast<long>(-1),
+        "sel_req_id", P.sel_req_id, "tolset", P.tolset,
+        "lab_k", lab_k, "lab_v", lab_v, "ports", ports,
+        "aff", aff, "anti", anti, "pref", pref, "pref_w", pref_w,
+        "tsc", tsc, "tsc_skew", tsc_skew,
+        "n_aff", P.n_aff, "gid", P.gid, "imageset", P.imageset,
+        "can_preempt", P.can_preempt ? Py_True : Py_False,
+        "vol_mode", empty, "vol_req", empty, "vol_cls", empty,
+        "vol_size", empty, "vol_epoch", Py_None, "epoch", Py_None);
+  }
+  Py_XDECREF(reqvec); Py_XDECREF(lab_k); Py_XDECREF(lab_v);
+  Py_XDECREF(ports); Py_XDECREF(aff); Py_XDECREF(anti); Py_XDECREF(pref);
+  Py_XDECREF(pref_w); Py_XDECREF(tsc); Py_XDECREF(tsc_skew);
+  Py_XDECREF(empty);
+  if (!out && !PyErr_Occurred()) {
+    PyErr_SetString(PyExc_RuntimeError, "pod_row internal error");
   }
   return out;
 }
@@ -1292,7 +1286,307 @@ PyObject* apply_rows(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// ---------------------------------------------------------------------------
+// pod_rows_into(pods, ctx, index_i64, specs, limits)
+//
+// The fused delta-path row builder (PERF.md "Host-encode budget",
+// round-5): parses each pod ONCE (parse_pod) and writes its arena row
+// straight from the C structs — no rowdata dict, no per-field Python
+// lists, no apply_rows re-read. `specs` is the apply_rows spec list
+// (dst, key, pad, mode) with one extension: mode-1 float64 columns
+// (the creation-timestamp array). `limits` carries the arena dims
+// guards {MPL, MA, MPorts, MC, R, flag_aff, flag_tsc}.
+//
+// Returns (guard_ok, results). results[i] is the pod's encoded port
+// list when its row was written natively, or None when the pod needs
+// the Python fallback (volumes / nodeAffinity / exotic selector ops —
+// caller builds its rowdata dict and apply_rows's just those). guard_ok
+// False means some pod exceeded an arena dim: the caller must bail to
+// the full encode, which rebuilds every row (partially written arena
+// rows are therefore harmless).
+// ---------------------------------------------------------------------------
+PyObject* pod_rows_into(PyObject*, PyObject* args) {
+  PyObject *pods_obj, *ctxd, *index_obj, *specs_obj, *limits;
+  if (!PyArg_ParseTuple(args, "OOOOO", &pods_obj, &ctxd, &index_obj,
+                        &specs_obj, &limits)) {
+    return nullptr;
+  }
+  Ctx c{};
+  if (!load_ctx(ctxd, c)) return nullptr;
+  long MPL, MA, MPorts, MC, R, flag_aff, flag_tsc;
+  if (!ctx_long(limits, "MPL", &MPL) || !ctx_long(limits, "MA", &MA) ||
+      !ctx_long(limits, "MPorts", &MPorts) || !ctx_long(limits, "MC", &MC) ||
+      !ctx_long(limits, "R", &R) || !ctx_long(limits, "flag_aff", &flag_aff) ||
+      !ctx_long(limits, "flag_tsc", &flag_tsc)) {
+    return nullptr;
+  }
+
+  View index;
+  if (!index.acquire(index_obj, PyBUF_C_CONTIGUOUS)) return nullptr;
+  if (index.buf.ndim != 1 ||
+      index.buf.itemsize != static_cast<Py_ssize_t>(sizeof(long))) {
+    PyErr_SetString(PyExc_ValueError, "index must be 1-D int64");
+    return nullptr;
+  }
+  const long* idx = static_cast<const long*>(index.buf.buf);
+  const Py_ssize_t n_idx = index.buf.shape[0];
+
+  // resolve each spec's key to a Parsed field once
+  enum Field {
+    F_REQVEC, F_LABK, F_LABV, F_PORTS, F_PREFW, F_TSCSKEW,
+    F_VOLMODE, F_VOLREQ, F_VOLCLS, F_VOLSIZE,        // empty for native pods
+    F_AFF, F_ANTI, F_PREF, F_TSC,
+    F_PRIO, F_REQID, F_PREFID, F_SELREQ, F_TOLSET, F_GID, F_IMAGESET,
+    F_CANPRE, F_CREATION,
+  };
+  struct Col {
+    int field;
+    long mode;
+    char kind;
+    Py_ssize_t isz, rows, width;
+    char* base;
+    float padf;
+    int padi;
+  };
+  PyObject* specs = PySequence_Fast(specs_obj, "specs must be a sequence");
+  if (!specs) return nullptr;
+  const Py_ssize_t n_specs = PySequence_Fast_GET_SIZE(specs);
+  std::vector<View> views(static_cast<size_t>(n_specs));
+  std::vector<Col> cols;
+  cols.reserve(static_cast<size_t>(n_specs));
+  bool ok = true;
+  for (Py_ssize_t s = 0; ok && s < n_specs; ++s) {
+    PyObject* spec = PySequence_Fast_GET_ITEM(specs, s);
+    PyObject *dst_obj, *key, *pad_obj, *m;
+    if (!PyArg_ParseTuple(spec, "OOOO", &dst_obj, &key, &pad_obj, &m)) {
+      ok = false;
+      break;
+    }
+    Col col{};
+    col.mode = PyLong_AsLong(m);
+    if (col.mode == -1 && PyErr_Occurred()) { ok = false; break; }
+    const char* ks = PyUnicode_AsUTF8(key);
+    if (!ks) { ok = false; break; }
+    if (!strcmp(ks, "reqvec")) col.field = F_REQVEC;
+    else if (!strcmp(ks, "lab_k")) col.field = F_LABK;
+    else if (!strcmp(ks, "lab_v")) col.field = F_LABV;
+    else if (!strcmp(ks, "ports")) col.field = F_PORTS;
+    else if (!strcmp(ks, "pref_w")) col.field = F_PREFW;
+    else if (!strcmp(ks, "tsc_skew")) col.field = F_TSCSKEW;
+    else if (!strcmp(ks, "vol_mode")) col.field = F_VOLMODE;
+    else if (!strcmp(ks, "vol_req")) col.field = F_VOLREQ;
+    else if (!strcmp(ks, "vol_cls")) col.field = F_VOLCLS;
+    else if (!strcmp(ks, "vol_size")) col.field = F_VOLSIZE;
+    else if (!strcmp(ks, "aff")) col.field = F_AFF;
+    else if (!strcmp(ks, "anti")) col.field = F_ANTI;
+    else if (!strcmp(ks, "pref")) col.field = F_PREF;
+    else if (!strcmp(ks, "tsc")) col.field = F_TSC;
+    else if (!strcmp(ks, "prio")) col.field = F_PRIO;
+    else if (!strcmp(ks, "req_id")) col.field = F_REQID;
+    else if (!strcmp(ks, "pref_id")) col.field = F_PREFID;
+    else if (!strcmp(ks, "sel_req_id")) col.field = F_SELREQ;
+    else if (!strcmp(ks, "tolset")) col.field = F_TOLSET;
+    else if (!strcmp(ks, "gid")) col.field = F_GID;
+    else if (!strcmp(ks, "imageset")) col.field = F_IMAGESET;
+    else if (!strcmp(ks, "can_preempt")) col.field = F_CANPRE;
+    else if (!strcmp(ks, "creation")) col.field = F_CREATION;
+    else {
+      PyErr_Format(PyExc_KeyError, "pod_rows_into: unknown key %s", ks);
+      ok = false;
+      break;
+    }
+    View& v = views[static_cast<size_t>(s)];
+    if (!v.acquire(dst_obj,
+                   PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE | PyBUF_FORMAT)) {
+      ok = false;
+      break;
+    }
+    col.kind = v.buf.format ? v.buf.format[0] : 'i';
+    col.isz = v.buf.itemsize;
+    col.base = static_cast<char*>(v.buf.buf);
+    col.rows = v.buf.shape[0];
+    col.width = col.mode == 0 ? v.buf.shape[1] : 1;
+    if (col.mode == 0) {
+      if (v.buf.ndim != 2 || col.isz != 4) {
+        PyErr_SetString(PyExc_ValueError,
+                        "pod_rows_into: mode-0 dst must be 2-D i32/f32");
+        ok = false;
+        break;
+      }
+      const double x = PyFloat_AsDouble(pad_obj);
+      if (x == -1.0 && PyErr_Occurred()) { ok = false; break; }
+      col.padf = static_cast<float>(x);
+      col.padi = static_cast<int>(PyLong_AsLong(pad_obj));
+      if (col.padi == -1 && PyErr_Occurred()) PyErr_Clear();  // float pad
+    } else if (v.buf.ndim != 1) {
+      PyErr_SetString(PyExc_ValueError, "pod_rows_into: mode-1 dst not 1-D");
+      ok = false;
+      break;
+    }
+    cols.push_back(col);
+  }
+
+  PyObject* pods = ok ? PySequence_Fast(pods_obj, "pods must be a sequence")
+                      : nullptr;
+  if (!pods) {
+    Py_DECREF(specs);
+    return nullptr;
+  }
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(pods);
+  PyObject* results = n <= n_idx ? PyList_New(n) : nullptr;
+  if (!results) {
+    if (!PyErr_Occurred()) {
+      PyErr_SetString(PyExc_ValueError, "index shorter than pods");
+    }
+    Py_DECREF(pods);
+    Py_DECREF(specs);
+    return nullptr;
+  }
+
+  bool guard_ok = true;
+  for (Py_ssize_t i = 0; ok && guard_ok && i < n; ++i) {
+    PyObject* pod = PySequence_Fast_GET_ITEM(pods, i);
+    Parsed P;
+    const long st = parse_pod(c, pod, P);
+    if (st == -2) { ok = false; break; }
+    if (st == -3) {
+      PyErr_Clear();
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(results, i, Py_None);  // caller's Python fallback
+      continue;
+    }
+    if (static_cast<long>(P.lab_k.size()) > MPL ||
+        P.n_aff > MA ||
+        static_cast<long>(P.ports.size()) > MPorts ||
+        static_cast<long>(P.tsc_skew.size()) > MC ||
+        static_cast<long>(P.reqvec.size()) > R ||
+        (!flag_aff && P.n_aff > 0) ||
+        (!flag_tsc && !P.tsc_skew.empty())) {
+      guard_ok = false;  // arena dims too small: full re-encode
+      break;
+    }
+    const Py_ssize_t t = idx[i];
+    for (Col& col : cols) {
+      if (t < 0 || t >= col.rows) {
+        PyErr_SetString(PyExc_IndexError, "pod_rows_into: target row");
+        ok = false;
+        break;
+      }
+      if (col.mode == 1) {  // scalar column
+        long sv = 0;
+        switch (col.field) {
+          case F_PRIO: sv = P.prio; break;
+          case F_REQID: case F_PREFID: sv = -1; break;
+          case F_SELREQ: sv = P.sel_req_id; break;
+          case F_TOLSET: sv = P.tolset; break;
+          case F_GID: sv = P.gid; break;
+          case F_IMAGESET: sv = P.imageset; break;
+          case F_CANPRE: sv = P.can_preempt ? 1 : 0; break;
+          case F_CREATION: break;
+          default:
+            PyErr_SetString(PyExc_ValueError,
+                            "pod_rows_into: 2-D key on mode-1 spec");
+            ok = false;
+        }
+        if (!ok) break;
+        if (col.field == F_CREATION) {
+          if (col.isz != 8) {
+            PyErr_SetString(PyExc_ValueError, "creation dst must be f64");
+            ok = false;
+            break;
+          }
+          reinterpret_cast<double*>(col.base)[t] = P.creation;
+        } else if (col.isz == 4) {
+          reinterpret_cast<int*>(col.base)[t] = static_cast<int>(sv);
+        } else if (col.isz == 1) {
+          col.base[t] = static_cast<char>(sv != 0);
+        } else {
+          PyErr_SetString(PyExc_ValueError, "unsupported scalar dtype");
+          ok = false;
+          break;
+        }
+        continue;
+      }
+      // 2-D row: pad, then copy the vector (guards above ensure fit)
+      char* out = col.base + t * col.width * 4;
+      const std::vector<long>* vl = nullptr;
+      const std::vector<double>* vd = nullptr;
+      switch (col.field) {
+        case F_REQVEC: vd = &P.reqvec; break;
+        case F_PREFW: vd = &P.pref_w; break;
+        case F_LABK: vl = &P.lab_k; break;
+        case F_LABV: vl = &P.lab_v; break;
+        case F_PORTS: vl = &P.ports; break;
+        case F_TSCSKEW: vl = &P.tsc_skew; break;
+        case F_AFF: vl = &P.aff; break;
+        case F_ANTI: vl = &P.anti; break;
+        case F_PREF: vl = &P.pref; break;
+        case F_TSC: vl = &P.tsc; break;
+        case F_VOLMODE: case F_VOLREQ: case F_VOLCLS: case F_VOLSIZE:
+          break;  // native pods carry no volumes: pad only
+        default:
+          PyErr_SetString(PyExc_ValueError,
+                          "pod_rows_into: scalar key on mode-0 spec");
+          ok = false;
+      }
+      if (!ok) break;
+      if (col.kind == 'f') {
+        float* of = reinterpret_cast<float*>(out);
+        for (Py_ssize_t j = 0; j < col.width; ++j) of[j] = col.padf;
+        if (vd) {
+          Py_ssize_t m2 = static_cast<Py_ssize_t>(vd->size());
+          if (m2 > col.width) m2 = col.width;
+          for (Py_ssize_t j = 0; j < m2; ++j) {
+            of[j] = static_cast<float>((*vd)[j]);
+          }
+        }
+      } else {
+        int* oi = reinterpret_cast<int*>(out);
+        for (Py_ssize_t j = 0; j < col.width; ++j) oi[j] = col.padi;
+        if (vl) {
+          Py_ssize_t m2 = static_cast<Py_ssize_t>(vl->size());
+          if (m2 > col.width) m2 = col.width;
+          for (Py_ssize_t j = 0; j < m2; ++j) {
+            oi[j] = static_cast<int>((*vl)[j]);
+          }
+        }
+      }
+    }
+    if (!ok) break;
+    PyObject* plist = list_from(P.ports);
+    if (!plist) { ok = false; break; }
+    PyList_SET_ITEM(results, i, plist);
+  }
+  Py_DECREF(pods);
+  Py_DECREF(specs);
+  if (!ok) {
+    Py_DECREF(results);
+    return nullptr;
+  }
+  if (!guard_ok) {
+    Py_DECREF(results);
+    Py_INCREF(Py_None);
+    PyObject* ret = PyTuple_Pack(2, Py_False, Py_None);
+    Py_DECREF(Py_None);
+    return ret;
+  }
+  // pods past a fallback slot may leave NULL holes if we broke early —
+  // cannot happen here (every path either fills or errors), but be safe
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    if (PyList_GET_ITEM(results, i) == nullptr) {
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(results, i, Py_None);
+    }
+  }
+  PyObject* ret = PyTuple_Pack(2, Py_True, results);
+  Py_DECREF(results);
+  return ret;
+}
+
 PyMethodDef methods[] = {
+    {"pod_rows_into", pod_rows_into, METH_VARARGS,
+     "pod_rows_into(pods, ctx, index_i64, specs, limits): fused parse + "
+     "direct arena write; returns (guard_ok, per-pod ports | None)"},
     {"apply_rows", apply_rows, METH_VARARGS,
      "apply_rows(specs, index_i64, rowdicts): batched delta arena write"},
     {"scatter_rows", scatter_rows, METH_VARARGS,

@@ -57,9 +57,9 @@ def test_transport_flake_retried_and_bench_parses(monkeypatch, capsys):
         return _mk_result(c)
 
     doc = _run_bench_main(monkeypatch, capsys, run_config)
-    assert [r["config"] for r in doc["detail"]["configs"]] == [1, 2]
+    assert [r["c"] for r in doc["configs"]] == [1, 2]
     # the retried flake is annotated, not fatal
-    errs = doc["detail"]["errors"]
+    errs = doc["errors"]
     assert errs[0]["config"] == 2 and errs[0]["transport"] is True
     assert doc["value"] == 2000.0  # headline falls back to last config
 
@@ -71,12 +71,11 @@ def test_permanent_config_failure_yields_partial_json(monkeypatch, capsys):
         return _mk_result(c)
 
     doc = _run_bench_main(monkeypatch, capsys, run_config, configs="1,4,5")
-    assert [r["config"] for r in doc["detail"]["configs"]] == [1, 5]
-    err = doc["detail"]["errors"][0]
+    assert [r["c"] for r in doc["configs"]] == [1, 5]
+    err = doc["errors"][0]
     assert err["config"] == 4 and err["transport"] is False
     assert err["attempt"] == 0  # non-transport errors are not retried
-    assert doc["detail"]["headline_config"] == 5
-    assert doc["value"] == 5000.0
+    assert doc["value"] == 5000.0  # headline falls back to last config
 
 
 def test_all_configs_failing_still_emits_parseable_line(monkeypatch, capsys):
@@ -85,8 +84,12 @@ def test_all_configs_failing_still_emits_parseable_line(monkeypatch, capsys):
 
     doc = _run_bench_main(monkeypatch, capsys, run_config)
     assert doc["value"] == 0.0
-    assert doc["detail"]["configs"] == []
-    assert len(doc["detail"]["errors"]) == 2
+    assert doc["configs"] == []
+    assert len(doc["errors"]) == 2
+    # the full detail (incl. tracebacks of what failed) is on disk
+    with open("BENCH_DETAIL.json") as f:
+        det = json.load(f)
+    assert len(det["errors"]) == 2
 
 
 def test_is_transport_error_classification():

@@ -189,3 +189,55 @@ if __name__ == "__main__":
     import sys
 
     pytest.main([__file__, "-v"] + sys.argv[1:])
+
+
+def test_fused_mixed_native_and_fallback_rows():
+    """Round-5 fused path (native pod_rows_into): a dirty batch mixing
+    natively-written pods with Python-fallback pods (volumes force the
+    fallback) must still be byte-identical to the full encode."""
+    from k8s_scheduler_tpu import native
+
+    if native.pod_rows_into is None:
+        pytest.skip("native extension not built")
+    nodes = make_cluster(6)
+    d = Driver()
+    pending = make_pods(30, seed=11, affinity_fraction=0.3, num_apps=4)
+    # volume-bearing pods take the dict fallback inside the fused call
+    pending += [
+        MakePod(f"vol-{i}").req({"cpu": "250m"}).volume(f"claim-{i}").obj()
+        for i in range(4)
+    ]
+    d.step(nodes, pending)
+    # churn BOTH kinds in one dirty batch -> mixed fused/fallback delta
+    pending[0] = make_pods(1, seed=99, name_prefix="fresh")[0]
+    pending[30] = (
+        MakePod("vol-new").req({"cpu": "250m"}).volume("claim-new").obj()
+    )
+    d.step(nodes, pending)
+    # and again so the second delta reuses rows[i] stored by both paths
+    pending[1] = make_pods(1, seed=100, name_prefix="fresh2")[0]
+    d.step(nodes, pending)
+
+
+def test_fused_guard_overflow_falls_back_to_full():
+    """A dirty pod that overflows an arena dim (here: more labels than
+    MPL) must make the fused call report guard_ok=False and the encoder
+    take the full path — still exact."""
+    from k8s_scheduler_tpu import native
+
+    if native.pod_rows_into is None:
+        pytest.skip("native extension not built")
+    nodes = make_cluster(4)
+    d = Driver()
+    pods = make_pods(20, seed=12)
+    d.step(nodes, pods)
+    pods[3] = (
+        MakePod("many-labels")
+        .req({"cpu": "100m"})
+        .labels({f"key-{j}": f"v-{j}" for j in range(40)})
+        .obj()
+    )  # blow past the sticky MPL dim
+    d.step(nodes, pods)
+    # subsequent delta over the grown arena still works
+    pods[4] = make_pods(1, seed=101, name_prefix="after")[0]
+    d.step(nodes, pods)
