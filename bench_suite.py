@@ -297,9 +297,17 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
         b = jax.device_put(b)
         stable = stable_state(spec, stable_fn, w, b)
         if keeper is not None:
+            # _carry_key excludes the existing set: a bind-fold keeps the
+            # [P,N] carry valid (st identity also joins the key; the fold
+            # mutates st in place, any other stable change rebuilds it)
+            enc_st = getattr(enc, "_stable", None)
             carry = keeper.state(
                 w, b, stable, dirty,
-                (spec.key(), getattr(enc, "_stable_key", None)),
+                (
+                    spec.key(), id(enc_st),
+                    getattr(enc, "_carry_key", None),
+                ),
+                pin=enc_st,
             )
             out = cyc(w, b, stable, carry)
         else:
@@ -307,11 +315,33 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
         pre = pre_fn(w, b, out, stable) if pre_fn is not None else None
         return out, pre, diag, stable, w, b
 
+    # ---- bind folding (VERDICT r4 weak #3 / item 3) ----
+    # The LATENCY loop models the production steady state: each cycle's
+    # bindings fold into the existing set (the encoder's incremental
+    # existing-fold keeps the stable side + device carry warm), bound
+    # pods leave pending, fresh arrivals refill to P_real, and every
+    # FOLD_EVICT_EVERY-th cycle a completion batch removes the folded
+    # tail (incremental un-fold). The THROUGHPUT loop below keeps the
+    # existing set fixed on purpose — its no-per-cycle-force methodology
+    # cannot observe bindings without paying a tunnel round-trip per
+    # cycle, so it measures pure decision throughput; the fold cost is
+    # carried by p50/p99/encode_p50 here. BENCH_FOLD=0 restores the
+    # round-4 fixed-existing behavior.
+    fold_binds = (
+        os.environ.get("BENCH_FOLD", "1") == "1" and cfg != 5
+    )
+    fold_evict_every = int(os.environ.get("BENCH_FOLD_EVICT", "4"))
+    base_len = len(base_existing)
+    folded_n = 0
+
     pending = None
     first_bufs = None
     fns = None
     for i in range(snapshots):
-        pending, groups = _draw_pending(cfg, i, pending, churn)
+        if fold_binds and pending is not None:
+            groups = []  # pending was updated in place after the last cycle
+        else:
+            pending, groups = _draw_pending(cfg, i, pending, churn)
         t0 = time.perf_counter()
         # encode_packed: the delta-arena fast path (encode + pack in one;
         # warm cycles rewrite only churned pod rows of the packed buffers)
@@ -376,6 +406,32 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
             totals["preemptors"] += int(np.asarray(pre.num_preemptors))
             totals["victims"] += int(np.asarray(pre.victims).sum())
 
+        if fold_binds:
+            # bound pods fold into the existing set (the encoder's
+            # incremental append-fold); fresh arrivals take their QUEUE
+            # SLOTS in place — a slot-reuse driver, so the delta encoder's
+            # dirty set is exactly the arrival count, as in r4's churn
+            # model, while the stable side now pays the real fold cost
+            bidx = np.flatnonzero((a[: len(pending)] >= 0)
+                                  & valid[: len(pending)])
+            if bidx.size:
+                pending = list(pending)
+                arrivals, _g = make_config_pending(
+                    cfg, seed=1000 + i, count=int(bidx.size),
+                    name_prefix=f"pod{i}-",
+                )
+                for j, newp in zip(bidx, arrivals):
+                    base_existing.append(
+                        (pending[int(j)], base_nodes[int(a[int(j)])].name)
+                    )
+                    pending[int(j)] = newp
+                folded_n += int(bidx.size)
+            if (i + 1) % fold_evict_every == 0 and folded_n:
+                # completion batch: the folded tail finishes and leaves
+                # (the encoder's incremental tail un-fold)
+                del base_existing[base_len:]
+                folded_n = 0
+
     # fixed tunnel round-trip: a no-op program on DEVICE-RESIDENT data
     # (numpy args would re-upload the 8MB buffer per call and pollute the
     # fixed-cost estimate)
@@ -384,6 +440,11 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     t0 = time.perf_counter()
     np.asarray(noop(dev_w))
     tunnel_rt = time.perf_counter() - t0
+
+    # the throughput loop measures pure decision throughput over a FIXED
+    # existing set (see fold note above): drop any folded residue first
+    if fold_binds and len(base_existing) > base_len:
+        del base_existing[base_len:]
 
     # pipelined throughput: re-encode + dispatch every snapshot
     # back-to-back, force once — encode overlaps device compute. The
@@ -493,6 +554,10 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
         "encode_p50_ms": round(_percentile(encode_times, 50) * 1e3, 3),
         "compile_seconds": round(compile_s, 2),
         "distinct_shapes": len(shape_keys),
+        "fold_binds": fold_binds,
+        "fold_hits": getattr(enc, "fold_hits", 0),
+        "delta_hits": enc.delta_hits,
+        "full_encodes": enc.full_encodes,
         **{k: v // max(snapshots, 1) for k, v in totals.items()},
     }
 

@@ -685,8 +685,12 @@ class CarryKeeper:
         self._cu(self.bucket)(wbuf, bbuf, stable, c, idx)
         self.key = None  # force a clean rebuild on first real use
 
-    def state(self, wbuf, bbuf, stable, dirty, regime_key):
+    def state(self, wbuf, bbuf, stable, dirty, regime_key, pin=None):
+        """`pin` keeps a strong ref to whatever object(s) the regime key
+        embeds raw id()s of (the encoder's stable dict) — while pinned,
+        CPython cannot recycle the address into a false key match."""
         np = self._np
+        self._pin = pin
         if (
             self.key != regime_key
             or dirty is None

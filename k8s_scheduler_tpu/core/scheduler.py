@@ -253,9 +253,13 @@ class Scheduler:
         # ref pinned in the entry: the encoder's _stable_key tuple contains
         # raw id()s whose objects older memo entries would not pin, so a
         # recycled address could otherwise produce a false hit on stale
-        # existing-pod tables
-        enc_st = getattr(encoder or self._encoder, "_stable", None)
-        key = (spec.key(), id(enc_st))
+        # existing-pod tables. fold_hits joins the key because the
+        # incremental existing-fold mutates the st dict IN PLACE (same
+        # identity, new contents) — each fold must recompute the device
+        # stable precomputes.
+        enc = encoder or self._encoder
+        enc_st = getattr(enc, "_stable", None)
+        key = (spec.key(), id(enc_st), getattr(enc, "fold_hits", 0))
         hit = self._dev_stable.get(key)
         if hit is None or hit[0] is not enc_st:
             hit = (enc_st, stable_fn(wbuf, bbuf))
@@ -443,9 +447,19 @@ class Scheduler:
             stable = self._stable_state(
                 spec, stable_fn, wbuf, bbuf, encoder
             )
+            # keyed on _carry_key (stable key MINUS existing/PDBs) plus
+            # the st dict identity: a bound-pod fold mutates st IN PLACE
+            # (same identity, carry still valid — only the encoder-
+            # reported dirty rows, incl. port-bearing slots, recompute),
+            # while any OTHER stable change rebuilds st and the carry
+            enc_st = getattr(encoder, "_stable", None)
             carry = keeper.state(
                 wbuf, bbuf, stable, dirty,
-                (spec.key(), id(getattr(encoder, "_stable", None))),
+                (
+                    spec.key(), id(enc_st),
+                    getattr(encoder, "_carry_key", None),
+                ),
+                pin=enc_st,
             )
             t_encode = self._now()
             self.metrics.cycle_duration.labels(phase="encode").observe(

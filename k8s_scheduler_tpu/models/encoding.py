@@ -560,7 +560,10 @@ class SnapshotEncoder:
         self._cycle_index += 1
         N = self.pad_nodes or _pow2_bucket(n_real)
         P = self.pad_pods or _pow2_bucket(p_real)
-        E = _pow2_bucket(e_real) if e_real else 8
+        # E is STICKY (like MPL/MA): the incremental existing-fold appends
+        # bound pods in place, and a completion batch that shrinks e_real
+        # must not flip the packed regime
+        E = self._stick("E", _pow2_bucket(e_real) if e_real else 8)
 
         node_index = {nd.name: i for i, nd in enumerate(nodes)}
         names_now = tuple(nd.name for nd in nodes)
@@ -1026,25 +1029,6 @@ class SnapshotEncoder:
                 pv_avail_arr[i] = not pv.claim_ref and pv.name not in claimed_pvs
 
             # ---- assemble existing-pod arrays ----
-            def _pdb_matches(pdb: api.PodDisruptionBudget, p: Pod) -> bool:
-                if p.namespace != pdb.namespace:
-                    return False
-                sel = pdb.selector
-                for k, v in sel.match_labels.items():
-                    if p.metadata.labels.get(k) != v:
-                        return False
-                for e in sel.match_expressions:
-                    val = p.metadata.labels.get(e.key)
-                    if e.operator == api.OP_IN and val not in e.values:
-                        return False
-                    if e.operator == api.OP_NOT_IN and val in e.values:
-                        return False
-                    if e.operator == api.OP_EXISTS and val is None:
-                        return False
-                    if e.operator == api.OP_DOES_NOT_EXIST and val is not None:
-                        return False
-                return True
-
             MB = 2  # PDBs tracked per pod (more than 2 selecting one pod is
             # pathological; extras conservatively protect via the first two)
             GP = max(len(pdbs), 1)
@@ -1085,6 +1069,14 @@ class SnapshotEncoder:
             # and preferred terms only), so required-affinity terms are dropped
 
             exist_group = np.full(E, -1, np.int32)
+            # absolute creation timestamps (f64) back the incremental
+            # existing-fold: exist_start can be re-based exactly when the
+            # oldest pod changes
+            exist_creation_abs = np.zeros(E, np.float64)
+            if e_real:
+                exist_creation_abs[:e_real] = [
+                    d["creation"] for d in exist_rows
+                ]
             native.fill_scalars(exist_prio, [d["prio"] for d in exist_rows])
             native.fill_scalars(exist_group, [d["gid"] for d in exist_rows])
             native.fill_scalars(
@@ -1129,7 +1121,9 @@ class SnapshotEncoder:
                         int(x) for x in d["ports"]
                     )
 
-            MUP = _pad_dim(max([len(u) for u in used_ports] + [1]), 4)
+            MUP = self._stick(
+                "MUP", _pad_dim(max([len(u) for u in used_ports] + [1]), 4)
+            )
             node_used_ports = np.full((N, MUP), -1, np.int32)
             for i, u in enumerate(used_ports):
                 if u:
@@ -1149,11 +1143,11 @@ class SnapshotEncoder:
                     np.where(starts, np.arange(sn.size), 0)
                 )
                 col = np.arange(sn.size) - group_start
-                MPN = _pad_dim(int(col.max()) + 1, 8)
+                MPN = self._stick("MPN", _pad_dim(int(col.max()) + 1, 8))
                 node_pods = np.full((N, MPN), -1, np.int32)
                 node_pods[sn, col] = se
             else:
-                MPN = _pad_dim(1, 8)
+                MPN = self._stick("MPN", _pad_dim(1, 8))
                 node_pods = np.full((N, MPN), -1, np.int32)
 
             # ---- topology domains (flat ids across keys) ----
@@ -1318,6 +1312,11 @@ class SnapshotEncoder:
                 "sel_exprs": sel_exprs,
                 "imgset_sizes": imgset_sizes,
                 "group_existing_count": group_existing_count,
+                # incremental existing-fold support (_try_fold_existing)
+                "exist_group": exist_group,
+                "exist_creation_abs": exist_creation_abs,
+                "start_base": start_base,
+                "e_real": e_real,
             }
             # strong refs keep cached id()s from being reused
             st["__refs"] = (list(nodes), [p for p, _ in existing],
@@ -1325,6 +1324,15 @@ class SnapshotEncoder:
                             list(pdbs))
             self._stable_key = stable_key
             self._stable = st
+
+        # the device-carry regime key: the [P,N] static base + [S,P]
+        # matched-pending depend on pod rows x node tables x volumes x
+        # interning dims — NOT on the existing-pod set or PDBs (the one
+        # existing coupling, NodePorts' used-port mask, is repaired by
+        # dirty-marking port-bearing pending pods on every existing-fold).
+        # Callers key CarryKeeper on THIS instead of _stable_key so a
+        # bound-pod fold does not trigger a full carry rebuild.
+        self._carry_key = (stable_key[0], stable_key[2]) + stable_key[4:]
 
         node_alloc = st["node_alloc"]
         node_requested = st["node_requested"]
@@ -1757,17 +1765,27 @@ class SnapshotEncoder:
         ClusterSnapshot whose array fields are views into them, and
         `dirty` names the rewritten pod slots (None = full rebuild)."""
         ds = self._delta_state
-        if (
-            ds is not None
-            and self._arena_spec is not None
-            and self._delta_precheck(
+        if ds is not None and self._arena_spec is not None:
+            ok = self._delta_precheck(
                 ds, nodes, existing, pvcs, pvs, storage_classes, pdbs
             )
-        ):
-            out = self._encode_delta(ds, pending, pod_groups, mutated_ids)
-            if out is not None:
-                self.delta_hits += 1
-                return out
+            if not ok and self._stable_except_existing_ok(
+                ds, nodes, pvcs, pvs, storage_classes, pdbs
+            ):
+                # ONLY the existing set changed — the per-cycle event of
+                # real serving (bindings fold in; a completion batch
+                # drops the tail). Try the incremental stable fold.
+                import time as _time
+
+                _ft = _time.perf_counter()
+                ok = self._try_fold_existing(ds, existing)
+                if ok:
+                    self._fold_ms = (_time.perf_counter() - _ft) * 1e3
+            if ok:
+                out = self._encode_delta(ds, pending, pod_groups, mutated_ids)
+                if out is not None:
+                    self.delta_hits += 1
+                    return out
         self.full_encodes += 1
         # a bailed delta leaves partial segment marks behind; an empty
         # profile is the "this encode took the full path" signal
@@ -1781,18 +1799,28 @@ class SnapshotEncoder:
     def _delta_precheck(
         self, ds, nodes, existing, pvcs, pvs, storage_classes, pdbs
     ) -> bool:
+        if not self._stable_except_existing_ok(
+            ds, nodes, pvcs, pvs, storage_classes, pdbs
+        ):
+            return False
+        if ds["exist_ids"] != (id(existing), len(existing)):
+            new = tuple((id(p), nm) for p, nm in existing)
+            if new != ds["exist_elems"]:
+                # stash for _try_fold_existing so the fold does not
+                # rebuild the same O(E) tuple a second time
+                self._exist_probe = (id(existing), new)
+                return False
+        return True
+
+    def _stable_except_existing_ok(
+        self, ds, nodes, pvcs, pvs, storage_classes, pdbs
+    ) -> bool:
         if not getattr(self, "_arena_synced", False):
             return False  # a direct encode() superseded the arena contents
         if ds["pads"][:2] != (self.pad_pods, self.pad_nodes):
             return False
         if ds["nodes_ids"] != (id(nodes), len(nodes)):
             if tuple(id(nd) for nd in nodes) != ds["nodes_elems"]:
-                return False
-        if ds["exist_ids"] != (id(existing), len(existing)):
-            if (
-                tuple((id(p), nm) for p, nm in existing)
-                != ds["exist_elems"]
-            ):
                 return False
         if ds["vol_ids"] != (
             id(pvcs), len(pvcs), id(pvs), len(pvs),
@@ -1814,6 +1842,274 @@ class SnapshotEncoder:
             return False
         return True
 
+    def _try_fold_existing(self, ds, existing) -> bool:
+        """Incremental existing-set fold (SURVEY §4 realism; VERDICT r4
+        item 3): bring the cached stable side up to date IN PLACE when the
+        existing set changed by a pure APPEND (pods bound since the last
+        cycle) or a pure TAIL REMOVAL (un-folding a completion batch of
+        recently bound pods). Anything else — middle-of-list removals,
+        node/volume/PDB changes, dict growth, arena-dim overflow, pods the
+        native parser does not cover — returns False and the caller takes
+        the full encode (which rebuilds the stable cache from scratch, so
+        partial st mutations on a failed fold are discarded wholesale
+        along with the stale _stable_key).
+
+        Exactness contract: after a successful fold, every st array is
+        byte-identical to what a from-scratch assembly over the new
+        existing list would produce (the packed-encoder differential
+        tests drive exactly this equivalence), and _stable_key is updated
+        so a later full encode with the same inputs REUSES the folded st.
+        The device carry stays valid (keyed on _carry_key, which excludes
+        the existing set); the one static coupling — NodePorts' used-port
+        mask — is repaired by marking every port-bearing pending slot
+        dirty, which the carry-update program then recomputes."""
+        from .. import native
+
+        if native.pod_rows_into is None:
+            return False
+        st = getattr(self, "_stable", None)
+        if st is None or "exist_creation_abs" not in st:
+            return False
+        old = ds["exist_elems"]
+        probe = getattr(self, "_exist_probe", None)
+        if probe is not None and probe[0] == id(existing):
+            new = probe[1]
+            self._exist_probe = None
+        else:
+            new = tuple((id(p), nm) for p, nm in existing)
+        if new == old:  # same elements, rebuilt list object
+            ds["exist_ids"] = (id(existing), len(existing))
+            return True
+        n_old, n_new = len(old), len(new)
+        if n_new > n_old and new[:n_old] == old:
+            pass  # pure append
+        elif n_new < n_old and old[:n_new] == new:
+            pass  # pure tail removal
+        else:
+            return False
+        L = min(n_old, n_new)
+        exist_req = st["exist_req"]
+        E = exist_req.shape[0]
+        if n_new > E:
+            return False  # E pad exhausted: full path grows the regime
+        dims = ds["dims"]
+        exist_node = st["exist_node"]
+        exist_ports = st["exist_ports"]
+        exist_group = st["exist_group"]
+        ca = st["exist_creation_abs"]
+        affected_nodes: set[int] = set()
+        port_nodes: set[int] = set()
+
+        if n_new < n_old:  # ---- tail removal ----
+            sl = np.arange(L, n_old)
+            en = exist_node[sl]
+            m = en >= 0
+            g = exist_group[sl]
+            np.subtract.at(st["group_existing_count"], g[g >= 0], 1)
+            affected_nodes.update(int(x) for x in en[m])
+            port_nodes.update(
+                int(n) for n, p0 in zip(en, exist_ports[sl, 0])
+                if n >= 0 and p0 >= 0
+            )
+            # restore full-path pad values so the arena stays
+            # byte-identical to a fresh assembly
+            exist_req[sl] = 0.0
+            st["el_keys"][sl] = -1
+            st["el_vals"][sl] = -1
+            exist_ports[sl] = -1
+            st["exist_anti"][sl] = -1
+            st["exist_pref"][sl] = -1
+            st["exist_pref_w"][sl] = 0.0
+            st["exist_prio"][sl] = 0
+            st["exist_pdb"][sl] = -1
+            st["exist_start"][sl] = 0.0
+            exist_node[sl] = -1
+            exist_group[sl] = -1
+            ca[sl] = 0.0
+            st["exist_valid"][sl] = False
+            # node_requested: f32 subtract is NOT the exact inverse of
+            # the full path's slot-ascending add accumulation — recompute
+            # the affected nodes' sums from their remaining member rows
+            # in the same ascending-slot order, so the result stays
+            # bitwise equal to a from-scratch assembly
+            if affected_nodes:
+                nr = st["node_requested"]
+                an0 = np.fromiter(affected_nodes, np.int64)
+                nr[an0] = 0.0
+                en_rem = exist_node[:n_new]
+                sel0 = np.isin(en_rem, an0)
+                mem = np.flatnonzero(sel0)  # ascending slots
+                if mem.size:
+                    np.add.at(nr, en_rem[mem], exist_req[mem])
+        else:  # ---- pure append ----
+            slots = np.arange(L, n_new, dtype=np.int64)
+            app = existing[L:]
+            specs = ds.get("exist_specs")
+            if specs is None or specs[0][0] is not exist_req:
+                specs = [
+                    (exist_req, "reqvec", 0.0, 0),
+                    (st["el_keys"], "lab_k", -1, 0),
+                    (st["el_vals"], "lab_v", -1, 0),
+                    (exist_ports, "ports", -1, 0),
+                    (st["exist_anti"].reshape(E, -1), "anti", -1, 0),
+                    (st["exist_pref"].reshape(E, -1), "pref", -1, 0),
+                    (st["exist_pref_w"], "pref_w", 0.0, 0),
+                    (st["exist_prio"], "prio", 0, 1),
+                    (exist_group, "gid", 0, 1),
+                    (ca, "creation", 0.0, 1),
+                ]
+                ds["exist_specs"] = specs
+            flag_aff, flag_tsc, _fv, _fm = ds["flags"]
+            limits = {
+                "MPL": dims["MPL"], "MA": dims["MA"],
+                # MEP (existing-pod port width), not the pending MPorts
+                "MPorts": exist_ports.shape[1],
+                "MC": 1 << 30,  # exist rows carry no tsc columns
+                "R": dims["R"],
+                "flag_aff": int(flag_aff),
+                # spread counts come from labels, not the existing pod's
+                # own constraints — tsc-bearing bound pods are fine
+                "flag_tsc": 1,
+            }
+            lens0 = self._table_lens()
+            guard_ok, res = native.pod_rows_into(
+                [p for p, _ in app], self._native_ctx(), slots, specs,
+                limits,
+            )
+            if not guard_ok or any(r is None for r in res):
+                return False  # dims overflow / unsupported pod
+            if self._table_lens() != lens0:
+                return False  # interning grew: finalize tables stale
+            nidx = ds["node_index"]
+            en_new = np.array(
+                [nidx.get(nm, -1) for _, nm in app], np.int32
+            )
+            exist_node[slots] = en_new
+            st["exist_valid"][slots] = True
+            m = en_new >= 0
+            np.add.at(st["node_requested"], en_new[m], exist_req[slots][m])
+            g = exist_group[slots]
+            np.add.at(st["group_existing_count"], g[g >= 0], 1)
+            affected_nodes.update(int(x) for x in en_new[m])
+            port_nodes.update(
+                int(n) for n, s in zip(en_new, slots)
+                if n >= 0 and exist_ports[s, 0] >= 0
+            )
+            pdbs = st["__refs"][5]
+            if pdbs:
+                MB = st["exist_pdb"].shape[1]
+                for j, (p, _nm) in enumerate(app):
+                    b = 0
+                    row = st["exist_pdb"][L + j]
+                    for gi, pdb in enumerate(pdbs):
+                        if b >= MB:
+                            break
+                        if _pdb_matches(pdb, p):
+                            row[b] = gi
+                            b += 1
+
+        # ---- used-port lists of affected nodes (rebuilt exactly as the
+        # full path builds them: member slots ascending, ports in row
+        # order) ----
+        if port_nodes:
+            if len(port_nodes) > 256:
+                return False  # pathological: cheaper as a full encode
+            nup = st["node_used_ports"]
+            MUP = nup.shape[1]
+            en_all = exist_node[:n_new]
+            for n in port_nodes:
+                members = np.flatnonzero(en_all == n)
+                ports_concat = [
+                    int(x) for s in members for x in exist_ports[s]
+                    if x >= 0
+                ]
+                if len(ports_concat) > MUP:
+                    return False
+                nup[n] = -1
+                if ports_concat:
+                    nup[n, : len(ports_concat)] = ports_concat
+
+        # ---- victim table rows of affected nodes (same lexsort key as
+        # the full path, restricted to those nodes) ----
+        if affected_nodes:
+            npods = st["node_pods"]
+            MPN = npods.shape[1]
+            an = np.fromiter(affected_nodes, np.int64)
+            en_all = exist_node[:n_new]
+            sel = np.isin(en_all, an)
+            e_ids = np.flatnonzero(sel)
+            npods[an] = -1
+            if e_ids.size:
+                order_v = np.lexsort(
+                    (-e_ids, st["exist_prio"][e_ids], en_all[e_ids])
+                )
+                se = e_ids[order_v].astype(np.int32)
+                sn = en_all[se]
+                starts = np.r_[True, sn[1:] != sn[:-1]]
+                group_start = np.maximum.accumulate(
+                    np.where(starts, np.arange(sn.size), 0)
+                )
+                col = np.arange(sn.size) - group_start
+                if int(col.max()) >= MPN:
+                    return False  # a node outgrew the victim-table width
+                npods[sn, col] = se
+
+        # ---- start times: re-base exactly when the oldest pod changed
+        # (full assembly computes base = min over the live set) ----
+        newbase = float(ca[:n_new].min()) if n_new else 0.0
+        if newbase != st["start_base"]:
+            st["exist_start"][:n_new] = (
+                ca[:n_new] - newbase
+            ).astype(np.float32)
+            st["start_base"] = newbase
+        elif n_new > n_old:
+            sl2 = np.arange(L, n_new)
+            st["exist_start"][sl2] = (ca[sl2] - newbase).astype(np.float32)
+        st["e_real"] = n_new
+
+        # ---- mirror into the packed arena ----
+        A = self._arena
+        lo, hi = L, max(n_old, n_new)
+        rng = slice(lo, hi)
+        for arena_name, st_name in (
+            ("exist_requested", "exist_req"),
+            ("exist_label_keys", "el_keys"),
+            ("exist_label_vals", "el_vals"),
+            ("exist_ports", "exist_ports"),
+            ("exist_anti_terms", "exist_anti"),
+            ("exist_pref_aff", "exist_pref"),
+            ("exist_pref_aff_w", "exist_pref_w"),
+            ("exist_node", "exist_node"),
+            ("exist_priority", "exist_prio"),
+            ("exist_pdb", "exist_pdb"),
+            ("exist_valid", "exist_valid"),
+        ):
+            A[arena_name][rng] = st[st_name][rng]
+        A["exist_start"][:] = st["exist_start"]
+        A["node_requested"][:] = st["node_requested"]
+        A["node_pods"][:] = st["node_pods"]
+        A["node_used_ports"][:] = st["node_used_ports"]
+        A["group_existing_count"][:] = st["group_existing_count"]
+        A["num_existing"][...] = n_new
+
+        # ---- commit identity bookkeeping ----
+        refs = st["__refs"]
+        st["__refs"] = (
+            refs[0], [p for p, _ in existing], refs[2], refs[3], refs[4],
+            refs[5],
+        )
+        k = self._stable_key
+        self._stable_key = (k[0], new) + k[2:]
+        ds["exist_ids"] = (id(existing), len(existing))
+        ds["exist_elems"] = new
+        # NodePorts static rows read node_used_ports: when the fold
+        # actually touched a used-port list, recompute the carry rows of
+        # every port-bearing pending slot this cycle
+        if port_nodes:
+            ds["fold_port_dirty"] = True
+        self.fold_hits = getattr(self, "fold_hits", 0) + 1
+        return True
+
     def _encode_delta(self, ds, pending, pod_groups, mutated_ids):
         """The fast path: rewrite only changed pod slots in the arena.
         Returns None to request a full encode (any partial bookkeeping it
@@ -1828,6 +2124,10 @@ class SnapshotEncoder:
 
         _t0 = _time.perf_counter()
         _prof = self.delta_profile = {}
+        fold_ms = getattr(self, "_fold_ms", None)
+        if fold_ms is not None:
+            _prof["fold"] = fold_ms
+            self._fold_ms = None
 
         def _mark(name):
             nonlocal _t0
@@ -1852,6 +2152,14 @@ class SnapshotEncoder:
             i for i in range(p_real)
             if ids[i] != id(pending[i]) or ids[i] in mutated_ids
         ]
+        if ds.pop("fold_port_dirty", False):
+            # an existing-fold changed node_used_ports; NodePorts static
+            # rows of port-bearing pending pods must reach the carry
+            # update, so their slots join the dirty set (their arena
+            # rewrite is a byte-identical no-op)
+            extra = [i for i in ds["port_set"] if i < p_real]
+            if extra:
+                dirty = sorted(set(dirty) | set(extra))
         _mark("detect")
         rowdata = ds["pod_rowdata"]
         lens0 = self._table_lens()
@@ -2061,6 +2369,28 @@ class SnapshotEncoder:
             self._arena_w, self._arena_b, self._arena_spec,
             self._arena_snap, None,
         )
+
+
+def _pdb_matches(pdb: api.PodDisruptionBudget, p: Pod) -> bool:
+    """Does `pdb`'s selector cover pod `p`? Shared by the full stable
+    assembly and the incremental existing-fold."""
+    if p.namespace != pdb.namespace:
+        return False
+    sel = pdb.selector
+    for k, v in sel.match_labels.items():
+        if p.metadata.labels.get(k) != v:
+            return False
+    for e in sel.match_expressions:
+        val = p.metadata.labels.get(e.key)
+        if e.operator == api.OP_IN and val not in e.values:
+            return False
+        if e.operator == api.OP_NOT_IN and val in e.values:
+            return False
+        if e.operator == api.OP_EXISTS and val is None:
+            return False
+        if e.operator == api.OP_DOES_NOT_EXIST and val is not None:
+            return False
+    return True
 
 
 def _aff(p: Pod) -> Affinity:

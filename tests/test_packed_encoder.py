@@ -241,3 +241,97 @@ def test_fused_guard_overflow_falls_back_to_full():
     # subsequent delta over the grown arena still works
     pods[4] = make_pods(1, seed=101, name_prefix="after")[0]
     d.step(nodes, pods)
+
+
+def test_fold_existing_append_tail_remove_and_rebase():
+    """Round-5 incremental existing-fold: appending bound pods and
+    removing a completion batch (tail) must update the stable side IN
+    PLACE (no full encode) and stay byte-identical to a from-scratch
+    assembly — including the NodePorts used-port lists, the node_pods
+    victim table, and an exist_start re-base when an appended pod is
+    older than every existing one."""
+    from k8s_scheduler_tpu import native
+
+    if native.pod_rows_into is None:
+        pytest.skip("native extension not built")
+    nodes = make_cluster(8)
+    d = Driver()
+    pods = make_pods(40, seed=21, affinity_fraction=0.2, num_apps=5)
+    # one pending pod with a host port (exercises the port-dirty repair)
+    pods[7] = (
+        MakePod("portpod").req({"cpu": "100m"}).host_port(8080).obj()
+    )
+    existing = [
+        (p, f"node-{i % 8}")
+        for i, p in enumerate(
+            make_pods(20, seed=22, name_prefix="run", num_apps=5)
+        )
+    ]
+    d.step(nodes, pods, existing)
+    d.step(nodes, pods, existing)  # warm the delta path
+    folds0 = getattr(d.a, "fold_hits", 0)
+    fulls0 = d.a.full_encodes
+
+    # ---- bindings fold in (append), one of them port-bearing ----
+    bound = [(pods[i], f"node-{i % 8}") for i in range(6)]
+    bound.append(
+        (MakePod("bport").req({"cpu": "100m"}).host_port(9090).obj(), "node-3")
+    )
+    existing2 = existing + bound
+    pending2 = pods[6:] + make_pods(5, seed=31, name_prefix="arr", num_apps=5)
+    d.step(nodes, pending2, existing2)
+    assert d.a.fold_hits == folds0 + 1
+    assert d.a.full_encodes == fulls0
+
+    # ---- completion batch: the appended tail leaves ----
+    existing3 = existing2[: len(existing)]
+    d.step(nodes, pending2, existing3)
+    assert d.a.fold_hits == folds0 + 2
+    assert d.a.full_encodes == fulls0
+
+    # ---- re-base: an appended pod OLDER than every existing pod ----
+    old_pod = (
+        MakePod("ancient").req({"cpu": "100m"}).created(-1000.0).obj()
+    )
+    existing4 = existing3 + [(old_pod, "node-1")]
+    d.step(nodes, pending2, existing4)
+    assert d.a.fold_hits == folds0 + 3
+    assert d.a.full_encodes == fulls0
+
+    # ---- middle-of-list removal: NOT foldable, full path, still exact
+    existing5 = existing4[1:]
+    d.step(nodes, pending2, existing5)
+    assert d.a.full_encodes == fulls0 + 1
+
+
+def test_fold_unfold_float_exactness_under_inexact_requests():
+    """f32-rounding stress for the fold/un-fold node_requested recompute:
+    0.1-core requests are inexact in float32, so a subtract-based un-fold
+    would drift by ULPs from a from-scratch assembly. Repeated
+    fold/evict cycles must stay byte-identical (the Driver compares
+    every array)."""
+    from k8s_scheduler_tpu import native
+
+    if native.pod_rows_into is None:
+        pytest.skip("native extension not built")
+    nodes = make_cluster(4)
+    d = Driver()
+    pods = [
+        MakePod(f"t-{i}").req({"cpu": "100m", "memory": "100Mi"}).obj()
+        for i in range(24)
+    ]
+    existing = [
+        (MakePod(f"r-{i}").req({"cpu": "100m"}).obj(), f"node-{i % 4}")
+        for i in range(12)
+    ]
+    d.step(nodes, pods, existing)
+    d.step(nodes, pods, existing)
+    for round_ in range(3):
+        bound = [
+            (pods[round_ * 4 + j], f"node-{j % 4}") for j in range(4)
+        ]
+        existing = existing + bound
+        d.step(nodes, pods, existing)
+        existing = existing[:12]  # completion batch
+        d.step(nodes, pods, existing)
+    assert d.a.fold_hits >= 6
