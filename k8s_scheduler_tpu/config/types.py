@@ -71,6 +71,15 @@ class Extender:
     http_timeout_seconds: float = 5.0
     # errors from an ignorable extender don't fail the pod's attempt
     ignorable: bool = False
+    # operator assertion that this extender's Filter/Prioritize verdicts
+    # depend only on (pod, node set) — i.e. are DETERMINISTIC per pod.
+    # When every configured extender sets this, the scheduler keeps the
+    # device-carry latency path: verdict rows live on device and only
+    # CHANGED pods re-consult the webhook each cycle (PERF.md "Extenders
+    # and the carry path"). Off by default: upstream extenders may be
+    # stateful, and those must be re-consulted for every pod each cycle
+    # (the full-path behavior).
+    carry_verdicts: bool = False
 
 
 @dataclass
@@ -212,6 +221,7 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
                     e.get("httpTimeout", 5.0)
                 ),
                 ignorable=e.get("ignorable", False),
+                carry_verdicts=e.get("carryVerdicts", False),
             )
             for e in data.get("extenders", [])
         ],

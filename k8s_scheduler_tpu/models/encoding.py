@@ -1790,6 +1790,7 @@ class SnapshotEncoder:
         # a bailed delta leaves partial segment marks behind; an empty
         # profile is the "this encode took the full path" signal
         self.delta_profile = {}
+        self.last_changed_slots = None  # full path: everything changed
         snap = self.encode(
             nodes, pending, existing, pod_groups, pvcs, pvs,
             storage_classes, pdbs,
@@ -2152,6 +2153,10 @@ class SnapshotEncoder:
             i for i in range(p_real)
             if ids[i] != id(pending[i]) or ids[i] in mutated_ids
         ]
+        # consumers that track POD-CONTENT changes (the extender-verdict
+        # carry) read this instead of the returned dirty set, which may
+        # be inflated by the port-repair slots below
+        self.last_changed_slots = np.asarray(dirty, np.int32)
         if ds.pop("fold_port_dirty", False):
             # an existing-fold changed node_used_ports; NodePorts static
             # rows of port-bearing pending pods must reach the carry

@@ -440,13 +440,20 @@ def run_preemption(
         )
 
         # ---- pickOneNodeForPreemption: lexicographic minimization ----
+        # row picks via one-hot masked sums, NOT take_along_axis: an
+        # arbitrary [N]-gather costs ~50us on this backend and the loop
+        # pays it per step x4; the masked reduce over the tiny MPN axis
+        # fuses into the surrounding elementwise work
+        def pick1(tab, idx):  # tab [N, W], idx [N] -> tab[n, idx[n]]
+            pos = jnp.arange(tab.shape[1], dtype=jnp.int32)[None, :]
+            return jnp.sum(
+                jnp.where(pos == idx[:, None], tab, 0), axis=1
+            )
+
         last = jnp.clip(k_min - 1, 0, MPN - 1)
-        max_vict_prio = jnp.take_along_axis(
-            vict_prio, last[:, None], axis=1
-        )[:, 0]  # priority of the highest (last-in-prefix) victim
-        sum_vict_prio = (
-            jnp.take_along_axis(prefix_prio, k_min[:, None], axis=1)[:, 0]
-            - jnp.take_along_axis(prefix_prio, k_claimed[:, None], axis=1)[:, 0]
+        max_vict_prio = pick1(vict_prio, last)
+        sum_vict_prio = pick1(prefix_prio, k_min) - pick1(
+            prefix_prio, k_claimed
         )
         n_vict = k_min - k_claimed
 
@@ -459,7 +466,7 @@ def run_preemption(
         best = lexmin(best, n_vict)
         # upstream: prefer the node whose highest victim started LATEST
         # (evict younger pods); minimize the negated start time
-        hi_start = jnp.take_along_axis(vict_start, last[:, None], axis=1)[:, 0]
+        hi_start = pick1(vict_start, last)
         best = lexmin(best, -hi_start, big=jnp.float32(jnp.inf))
         b = jnp.argmax(best).astype(jnp.int32)  # lowest node index among ties
 
@@ -500,10 +507,28 @@ def run_preemption(
         jnp.zeros(GP, jnp.int32),
         jnp.zeros((N, Q), bool),
     )
-    (_, _, victims, _, _), (pods, noms) = jax.lax.scan(
-        step, init, jnp.arange(C2, dtype=jnp.int32)
+    # the serialization loop runs only over LIVE candidates: sel2 sorts
+    # feasible candidates first (infeasible keys are _BIG_I32), so ranks
+    # >= n_live are guaranteed no-ops (live2 False -> no claim, no
+    # nomination) and a while_loop bounded by n_live skips them. At
+    # config #4 that is ~19 latency-bound steps instead of scan_budget
+    # (64) — each dead step cost ~0.2 ms on TPU.
+    n_live = jnp.sum(live2).astype(jnp.int32)
+    pods0 = cand_ids2  # rank -> pod id is static; dead ranks emit -1
+    noms0 = jnp.full(C2, -1, jnp.int32)
+
+    def w_cond(st):
+        return st[0] < n_live
+
+    def w_body(st):
+        rank, carry, noms_acc = st
+        carry, (_p, nom_p) = step(carry, rank)
+        return rank + 1, carry, noms_acc.at[rank].set(nom_p)
+
+    _, (_, _, victims, _, _), noms = jax.lax.while_loop(
+        w_cond, w_body, (jnp.int32(0), init, noms0)
     )
-    nominated = jnp.full(P, -1, jnp.int32).at[pods].max(noms)
+    nominated = jnp.full(P, -1, jnp.int32).at[pods0].max(noms)
     return PreemptionResult(
         nominated=nominated,
         victims=victims & snap.exist_valid,

@@ -1,0 +1,59 @@
+"""Round-5 baseline: device time of the config-#4 decision chain pieces
+(carry cycle, preemption, diagnosis) separately and chained.
+
+Run:  python scripts/probe_chain5.py
+"""
+import sys, time
+sys.path.insert(0, ".")
+import jax
+
+from k8s_scheduler_tpu.utils.compilation_cache import enable_compilation_cache
+
+enable_compilation_cache()
+import numpy as np
+from bench_suite import make_config_base, make_config_workload, _pad
+from devtime import devtime
+from k8s_scheduler_tpu.core import (
+    build_diagnosis_fn,
+    build_packed_cycle_carry_fn,
+    build_packed_preemption_fn,
+    build_stable_state_fn,
+)
+from k8s_scheduler_tpu.core.cycle import CarryKeeper
+from k8s_scheduler_tpu.models import SnapshotEncoder
+
+enc = SnapshotEncoder(pad_pods=_pad(10000), pad_nodes=_pad(5000))
+bn, be = make_config_base(4)
+_n, pods, _e, groups = make_config_workload(4, seed=1000)
+w, b, spec, snap, dirty = enc.encode_packed(bn, pods, be, groups)
+w = jax.device_put(np.asarray(w)); b = jax.device_put(np.asarray(b))
+t0 = time.perf_counter()
+stable_fn = build_stable_state_fn(spec)
+stable = stable_fn(w, b)
+keeper = CarryKeeper(spec)
+carry = keeper.ci(w, b, stable)
+cyc = build_packed_cycle_carry_fn(spec)
+pre = build_packed_preemption_fn(spec)
+diag = build_diagnosis_fn(spec)
+out = cyc(w, b, stable, carry)
+op = pre(w, b, out, stable)
+np.asarray(op.nominated)
+print(f"compile+warm {time.perf_counter()-t0:.0f}s", flush=True)
+
+print(f"stable_fn    : {devtime(lambda: stable_fn(w, b), reps=8)*1e3:7.1f} ms")
+print(f"cycle        : {devtime(lambda: cyc(w, b, stable, carry), reps=8)*1e3:7.1f} ms")
+print(f"preempt      : {devtime(lambda: pre(w, b, out, stable), reps=8)*1e3:7.1f} ms")
+print(f"diag         : {devtime(lambda: diag(w, b, stable, out.assignment, out.node_requested, out.pv_claimed), reps=8)*1e3:7.1f} ms")
+
+def chain():
+    o = cyc(w, b, stable, carry)
+    return pre(w, b, o, stable)
+
+print(f"cycle+preempt: {devtime(chain, reps=8)*1e3:7.1f} ms")
+
+# carry-update program (the per-cycle dirty-row cost in serving)
+idx = np.zeros(keeper.bucket, np.int32)
+cu = keeper._cu(keeper.bucket)
+c2 = cu(w, b, stable, carry, idx)
+np.asarray(next(iter(c2.values())))[:1] if isinstance(c2, dict) else None
+print(f"carry-update : {devtime(lambda: cu(w, b, stable, carry, idx), reps=8)*1e3:7.1f} ms (bucket {keeper.bucket})")

@@ -1,0 +1,34 @@
+"""Device cost of the extender-verdict carry at config #4: the carry
+cycle with device-resident verdict arrays (unchanged verdicts) vs the
+plain carry cycle. Run: python scripts/probe_extender_carry5.py"""
+import sys
+sys.path.insert(0, ".")
+sys.path.insert(0, "scripts")
+import jax
+from k8s_scheduler_tpu.utils.compilation_cache import enable_compilation_cache
+enable_compilation_cache()
+import numpy as np
+from bench_suite import make_config_base, make_config_workload, _pad
+from devtime import devtime
+from k8s_scheduler_tpu.core import build_packed_cycle_carry_fn, build_stable_state_fn
+from k8s_scheduler_tpu.core.cycle import CarryKeeper
+from k8s_scheduler_tpu.models import SnapshotEncoder
+
+enc = SnapshotEncoder(pad_pods=_pad(10000), pad_nodes=_pad(5000))
+bn, be = make_config_base(4)
+_n, pods, _e, groups = make_config_workload(4, seed=1000)
+w, b, spec, snap, dirty = enc.encode_packed(bn, pods, be, groups)
+w = jax.device_put(np.asarray(w)); b = jax.device_put(np.asarray(b))
+stable = build_stable_state_fn(spec)(w, b)
+keeper = CarryKeeper(spec)
+carry = keeper.ci(w, b, stable)
+P = carry["sbase"].shape[0]; N = carry["sbase"].shape[1]
+cyc = build_packed_cycle_carry_fn(spec)
+cyc_e = build_packed_cycle_carry_fn(spec, extender_args=True)
+em = jax.device_put(np.ones((P, N), bool))
+es = jax.device_put(np.zeros((P, N), np.float32))
+a0 = np.asarray(cyc(w, b, stable, carry).assignment)
+a1 = np.asarray(cyc_e(w, b, stable, carry, em, es).assignment)
+print("all-pass extender == plain:", bool((a0 == a1).all()))
+print(f"plain carry cycle   : {devtime(lambda: cyc(w, b, stable, carry), reps=8)*1e3:7.1f} ms")
+print(f"extender-carry cycle: {devtime(lambda: cyc_e(w, b, stable, carry, em, es), reps=8)*1e3:7.1f} ms")

@@ -84,14 +84,34 @@ def main():
 
     hist = Counter((op, shape) for shape, op in colls)
     total_bytes = 0
+    max_elems = 0
+    claim_sort_ags = 0
     print(f"P={P} N={N} collectives={len(colls)}")
     for (op, shape), n in sorted(hist.items(), key=lambda kv: -kv[1]):
-        m = re.findall(r"(\d+)", shape.split("[")[-1])
-        elems = int(np.prod([int(x) for x in m])) if m else 0
+        # per-bracket-group product, max over groups (tuple shapes have
+        # several); NOT a flat digit scan — the '{0}' layout suffix would
+        # zero the product and trivially pass the payload assertions
+        elems = max(
+            (
+                int(np.prod([int(x) for x in g.split(",")]))
+                for g in re.findall(r"\[([\d,]+)\]", shape)
+            ),
+            default=0,
+        )
         bytes_ = elems * (2 if "bf16" in shape else 4)
         total_bytes += n * bytes_
+        max_elems = max(max_elems, elems)
+        if op.startswith("all-gather") and f"[{P}," in shape:
+            # the per-pass claim sort's replicated tiny [P, k] gathers —
+            # linear in P, watched because they are the one P-scaling
+            # collective left (VERDICT r4 weak #5)
+            claim_sort_ags += n
         print(f"  {n:3d} x {op:20s} {shape}  (~{bytes_/1e3:.1f} KB each)")
     print(f"approx collective payload total: {total_bytes/1e6:.2f} MB")
+    print(f"max single-collective payload: {max_elems} elems "
+          f"({max_elems * 4 / 1e6:.2f} MB at f32)")
+    print(f"P-scaling claim-sort all-gathers (s32[{P},k]-class): "
+          f"{claim_sort_ags}")
 
     # did the big tensors stay partitioned? look for full-size [P,N]
     # parameters/fusions vs [P/8, N]
@@ -99,7 +119,19 @@ def main():
     part = hlo.count(f"f32[{P//8},{N}]")
     print(f"f32[{P},{N}] occurrences (replicated-size): {full}")
     print(f"f32[{P//8},{N}] occurrences (partitioned-size): {part}")
+    # the defining bounds (asserted, not just printed): nothing moves the
+    # [P,N] static base, and no collective exceeds a [B,N]-round payload
+    assert full == 0 or max_elems < P * N, (
+        f"a collective moves ~[P,N]: max {max_elems} elems"
+    )
+    bound = 2 * max(1280 * N, 64 * N)  # [B,N] round all-reduce class
+    assert max_elems <= bound, (
+        f"collective payload {max_elems} exceeds the [B,N] bound {bound}"
+    )
 
+    if os.environ.get("PROBE_COMPILE_ONLY") == "1":
+        print("compile-only audit PASSED (payload bounds asserted)")
+        return
     out = cyc(w_r, b_r, stable_r, carry_sh)
     a_sh = np.asarray(out.assignment)
     out2 = cyc(w, b, stable, carry)

@@ -1,0 +1,82 @@
+"""Fusion-level TPU trace of the config-#4 preemption program."""
+import collections, glob, gzip, json, sys
+sys.path.insert(0, ".")
+import jax
+
+from k8s_scheduler_tpu.utils.compilation_cache import enable_compilation_cache
+
+enable_compilation_cache()
+import numpy as np
+from bench_suite import make_config_base, make_config_workload, _pad
+from k8s_scheduler_tpu.core import (
+    build_packed_cycle_carry_fn, build_packed_preemption_fn,
+    build_stable_state_fn,
+)
+from k8s_scheduler_tpu.core.cycle import CarryKeeper
+from k8s_scheduler_tpu.models import SnapshotEncoder
+
+
+def main():
+    enc = SnapshotEncoder(pad_pods=_pad(10000), pad_nodes=_pad(5000))
+    bn, be = make_config_base(4)
+    _n, pods, _e, groups = make_config_workload(4, seed=1000)
+    w, b, spec, snap, dirty = enc.encode_packed(bn, pods, be, groups)
+    w = jax.device_put(np.asarray(w))
+    b = jax.device_put(np.asarray(b))
+    cycle = build_packed_cycle_carry_fn(spec)
+    stable = build_stable_state_fn(spec)(w, b)
+    keeper = CarryKeeper(spec)
+    carry = keeper.ci(w, b, stable)
+    out = cycle(w, b, stable, carry)
+    pre = build_packed_preemption_fn(spec)
+    op = pre(w, b, out, stable)
+    np.asarray(op.nominated)
+
+    import shutil
+
+    shutil.rmtree("/tmp/jaxtrace5", ignore_errors=True)
+    with jax.profiler.trace("/tmp/jaxtrace5"):
+        for _ in range(3):
+            op = pre(w, b, out, stable)
+        np.asarray(op.nominated)
+
+    hlo = pre.lower(w, b, out, stable).compile().as_text()
+    src_of = {}
+    for line in hlo.splitlines():
+        line = line.strip()
+        if not line.startswith("%") or "metadata=" not in line:
+            continue
+        name = line.split(" ", 1)[0].lstrip("%")
+        m = ""
+        if 'op_name="' in line:
+            m = line.split('op_name="', 1)[1].split('"', 1)[0]
+        sf = ""
+        if 'source_file="' in line:
+            sf = line.split('source_file="', 1)[1].split('"', 1)[0]
+            if 'source_line=' in line:
+                sf += ":" + line.split("source_line=", 1)[1].split(
+                    ",", 1)[0].rstrip("} ")
+        src_of[name] = (m, sf)
+
+    files = glob.glob("/tmp/jaxtrace5/**/*.trace.json.gz", recursive=True)
+    agg = collections.Counter()
+    for f in files:
+        with gzip.open(f, "rt") as fh:
+            data = json.load(fh)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            name = ev.get("name", "")
+            dur = ev.get("dur", 0)
+            args = ev.get("args", {})
+            hname = args.get("hlo_op", name)
+            agg[hname] += dur
+    total = sum(agg.values())
+    print(f"total traced us: {total} (3 reps)")
+    for name, us in agg.most_common(30):
+        mo, sf = src_of.get(name, ("", ""))
+        print(f"{us/3:9.0f} us  {name[:46]:46s} {mo[:40]:40s} {sf[-40:]}")
+
+
+if __name__ == "__main__":
+    main()

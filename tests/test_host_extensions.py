@@ -203,3 +203,77 @@ def test_extender_error_nonignorable_backoff():
     assert stats.scheduled == 0 and not bound
     assert stats.bind_errors == 1
     assert s.queue.pending_counts().get("backoff", 0) == 1
+
+
+def test_extender_verdict_carry_matches_fallback(extender_server):
+    """VERDICT r4 item 7: carryVerdicts keeps the device-carry latency
+    path with a LIVE HTTP extender — placements must equal the fallback
+    (full-path) scheduler's over churned cycles, and after warmup the
+    webhook is consulted only for CHANGED pods."""
+    ext = {
+        "urlPrefix": extender_server,
+        "filterVerb": "filter",
+        "prioritizeVerb": "prioritize",
+        "weight": 2,
+    }
+    cfg_carry = load_config({
+        "extenders": [dict(ext, carryVerdicts=True)]
+    })
+    cfg_full = load_config({"extenders": [dict(ext)]})
+    s_carry, bound_carry = make_sched(config=cfg_carry)
+    s_full, bound_full = make_sched(config=cfg_full)
+    assert s_carry._use_carry and not s_full._use_carry
+
+    for s in (s_carry, s_full):
+        for i in range(4):
+            s.on_node_add(
+                MakeNode(f"n{i}").capacity({"cpu": "4"}).obj()
+            )
+        # a second allowed node so scoring (n1 boosted) is observable
+        s.on_node_add(MakeNode("m1").capacity({"cpu": "4"}).obj())
+
+    pods = [MakePod(f"p{i}").req({"cpu": "1"}).obj() for i in range(6)]
+    for s in (s_carry, s_full):
+        for p in pods:
+            s.on_pod_add(p)
+        s.schedule_cycle()
+    assert sorted(bound_carry.items()) == sorted(bound_full.items())
+    assert bound_carry  # extender filter left n1/m1; pods placed
+
+    # churn: one NEW pod arrives; the carried scheduler re-consults the
+    # webhook only for it (plus any requeued losers)
+    _ExtenderHandler.calls = []
+    for s, nm in ((s_carry, "fresh-a"), (s_full, "fresh-b")):
+        s.on_pod_add(MakePod(nm).req({"cpu": "1"}).obj())
+    n0_carry = len(bound_carry)
+    n0_full = len(bound_full)
+    calls_before = len(
+        [p for p, _ in _ExtenderHandler.calls if p.endswith("/filter")]
+    )
+    s_carry.schedule_cycle()
+    carry_filter_pods = {
+        b["Pod"]["metadata"]["name"]
+        for p, b in _ExtenderHandler.calls
+        if p.endswith("/filter")
+    }
+    carry_filter_calls = len(
+        [p for p, _ in _ExtenderHandler.calls if p.endswith("/filter")]
+    ) - calls_before
+    s_full.schedule_cycle()
+    assert len(bound_carry) - n0_carry == len(bound_full) - n0_full == 1
+    # exactly ONE webhook filter consult: the fresh arrival (all other
+    # verdict rows were carried on device)
+    assert carry_filter_calls == 1, carry_filter_calls
+    fresh_a = next(
+        n for u, n in bound_carry.items() if u.endswith("/fresh-a")
+    )
+    fresh_b = next(
+        n for u, n in bound_full.items() if u.endswith("/fresh-b")
+    )
+    assert fresh_a == fresh_b
+    # the carried scheduler consulted the webhook ONLY for changed pods
+    # (the fresh arrival; earlier pods' verdict rows were carried)
+    assert "fresh-a" in carry_filter_pods
+    assert not any(p.startswith("p") for p in carry_filter_pods), (
+        f"carried pods re-consulted: {carry_filter_pods}"
+    )
