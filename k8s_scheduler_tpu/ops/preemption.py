@@ -33,11 +33,14 @@ one latency-bound step per candidate, mostly no-ops.)
 
 PodDisruptionBudgets: a victim protected by a PDB whose remaining budget
 (disruptionsAllowed minus victims already claimed THIS cycle) is exhausted
-truncates the node's eligible prefix — no prefix reaching past it is
-considered, and claimed victims decrement their PDBs' budgets in the scan
-carry so one cycle never over-disrupts a budget. (Upstream prefers
-PDB-violating victims last but may still evict them; this kernel never
-does — strictly conservative.)
+is evicted only as a LAST RESORT: the per-prefix violation count is the
+FIRST node-choice key (upstream pickOneNodeForPreemption criterion #1),
+so a zero-violation node always wins, and claimed victims decrement
+their PDBs' budgets in the scan carry. Residual vs upstream (PARITY #4):
+within one node the victim set stays a priority-ascending PREFIX, while
+upstream's two-pass re-add prefers KEEPING a protected pod over an
+unprotected higher-priority one — the pod places either way; the victim
+identity can differ in mixed protected/unprotected prefixes.
 
 Tie-breaks mirror upstream pickOneNodeForPreemption: min highest-victim
 priority, min victim priority sum, min victim count, then LATEST start
@@ -63,8 +66,9 @@ the prefix's victims subtracted —
 the budgeted candidate view and excluding NodePorts (see
 core.cycle._preemption_gate_rows). Remaining deviations: victims are
 priority-order PREFIXES per node (upstream's remove/re-add minimization
-is prefix-shaped too, but can skip PDB-protected pods where this kernel
-truncates); and within one batch pass, earlier candidates' victims are
+is prefix-shaped too, except that it can skip PDB-protected pods — see
+the PARITY #4 residual above); and within one batch pass, earlier
+candidates' victims are
 reflected in capacity (k_claimed / nominated_req) but not in the
 affinity/spread count tables later candidates read — stale counts are
 conservative for anti (never evict where upstream would not) and at
@@ -198,16 +202,13 @@ def run_preemption(
         vict_valid[None, :, :] & (vict_prio[None, :, :] < prio_c[:, None, None]),
         axis=2,
     ).astype(jnp.int32)  # [C, N]
-    prot0 = jnp.zeros(vict_valid.shape, bool)
-    for b in range(MB):
-        g = vict_pdb[:, :, b]
-        prot0 |= (g >= 0) & (snap.pdb_allowed[jnp.clip(g, 0, GP - 1)] <= 0)
-    prot0 &= vict_valid
-    pos_row = jnp.arange(MPN, dtype=jnp.int32)[None, :]
-    first_prot0 = jnp.min(
-        jnp.where(prot0, pos_row, MPN), axis=1
-    ).astype(jnp.int32)  # [N]
-    elig0 = jnp.minimum(elig_cn, first_prot0[None, :])  # [C, N]
+    # last-resort eviction (SURVEY §3.4 / PARITY #4): PDB-protected
+    # victims no longer truncate the eligible prefix — upstream MAY evict
+    # them when nothing else places the pod, preferring nodes with the
+    # fewest violations (pickOneNodeForPreemption criterion #1, the
+    # scan phase's first lexmin key). The prefilter therefore caps
+    # prefixes by priority only.
+    elig0 = elig_cn  # [C, N]
     free0 = snap.node_allocatable - node_requested + slack  # [N, R]
     fits0 = jnp.all(
         req_c[:, None, None, :]
@@ -397,18 +398,42 @@ def run_preemption(
 
         # eligible victims: strictly lower priority than the preemptor
         elig = jnp.sum(vict_valid & (vict_prio < prio), axis=1).astype(jnp.int32)
-        # PDB truncation: a victim whose remaining budget is exhausted
-        # caps the usable prefix at its position (prefixes never skip)
+        # PDB protection no longer truncates the prefix: protected
+        # victims are evictable as a LAST RESORT, and the per-prefix
+        # violation count becomes the first node-choice key below.
+        # A victim VIOLATES when its within-group ordinal among the NEW
+        # victims (slots >= k_claimed; earlier claims already consumed
+        # pdb_used) exceeds the group's remaining budget — upstream's
+        # filterPodsWithPDBViolation decrements per victim, so a
+        # budget-1 group with two members in one prefix yields exactly
+        # one violation, not zero.
         budget_rem = snap.pdb_allowed - pdb_used  # [GP]
-        prot = jnp.zeros(vict_valid.shape, bool)
-        for b in range(MB):
-            g = vict_pdb[:, :, b]
-            prot |= (g >= 0) & (budget_rem[jnp.clip(g, 0, GP - 1)] <= 0)
-        prot &= vict_valid
-        first_prot = jnp.min(
-            jnp.where(prot, pos_row, MPN), axis=1
-        ).astype(jnp.int32)  # [N]
-        elig = jnp.minimum(elig, first_prot)
+        gids = jnp.arange(GP, dtype=vict_pdb.dtype)
+        memb = jnp.any(
+            vict_pdb[:, :, :, None] == gids[None, None, None, :], axis=2
+        ) & vict_valid[:, :, None]  # [N, MPN, GP]
+        ordinal = jnp.cumsum(memb.astype(jnp.int32), axis=1)  # inclusive
+        pos3 = jnp.arange(MPN, dtype=jnp.int32)[None, :, None]
+        claimed_cnt = jnp.sum(
+            jnp.where(pos3 < k_claimed[:, None, None], memb, False)
+            .astype(jnp.int32),
+            axis=1,
+        )  # [N, GP] members already claimed by earlier nominations
+        prot = jnp.any(
+            memb
+            & (
+                ordinal - claimed_cnt[:, None, :]
+                > budget_rem[None, None, :]
+            ),
+            axis=2,
+        ) & vict_valid  # [N, MPN]
+        cum_prot = jnp.concatenate(
+            [
+                jnp.zeros((N, 1), jnp.int32),
+                jnp.cumsum(prot.astype(jnp.int32), axis=1),
+            ],
+            axis=1,
+        )  # [N, MPN+1]
         free_base = (
             snap.node_allocatable - node_requested - nominated_req + slack
         )  # [N, R]
@@ -456,12 +481,17 @@ def run_preemption(
             prefix_prio, k_claimed
         )
         n_vict = k_min - k_claimed
+        # NEW victims' PDB violations (upstream pickOneNodeForPreemption
+        # criterion #1): nodes needing no violation always win over
+        # last-resort nodes
+        viol = pick1(cum_prot, k_min) - pick1(cum_prot, k_claimed)
 
         def lexmin(cand, key, big=_BIG_I32):
             key = jnp.where(cand, key, big)
             return cand & (key == jnp.min(key))
 
-        best = lexmin(candidate, max_vict_prio)
+        best = lexmin(candidate, viol)
+        best = lexmin(best, max_vict_prio)
         best = lexmin(best, sum_vict_prio)
         best = lexmin(best, n_vict)
         # upstream: prefer the node whose highest victim started LATEST

@@ -1012,6 +1012,8 @@ def schedule_with_preemption(
     pvcs: Sequence = (),
     pvs: Sequence = (),
     storage_classes: Sequence = (),
+    budget: int | None = None,
+    scan_budget: int | None = None,
 ) -> tuple[list[OracleDecision], list["OraclePreemption"]]:
     """schedule() then the preemption pass on whatever stayed pending."""
     weights = weights or OracleWeights()
@@ -1029,6 +1031,7 @@ def schedule_with_preemption(
     return decisions, preempt(
         nodes, pending, existing, decisions, post_state, pdbs=pdbs,
         pvcs=pvcs, pvs=pvs, storage_classes=storage_classes,
+        budget=budget, scan_budget=scan_budget,
     )
 
 
@@ -1048,13 +1051,17 @@ def preempt(
     pvcs: Sequence = (),
     pvs: Sequence = (),
     storage_classes: Sequence = (),
+    budget: int | None = None,
+    scan_budget: int | None = None,
 ) -> list[OraclePreemption]:
     """Sequential preemption over the unschedulable pods in queue order,
     mirroring ops/preemption.py's semantics: per node, victims are a prefix
     of the existing pods sorted ascending by priority; the minimal prefix
     that frees enough resources wins; a victim protected by an exhausted
-    PodDisruptionBudget truncates the usable prefix (claims decrement
-    budgets within the pass); node choice minimizes (highest victim
+    PodDisruptionBudget is evicted only as a LAST RESORT — the number of
+    PDB violations among the NEW victims is the FIRST node-choice key
+    (upstream pickOneNodeForPreemption criterion #1), and claims decrement
+    budgets within the pass; node choice then minimizes (highest victim
     priority, victim priority sum, victim count, -(highest victim start
     time), node index). `post_state` is the oracle state AFTER the
     scheduling pass (committed pods consume capacity); the static filters
@@ -1088,11 +1095,60 @@ def preempt(
     unsched = [pi for pi in queue_order(pending)
                if decisions[pi].node_index < 0
                and pending[pi].spec.preemption_policy != "Never"]
+    # ---- per-cycle latency budgets (ops/preemption.py mirror) ----
+    # `budget`: only the lowest-rank `budget` candidates are considered
+    # at all (phase-1 table bound). `scan_budget`: of those, only the
+    # first `scan_budget` that are RESOURCE-FEASIBLE against the
+    # pristine post-cycle state (the kernel's phase-1 prefilter — static
+    # gate + some prefix k in [1, elig] whose freed resources fit,
+    # IGNORING contention and the non-resource what-if) get a scan slot;
+    # later candidates defer to the next cycle.
+    if budget is not None:
+        unsched = unsched[:budget]
+    if scan_budget is not None and len(unsched) > scan_budget:
+        def _pristine_feasible(pi: int) -> bool:
+            pod = pending[pi]
+            req = pod.resource_requests()
+            for i in range(len(nodes)):
+                if not all(
+                    f(pod, static_state, i)
+                    for f in PREEMPTION_STATIC_FILTERS
+                ):
+                    continue
+                victs = per_node[i]
+                elig = sum(
+                    1 for e in victs
+                    if existing[e][0].spec.priority < pod.spec.priority
+                )
+                alloc = nodes[i].status.allocatable
+                freed: dict[str, float] = {}
+                for k in range(1, elig + 1):
+                    for r, v in (
+                        existing[victs[k - 1]][0].resource_requests().items()
+                    ):
+                        freed[r] = freed.get(r, 0.0) + v
+                    ok = True
+                    for r, v in req.items():
+                        used = (
+                            post_state.requested[i].get(r, 0.0)
+                            - freed.get(r, 0.0)
+                        )
+                        a = alloc.get(r, 0.0)
+                        if used + v > a * (1 + 1e-5) + 1e-5:
+                            ok = False
+                            break
+                    if ok:
+                        return True
+            return False
+
+        unsched = [pi for pi in unsched if _pristine_feasible(pi)][
+            :scan_budget
+        ]
     for pi in unsched:
         pod = pending[pi]
         req = pod.resource_requests()
         pod_ports = {(pt, proto) for pt, proto, _ip in pod.host_ports()}
-        candidates = []  # (max_prio, sum_prio, n_vict, -hi_start, node, k_min)
+        candidates = []  # (pdb_violations, max_prio, sum_prio, n_vict, -hi_start, node, k_min)
         for i in range(len(nodes)):
             if not all(f(pod, static_state, i) for f in PREEMPTION_STATIC_FILTERS):
                 continue
@@ -1104,14 +1160,24 @@ def preempt(
                 1 for e in victs
                 if existing[e][0].spec.priority < pod.spec.priority
             )
-            # PDB truncation: an exhausted-budget victim caps the prefix
-            for pos_, e in enumerate(victs):
-                if any(
-                    pdbs[g].disruptions_allowed - pdb_used[g] <= 0
-                    for g in pod_pdbs[e]
-                ):
-                    elig = min(elig, pos_)
-                    break
+            # PDB protection no longer truncates: protected victims are
+            # last-resort evictable; violations count toward the node
+            # choice below. A victim violates when its within-group
+            # ordinal among the NEW victims (from k_claimed on; earlier
+            # claims already consumed pdb_used) exceeds the remaining
+            # budget — per-victim decrement, like upstream's
+            # filterPodsWithPDBViolation (kernel mirror).
+            protected = [False] * len(victs)
+            grp_cnt: dict[int, int] = {}
+            for pos_ in range(k_claimed[i], len(victs)):
+                e = victs[pos_]
+                flag = False
+                for g in pod_pdbs[e]:
+                    grp_cnt[g] = grp_cnt.get(g, 0) + 1
+                    rem = pdbs[g].disruptions_allowed - pdb_used[g]
+                    if grp_cnt[g] > rem:
+                        flag = True
+                protected[pos_] = flag
 
             def fits(k: int) -> bool:
                 alloc = nodes[i].status.allocatable
@@ -1161,6 +1227,7 @@ def preempt(
             new = victs[k_claimed[i]:k_min]
             hi = victs[k_min - 1]  # highest-priority (last) prefix victim
             candidates.append((
+                sum(protected[k_claimed[i]:k_min]),  # PDB violations
                 max(existing[e][0].spec.priority for e in new),
                 sum(existing[e][0].spec.priority for e in new),
                 len(new),
@@ -1170,7 +1237,7 @@ def preempt(
             ))
         if not candidates:
             continue
-        max_p, sum_p, n_v, neg_start, node, k_min = min(candidates)
+        _viol, max_p, sum_p, n_v, neg_start, node, k_min = min(candidates)
         victims = per_node[node][k_claimed[node]:k_min]
         k_claimed[node] = k_min
         for e in victims:

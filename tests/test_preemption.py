@@ -169,7 +169,11 @@ def test_schedulable_pods_do_not_preempt():
     assert int(pre.num_preemptors) == 0
 
 
-def test_pdb_protected_victim_truncates_prefix():
+def test_pdb_last_resort_eviction_places_pod():
+    """SURVEY §3.4 / PARITY #4 (round 5): a pod placeable ONLY by
+    violating a PDB gets placed, as upstream would — protected victims
+    no longer truncate the prefix, they cost a violation in the node
+    choice instead."""
     from k8s_scheduler_tpu.models.api import LabelSelector, PodDisruptionBudget
 
     nodes = [MakeNode("n0").capacity({"cpu": "2"}).obj()]
@@ -183,22 +187,50 @@ def test_pdb_protected_victim_truncates_prefix():
         "db-pdb", selector=LabelSelector(match_labels={"app": "db"}),
         disruptions_allowed=0,
     )]
-    # the lowest-priority victim is PDB-protected: the prefix is truncated
-    # at it, so no eviction set frees enough -> no preemption at all
+    # budget exhausted: the ONLY way to place the pod evicts the
+    # protected victim — last-resort eviction does it
     got, want, _ = run_both(nodes, pods, existing, pdbs=pdbs)
-    assert got == want == ([-1], [])
-    # with budget, the same setup preempts
+    assert got == want
+    assert got[0] == [0]
+    assert sorted(got[1]) == [0, 1]  # both victims evicted
+    # with budget available the same setup preempts without a violation
     pdbs[0].disruptions_allowed = 1
     got, want, _ = run_both(nodes, pods, existing, pdbs=pdbs)
     assert got == want
     assert got[0] == [0]
 
 
+def test_pdb_zero_violation_node_preferred():
+    """pickOneNodeForPreemption criterion #1: a node whose victims
+    violate no PDB always beats a node that needs a violation — even
+    when the violating node would win every later tie-break."""
+    from k8s_scheduler_tpu.models.api import LabelSelector, PodDisruptionBudget
+
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "1"}).obj() for i in range(2)]
+    existing = [
+        # n0's victim is protected but LOWER priority (would win the
+        # max-victim-priority tie-break if violations didn't come first)
+        (MakePod("prot").req({"cpu": "1"}).priority(1)
+         .labels({"app": "db"}).obj(), "n0"),
+        (MakePod("free").req({"cpu": "1"}).priority(2).obj(), "n1"),
+    ]
+    pods = [MakePod("hi").req({"cpu": "1"}).priority(10).obj()]
+    pdbs = [PodDisruptionBudget(
+        "db-pdb", selector=LabelSelector(match_labels={"app": "db"}),
+        disruptions_allowed=0,
+    )]
+    got, want, _ = run_both(nodes, pods, existing, pdbs=pdbs)
+    assert got == want
+    assert got[0] == [1]  # the zero-violation node
+    assert got[1] == [1]
+
+
 def test_pdb_budget_consumed_within_cycle():
     from k8s_scheduler_tpu.models.api import LabelSelector, PodDisruptionBudget
 
     # two nodes, each holding one member of the same PDB group with
-    # budget 1: only ONE preemptor may evict this cycle
+    # budget 1: the first preemptor consumes the budget; the second
+    # places only via a LAST-RESORT violation (as upstream may)
     nodes = [MakeNode(f"n{i}").capacity({"cpu": "1"}).obj() for i in range(2)]
     existing = [
         (MakePod(f"m{i}").req({"cpu": "1"}).priority(1)
@@ -216,8 +248,8 @@ def test_pdb_budget_consumed_within_cycle():
     )]
     got, want, _ = run_both(nodes, pods, existing, pdbs=pdbs)
     assert got == want
-    assert sum(1 for n in got[0] if n >= 0) == 1
-    assert len(got[1]) == 1
+    assert sum(1 for n in got[0] if n >= 0) == 2
+    assert len(got[1]) == 2
 
 
 def test_start_time_tie_break_prefers_younger_victim():
@@ -237,8 +269,10 @@ def test_start_time_tie_break_prefers_younger_victim():
 
 
 def test_randomized_differential_preemption():
+    from k8s_scheduler_tpu.models.api import LabelSelector, PodDisruptionBudget
+
     rng = np.random.default_rng(7)
-    for trial in range(6):
+    for trial in range(8):
         n_nodes = int(rng.integers(2, 6))
         nodes = [
             MakeNode(f"n{i}").capacity(
@@ -248,17 +282,66 @@ def test_randomized_differential_preemption():
         ]
         existing = []
         for i in range(int(rng.integers(0, 8))):
-            existing.append((
-                MakePod(f"e{i}").req(
-                    {"cpu": f"{int(rng.integers(200, 1500))}m"}
-                ).priority(int(rng.integers(0, 6))).obj(),
-                f"n{int(rng.integers(0, n_nodes))}",
-            ))
+            b = MakePod(f"e{i}").req(
+                {"cpu": f"{int(rng.integers(200, 1500))}m"}
+            ).priority(int(rng.integers(0, 6)))
+            if rng.random() < 0.5:  # half the victims sit under a PDB
+                b = b.labels({"app": f"a{int(rng.integers(0, 2))}"})
+            existing.append((b.obj(), f"n{int(rng.integers(0, n_nodes))}"))
         pods = [
             MakePod(f"p{i}").req(
                 {"cpu": f"{int(rng.integers(500, 3000))}m"}
             ).priority(int(rng.integers(0, 12))).created(float(i)).obj()
             for i in range(int(rng.integers(1, 8)))
         ]
-        got, want, _ = run_both(nodes, pods, existing)
+        # tight budgets so BOTH the violation-counting and the
+        # last-resort path get exercised across trials
+        pdbs = [
+            PodDisruptionBudget(
+                f"pdb-a{g}",
+                selector=LabelSelector(match_labels={"app": f"a{g}"}),
+                disruptions_allowed=int(rng.integers(0, 2)),
+            )
+            for g in range(2)
+        ]
+        got, want, _ = run_both(nodes, pods, existing, pdbs=pdbs)
         assert got == want, f"trial {trial}: {got} != {want}"
+
+
+def test_pdb_multi_member_prefix_counts_violations_per_victim():
+    """Upstream filterPodsWithPDBViolation decrements per victim: a
+    budget-1 group with TWO members in one victim prefix yields exactly
+    ONE violation — so it TIES (and then loses later tie-breaks or wins)
+    against a node violating an exhausted group once, rather than
+    scoring a bogus zero."""
+    from k8s_scheduler_tpu.models.api import LabelSelector, PodDisruptionBudget
+
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "1"}).obj() for i in range(2)]
+    existing = [
+        # n0: two 500m members of budget-1 group "a" (both must go)
+        (MakePod("a0").req({"cpu": "500m"}).priority(1)
+         .labels({"app": "a"}).created(50.0).obj(), "n0"),
+        (MakePod("a1").req({"cpu": "500m"}).priority(1)
+         .labels({"app": "a"}).created(60.0).obj(), "n0"),
+        # n1: one 1-cpu member of exhausted group "b"
+        (MakePod("b0").req({"cpu": "1"}).priority(1)
+         .labels({"app": "b"}).created(70.0).obj(), "n1"),
+    ]
+    pods = [MakePod("hi").req({"cpu": "1"}).priority(10).obj()]
+    pdbs = [
+        PodDisruptionBudget(
+            "pdb-a", selector=LabelSelector(match_labels={"app": "a"}),
+            disruptions_allowed=1,
+        ),
+        PodDisruptionBudget(
+            "pdb-b", selector=LabelSelector(match_labels={"app": "b"}),
+            disruptions_allowed=0,
+        ),
+    ]
+    got, want, _ = run_both(nodes, pods, existing, pdbs=pdbs)
+    assert got == want
+    # both nodes need exactly ONE violation; the tie moves to
+    # max-victim-priority (equal), sum (2 vs 1 -> n1 wins), so the
+    # correct per-victim counting is observable in the node choice
+    assert got[0] == [1]
+    assert got[1] == [2]
