@@ -261,14 +261,10 @@ def _make_pv_choice_fn(ctx: CycleContext):
         B = node_of.shape[0]
         if claimed is None:  # plugin disabled in this profile
             return jnp.full((B, MVol), -1, jnp.int32)
-        return jnp.stack(
-            [
-                volumes_ops.chosen_pv(
-                    vsnap, ctx.expr_node_mask, claimed, node_of, live, j
-                )
-                for j in range(MVol)
-            ],
-            axis=1,
+        # contention-free fold-pass simulation (SDR-safe choice, intra-
+        # pod distinctness) so the guard key predicts fold_pv_claims
+        return volumes_ops.chosen_pv_slots(
+            vsnap, ctx.expr_node_mask, claimed, node_of, live
         )
 
     return pv_choice_fn

@@ -279,24 +279,25 @@ class VolumeBinding(PluginBase):
         snap = ctx.snap
         claimed = extra
         MVol = snap.pod_vol_mode.shape[1]
-        if MVol >= 2 and snap.has_multi_volume:
-            # constrained slots claim first (greedy lowest-index claiming
-            # processed permissive-first can dead-end; exact for 2 slots
-            # — see ops/volumes.fold_pv_claims)
-            counts = volumes_ops.slot_candidate_counts_row(
-                snap, ctx.expr_node_mask, claimed, node, p
-            )
-            perm = jnp.argsort(counts)
-        else:
-            perm = jnp.arange(MVol)
+        multi = MVol >= 2 and snap.has_multi_volume
+        # slots claim in index order; multi-volume pods use the SDR-safe
+        # choice (greedy lowest-index claiming can dead-end even when the
+        # Hall mask admitted the pod — see ops/volumes.chosen_pv_sdr)
+        pending = snap.pod_vol_mode[p] == 1  # [MVol]
         for t in range(MVol):
-            ch = volumes_ops.chosen_pv_row(
-                snap, ctx.expr_node_mask, claimed, node, p, perm[t]
-            )
+            if multi:
+                ch = volumes_ops.chosen_pv_sdr_row(
+                    snap, ctx.expr_node_mask, claimed, node, p, pending, t
+                )
+            else:
+                ch = volumes_ops.chosen_pv_row(
+                    snap, ctx.expr_node_mask, claimed, node, p, t
+                )
             ch = jnp.where(committed, ch, -1)
             claimed = claimed.at[jnp.clip(ch, 0, claimed.shape[0] - 1)].max(
                 ch >= 0
             )
+            pending = pending.at[t].set(False)
         return claimed
 
     def extra_update_batched(self, ctx: CycleContext, extra, accepted,

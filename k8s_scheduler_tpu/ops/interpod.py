@@ -355,6 +355,22 @@ def _term_counts(snap, cbn, sel, k):  # sel,k: i32 [P] -> f32 [P, N]
     return _term_pick(snap, cbn, sel, k, exact=True)
 
 
+def _multi_hot(snap, sel, k, w) -> jnp.ndarray:  # [P, A] each -> f32 [P, K*S]
+    """Weighted MULTI-hot term matrix: row (k, sel) accumulates w[:, a]
+    over the term axis. Collapses A per-slot `one-hot @ table` dots into
+    ONE dot — the term-compaction lever from PERF.md item 4. Callers
+    zero w for invalid slots; duplicate (sel, k) slots sum, which every
+    consumer's algebra wants (satisfied-term counts, additive weights)."""
+    S = snap.sel_exprs.shape[0]
+    K = snap.node_domains.shape[1]
+    W = jnp.zeros((sel.shape[0], K * S), jnp.float32)
+    ks = jnp.arange(K * S, dtype=jnp.int32)[None, :]
+    for a in range(sel.shape[1]):  # A is tiny/static; fuses to one pass
+        row = jnp.clip(k[:, a], 0, K - 1) * S + jnp.clip(sel[:, a], 0, S - 1)
+        W = W + jnp.where(row[:, None] == ks, w[:, a][:, None], 0.0)
+    return W
+
+
 def affinity_mask_batched(snap, state: AffinityState, m_pending,
                           cbn) -> jnp.ndarray:  # bool [P, N]
     """Required affinity + anti-affinity + symmetric anti for ALL pods.
@@ -362,25 +378,33 @@ def affinity_mask_batched(snap, state: AffinityState, m_pending,
     Only the SIGN of the domain counts matters here (c > 0 / c <= 0), so
     the picks run over a shared 0/1 presence table — bf16-exact at any
     matmul precision; the -1 no-domain sentinel lands in the 'not
-    positive' bucket both checks want."""
+    positive' bucket both checks want.
+
+    Term-compacted (PERF item 4): instead of one [P,K*S]@[K*S,N] dot per
+    term slot (2*MA dots), a multi-hot count matrix per direction gives
+    TWO dots total. Required terms: a valid non-boot term is satisfied
+    iff its row is positive, so satisfied-count == required-count iff
+    every term holds (counts are small ints — bf16-exact, f32 accum).
+    Anti terms: violated iff the multi-hot dot against positivity is
+    nonzero."""
     P, N = m_pending.shape[1], snap.N
-    ok = jnp.ones((P, N), bool)
-    MA = snap.pod_aff_terms.shape[1]
     S = state.total.shape[0]
     pid = jnp.arange(P, dtype=jnp.int32)
     pos = (cbn > 0).astype(jnp.float32)  # [K*S, N]
-    for a in range(MA):
-        sel = snap.pod_aff_terms[:, a, 0]  # [P]
-        k = snap.pod_aff_terms[:, a, 1]
-        c_pos = _term_pick(snap, pos, sel, k, exact=False) > 0.5  # [P, N]
-        scl = jnp.clip(sel, 0, S - 1)
-        boot = (state.total[scl] == 0) & m_pending[scl, pid]  # [P]
-        ok &= jnp.where((sel >= 0)[:, None], boot[:, None] | c_pos, True)
-    for a in range(MA):
-        sel = snap.pod_anti_terms[:, a, 0]
-        k = snap.pod_anti_terms[:, a, 1]
-        c_pos = _term_pick(snap, pos, sel, k, exact=False) > 0.5
-        ok &= jnp.where((sel >= 0)[:, None], ~c_pos, True)
+
+    sel = snap.pod_aff_terms[..., 0]  # [P, MA]
+    k = snap.pod_aff_terms[..., 1]
+    scl = jnp.clip(sel, 0, S - 1)
+    boot = (state.total[scl] == 0) & m_pending[scl, pid[:, None]]  # [P, MA]
+    need = (sel >= 0) & ~boot
+    W = _multi_hot(snap, sel, k, need.astype(jnp.float32))
+    n_req = jnp.sum(need, axis=1).astype(jnp.float32)  # [P]
+    ok = jax.lax.dot(W, pos) >= n_req[:, None] - 0.5
+
+    a_sel = snap.pod_anti_terms[..., 0]
+    a_k = snap.pod_anti_terms[..., 1]
+    Wa = _multi_hot(snap, a_sel, a_k, (a_sel >= 0).astype(jnp.float32))
+    ok &= jax.lax.dot(Wa, pos) < 0.5
     # symmetric: any placed pod's anti term whose selector matches p —
     # [P,S]x[S,N] matmul on the MXU instead of a per-pod [S,N] reduction
     viol = (
@@ -392,17 +416,19 @@ def affinity_mask_batched(snap, state: AffinityState, m_pending,
 def affinity_score_batched(snap, state: AffinityState, m_pending, cbn,
                            feasible) -> jnp.ndarray:  # f32 [P, N]
     """Preferred-term score for ALL pods, normalized per pod to
-    [-100, 100] by max |raw| over that pod's feasible nodes."""
-    P, N = m_pending.shape[1], snap.N
-    raw = jnp.zeros((P, N), jnp.float32)
-    MA = snap.pod_pref_aff.shape[1]
-    for a in range(MA):
-        sel = snap.pod_pref_aff[:, a, 0]
-        k = snap.pod_pref_aff[:, a, 1]
-        c = _term_counts(snap, cbn, sel, k)
-        w = snap.pod_pref_aff_w[:, a]  # [P]
-        raw += jnp.where((sel >= 0)[:, None] & (c > 0),
-                         w[:, None] * jnp.maximum(c, 0.0), 0.0)
+    [-100, 100] by max |raw| over that pod's feasible nodes.
+
+    Term-compacted: per-slot contribution w * max(c, 0) * (c > 0) equals
+    w * relu(c) (the -1 no-domain sentinel relus to 0), which is LINEAR
+    in the table — so all MA exact picks collapse to one weighted
+    multi-hot dot against relu(cbn) at HIGH precision (counts exceed
+    bf16's integer range; bf16_3x keeps the products exact)."""
+    sel = snap.pod_pref_aff[..., 0]  # [P, MA]
+    k = snap.pod_pref_aff[..., 1]
+    w = jnp.where(sel >= 0, snap.pod_pref_aff_w, 0.0)
+    Ww = _multi_hot(snap, sel, k, w)
+    raw = jax.lax.dot(Ww, jnp.maximum(cbn, 0.0),
+                      precision=jax.lax.Precision.HIGH)
     raw += m_pending.T.astype(jnp.float32) @ state.pref_sym  # [P, N]
     hi = jnp.max(jnp.where(feasible, jnp.abs(raw), 0.0), axis=1, keepdims=True)
     return jnp.where(hi > 0, raw / hi * 100.0, 0.0)
@@ -446,16 +472,16 @@ def spread_mask_batched(snap, state: AffinityState, cbn,
 
 def spread_score_batched(snap, state: AffinityState, cbn,
                          feasible) -> jnp.ndarray:  # f32 [P, N]
-    P, N = snap.P, snap.N
-    raw = jnp.zeros((P, N), jnp.float32)
-    MC = snap.pod_tsc.shape[1]
-    for c in range(MC):
-        k = snap.pod_tsc[:, c, 0]
-        sel = snap.pod_tsc[:, c, 1]
-        when = snap.pod_tsc[:, c, 2]
-        cnt = _term_counts(snap, cbn, sel, k)
-        soft = (k >= 0) & (when == enc.WHEN_SCHEDULE_ANYWAY)
-        raw += jnp.where(soft[:, None], jnp.maximum(cnt, 0.0), 0.0)
+    # Term-compacted like affinity_score_batched: soft-slot contribution
+    # max(cnt, 0) is relu-linear in the table, so MC exact picks become
+    # one multi-hot dot against relu(cbn).
+    k = snap.pod_tsc[..., 0]  # [P, MC]
+    sel = snap.pod_tsc[..., 1]
+    when = snap.pod_tsc[..., 2]
+    soft = (k >= 0) & (when == enc.WHEN_SCHEDULE_ANYWAY)
+    Ws = _multi_hot(snap, sel, k, soft.astype(jnp.float32))
+    raw = jax.lax.dot(Ws, jnp.maximum(cbn, 0.0),
+                      precision=jax.lax.Precision.HIGH)
     hi = jnp.max(jnp.where(feasible, raw, 0.0), axis=1, keepdims=True)
     return jnp.where(hi > 0, (1.0 - raw / hi) * 100.0, 100.0)
 

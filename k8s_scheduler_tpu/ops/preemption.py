@@ -87,6 +87,12 @@ import jax.numpy as jnp
 from ..models import encoding as enc
 from . import interpod as interpod_ops
 
+# Production per-cycle latency budgets (the DefaultPreemption plugin's
+# defaults; the differential soak imports these so oracle-side truncation
+# semantics can never drift from what the kernel actually runs).
+DEFAULT_BUDGET = 256
+DEFAULT_SCAN_BUDGET = 64
+
 _REL_EPS = 1e-5
 _BIG_I32 = jnp.int32(2**31 - 1)
 
@@ -109,11 +115,11 @@ def run_preemption(
     excluded: jnp.ndarray | None = None,  # bool [P] never preempt (e.g.
     # gang-dropped members: they fit without eviction, their group is what
     # failed — upstream never runs PostFilter for Permit rejections)
-    budget: int = 256,  # max preemptor candidates PREFILTERED per cycle:
+    budget: int = DEFAULT_BUDGET,  # max preemptor candidates PREFILTERED per cycle:
     # phase 1 evaluates the `budget` lowest-rank unschedulable pods in one
     # batched pass (bounds the [C, N, MPN] table); candidates beyond it
     # stay queued and get their attempt next cycle
-    scan_budget: int = 64,  # max NOMINATIONS per cycle: phase 2 scans the
+    scan_budget: int = DEFAULT_SCAN_BUDGET,  # max NOMINATIONS per cycle: phase 2 scans the
     # `scan_budget` lowest-rank prefilter survivors sequentially (one
     # latency-bound lax.scan step each, ~0.2ms); survivors beyond it defer
     # to the next cycle — upstream nominates ONE pod per ScheduleOne

@@ -27,6 +27,19 @@ choice), later pods in the cycle see the PV as unavailable, and the
 rounds engine's participant table additionally resolves SAME-ROUND
 claimants of one PV by rank (`_RB_PV`). Dynamic provisioning is
 unlimited and needs no arbitration.
+
+Multi-volume pods are admitted JOINTLY (Hall's condition, `_hall_ok`)
+and claim with the SDR-SAFE choice (`chosen_pv_sdr`): each slot takes
+the lowest-index PV whose removal keeps Hall's condition over the pod's
+remaining static-needy slots — exact for ANY candidate-set shape by the
+systems-of-distinct-representatives argument. Plain slot-order greedy
+CAN dead-end even on the nested (class + capacity-threshold at one
+node) sets the current encoder produces — see the 3-slot chain test;
+the SDR rule is what makes claiming exact, and unlike the older
+constrained-count-first ordering it stays exact for crossing sets too.
+The subset enumeration is capped beyond 7 slots, where per-pod
+dominance groups keep laminar families exact (PARITY #8 residual is
+crossing sets beyond 7 slots only).
 """
 
 from __future__ import annotations
@@ -63,10 +76,14 @@ def _hall_subsets(MVol: int):
     """Slot subsets (size >= 2) whose Hall condition the joint-admission
     check enumerates. Exact (all subsets) up to MVol=6; beyond that the
     2^MVol matmul count would explode compile and device time, so only
-    pairs + the full set are checked — necessary conditions that keep
-    the common two-way conflicts exact, with >=3-way-nested residual
-    over-admission documented in PARITY #8. MVol is a sticky pad dim
-    with bucket 2; real pods rarely mount > 4 PVCs."""
+    pairs + the full set are statically enumerated and the per-pod
+    DOMINANCE GROUPS (_dominance_anchors) cover the rest — for LAMINAR
+    candidate families (everything the class + capacity-threshold model
+    can produce) the Hall-tight subsets are exactly the dominance
+    groups, so the capped regime stays exact at any slot count; only
+    >6-slot pods with CROSSING sets (not currently producible) retain a
+    residual (PARITY #8). MVol is a sticky pad dim with bucket 2; real
+    pods rarely mount > 4 PVCs."""
     import itertools
 
     if MVol <= 6:
@@ -81,6 +98,14 @@ def _hall_subsets(MVol: int):
     ]
 
 
+def _membership(cands, a, t):
+    """bool [...]: is slot t's candidate set contained in slot a's, per
+    pod — the dominance-group membership test (A ⊆ B on the claimable
+    PV sets; inclusion on the raw sets implies inclusion on any common
+    node/claim restriction)."""
+    return ~jnp.any(cands[t] & ~cands[a], axis=-1)
+
+
 def _hall_ok(pv_ok_f, cands, dyn_oks, modes, ok):
     """Joint feasibility across a pod's unbound volume slots (PARITY #8
     closure): the per-slot static_ok tests admit a pod whose two PVCs
@@ -92,10 +117,13 @@ def _hall_ok(pv_ok_f, cands, dyn_oks, modes, ok):
     ride dynamic provisioning on the node never constrain (their
     subsets are dominated by the pure-static sub-subsets, enumerated
     too). Singletons are the existing per-slot test, so only subsets of
-    size >= 2 are added — one [P,V]x[V,N] count matmul each. The
+    size >= 2 are added — one [P,V]x[V,N] count matmul each. Beyond 6
+    slots the static enumeration is capped and per-pod DOMINANCE GROUPS
+    take over (exact for laminar families — see _hall_subsets). The
     single-pod [N]-scale twin lives in volume_mask_unbound_row; keep
     the two in lockstep."""
-    for s in _hall_subsets(len(cands)):
+    MVol = len(cands)
+    for s in _hall_subsets(MVol):
         u = cands[s[0]]
         for j in s[1:]:
             u = u | cands[j]
@@ -105,6 +133,26 @@ def _hall_ok(pv_ok_f, cands, dyn_oks, modes, ok):
             for j in s
         )
         ok &= avail + 0.5 >= need.astype(jnp.float32)
+    if MVol > 6:
+        # dominance groups, one per anchor slot: members are the slots
+        # whose candidate set is CONTAINED in the anchor's, need counts
+        # the static-needy members — for laminar families every
+        # Hall-tight subset is such a group (the down-set of its
+        # largest member), so this keeps the capped regime exact. The
+        # group union IS the anchor's set (members are subsets of it),
+        # so avail is one anchor matmul, no union accumulation.
+        for a in range(MVol):
+            need = None
+            for t in range(MVol):
+                member = _membership(cands, a, t)  # [P]
+                n_t = (
+                    member[:, None]
+                    & (modes[t] == 1)[:, None]
+                    & ~dyn_oks[t]
+                ).astype(jnp.int32)
+                need = n_t if need is None else need + n_t
+            avail = cands[a].astype(jnp.float32) @ pv_ok_f
+            ok &= avail + 0.5 >= need.astype(jnp.float32)
     return ok
 
 
@@ -225,8 +273,8 @@ def volume_mask_unbound_row(snap, expr_mask, pv_claimed, p):
         modes.append(mode)
     if MVol >= 2 and snap.has_multi_volume:
         # Hall's condition over this pod's slots — the single-pod
-        # [N]-scale twin of _hall_ok (same subsets via _hall_subsets;
-        # keep in lockstep)
+        # [N]-scale twin of _hall_ok (same subsets via _hall_subsets
+        # plus the capped-regime dominance groups; keep in lockstep)
         for sub in _hall_subsets(MVol):
             u = cands[sub[0]]
             for j in sub[1:]:
@@ -239,30 +287,116 @@ def volume_mask_unbound_row(snap, expr_mask, pv_claimed, p):
                 for j in sub
             )
             ok &= avail >= need
+        if MVol > 6:
+            # group union == anchor set, like _hall_ok
+            for a in range(MVol):
+                need = None
+                for t in range(MVol):
+                    member = _membership(cands, a, t)  # scalar bool
+                    n_t = (
+                        member & (modes[t] == 1) & ~dyn_oks[t]
+                    ).astype(jnp.int32)
+                    need = n_t if need is None else need + n_t
+                avail = jnp.sum(
+                    cands[a][:, None] & pv_ok, axis=0, dtype=jnp.int32
+                )  # [N]
+                ok &= avail >= need
     return ok
 
 
-def slot_candidate_counts_row(snap, expr_mask, pv_claimed, node, p):
-    """i32 [MVol]: per-slot count of compatible available unclaimed PVs
-    usable at `node` for pod `p` — the scan engine's claim-order key
-    (constrained slots claim first; see fold_pv_claims)."""
-    MVol = snap.pod_vol_mode.shape[1]
-    at_node = (
-        pv_node_table(snap, expr_mask)[:, jnp.clip(node, 0, snap.N - 1)]
-        & ~pv_claimed
-    )  # [V]
-    return jnp.stack(
-        [
-            jnp.sum(
-                (snap.pv_class == snap.pod_vol_class[p, j])
-                & (snap.pv_capacity + _CAP_EPS >= snap.pod_vol_size[p, j])
-                & (snap.pod_vol_mode[p, j] == 1)
-                & at_node,
-                dtype=jnp.int32,
-            )
-            for j in range(MVol)
+def _sdr_other_subsets(MVol: int, j: int):
+    """Subsets (size >= 1) of the slots other than `j` whose Hall margin
+    the SDR-safe choice checks. Exact (all subsets) while the
+    enumeration stays small; beyond 6 remaining slots only singletons +
+    pairs + the full rest are statically enumerated and _sdr_safe_choice
+    adds the per-pod dominance groups, which keep the capped regime
+    exact for laminar candidate families at any slot count (crossing
+    sets beyond 7 slots remain a PARITY #8 residual)."""
+    import itertools
+
+    others = [t for t in range(MVol) if t != j]
+    if len(others) <= 6:
+        return [
+            s
+            for r in range(1, len(others) + 1)
+            for s in itertools.combinations(others, r)
         ]
+    return [
+        *itertools.combinations(others, 1),
+        *itertools.combinations(others, 2),
+        tuple(others),
+    ]
+
+
+def _sdr_safe_choice(cand_j, cands, needy, dyn_j, MVol, j):
+    """SDR-preserving candidate choice for slot j, batched over pods.
+
+    cand_j bool [P, V]: slot j's claimable PVs (already node-admissible,
+    unclaimed, active-masked). cands: per-slot [P, V] claimable sets.
+    needy bool [P, MVol]: pending slots that REQUIRE a static PV (no
+    dynamic ride at this node). dyn_j bool [P]: slot j can ride dynamic.
+
+    Rule (exact by the classic systems-of-distinct-representatives
+    argument): claim the LOWEST-INDEX v in cand_j whose removal keeps
+    Hall's condition over every subset of the other pending needy slots
+    — i.e. v is unsafe iff some subset s has margin avail(s) - need(s)
+    <= 0 and v lies in s's candidate union. When Hall holds for the
+    needy slots, a safe v always exists for a needy slot; a dyn-capable
+    slot with no safe v rides dynamic (-1) instead of stealing; a needy
+    slot with no safe v (the pod is already beyond Hall's guarantee,
+    e.g. same-pass contention losses) falls back to the lowest
+    candidate, matching the old greedy behavior."""
+    P, V = cand_j.shape
+    unsafe = jnp.zeros((P, V), bool)
+    for s in _sdr_other_subsets(MVol, j):
+        u = jnp.zeros((P, V), bool)
+        need = jnp.zeros((P,), jnp.int32)
+        for t in s:
+            u = u | (cands[t] & needy[:, t][:, None])
+            need = need + needy[:, t].astype(jnp.int32)
+        avail = jnp.sum(u, axis=1, dtype=jnp.int32)
+        unsafe = unsafe | (u & (avail <= need)[:, None])
+    others = [t for t in range(MVol) if t != j]
+    if len(others) > 6:
+        # capped static enumeration: per-pod dominance groups cover the
+        # mid-size subsets (exact for laminar candidate families — see
+        # _hall_subsets; a group is the needy down-set of its anchor).
+        # NOTE the union is needy-masked like this function's static-
+        # subset loop above; _hall_ok's group union deliberately seeds
+        # the anchor's full set to match ITS static-subset convention.
+        for a in others:
+            u = jnp.zeros((P, V), bool)
+            need = jnp.zeros((P,), jnp.int32)
+            for t in others:
+                member = _membership(cands, a, t) & needy[:, t]
+                u = u | (cands[t] & member[:, None])
+                need = need + member.astype(jnp.int32)
+            avail = jnp.sum(u, axis=1, dtype=jnp.int32)
+            unsafe = unsafe | (u & (avail <= need)[:, None])
+    safe = cand_j & ~unsafe
+    ids = jnp.arange(V, dtype=jnp.int32)[None, :]
+    best_safe = jnp.min(jnp.where(safe, ids, V), axis=1).astype(jnp.int32)
+    best_any = jnp.min(jnp.where(cand_j, ids, V), axis=1).astype(jnp.int32)
+    chosen = jnp.where(
+        best_safe < V,
+        best_safe,
+        jnp.where(dyn_j, -1, jnp.where(best_any < V, best_any, -1)),
     )
+    return chosen
+
+
+def _dyn_at_node(snap, expr_mask, node_of):  # bool [P, MVol]
+    """Whether each volume slot can ride dynamic provisioning at the
+    pod's chosen node (storage-class allowedTopologies admit it)."""
+    req = labels_ops.requirement_mask(snap.rq_exprs, expr_mask)  # [Rq, N]
+    Rq = req.shape[0]
+    nsafe = jnp.clip(node_of, 0, snap.N - 1)
+    req_at = req[:, nsafe].T  # [P, Rq]
+    rid = snap.pod_vol_req  # [P, MVol]
+    picked = jnp.take_along_axis(
+        req_at, jnp.clip(rid, 0, Rq - 1), axis=1
+    )  # [P, MVol]
+    return jnp.where(rid == -2, False, jnp.where(rid >= 0, picked, True))
 
 
 def chosen_pv_row(snap, expr_mask, pv_claimed, node, p, j):
@@ -295,50 +429,33 @@ def fold_pv_claims(snap, expr_mask, pv_claimed, accepted, node_of,
     the batch is known claim-disjoint (the rounds engine's _RB_PV guard
     guarantees it) the loop exits after one pass.
 
-    Within a pod, slots claim in ASCENDING candidate-count order (slot
-    order inside a pod carries no meaning, so the slot axis is permuted
-    per pod): greedy lowest-index claiming processed permissive-first
-    can dead-end — slot A {pv0, pv1} takes pv0 before slot B {pv0} —
-    even though the Hall-condition mask admitted the pod because a
-    distinct assignment exists. Constrained-first is exact for 2 slots;
-    a >=3-slot adversarial chain remains a documented PARITY residual."""
+    Within a pod, slots claim in index order with the SDR-SAFE choice
+    (chosen_pv_sdr): greedy lowest-index claiming can dead-end — slot A
+    {pv0, pv1} takes pv0 before slot B {pv0} — even though the
+    Hall-condition mask admitted the pod because a distinct assignment
+    exists. The SDR rule (claim the lowest PV whose removal keeps
+    Hall's condition over the remaining needy slots) is EXACT for any
+    slot count the subset enumeration covers (all of MVol <= 7; capped
+    beyond — PARITY #8)."""
     V = snap.pv_avail.shape[0]
     P = accepted.shape[0]
     MVol = snap.pod_vol_mode.shape[1]
     big = jnp.int32(2**31 - 1)
-    if MVol >= 2 and snap.has_multi_volume:
-        import dataclasses
-
-        pvt = pv_node_table(snap, expr_mask) & ~pv_claimed[:, None]
-        nsafe = jnp.clip(node_of, 0, snap.N - 1)
-        at_node = pvt[:, nsafe].T  # [P, V]
-        counts = jnp.stack(
-            [
-                jnp.sum(
-                    pod_pv_cand(snap, j) & at_node, axis=1,
-                    dtype=jnp.int32,
-                )
-                for j in range(MVol)
-            ],
-            axis=1,
-        )  # [P, MVol]
-        perm = jnp.argsort(counts, axis=1).astype(jnp.int32)
-        snap = dataclasses.replace(
-            snap,
-            pod_vol_mode=jnp.take_along_axis(snap.pod_vol_mode, perm, 1),
-            pod_vol_req=jnp.take_along_axis(snap.pod_vol_req, perm, 1),
-            pod_vol_class=jnp.take_along_axis(snap.pod_vol_class, perm, 1),
-            pod_vol_size=jnp.take_along_axis(snap.pod_vol_size, perm, 1),
-        )
+    multi = MVol >= 2 and snap.has_multi_volume
 
     def body(carry):
         claimed, pending_slots, _progress = carry
         progress = jnp.zeros((), bool)
         for j in range(MVol):
-            ch = chosen_pv(
-                snap, expr_mask, claimed, node_of,
-                pending_slots[:, j], j,
-            )  # [P]
+            if multi:
+                ch = chosen_pv_sdr(
+                    snap, expr_mask, claimed, node_of, pending_slots, j
+                )  # [P]
+            else:
+                ch = chosen_pv(
+                    snap, expr_mask, claimed, node_of,
+                    pending_slots[:, j], j,
+                )  # [P]
             has = ch >= 0
             chc = jnp.clip(ch, 0, V - 1)
             # lowest rank per chosen PV wins this pass
@@ -388,3 +505,91 @@ def chosen_pv(snap, expr_mask, pv_claimed, node_of, active, j):
     idx = jnp.where(cand, jnp.arange(V, dtype=jnp.int32)[None, :], V)
     best = jnp.min(idx, axis=1).astype(jnp.int32)
     return jnp.where(best < V, best, -1)
+
+
+def chosen_pv_sdr(snap, expr_mask, pv_claimed, node_of, pending_slots, j,
+                  mine=None):
+    """i32 [P]: the SDR-safe claim for slot j (see _sdr_safe_choice) —
+    chosen_pv's multi-volume replacement. `pending_slots` (bool
+    [P, MVol]) marks unresolved unbound-static slots; the OTHER pending
+    needy slots define the Hall margins the choice must preserve.
+    `mine` (bool [P, V] or None) additionally excludes PVs this pod
+    already claimed in the same resolution pass (intra-pod
+    distinctness for the contention-free guard simulation)."""
+    MVol = snap.pod_vol_mode.shape[1]
+    pvt = pv_node_table(snap, expr_mask) & ~pv_claimed[:, None]  # [V, N]
+    nsafe = jnp.clip(node_of, 0, snap.N - 1)
+    at_node = pvt[:, nsafe].T  # [P, V]
+    if mine is not None:
+        at_node = at_node & ~mine
+    dyn = _dyn_at_node(snap, expr_mask, node_of)  # [P, MVol]
+    cands = [pod_pv_cand(snap, t) & at_node for t in range(MVol)]
+    needy = pending_slots & (snap.pod_vol_mode == 1) & ~dyn  # [P, MVol]
+    active = pending_slots[:, j]
+    cand_j = cands[j] & active[:, None]
+    ch = _sdr_safe_choice(cand_j, cands, needy, dyn[:, j], MVol, j)
+    return jnp.where(active, ch, -1)
+
+
+def chosen_pv_slots(snap, expr_mask, pv_claimed, node_of, active):
+    """i32 [P, MVol]: the claims a CONTENTION-FREE fold pass would make
+    for each active pod — slots in index order, SDR-safe choice when
+    multi-volume, intra-pod distinctness via a per-pod `mine` bitmap.
+    The rounds engine's _RB_PV guard key (must predict fold_pv_claims's
+    first-pass behavior so claim-disjoint batches fold in one pass)."""
+    MVol = snap.pod_vol_mode.shape[1]
+    V = snap.pv_avail.shape[0]
+    P = node_of.shape[0]
+    multi = MVol >= 2 and snap.has_multi_volume
+    pending = jnp.broadcast_to(active[:, None], (P, MVol)) & (
+        snap.pod_vol_mode == 1
+    )
+    mine = jnp.zeros((P, V), bool)
+    out = []
+    for j in range(MVol):
+        if multi:
+            ch = chosen_pv_sdr(
+                snap, expr_mask, pv_claimed, node_of, pending, j, mine=mine
+            )
+        else:
+            ch = chosen_pv(
+                snap, expr_mask, pv_claimed, node_of, pending[:, j], j
+            )
+        out.append(ch)
+        has = ch >= 0
+        chc = jnp.clip(ch, 0, V - 1)
+        mine = mine.at[jnp.arange(P), chc].max(has)
+        pending = pending.at[:, j].set(False)
+    return jnp.stack(out, axis=1)
+
+
+def chosen_pv_sdr_row(snap, expr_mask, pv_claimed, node, p, pending_row,
+                      j):
+    """Single-pod [V]-scale twin of chosen_pv_sdr (the scan engine's
+    per-step claim; keep in lockstep)."""
+    MVol = snap.pod_vol_mode.shape[1]
+    V = snap.pv_avail.shape[0]
+    nsafe = jnp.clip(node, 0, snap.N - 1)
+    at_node = pv_node_table(snap, expr_mask)[:, nsafe] & ~pv_claimed  # [V]
+    req = labels_ops.requirement_mask(snap.rq_exprs, expr_mask)
+    Rq = req.shape[0]
+    req_at = req[:, nsafe]  # [Rq]
+    rid = snap.pod_vol_req[p]  # [MVol]
+    picked = req_at[jnp.clip(rid, 0, Rq - 1)]
+    dyn = jnp.where(rid == -2, False, jnp.where(rid >= 0, picked, True))
+    mode = snap.pod_vol_mode[p]  # [MVol]
+
+    def cand_row(t):
+        return (
+            (snap.pv_class == snap.pod_vol_class[p, t])
+            & (snap.pv_capacity + _CAP_EPS >= snap.pod_vol_size[p, t])
+            & (mode[t] == 1)
+            & at_node
+        )  # [V]
+
+    cands = [cand_row(t)[None, :] for t in range(MVol)]  # [1, V] each
+    needy = (pending_row & (mode == 1) & ~dyn)[None, :]  # [1, MVol]
+    active = pending_row[j]
+    cand_j = cands[j] & active
+    ch = _sdr_safe_choice(cand_j, cands, needy, dyn[j][None], MVol, j)[0]
+    return jnp.where(active, ch, -1)

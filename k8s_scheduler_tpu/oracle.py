@@ -178,15 +178,19 @@ class OracleState:
             self._claim_static_pvs(node_idx, pod)
 
     def _claim_static_pvs(self, node_idx: int, pod: Pod) -> None:
-        """Mirror of ops/volumes.chosen_pv + fold_pv_claims: lowest-index
-        compatible available unclaimed PV per unbound
-        WaitForFirstConsumer slot, slots claimed in ASCENDING
-        candidate-count order (constrained-first — greedy permissive-
-        first claiming can dead-end even when a distinct assignment
-        exists; exact for 2 slots, like the kernel)."""
+        """Mirror of ops/volumes.chosen_pv_sdr + fold_pv_claims: slots
+        claim in spec order; each claims the LOWEST-INDEX compatible
+        available unclaimed PV whose removal keeps Hall's condition over
+        the pod's remaining static-needy slots (the SDR-safe choice —
+        exact: it always extends to a full distinct assignment when one
+        exists). A dynamic-capable slot with no safe candidate rides
+        dynamic instead of stealing; a needy slot with no safe candidate
+        falls back to the lowest candidate (beyond Hall's guarantee)."""
+        import itertools
+
         claims = []
         node = self.nodes[node_idx]
-        slots = []
+        slots = []  # (pvc, dyn_capable) in spec order
         for claim in pod.spec.volumes:
             pvc = self.pvcs.get(f"{pod.namespace}/{claim}")
             if pvc is None or pvc.volume_name:
@@ -194,21 +198,68 @@ class OracleState:
             cls = self.storage_classes.get(pvc.storage_class)
             if cls is None or cls.volume_binding_mode != api.VOLUME_BINDING_WAIT:
                 continue
-            cand = [
+            dyn = bool(cls.provisioner) and (
+                not cls.allowed_topologies
+                or any(_match_term(node, t) for t in cls.allowed_topologies)
+            )
+            slots.append((pvc, dyn))
+
+        def cand_of(pvc):  # current claimable PVs, pv_list order
+            return [
                 pv
                 for pv in self.pv_list
                 if pv.storage_class == pvc.storage_class
                 and _pv_usable(self, pv, pvc, node)
             ]
-            slots.append((len(cand), len(slots), cand))
-        slots.sort(key=lambda s: (s[0], s[1]))
-        for _cnt, _order, cand in slots:
+
+        def other_subsets(needy_cands):
+            """Mirror of ops/volumes._sdr_other_subsets plus the
+            capped-regime dominance groups of _sdr_safe_choice."""
+            others = sorted(needy_cands)
+            if len(others) <= 6:
+                return [
+                    s
+                    for r in range(1, len(others) + 1)
+                    for s in itertools.combinations(others, r)
+                ]
+            subs = [
+                *itertools.combinations(others, 1),
+                *itertools.combinations(others, 2),
+                tuple(others),
+            ]
+            for a in others:  # dominance groups (needy down-sets)
+                subs.append(tuple(
+                    t for t in others
+                    if needy_cands[t] <= needy_cands[a]
+                ))
+            return subs
+
+        for j, (pvc, dyn) in enumerate(slots):
+            cand = cand_of(pvc)
+            # needy = later unresolved slots that REQUIRE a static PV
+            needy = [
+                (t, slots[t][0])
+                for t in range(j + 1, len(slots))
+                if not slots[t][1]
+            ]
+            needy_cands = {t: {pv.name for pv in cand_of(p)} for t, p in needy}
+            # tight unions are PV-independent: compute once per slot, not
+            # per candidate — a PV is unsafe iff it lies in any of them
+            unsafe = set()
+            for s in other_subsets(needy_cands):
+                union = set().union(*(needy_cands[t] for t in s))
+                if len(union) - 1 < len(s):
+                    unsafe |= union
+            chosen = None
             for pv in cand:
-                if pv.name in self.claimed_static:
-                    continue  # taken by an earlier slot of this pod
-                self.claimed_static.add(pv.name)
-                claims.append(pv.name)
-                break
+                if pv.name not in unsafe:
+                    chosen = pv
+                    break
+            if chosen is None and not dyn and cand:
+                chosen = cand[0]
+            if chosen is not None:
+                self.claimed_static.add(chosen.name)
+                claims.append(chosen.name)
         if claims:
             self.pod_claims[id(pod)] = claims
 

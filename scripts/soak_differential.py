@@ -31,6 +31,7 @@ import numpy as np
 
 from k8s_scheduler_tpu import oracle
 from k8s_scheduler_tpu.core import build_cycle_fn, build_preemption_fn
+from k8s_scheduler_tpu.ops import preemption as preemption_ops
 from k8s_scheduler_tpu.models import SnapshotEncoder
 from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
 
@@ -271,7 +272,8 @@ def mid_case(seed: int, scan_cycle, rounds_cycle, pre_fn, enc):
         _dec, opre = oracle.schedule_with_preemption(
             nodes, pods, existing, pvcs=pvcs, pvs=pvs,
             storage_classes=classes,
-            budget=256, scan_budget=64,
+            budget=preemption_ops.DEFAULT_BUDGET,
+            scan_budget=preemption_ops.DEFAULT_SCAN_BUDGET,
         )
         # PRODUCTION budgets on BOTH sides: the oracle mirrors the
         # kernel's prefilter cap and scan cap, so the comparison is

@@ -354,3 +354,141 @@ def test_two_pods_two_pvcs_each_contending():
     ]
     out = build_cycle_fn(commit_mode="scan")(snap)
     assert list(np.asarray(out.assignment)[:2]) == want
+
+
+# ---- SDR-safe claim choice (VERDICT r4 missing #3 closure) ----
+
+
+def test_three_slot_nested_chain_places_and_claims_distinct():
+    """3-slot nested chain: c0 (1 GiB) fits all three PVs, c1 (5 GiB)
+    fits pv-0/pv-1, c2 (8 GiB) fits only pv-0. Lowest-index greedy in
+    slot order would strand c2; the SDR-safe choice (claim the lowest
+    PV whose removal keeps Hall's condition for the remaining needy
+    slots) must assign c0=pv-2, c1=pv-1, c2=pv-0 in both engines and
+    the oracle."""
+    nodes, pods, pvcs, pvs, classes = _joint_fixture(
+        n_pvs=3, sizes=(1, 5, 8), pv_caps=[10, 6, 2]
+    )
+    assert_differential(nodes, pods, pvcs, pvs, classes)
+    enc = SnapshotEncoder(pad_pods=8, pad_nodes=4)
+    snap = enc.encode(nodes, pods, pvcs=pvcs, pvs=pvs,
+                      storage_classes=classes)
+    for mode in ("scan", "rounds"):
+        out = build_cycle_fn(commit_mode=mode)(snap)
+        assert np.asarray(out.assignment)[0] == 0, mode
+        assert np.asarray(out.pv_claimed).sum() == 3, mode
+
+    state = oracle.OracleState.build(nodes, (), pvcs, pvs, classes)
+    assert oracle.filter_volume_binding(pods[0], state, 0)
+    state.add(0, pods[0])
+    assert state.claimed_static == {"pv-0", "pv-1", "pv-2"}
+
+
+def test_sdr_safe_choice_crossing_sets():
+    """Unit test of the SDR chooser on CROSSING candidate sets — not
+    producible through the encoder today (per-slot sets are capacity-
+    nested within a class at one node, where the old constrained-
+    count-first ordering happened to be exact); this guards the
+    mechanism for richer future candidate semantics (PVC selectors,
+    access modes), where count ordering is NOT enough. Sets A{0,3},
+    B{0,1}, C{0,1}: every slot has 2 candidates, so count ordering
+    degenerates to slot order, greedy gives A=pv0 and strands one of
+    B/C; SDR must start A at pv3."""
+    import jax.numpy as jnp
+
+    from k8s_scheduler_tpu.ops.volumes import _sdr_safe_choice
+
+    V = 4
+
+    def row(*idx):
+        r = np.zeros((1, V), bool)
+        r[0, list(idx)] = True
+        return jnp.asarray(r)
+
+    cands = [row(0, 3), row(0, 1), row(0, 1)]
+    needy = jnp.ones((1, 3), bool)
+    no_dyn = jnp.zeros((1,), bool)
+    assert int(_sdr_safe_choice(cands[0], cands, needy, no_dyn, 3, 0)[0]) == 3
+
+    # dyn-capable slot with no safe candidate rides dynamic (-1)...
+    cands2 = [row(0), row(0)]
+    needy2 = jnp.asarray([[False, True]])
+    assert int(
+        _sdr_safe_choice(cands2[0], cands2, needy2, jnp.ones((1,), bool),
+                         2, 0)[0]
+    ) == -1
+    # ...while a needy slot with no safe candidate falls back to the
+    # lowest candidate (the pod is beyond Hall's guarantee)
+    assert int(
+        _sdr_safe_choice(cands2[0], cands2, needy2, no_dyn, 2, 0)[0]
+    ) == 0
+
+
+def test_chosen_pv_slots_intra_pod_distinct():
+    """The rounds-engine guard's contention-free simulation must claim
+    DISTINCT PVs across one pod's slots (per-pod `mine` bitmap), so its
+    _RB_PV keys predict fold_pv_claims's first pass."""
+    import jax.numpy as jnp
+
+    from k8s_scheduler_tpu.framework.interfaces import CycleContext
+    from k8s_scheduler_tpu.ops import volumes as volumes_ops
+
+    nodes, pods, pvcs, pvs, classes = _joint_fixture(
+        n_pvs=3, sizes=(1, 5, 8), pv_caps=[10, 6, 2]
+    )
+    enc = SnapshotEncoder(pad_pods=8, pad_nodes=4)
+    snap = enc.encode(nodes, pods, pvcs=pvcs, pvs=pvs,
+                      storage_classes=classes)
+    ctx = CycleContext(snap)
+    P = snap.P
+    node_of = jnp.zeros((P,), jnp.int32)
+    active = jnp.zeros((P,), bool).at[0].set(True)
+    claimed0 = jnp.zeros((snap.pv_avail.shape[0],), bool)
+    ch = np.asarray(
+        volumes_ops.chosen_pv_slots(
+            snap, ctx.expr_node_mask, claimed0, node_of, active
+        )
+    )[0]
+    got = [c for c in ch if c >= 0]
+    assert sorted(got) == [0, 1, 2], ch
+    assert len(set(got)) == 3
+
+
+def test_eight_slot_admission_mid_size_tight_subset_rejected():
+    """8 slots forces the capped subset enumeration (MVol > 6); the
+    Hall-tight subset here is a TRIPLE (three size-8 slots over two
+    big PVs) that neither pairs nor the full set catch — the per-pod
+    dominance groups must reject it, and the oracle (full enumeration)
+    agrees."""
+    nodes, pods, pvcs, pvs, classes = _joint_fixture(
+        n_pvs=8, sizes=(8, 8, 8, 1, 1, 1, 1, 1),
+        pv_caps=[10, 10, 2, 2, 2, 2, 2, 2],
+    )
+    assert_differential(nodes, pods, pvcs, pvs, classes)
+    got, _ = kernel_mask(nodes, pods, pvcs, pvs, classes)
+    assert not got[0, 0]
+
+
+def test_eight_slot_claims_via_dominance_groups():
+    """8 feasible slots (capped enumeration): the small slot s0 must
+    NOT claim one of the three big PVs its three size-8 siblings need
+    (a triple the singles/pairs/full-set margins all miss) — the
+    dominance-group margin steers s0 to a small PV and all 8 slots
+    claim distinct PVs."""
+    nodes, pods, pvcs, pvs, classes = _joint_fixture(
+        n_pvs=8, sizes=(1, 8, 8, 8, 1, 1, 1, 1),
+        pv_caps=[10, 10, 10, 2, 2, 2, 2, 2],
+    )
+    assert_differential(nodes, pods, pvcs, pvs, classes)
+    enc = SnapshotEncoder(pad_pods=8, pad_nodes=4)
+    snap = enc.encode(nodes, pods, pvcs=pvcs, pvs=pvs,
+                      storage_classes=classes)
+    for mode in ("scan", "rounds"):
+        out = build_cycle_fn(commit_mode=mode)(snap)
+        assert np.asarray(out.assignment)[0] == 0, mode
+        assert np.asarray(out.pv_claimed).sum() == 8, mode
+
+    state = oracle.OracleState.build(nodes, (), pvcs, pvs, classes)
+    assert oracle.filter_volume_binding(pods[0], state, 0)
+    state.add(0, pods[0])
+    assert state.claimed_static == {f"pv-{v}" for v in range(8)}
