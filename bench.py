@@ -19,7 +19,8 @@ Per config, bench_suite reports BOTH:
   an async-dispatch artifact; numbers here force real results.)
 
 Env knobs: BENCH_FORCE_CPU=1, BENCH_SNAPSHOTS=<n> (per-config override),
-BENCH_CONFIGS=1,2,3,4,5, BENCH_CHURN=<frac>, BENCH_COMMIT_MODE.
+BENCH_CONFIGS=1,2,3,4,5, BENCH_CHURN=<frac>, BENCH_COMMIT_MODE,
+BENCH_ISOLATE=0 (disable the per-config subprocess isolation).
 """
 
 import json
@@ -30,6 +31,81 @@ TARGET_DECISIONS_PER_SEC = 50_000.0
 
 # distinct snapshots per config; overridable via BENCH_SNAPSHOTS
 DEFAULT_SNAPSHOTS = {1: 50, 2: 50, 3: 50, 4: 30, 5: 30}
+
+
+def _run_one_isolated(c: int, n: int):
+    """Run one config in a FRESH interpreter (default; BENCH_ISOLATE=0
+    falls back to in-process). The axon rig can WEDGE a whole process:
+    after certain executable-cache faults (observed: the second
+    invocation of a second-regime preemption program raising
+    'INVALID_ARGUMENT: TPU backend error'), every later device op in the
+    process — including plain device_put — fails. In-process isolation
+    (_run_one) then loses every later config too, which is exactly how
+    round 5's first full run zeroed configs 4-5 after one fault.
+    Subprocess isolation contains the wedge to one config attempt, and
+    the retry gets a clean backend session."""
+    import subprocess
+    import tempfile
+
+    fd, out_path = tempfile.mkstemp(prefix=f"bench_cfg{c}_", suffix=".json")
+    os.close(fd)
+    code = (
+        "import json, bench_suite\n"
+        f"r = bench_suite.run_config({c}, snapshots={n})\n"
+        f"json.dump(r, open({out_path!r}, 'w'))\n"
+    )
+    timeout_s = float(os.environ.get("BENCH_CONFIG_TIMEOUT", "2400"))
+    last_err = None
+    try:
+        for attempt in range(2):
+            env = dict(os.environ)
+            if attempt == 1 and last_err and not last_err.get("transport"):
+                # wedge-class failures are deterministic in the fold
+                # replay: the retry drops bind-folding (recorded
+                # honestly — the result carries fold_binds:false and the
+                # error stays in errors[]) so the config still produces
+                # evidence
+                env["BENCH_FOLD"] = "0"
+            try:
+                p = subprocess.run(
+                    [sys.executable, "-c", code],
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                    capture_output=True, text=True, timeout=timeout_s,
+                    env=env,
+                )
+            except subprocess.TimeoutExpired:
+                # a hang-shaped wedge: the fresh-process retry (with
+                # folding dropped) is still worth one shot
+                last_err = {"config": c, "attempt": attempt,
+                            "transport": False,
+                            "error": f"timeout after {timeout_s}s"}
+                print(f"bench: config {c} attempt {attempt} timed out",
+                      file=sys.stderr, flush=True)
+                continue
+            if p.stderr:
+                sys.stderr.write(p.stderr[-4000:])
+                sys.stderr.flush()
+            if p.returncode == 0 and os.path.getsize(out_path) > 0:
+                with open(out_path) as f:
+                    r = json.load(f)
+                return r, last_err
+            from k8s_scheduler_tpu.core.cycle import is_transport_error
+
+            tail = (p.stderr or "").strip().splitlines()
+            msg = tail[-1] if tail else f"rc={p.returncode}"
+            transport = is_transport_error(RuntimeError(p.stderr or ""))
+            last_err = {"config": c, "attempt": attempt,
+                        "transport": transport, "error": msg[-300:]}
+            print(f"bench: config {c} attempt {attempt} failed "
+                  f"(subprocess): {msg[-300:]}", file=sys.stderr, flush=True)
+            # a fresh process IS the recovery for wedge-class faults, so
+            # one retry is worth it for any failure class here
+        return None, last_err
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
 
 
 def _run_one(run_config, c: int, n: int):
@@ -81,11 +157,15 @@ def main() -> None:
         for c in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(",")
     ]
     override = os.environ.get("BENCH_SNAPSHOTS")
+    isolate = os.environ.get("BENCH_ISOLATE", "1") == "1"
     results = []
     errors = []
     for c in configs:
         n = int(override) if override else DEFAULT_SNAPSHOTS[c]
-        r, err = _run_one(bench_suite.run_config, c, n)
+        if isolate:
+            r, err = _run_one_isolated(c, n)
+        else:
+            r, err = _run_one(bench_suite.run_config, c, n)
         if r is not None:
             results.append(r)
         if err is not None:

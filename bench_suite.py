@@ -258,8 +258,35 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
         return hit
 
     # one encoder across snapshots keeps the string/selector dictionaries
-    # stable (what a long-lived serving process sees)
-    enc = SnapshotEncoder(pad_pods=_pad(P_real), pad_nodes=_pad(N_real))
+    # stable (what a long-lived serving process sees). pad_existing
+    # pre-sizes the sticky E regime for the fold loop's growth (base +
+    # up to one full pending set before the first eviction + churn-sized
+    # binds for the rest of the window): an E-regime flip mid-run costs
+    # a full recompile AND has tripped a rig executable-cache wedge
+    # (see bench.py _run_one_isolated).
+    fold_binds = (
+        os.environ.get("BENCH_FOLD", "1") == "1" and cfg != 5
+    )
+    fold_evict_every = int(os.environ.get("BENCH_FOLD_EVICT", "4"))
+    base_nodes, base_existing = make_config_base(cfg)
+    e_need = (
+        len(base_existing)
+        + P_real
+        + (fold_evict_every - 1) * max(1, int(churn * P_real))
+    )
+    # MPN (hot-node victim-table depth): base depth + the fold window's
+    # binds assuming a 4x concentration over the uniform share
+    mpn_need = (
+        -(-len(base_existing) // max(N_real, 1))
+        + 4 * max(1, e_need // max(N_real, 1))
+    )
+    enc = SnapshotEncoder(
+        pad_pods=_pad(P_real), pad_nodes=_pad(N_real),
+        pad_existing=_pad(e_need) if fold_binds else None,
+        pad_pods_per_node=(
+            ((mpn_need + 7) // 8) * 8 if fold_binds else None
+        ),
+    )
 
     # Timing methodology: on this rig the TPU sits behind a tunnel with a
     # measured fixed dispatch round-trip (reported as tunnel_rt_ms), and
@@ -277,8 +304,6 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     shape_keys: set = set()
     totals = {"scheduled": 0, "unschedulable": 0, "gang_dropped": 0,
               "preemptors": 0, "victims": 0}
-    base_nodes, base_existing = make_config_base(cfg)
-
     noop = jax.jit(lambda w: w[:8].sum())
 
     def dispatch(fns, w, b, dirty):
@@ -326,11 +351,8 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     # cannot observe bindings without paying a tunnel round-trip per
     # cycle, so it measures pure decision throughput; the fold cost is
     # carried by p50/p99/encode_p50 here. BENCH_FOLD=0 restores the
-    # round-4 fixed-existing behavior.
-    fold_binds = (
-        os.environ.get("BENCH_FOLD", "1") == "1" and cfg != 5
-    )
-    fold_evict_every = int(os.environ.get("BENCH_FOLD_EVICT", "4"))
+    # round-4 fixed-existing behavior. (fold_binds/fold_evict_every are
+    # defined above, before the encoder, to size pad_existing.)
     base_len = len(base_existing)
     folded_n = 0
 

@@ -117,6 +117,18 @@ _CORRUPT_MARKERS = (
     "compiled program expected",   # supplied N buffers, expected N+1
     "buffer with incompatible size",  # stale entry from another regime
     "Executable expected parameter",
+    # rig wedge observed in round 5: after an E-regime flip, the second
+    # invocation of the second-regime preemption executable raises this
+    # and the process's backend session is wedged (subsequent device_put
+    # fails too). clear_cache+retry does NOT heal it in-process — the
+    # strike surfaces it on the metrics endpoint and the bounded retries
+    # raise, at which point a process restart (with the persistent
+    # compilation cache warm) is the recovery. Avoidance: pre-size the
+    # sticky E and MPN pads (SnapshotEncoder(pad_existing=...,
+    # pad_pods_per_node=...)) so bind-folding never flips the regime
+    # mid-serving. The marker is the common substring of the observed
+    # formats ('INVALID_ARGUMENT: TPU backend error (InvalidArgument)').
+    "TPU backend error",
 )
 
 # tunneled-rig transport flake signatures: the compile/execute RPC dies

@@ -400,11 +400,23 @@ class SnapshotEncoder:
         pad_pods: int | None = None,
         pad_nodes: int | None = None,
         queue_sort=None,  # QueueSortPlugin; None = PrioritySort
+        pad_existing: int | None = None,  # pre-size the sticky E pad: a
+        # deployment that folds bindings into the existing set should set
+        # this to its expected steady-state existing count so the E
+        # regime (and the ~100 s cold recompile a regime flip costs)
+        # never changes mid-serving
+        pad_pods_per_node: int | None = None,  # pre-size the sticky MPN
+        # (victim-table) pad the same way: bind-folds deepen hot nodes'
+        # pod lists, and an MPN flip is a full regime change too. NOTE
+        # the preemption what-if tables scale with MPN — size to the
+        # realistic hot-node depth, not the worst case
     ) -> None:
         self.strings = StringInterner()
         self.resource_names = list(resource_names)
         self.pad_pods = pad_pods
         self.pad_nodes = pad_nodes
+        self.pad_existing = pad_existing
+        self.pad_pods_per_node = pad_pods_per_node
         # the profile's queueSort plugin (SURVEY §2 C11): owns the
         # pod_order rank both encode paths bake into the snapshot
         if queue_sort is None:
@@ -562,8 +574,14 @@ class SnapshotEncoder:
         P = self.pad_pods or _pow2_bucket(p_real)
         # E is STICKY (like MPL/MA): the incremental existing-fold appends
         # bound pods in place, and a completion batch that shrinks e_real
-        # must not flip the packed regime
-        E = self._stick("E", _pow2_bucket(e_real) if e_real else 8)
+        # must not flip the packed regime; pad_existing pre-sizes it
+        E = self._stick(
+            "E",
+            max(
+                _pow2_bucket(e_real) if e_real else 8,
+                self.pad_existing or 0,
+            ),
+        )
 
         node_index = {nd.name: i for i, nd in enumerate(nodes)}
         names_now = tuple(nd.name for nd in nodes)
@@ -965,7 +983,11 @@ class SnapshotEncoder:
             "MPL", _pad_dim(max([len(d["lab_k"]) for d in all_rows] + [1]), 8)
         )
         MA = self._stick(
-            "MA", _pad_dim(max([d["n_aff"] for d in all_rows] + [1]), 4)
+            # bucket 2, not 4: real pods rarely carry >2 terms per axis
+            # and every per-slot loop in the dyn kernels (W builds,
+            # spread-mask HIGH dots, update matmuls, preemption what-if)
+            # pays the pad directly; sticky growth keeps recompiles rare
+            "MA", _pad_dim(max([d["n_aff"] for d in all_rows] + [1]), 2)
         )
 
         from .. import native
@@ -1143,11 +1165,18 @@ class SnapshotEncoder:
                     np.where(starts, np.arange(sn.size), 0)
                 )
                 col = np.arange(sn.size) - group_start
-                MPN = self._stick("MPN", _pad_dim(int(col.max()) + 1, 8))
+                MPN = self._stick(
+                    "MPN",
+                    max(_pad_dim(int(col.max()) + 1, 8),
+                        self.pad_pods_per_node or 0),
+                )
                 node_pods = np.full((N, MPN), -1, np.int32)
                 node_pods[sn, col] = se
             else:
-                MPN = self._stick("MPN", _pad_dim(1, 8))
+                MPN = self._stick(
+                    "MPN",
+                    max(_pad_dim(1, 8), self.pad_pods_per_node or 0),
+                )
                 node_pods = np.full((N, MPN), -1, np.int32)
 
             # ---- topology domains (flat ids across keys) ----
@@ -1424,8 +1453,8 @@ class SnapshotEncoder:
         pod_pref_aff_w = np.zeros((P, MA), np.float32)
 
         MC = self._stick(
-            "MC",
-            _pad_dim(max([len(d["tsc_skew"]) for d in pend_rows] + [1]), 4),
+            "MC",  # bucket 2 like MA (same per-slot-loop cost argument)
+            _pad_dim(max([len(d["tsc_skew"]) for d in pend_rows] + [1]), 2),
         )
         pod_tsc = np.full((P, MC, 3), -1, np.int32)
         pod_tsc_skew = np.zeros((P, MC), np.int32)

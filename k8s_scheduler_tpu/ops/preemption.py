@@ -80,6 +80,7 @@ re-lists, so the same information lag exists across its cycles).
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -550,6 +551,12 @@ def run_preemption(
     # config #4 that is ~19 latency-bound steps instead of scan_budget
     # (64) — each dead step cost ~0.2 ms on TPU.
     n_live = jnp.sum(live2).astype(jnp.int32)
+    if os.environ.get("K8S_TPU_PREEMPT_FIXED_LOOP") == "1":
+        # debug/workaround knob: run every budgeted rank (dead ranks are
+        # no-ops) instead of the data-dependent live bound — isolates
+        # rig issues with dynamic-trip while loops at ~0.2 ms per dead
+        # step
+        n_live = jnp.int32(C2)
     pods0 = cand_ids2  # rank -> pod id is static; dead ranks emit -1
     noms0 = jnp.full(C2, -1, jnp.int32)
 

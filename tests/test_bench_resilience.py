@@ -36,6 +36,9 @@ def _mk_result(cfg):
 def _run_bench_main(monkeypatch, capsys, run_config, configs="1,2"):
     monkeypatch.setenv("BENCH_CONFIGS", configs)
     monkeypatch.setenv("BENCH_SNAPSHOTS", "1")
+    # in-process so the monkeypatched run_config is what executes (the
+    # default subprocess isolation would run the real one)
+    monkeypatch.setenv("BENCH_ISOLATE", "0")
     import bench_suite
 
     monkeypatch.setattr(bench_suite, "run_config", run_config)
