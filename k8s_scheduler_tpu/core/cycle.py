@@ -123,11 +123,21 @@ _CORRUPT_MARKERS = (
     # fails too). clear_cache+retry does NOT heal it in-process — the
     # strike surfaces it on the metrics endpoint and the bounded retries
     # raise, at which point a process restart (with the persistent
-    # compilation cache warm) is the recovery. Avoidance: pre-size the
-    # sticky E and MPN pads (SnapshotEncoder(pad_existing=...,
-    # pad_pods_per_node=...)) so bind-folding never flips the regime
-    # mid-serving. The marker is the common substring of the observed
-    # formats ('INVALID_ARGUMENT: TPU backend error (InvalidArgument)').
+)
+
+# rig wedge signatures (round 5): after an E/MPN-regime flip, the second
+# invocation of the second-regime preemption executable raises this and
+# the process's backend SESSION is wedged — every later device op,
+# including plain device_put, fails; clear_cache + retrace does NOT heal
+# it (verified on-rig), so retrying would only burn ~100 s retraces
+# before the inevitable raise. _Resilient records the strike and raises
+# IMMEDIATELY: a process restart with the warm persistent compilation
+# cache (~1-7 s) is the recovery, per the stateless design. Avoidance:
+# pre-size the sticky E and MPN pads (SnapshotEncoder(pad_existing=...,
+# pad_pods_per_node=...)) so bind-folding never flips the regime
+# mid-serving. Marker = common substring of the observed formats
+# ('INVALID_ARGUMENT: TPU backend error (InvalidArgument)').
+_WEDGE_MARKERS = (
     "TPU backend error",
 )
 
@@ -221,6 +231,12 @@ class _Resilient:
                     import time
 
                     time.sleep(0.5 * (attempt + 1))
+                elif any(m in msg for m in _WEDGE_MARKERS):
+                    # not healable in-process (see _WEDGE_MARKERS):
+                    # strike for observability, fail fast for the
+                    # restart-based recovery
+                    _record_strike(self._fn.__name__, "backend_wedge")
+                    raise
                 elif any(m in msg for m in _CORRUPT_MARKERS):
                     _record_strike(self._fn.__name__, "executable_cache")
                     self._fn.clear_cache()

@@ -574,13 +574,15 @@ class SnapshotEncoder:
         P = self.pad_pods or _pow2_bucket(p_real)
         # E is STICKY (like MPL/MA): the incremental existing-fold appends
         # bound pods in place, and a completion batch that shrinks e_real
-        # must not flip the packed regime; pad_existing pre-sizes it
+        # must not flip the packed regime; pad_existing pre-sizes it.
+        # The pad folds INTO the pow2 bucket (not max'd after) so a
+        # non-power-of-two pad can never leave E below the bucket a
+        # grown e_real would demand — that would re-flip the regime
+        # mid-run, the exact thing pre-sizing exists to prevent.
         E = self._stick(
             "E",
-            max(
-                _pow2_bucket(e_real) if e_real else 8,
-                self.pad_existing or 0,
-            ),
+            _pow2_bucket(max(e_real, self.pad_existing or 0))
+            if (e_real or self.pad_existing) else 8,
         )
 
         node_index = {nd.name: i for i, nd in enumerate(nodes)}

@@ -163,6 +163,32 @@ def test_resilient_corruption_strike_clears_cache_and_counts():
     assert RESILIENT_STRIKES == {("fake_corrupt", "executable_cache"): 1}
 
 
+def test_resilient_wedge_fails_fast_with_strike():
+    """The rig-wedge signature is NOT healable in-process (clear_cache +
+    retrace fail once the backend session is wedged — PERF.md r5), so
+    _Resilient must record the strike and raise on the FIRST attempt
+    instead of burning ~100s retraces."""
+    state = {"calls": 0, "cleared": 0}
+
+    def fn(x):
+        state["calls"] += 1
+        raise RuntimeError(
+            "INVALID_ARGUMENT: TPU backend error (InvalidArgument)."
+        )
+
+    fn.__name__ = "fake_wedge"
+    fn.clear_cache = lambda: state.__setitem__(
+        "cleared", state["cleared"] + 1
+    )
+
+    RESILIENT_STRIKES.clear()
+    with pytest.raises(RuntimeError, match="TPU backend error"):
+        _Resilient(fn)(1)
+    assert state["calls"] == 1  # no doomed retries
+    assert state["cleared"] == 0  # no needless retrace
+    assert RESILIENT_STRIKES == {("fake_wedge", "backend_wedge"): 1}
+
+
 def test_resilient_reraises_non_retryable():
     def fn(x):
         raise ValueError("rank mismatch in dot_general")
