@@ -95,6 +95,15 @@ class SchedulerConfiguration:
     # "scan" = strict sequential per-pod scan (exact ScheduleOne order)
     commit_mode: str = "rounds"
     extenders: list[Extender] = field(default_factory=list)
+    # sticky-regime pre-sizing (TPU-native extension): a fold-heavy
+    # deployment (bindings folded into the existing set every cycle)
+    # should pre-size the existing-pod pad to its steady-state count and
+    # the per-node victim-table depth to its hot-node depth, so the
+    # packed regime never flips mid-serving — a flip costs a full
+    # recompile and has tripped a rig-side executable wedge (PERF.md
+    # "fold-mode rig wedge"). 0 = size from the first snapshot.
+    pad_existing: int = 0
+    pad_pods_per_node: int = 0
 
     def profile(self, scheduler_name: str = "default-scheduler") -> Profile:
         for p in self.profiles:
@@ -210,6 +219,8 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         pod_max_backoff_seconds=data.get("podMaxBackoffSeconds", 10.0),
         gang_scheduling=data.get("gangScheduling", True),
         commit_mode=data.get("commitMode", "rounds"),
+        pad_existing=int(data.get("padExisting", 0)),
+        pad_pods_per_node=int(data.get("padPodsPerNode", 0)),
         extenders=[
             Extender(
                 url_prefix=e["urlPrefix"],

@@ -147,7 +147,11 @@ class Scheduler:
 
         self._encoders = {
             n: SnapshotEncoder(
-                queue_sort=queue_sort_for_profile(self.config.profile(n))
+                queue_sort=queue_sort_for_profile(self.config.profile(n)),
+                pad_existing=self.config.pad_existing or None,
+                pad_pods_per_node=(
+                    self.config.pad_pods_per_node or None
+                ),
             )
             for n in names
         }

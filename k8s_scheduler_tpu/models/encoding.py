@@ -1167,17 +1167,22 @@ class SnapshotEncoder:
                     np.where(starts, np.arange(sn.size), 0)
                 )
                 col = np.arange(sn.size) - group_start
+                # the pad folds INTO the bucket-of-8 (like E into its
+                # pow2 bucket): a non-multiple-of-8 pad must not leave
+                # MPN below the bucket a grown depth would demand
                 MPN = self._stick(
                     "MPN",
-                    max(_pad_dim(int(col.max()) + 1, 8),
-                        self.pad_pods_per_node or 0),
+                    _pad_dim(
+                        max(int(col.max()) + 1,
+                            self.pad_pods_per_node or 0), 8
+                    ),
                 )
                 node_pods = np.full((N, MPN), -1, np.int32)
                 node_pods[sn, col] = se
             else:
                 MPN = self._stick(
                     "MPN",
-                    max(_pad_dim(1, 8), self.pad_pods_per_node or 0),
+                    _pad_dim(max(1, self.pad_pods_per_node or 0), 8),
                 )
                 node_pods = np.full((N, MPN), -1, np.int32)
 

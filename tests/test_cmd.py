@@ -107,3 +107,28 @@ def test_lease_heartbeat_renews(tmp_path):
         assert lease.holder()["renewTime"] > first
     finally:
         lease.release()
+
+
+def test_pad_presizing_flows_from_yaml_to_encoder():
+    """padExisting / padPodsPerNode (PERF.md 'fold-mode rig wedge'
+    avoidance) must reach the per-profile encoders, and the encoded
+    regime must honor them (E folded into the pow2 bucket, MPN into
+    the bucket-of-8)."""
+    from k8s_scheduler_tpu.config.types import load_config
+    from k8s_scheduler_tpu.core.scheduler import Scheduler
+    from k8s_scheduler_tpu.models import MakeNode, MakePod
+
+    cfg = load_config(
+        "padExisting: 300\npadPodsPerNode: 25\n"
+    )
+    assert cfg.pad_existing == 300 and cfg.pad_pods_per_node == 25
+    sched = Scheduler(config=cfg)
+    enc = sched._encoder
+    assert enc.pad_existing == 300 and enc.pad_pods_per_node == 25
+    nodes = [MakeNode("a").capacity({"cpu": "8"}).obj()]
+    pods = [MakePod("p").req({"cpu": "1"}).obj()]
+    ex = [(MakePod("e").req({"cpu": "1"}).obj(), "a")]
+    snap = enc.encode(nodes, pods, existing=ex)
+    assert snap.exist_valid.shape[0] == 512  # pow2 bucket of 300
+    assert snap.node_pods.shape[1] == 32  # bucket-of-8 ABOVE the pad: a
+    # depth within the operator's sizing must never outgrow the regime
