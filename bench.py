@@ -212,6 +212,10 @@ def main() -> None:
             "p99": round(r["p99_ms"], 1),
             "dev": round(r.get("device_ms", 0.0), 1),
             "enc": round(r.get("encode_p50_ms", 0.0), 1),
+            # split-phase pipeline: encode-overlap % and decision-fetch
+            # bytes (the slimmed payload the bind path blocks on)
+            "ov": round(r.get("overlap_pct", 0.0)),
+            "fb": r.get("fetch_bytes", 0),
             "sched": r.get("scheduled", 0),
             "unsched": r.get("unschedulable", 0),
         }

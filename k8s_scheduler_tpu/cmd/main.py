@@ -51,6 +51,23 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         "--profile-every", type=int, default=0,
         help="every N cycles, run the per-plugin profiling pass (0 = off)",
     )
+    ap.add_argument(
+        "--forced-sync", action="store_true",
+        help="block every cycle dispatch to completion (disables the "
+        "split-phase serving pipeline's overlap; for debugging and "
+        "latency measurement — results are identical either way)",
+    )
+    ap.add_argument(
+        "--pad-ma", type=int, default=0,
+        help="pre-size the sticky per-pod affinity-term pad (MA) so a "
+        "mid-serving arrival of a many-term pod cannot flip the packed "
+        "regime (overrides config padMa; 0 = keep config)",
+    )
+    ap.add_argument(
+        "--pad-mc", type=int, default=0,
+        help="pre-size the sticky per-pod topology-spread-constraint pad "
+        "(MC) the same way (overrides config padMc; 0 = keep config)",
+    )
     return ap
 
 
@@ -59,6 +76,12 @@ def main(argv: list[str] | None = None) -> int:
     config = (
         load_config(args.config) if args.config else SchedulerConfiguration()
     )
+    if args.pad_ma:
+        config.pad_ma = args.pad_ma
+    if args.pad_mc:
+        config.pad_mc = args.pad_mc
+    if args.forced_sync:
+        config.forced_sync = True
 
     # multi-host (DCN) runtime: a no-op unless the launcher set the JAX
     # coordinator env vars (parallel/mesh.py initialize_distributed)

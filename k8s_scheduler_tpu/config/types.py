@@ -104,6 +104,19 @@ class SchedulerConfiguration:
     # "fold-mode rig wedge"). 0 = size from the first snapshot.
     pad_existing: int = 0
     pad_pods_per_node: int = 0
+    # pre-size the sticky per-pod term pads the same way (ADVICE r5): MA
+    # = (anti-)affinity/preferred terms per pod, MC = topology-spread
+    # constraints per pod. Both bucket by 2, so a mid-serving arrival of
+    # a 3-4-term pod otherwise flips the regime. 0 = size from the first
+    # snapshot.
+    pad_ma: int = 0
+    pad_mc: int = 0
+    # serving-pipeline escape hatch: block every cycle dispatch to
+    # completion before continuing (strict sequential execution —
+    # identical results, no overlap). For tests and latency measurement;
+    # production serving leaves this False and overlaps preemption/
+    # diagnosis/transfer with host bind work (core/pipeline.py).
+    forced_sync: bool = False
 
     def profile(self, scheduler_name: str = "default-scheduler") -> Profile:
         for p in self.profiles:
@@ -221,6 +234,9 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         commit_mode=data.get("commitMode", "rounds"),
         pad_existing=int(data.get("padExisting", 0)),
         pad_pods_per_node=int(data.get("padPodsPerNode", 0)),
+        pad_ma=int(data.get("padMa", 0)),
+        pad_mc=int(data.get("padMc", 0)),
+        forced_sync=bool(data.get("forcedSync", False)),
         extenders=[
             Extender(
                 url_prefix=e["urlPrefix"],

@@ -1,4 +1,5 @@
 from .cycle import (  # noqa: F401
+    CycleDecision,
     CycleResult,
     build_carry_fns,
     build_cycle_fn,
@@ -9,4 +10,5 @@ from .cycle import (  # noqa: F401
     build_preemption_fn,
     build_stable_state_fn,
 )
+from .pipeline import ServingPipeline, build_decision_slim_fn  # noqa: F401
 from .scheduler import CycleStats, Scheduler  # noqa: F401

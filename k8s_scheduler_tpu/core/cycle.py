@@ -59,6 +59,24 @@ class CycleResult:
     # capacity rejections, guard rejections) per round, summed over passes
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CycleDecision:
+    """The latency-critical subset of a cycle's outputs: exactly what the
+    driver must have in hand before bindings can go out, and nothing
+    else. `build_cycle_fn(outputs="latency")` returns this instead of
+    CycleResult — reject attribution, per-round convergence diagnostics,
+    and the PV claim bitmap are then never computed on the decision
+    path (XLA dead-code-eliminates their kernels from the compiled
+    program); FailedScheduling attribution comes from the separate
+    diagnosis program (build_diagnosis_fn), off-path."""
+
+    assignment: jnp.ndarray  # i32 [P] node index or -1
+    node_requested: jnp.ndarray  # f32 [N, R] post-cycle (the carry)
+    unschedulable: jnp.ndarray  # bool [P] valid pod that found no node
+    gang_dropped: jnp.ndarray  # bool [P] placed, then unwound
+
+
 def sampling_mask(snap: ClusterSnapshot, pct: int) -> jnp.ndarray:
     """percentageOfNodesToScore: restrict each pod to a rotating window of
     candidate nodes (bool [P, N]).
@@ -117,12 +135,6 @@ _CORRUPT_MARKERS = (
     "compiled program expected",   # supplied N buffers, expected N+1
     "buffer with incompatible size",  # stale entry from another regime
     "Executable expected parameter",
-    # rig wedge observed in round 5: after an E-regime flip, the second
-    # invocation of the second-regime preemption executable raises this
-    # and the process's backend session is wedged (subsequent device_put
-    # fails too). clear_cache+retry does NOT heal it in-process — the
-    # strike surfaces it on the metrics endpoint and the bounded retries
-    # raise, at which point a process restart (with the persistent
 )
 
 # rig wedge signatures (round 5): after an E/MPN-regime flip, the second
@@ -231,15 +243,20 @@ class _Resilient:
                     import time
 
                     time.sleep(0.5 * (attempt + 1))
+                elif any(m in msg for m in _CORRUPT_MARKERS):
+                    # corrupt BEFORE wedge: the wedge marker is a broad
+                    # substring ('TPU backend error') that can wrap an
+                    # INVALID_ARGUMENT-carried corruption message, and the
+                    # healable clear_cache+retry recovery must win when
+                    # both match (ADVICE r5)
+                    _record_strike(self._fn.__name__, "executable_cache")
+                    self._fn.clear_cache()
                 elif any(m in msg for m in _WEDGE_MARKERS):
                     # not healable in-process (see _WEDGE_MARKERS):
                     # strike for observability, fail fast for the
                     # restart-based recovery
                     _record_strike(self._fn.__name__, "backend_wedge")
                     raise
-                elif any(m in msg for m in _CORRUPT_MARKERS):
-                    _record_strike(self._fn.__name__, "executable_cache")
-                    self._fn.clear_cache()
                 else:
                     raise
 
@@ -376,10 +393,22 @@ def build_cycle_fn(
     max_rounds: int = 64,
     percentage_of_nodes_to_score: int = 0,  # 0 = adaptive (upstream default)
     rounds_kw: dict | None = None,  # compact/passes/shortlist overrides
+    outputs: str = "full",  # "full" -> CycleResult, "latency" ->
+    # CycleDecision: only the decision carry is computed; reject
+    # attribution / per-round diagnostics / pv_claimed move off the
+    # decision path (build_diagnosis_fn is the deferred companion)
 ) -> Callable[[ClusterSnapshot], CycleResult]:
     """Compile the cycle for a framework (default: the default plugin set).
     The returned callable is jitted; snapshots with identical padded shapes
     reuse the compiled program.
+
+    `outputs` selects the split-phase axis: "full" returns the classic
+    CycleResult (diagnostic outputs fused into the decision program);
+    "latency" returns a CycleDecision whose compiled program contains ONLY
+    the work needed to decide placements — the parity contract (enforced
+    by tests/test_pipeline.py) is that its assignment/node_requested/
+    unschedulable/gang_dropped are bit-identical to the monolithic
+    program's in both commit modes.
 
     `commit_mode` selects the in-cycle commitment engine:
       - "scan": the strict sequential scan (ops/commit.py) — exact
@@ -399,8 +428,11 @@ def build_cycle_fn(
     fw = framework or Framework.from_config()
     if commit_mode not in ("scan", "rounds"):
         raise ValueError(f"unknown commit_mode {commit_mode!r}")
+    if outputs not in ("full", "latency"):
+        raise ValueError(f"unknown outputs {outputs!r}")
     if commit_mode == "rounds":
         fw.check_batched_parity()
+    lean = outputs == "latency"
 
     def cycle(snap: ClusterSnapshot, stable=None) -> CycleResult:
         ctx = CycleContext(snap)
@@ -410,7 +442,13 @@ def build_cycle_fn(
             # once per stable regime by build_stable_state_fn — seeding
             # the context cache makes XLA drop the in-cycle recompute
             ctx._cache.update(stable)
-        smask, sscore, srejects = fw.static(ctx)
+        if lean:
+            # same mask/score op chain as fw.static (bit-identical
+            # outputs), minus the per-filter first-rejector attribution
+            smask, sscore = fw.static_lean(ctx)
+            srejects = None
+        else:
+            smask, sscore, srejects = fw.static(ctx)
         if snap.has_extender:
             # HTTP-extender Filter/Prioritize verdicts, computed host-side
             # before the cycle (upstream runs extenders after in-tree
@@ -473,30 +511,36 @@ def build_cycle_fn(
             # PostFilter would consider gets real gate rows); other
             # unplaced pods follow and get attribution on a best-effort
             # basis — beyond the window: empty gate rows and zero dyn
-            # attribution, retried next cycle.
-            unplaced = snap.pod_valid & (rres.assignment < 0)
-            B_attr = rounds_ops.compact_window(snap.P)
-            rank32 = snap.pod_order.astype(jnp.int32)
-            ucan = unplaced & snap.pod_can_preempt
-            ukey = jnp.where(
-                ucan, rank32,
-                jnp.where(unplaced, rank32 + jnp.int32(1 << 24),
-                          jnp.int32(2**31 - 1)),
-            )
-            ugid = jnp.argsort(ukey)[:B_attr].astype(jnp.int32)
-            uact = unplaced[ugid]
-            uvsnap = rounds_ops._pod_view(snap, ugid)
-            uvmp = ctx.matched_pending[:, ugid]
-            uvsmask = smask[ugid]
-            _um, _us, upf = dyn_batched_view_fn(
-                uvsnap, uvmp, rres.node_requested, rres.extra, uvsmask
-            )
-            urejects = fw.attribute_rejects(uvsmask, upf, rows=uact)
-            dyn_aux = (
-                jnp.zeros((snap.P, len(fw.filters)), jnp.int32)
-                .at[ugid]
-                .add(jnp.where(uact[:, None], urejects, 0))
-            )
+            # attribution, retried next cycle. The latency program skips
+            # all of it (the diagnosis program owns attribution there).
+            if lean:
+                dyn_aux = jnp.zeros(
+                    (snap.P, len(fw.filters)), jnp.int32
+                )
+            else:
+                unplaced = snap.pod_valid & (rres.assignment < 0)
+                B_attr = rounds_ops.compact_window(snap.P)
+                rank32 = snap.pod_order.astype(jnp.int32)
+                ucan = unplaced & snap.pod_can_preempt
+                ukey = jnp.where(
+                    ucan, rank32,
+                    jnp.where(unplaced, rank32 + jnp.int32(1 << 24),
+                              jnp.int32(2**31 - 1)),
+                )
+                ugid = jnp.argsort(ukey)[:B_attr].astype(jnp.int32)
+                uact = unplaced[ugid]
+                uvsnap = rounds_ops._pod_view(snap, ugid)
+                uvmp = ctx.matched_pending[:, ugid]
+                uvsmask = smask[ugid]
+                _um, _us, upf = dyn_batched_view_fn(
+                    uvsnap, uvmp, rres.node_requested, rres.extra, uvsmask
+                )
+                urejects = fw.attribute_rejects(uvsmask, upf, rows=uact)
+                dyn_aux = (
+                    jnp.zeros((snap.P, len(fw.filters)), jnp.int32)
+                    .at[ugid]
+                    .add(jnp.where(uact[:, None], urejects, 0))
+                )
             result = commit_ops.CommitResult(
                 assignment=rres.assignment,
                 node_requested=rres.node_requested,
@@ -508,7 +552,11 @@ def build_cycle_fn(
             diag_per_round = rres.diag_per_round
         else:
             def dyn_fn(p, node_req, ext, static_row):
-                return fw.dyn(ctx, p, node_req, ext, static_row)
+                out = fw.dyn(ctx, p, node_req, ext, static_row)
+                # latency program: drop the per-step reject attribution
+                # (the scan then stacks a scalar zero instead of [F]
+                # counts, and XLA removes the attribution kernels)
+                return out[:2] if lean else out
 
             def update_fn(ext, p, node, ok):
                 return fw.extra_update(ctx, ext, p, node, ok)
@@ -535,6 +583,10 @@ def build_cycle_fn(
             result, dropped = _gang_unwind(snap, result)
         unsched = snap.pod_valid & (result.assignment < 0)
 
+        if lean:
+            return CycleDecision(
+                result.assignment, result.node_requested, unsched, dropped
+            )
         return CycleResult(
             result.assignment, result.node_requested, unsched, dropped,
             srejects + result.dyn_aux,
@@ -548,7 +600,7 @@ def build_cycle_fn(
         cycle, "cycle",
         disc=(
             f"{commit_mode}|{gang_scheduling}|{max_rounds}|"
-            f"{percentage_of_nodes_to_score}|"
+            f"{percentage_of_nodes_to_score}|{outputs}|"
             f"{sorted((rounds_kw or {}).items())!r}|{_fw_disc(fw)}"
         ),
     )
@@ -949,7 +1001,8 @@ def build_packed_cycle_carry_fn(
 
 
 def build_diagnosis_fn(spec, framework: Framework | None = None,
-                       window: int = 2048, extender_args: bool = False):
+                       window: int = 2048, extender_args: bool = False,
+                       donate: bool = False):
     """The DIAGNOSIS program: full FailedScheduling attribution for every
     unplaced pod, computed off the decision path (VERDICT r2 item 5 —
     no pod ever gets blank reasons, regardless of how many are
@@ -1035,12 +1088,19 @@ def build_diagnosis_fn(spec, framework: Framework | None = None,
         )
         return rej
 
+    # `donate` hands the packed input buffers to XLA for reuse (the
+    # diagnosis program is the slot's LAST consumer in the pipeline, so
+    # the arena recycles without waiting for Python refcounts). Donated
+    # buffers cannot feed a _Resilient re-invoke — donation is for
+    # drivers that prefer arena reuse over the executable-cache retry.
+    kw = {"donate_argnums": (0, 1)} if donate else {}
     return _jit(
         diagnose, "diagnose",
         disc=(
-            f"{window}|ext{int(extender_args)}|"
+            f"{window}|ext{int(extender_args)}|don{int(donate)}|"
             + repr(spec.key()) + _fw_disc(fw)
         ),
+        **kw,
     )
 
 

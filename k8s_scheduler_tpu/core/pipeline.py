@@ -1,0 +1,363 @@
+"""Double-buffered async serving pipeline: only decision bytes block.
+
+The serving hot path's latency budget is dominated by work that does NOT
+have to sit between "snapshot encoded" and "bindings out": FailedScheduling
+attribution, per-round convergence diagnostics, the preemption what-if, and
+most of the device->host transfer itself (a full CycleResult fetch moves
+[P, F] reject counts and per-round tables nobody reads before binding).
+`ServingPipeline` restructures one cycle as:
+
+    encode (host)                       # caller, before dispatch()
+    -> dispatch: upload into slot k%2, carry update, latency cycle program
+       (all ASYNC — JAX dispatches and returns futures)
+    -> caller continues host work (extender webhooks, event drain, ...)
+    -> decisions(): block on ONE slimmed device->host copy — an i16 (when
+       N < 2^15) assignment plus a u8 flag byte per pod, instead of the
+       i32 + 2 x bool + diagnostics payload
+    -> winners bind; the preemption and diagnosis programs are dispatched
+       non-blocking and forced only when a loser actually needs them
+
+Two slots double-buffer the packed input arenas: slot k's buffers stay
+alive for cycle k's deferred consumers (diagnosis / preemption) while
+cycle k+1 uploads into the other slot; when a slot is reused its previous
+buffers are released first, so the allocator recycles the same-sized
+blocks instead of growing (no per-cycle realloc). Optional donation
+(`donate_diagnosis`) hands the slot's buffers to the diagnosis program
+outright — the last consumer — trading the _Resilient retry of that one
+program for immediate arena reuse.
+
+Ordering contract: cycle k's binds MUST fold into the cache before cycle
+k+1's encode reads it. The pipeline enforces the observable half — by
+default `dispatch()` refuses to start cycle k+1 until cycle k's decisions
+were fetched (without them no bind can have been issued, so an encode
+that already ran read a stale cache). Drivers that fold nothing (pure
+throughput loops, probes) opt out with `require_decision_fetch=False`.
+
+`forced_sync=True` is the escape hatch for tests and latency measurement:
+every dispatch blocks to completion before returning, restoring strict
+sequential execution with identical results (the split is a scheduling
+change, not a semantic one).
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .cycle import _jit
+
+
+def build_decision_slim_fn(num_nodes: int):
+    """Jitted output-transfer slimming for the decision fetch:
+    (assignment i32 [P], unschedulable bool [P], gang_dropped bool [P])
+    -> (assignment i16|i32 [P], flags u8 [P]) where flags bit0 =
+    unschedulable, bit1 = gang_dropped. The i16 narrowing is exact
+    whenever every node index (and -1) fits, i.e. N < 2**15."""
+    narrow = num_nodes < (1 << 15)
+
+    def slim(assignment, unschedulable, gang_dropped):
+        a = assignment.astype(jnp.int16) if narrow else assignment
+        flags = unschedulable.astype(jnp.uint8) | (
+            gang_dropped.astype(jnp.uint8) << 1
+        )
+        return a, flags
+
+    return _jit(slim, "decision_slim", disc=f"narrow{int(narrow)}")
+
+
+class CycleHandle:
+    """One in-flight cycle: device-side futures plus the host-side fetch
+    state. Created by ServingPipeline.dispatch(); the caller blocks only
+    in decisions() (the slimmed fetch) — everything else resolves lazily."""
+
+    def __init__(self, pipe, result, slim, wbuf, bbuf, stable, emask):
+        self._pipe = pipe
+        self.result = result  # CycleResult/CycleDecision device futures
+        self._slim = slim  # (i16|i32 [P], u8 [P]) device futures
+        self._wbuf = wbuf
+        self._bbuf = bbuf
+        self._stable = stable
+        self._emask = emask
+        self._decisions = None
+        self._t_decisions = None
+        self._diag = None
+        self._pre = None
+        self.fetched = False
+
+    # ---- the one blocking fetch -----------------------------------------
+
+    def decisions(self):
+        """(assignment i32 [P], unschedulable bool [P], gang_dropped
+        bool [P]) as numpy — blocks on the slimmed transfer only."""
+        if self._decisions is None:
+            now = self._pipe._now
+            t0 = now()
+            try:
+                a, flags = jax.device_get(self._slim)
+            except Exception:
+                # a failed fetch consumes the cycle: no bind can come of
+                # it, so the ordering guard must NOT hold the pipeline
+                # hostage — the next dispatch proceeds against a cache
+                # without this cycle's (never-issued) binds, which is
+                # exactly what it would have read. Without this, one
+                # transient device error would poison the memoized
+                # pipeline's guard forever (permanent serving outage).
+                self.fetched = True
+                self.release()
+                raise
+            self._t_decisions = now()
+            st = self._pipe.stats
+            st["decision_wait_ms"] = (self._t_decisions - t0) * 1e3
+            st["fetch_bytes"] = int(a.nbytes + flags.nbytes)
+            # what the un-slimmed fetch of the same fields would move
+            st["fetch_bytes_full"] = int(a.shape[0] * (4 + 1 + 1))
+            self._pipe._fetch_bytes_total += st["fetch_bytes"]
+            m = self._pipe._metrics
+            if m is not None:
+                m.cycle_duration.labels(phase="decision_fetch").observe(
+                    self._t_decisions - t0
+                )
+                m.decision_fetch_bytes.inc(st["fetch_bytes"])
+            self._decisions = (
+                np.asarray(a, dtype=np.int32),
+                (flags & 1) != 0,
+                (flags & 2) != 0,
+            )
+            self.fetched = True
+        return self._decisions
+
+    # ---- deferred (off the bind path) -----------------------------------
+
+    def dispatch_preemption(self):
+        """Dispatch the preemption PostFilter program (non-blocking);
+        returns its device-side result or None. Forcing it is the
+        caller's choice — typically after winners were bound, so device
+        preemption time overlaps the host bind loop."""
+        if self._pre is None and self._pipe._preempt_fn is not None:
+            self._pre = self._pipe._preempt_fn(
+                self._wbuf, self._bbuf, self.result, self._stable
+            )
+        return self._pre
+
+    def dispatch_diagnosis(self):
+        """Dispatch the FailedScheduling diagnosis program (non-blocking);
+        returns the device-side [P, F] handle or None when the pipeline
+        has no diagnosis program."""
+        if self._diag is None and self._pipe._diag_fn is not None:
+            r = self.result
+            # pv_claimed and emask are INDEPENDENT optionals — forwarded
+            # by keyword so a latency cycle without pv_claimed still
+            # carries the extender verdicts into attribution
+            kw = {}
+            pv = getattr(r, "pv_claimed", None)
+            if pv is not None:
+                kw["pv_claimed"] = pv
+            if self._emask is not None:
+                kw["emask"] = self._emask
+            self._diag = self._pipe._diag_fn(
+                self._wbuf, self._bbuf, self._stable,
+                r.assignment, r.node_requested, **kw,
+            )
+            if self._pipe._donate_diagnosis:
+                # the diagnosis program consumed (donated) the slot's
+                # packed buffers — nothing may reference them again
+                self._wbuf = self._bbuf = None
+        return self._diag
+
+    def reject_counts(self):
+        """Force the diagnosis output (i32 [P, F]); returns None when no
+        diagnosis program exists. Records the deferred-diagnosis lag —
+        how long after the decision fetch the attribution became
+        available (the window FailedScheduling events trail binds by)."""
+        d = self.dispatch_diagnosis()
+        if d is None:
+            return None
+        arr = np.asarray(d)
+        if self._t_decisions is not None:
+            lag = (self._pipe._now() - self._t_decisions) * 1e3
+            self._pipe.stats["diag_lag_ms"] = lag
+            m = self._pipe._metrics
+            if m is not None:
+                m.cycle_duration.labels(phase="diag_lag").observe(
+                    lag / 1e3
+                )
+        return arr
+
+    def block(self):
+        """Force everything in flight (the forced_sync escape hatch)."""
+        try:
+            jax.block_until_ready((self.result, self._slim))
+        except Exception:
+            # same contract as a failed decisions() fetch: the cycle is
+            # consumed, the guard releases (see decisions)
+            self.fetched = True
+            self.release()
+            raise
+        return self
+
+    def release(self):
+        """Drop every device reference so the slot's arena blocks free
+        (the allocator then recycles them for the next upload)."""
+        self.result = self._slim = self._diag = self._pre = None
+        self._wbuf = self._bbuf = self._stable = self._emask = None
+
+
+class ServingPipeline:
+    """Owns the two upload slots, the in-flight handle, and the carry
+    hand-off (CarryKeeper-compatible). One instance per compiled packed
+    regime — the Scheduler memoizes it next to the programs.
+
+    `cycle_fn` is any packed cycle program: carry-path
+    (build_packed_cycle_carry_fn, with `keeper`), or plain packed
+    (build_packed_cycle_fn, `keeper=None`). `diag_fn`/`preempt_fn` are
+    the deferred companions (None disables them)."""
+
+    def __init__(
+        self,
+        cycle_fn,
+        *,
+        keeper=None,
+        diag_fn=None,
+        preempt_fn=None,
+        forced_sync: bool = False,
+        require_decision_fetch: bool = True,
+        donate_diagnosis: bool = False,
+        metrics=None,
+        now=_time.perf_counter,
+        slots: int = 2,
+    ) -> None:
+        if donate_diagnosis and preempt_fn is not None:
+            # a donated diagnosis consumes the slot's packed buffers; a
+            # preemption program dispatched after it would read freed
+            # memory — refuse the combination instead of ordering traps
+            raise ValueError(
+                "donate_diagnosis requires preempt_fn=None "
+                "(preemption reads the packed buffers after diagnosis)"
+            )
+        self._cycle_fn = cycle_fn
+        self._keeper = keeper
+        self._diag_fn = diag_fn
+        self._preempt_fn = preempt_fn
+        self.forced_sync = forced_sync
+        self.require_decision_fetch = require_decision_fetch
+        self._donate_diagnosis = donate_diagnosis
+        self._metrics = metrics
+        self._now = now
+        self._slots = [None] * max(2, slots)
+        self._slim_fn = None
+        self._last = None
+        self._n = 0
+        self._fetch_bytes_total = 0
+        self._pending_encode_ms: float | None = None
+        # per-cycle stage report (the split-phase measurement): refreshed
+        # by dispatch()/decisions()/reject_counts(); encode_ms is fed by
+        # the caller via note_encode()
+        self.stats: dict[str, float] = {}
+
+    @property
+    def cycles(self) -> int:
+        return self._n
+
+    @property
+    def fetch_bytes_total(self) -> int:
+        return self._fetch_bytes_total
+
+    def note_encode(self, seconds: float) -> None:
+        """Record the host encode time of the snapshot about to be
+        dispatched — feeds the overlap accounting in stage_report."""
+        self._pending_encode_ms = seconds * 1e3
+
+    def dispatch(
+        self,
+        wbuf,
+        bbuf,
+        stable,
+        *,
+        dirty=None,
+        carry_key=None,
+        pin=None,
+        emask=None,
+        escore=None,
+        device_put: bool = True,
+    ) -> CycleHandle:
+        """Upload + dispatch one cycle; returns immediately with a
+        CycleHandle (unless forced_sync). Raises if the previous cycle's
+        decisions were never fetched while require_decision_fetch — the
+        strict-ordering guard (see module docstring)."""
+        if (
+            self.require_decision_fetch
+            and self._last is not None
+            and not self._last.fetched
+        ):
+            raise RuntimeError(
+                "ServingPipeline: cycle k+1 dispatched before cycle k's "
+                "decisions were fetched — binds cannot have folded before "
+                "this snapshot was encoded (pass "
+                "require_decision_fetch=False for fold-free loops)"
+            )
+        t0 = self._now()
+        slot = self._n % len(self._slots)
+        prev = self._slots[slot]
+        if prev is not None:
+            # release slot k-2's device references BEFORE uploading so
+            # the allocator hands back the same-sized blocks (double-
+            # buffered arena reuse instead of per-cycle growth)
+            prev.release()
+        if device_put:
+            wbuf = jax.device_put(wbuf)
+            bbuf = jax.device_put(bbuf)
+        if self._keeper is not None:
+            carry = self._keeper.state(
+                wbuf, bbuf, stable, dirty, carry_key, pin=pin
+            )
+            if emask is not None:
+                result = self._cycle_fn(
+                    wbuf, bbuf, stable, carry, emask, escore
+                )
+            else:
+                result = self._cycle_fn(wbuf, bbuf, stable, carry)
+        else:
+            result = self._cycle_fn(wbuf, bbuf, stable)
+        if self._slim_fn is None:
+            self._slim_fn = build_decision_slim_fn(
+                result.node_requested.shape[0]
+            )
+        slim = self._slim_fn(
+            result.assignment, result.unschedulable, result.gang_dropped
+        )
+        handle = CycleHandle(
+            self, result, slim, wbuf, bbuf, stable, emask
+        )
+        self._slots[slot] = handle
+        self._last = handle
+        self._n += 1
+        dispatch_s = self._now() - t0
+        self.stats = {"dispatch_ms": dispatch_s * 1e3}
+        if self._pending_encode_ms is not None:
+            self.stats["encode_ms"] = self._pending_encode_ms
+            self._pending_encode_ms = None
+        if self._metrics is not None:
+            self._metrics.cycle_duration.labels(phase="dispatch").observe(
+                dispatch_s
+            )
+        if self.forced_sync:
+            handle.block()
+        return handle
+
+    def stage_report(self) -> dict[str, float]:
+        """Last-cycle per-stage breakdown: dispatch_ms, decision_wait_ms,
+        fetch_bytes (+ the full-payload bytes it replaced), diag_lag_ms,
+        encode_ms, and encode_hidden_ms — the portion of the reported
+        encode that overlapped in-flight device work (encode minus the
+        observed decision wait shortfall is not derivable per-cycle, so
+        hidden = max(0, encode - decision_wait) is the conservative
+        per-cycle estimate; the probe/bench compute the exact overlap
+        from separated encode/device baselines)."""
+        st = dict(self.stats)
+        enc = st.get("encode_ms", 0.0)
+        wait = st.get("decision_wait_ms", 0.0)
+        st["encode_hidden_ms"] = max(0.0, enc - wait)
+        return st

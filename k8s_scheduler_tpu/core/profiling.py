@@ -221,6 +221,34 @@ def _dyn_probe(plugin, snap: ClusterSnapshot, as_score: bool):
     return jax.jit(fn)
 
 
+def overlap_stats(
+    encode_s: float, device_s: float, pipelined_s: float
+) -> dict[str, float]:
+    """Split-phase overlap accounting for the serving pipeline
+    (core/pipeline.py): given three independently measured medians —
+    host encode alone, device cycle (dispatch + slimmed decision fetch)
+    alone, and the pipelined per-cycle wall time (dispatch cycle k, then
+    encode cycle k+1 on the host, then fetch k's decisions) — report how
+    much of the smaller stage was hidden behind the larger one.
+
+        hidden      = encode + device - pipelined   (>= 0)
+        overlap_pct = hidden / min(encode, device) * 100
+
+    100% means the cheaper stage ran entirely in the other's shadow (the
+    pipelined cycle costs max(encode, device), not the sum); 0% means no
+    overlap (fully serial — e.g. forced_sync)."""
+    hidden = max(0.0, encode_s + device_s - pipelined_s)
+    denom = min(encode_s, device_s)
+    pct = 100.0 * hidden / denom if denom > 0 else 0.0
+    return {
+        "encode_ms": round(encode_s * 1e3, 3),
+        "device_ms": round(device_s * 1e3, 3),
+        "pipelined_ms": round(pipelined_s * 1e3, 3),
+        "encode_hidden_ms": round(min(hidden, encode_s) * 1e3, 3),
+        "overlap_pct": round(min(pct, 100.0), 1),
+    }
+
+
 def trace_cycle(cycle_fn, snap: ClusterSnapshot, log_dir: str):
     """One fused cycle under jax.profiler (TensorBoard/Perfetto trace)."""
     with jax.profiler.trace(log_dir):

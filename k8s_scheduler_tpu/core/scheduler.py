@@ -14,6 +14,13 @@ cycle and dispatches binds through an injectable `binder` callable —
 synchronous by default; the gRPC service wraps it with its own transport.
 Bind failures forget the assumption and requeue with backoff (upstream
 handleBindingCycleError).
+
+The device side runs through the split-phase ServingPipeline
+(core/pipeline.py): the cycle program is dispatched async, the only
+blocking transfer is the slimmed decision payload, winners bind before
+the (deferred, overlapped) preemption/diagnosis programs are forced for
+the losers, and cycle k's binds always fold into the cache before cycle
+k+1's encode reads it. `forced_sync` restores sequential execution.
 """
 
 from __future__ import annotations
@@ -86,6 +93,9 @@ class Scheduler:
         metrics: SchedulerMetrics | None = None,
         events: EventRecorder | None = None,
         host_plugins: "list | None" = None,
+        forced_sync: bool | None = None,  # None = config.forced_sync;
+        # True blocks every pipeline dispatch to completion (strict
+        # sequential execution — the tests/measurement escape hatch)
     ) -> None:
         self.config = config or SchedulerConfiguration()
         # one Framework per profile (SURVEY.md §2 C12 / §5.6: multiple
@@ -152,9 +162,14 @@ class Scheduler:
                 pad_pods_per_node=(
                     self.config.pad_pods_per_node or None
                 ),
+                pad_ma=self.config.pad_ma or None,
+                pad_mc=self.config.pad_mc or None,
             )
             for n in names
         }
+        self.forced_sync = (
+            self.config.forced_sync if forced_sync is None else forced_sync
+        )
         self._encoder = self._encoders[names[0]]
         self._cycle_kw = dict(
             gang_scheduling=self.config.gang_scheduling,
@@ -219,6 +234,8 @@ class Scheduler:
         self._preempt = build_preemption_fn(self.framework)
 
     def _packed_fns(self, spec, profile: str):
+        from .pipeline import ServingPipeline
+
         fw = self.frameworks[profile]
         key = (spec.key(), profile)
         hit = self._packed.get(key)
@@ -248,11 +265,20 @@ class Scheduler:
                     spec, framework=fw, **self._cycle_kw
                 )
                 keeper = diag = ext_keeper = None
+            preempt = build_packed_preemption_fn(spec, fw)
+            pipe = ServingPipeline(
+                cyc,
+                keeper=keeper,
+                diag_fn=diag,
+                preempt_fn=preempt,
+                forced_sync=self.forced_sync,
+                metrics=self.metrics,
+            )
             hit = (
                 cyc,
-                build_packed_preemption_fn(spec, fw),
+                preempt,
                 build_stable_state_fn(spec),
-                keeper, diag, ext_keeper,
+                keeper, diag, ext_keeper, pipe,
             )
             self._packed[key] = hit
             # bounded: grow-only interning dimensions make old regimes
@@ -446,6 +472,9 @@ class Scheduler:
         extender_errors: dict[int, str] = {}
         diag = None
         t_start = self._now()
+        import os as _os
+
+        do_device_put = _os.environ.get("K8S_TPU_NO_DEVICE_PUT") != "1"
         if self._use_carry:
             mut = self._nominated_mut[profile]
             wbuf, bbuf, spec, snap, dirty = encoder.encode_packed(
@@ -456,32 +485,17 @@ class Scheduler:
             # ONE host->device upload per cycle (device_put copies the
             # arena synchronously); numpy args would re-upload the packed
             # buffers once per program in the chain below
-            import os as _os
-
-            if _os.environ.get("K8S_TPU_NO_DEVICE_PUT") != "1":
+            if do_device_put:
                 import jax as _jax
 
                 wbuf = _jax.device_put(wbuf)
                 bbuf = _jax.device_put(bbuf)
             (
                 pcycle, ppreempt, stable_fn, keeper, diag, ext_keeper,
+                pipe,
             ) = self._packed_fns(spec, profile)
             stable = self._stable_state(
                 spec, stable_fn, wbuf, bbuf, encoder
-            )
-            # keyed on _carry_key (stable key MINUS existing/PDBs) plus
-            # the st dict identity: a bound-pod fold mutates st IN PLACE
-            # (same identity, carry still valid — only the encoder-
-            # reported dirty rows, incl. port-bearing slots, recompute),
-            # while any OTHER stable change rebuilds st and the carry
-            enc_st = getattr(encoder, "_stable", None)
-            carry = keeper.state(
-                wbuf, bbuf, stable, dirty,
-                (
-                    spec.key(), id(enc_st),
-                    getattr(encoder, "_carry_key", None),
-                ),
-                pin=enc_st,
             )
             t_encode = self._now()
             self.metrics.cycle_duration.labels(phase="encode").observe(
@@ -510,11 +524,25 @@ class Scheduler:
                     i: m for i, m in ext_keeper.errors.items()
                     if i < len(pending)
                 }
-                result = pcycle(
-                    wbuf, bbuf, stable, carry, ext_mask, ext_score
-                )
-            else:
-                result = pcycle(wbuf, bbuf, stable, carry)
+            # async dispatch: the carry update (keyed on _carry_key —
+            # stable key MINUS existing/PDBs — plus the st dict identity;
+            # a bound-pod fold mutates st IN PLACE, carry still valid)
+            # and the latency cycle program go out without blocking; the
+            # only synchronous read below is the slimmed decision fetch
+            enc_st = getattr(encoder, "_stable", None)
+            pipe.forced_sync = self.forced_sync
+            pipe.note_encode(t_encode - t_start)
+            handle = pipe.dispatch(
+                wbuf, bbuf, stable,
+                dirty=dirty,
+                carry_key=(
+                    spec.key(), id(enc_st),
+                    getattr(encoder, "_carry_key", None),
+                ),
+                pin=enc_st,
+                emask=ext_mask, escore=ext_score,
+                device_put=False,  # uploaded above (stable/carry share it)
+            )
         else:
             snap = encoder.encode(nodes, pending, existing, **kw)
             if self.extenders:
@@ -538,10 +566,14 @@ class Scheduler:
                     )
             spec = packing.make_spec(snap)
             (
-                pcycle, ppreempt, stable_fn, _keeper, diag, _ek,
+                pcycle, ppreempt, stable_fn, _keeper, diag, _ek, pipe,
             ) = self._packed_fns(spec, profile)
-            ext_mask = None
             wbuf, bbuf = packing.pack(snap, spec)
+            if do_device_put:
+                import jax as _jax
+
+                wbuf = _jax.device_put(wbuf)
+                bbuf = _jax.device_put(bbuf)
             stable = self._stable_state(
                 spec, stable_fn, wbuf, bbuf, encoder
             )
@@ -549,59 +581,60 @@ class Scheduler:
             self.metrics.cycle_duration.labels(phase="encode").observe(
                 t_encode - t_start
             )
-            result = pcycle(wbuf, bbuf, stable)
-        assignment = np.asarray(result.assignment)[: len(pending)]
-        gang_dropped = np.asarray(result.gang_dropped)[: len(pending)]
+            pipe.forced_sync = self.forced_sync
+            pipe.note_encode(t_encode - t_start)
+            handle = pipe.dispatch(
+                wbuf, bbuf, stable, device_put=False
+            )
+        # the ONLY blocking transfer on the bind path: the slimmed
+        # decision payload (i16 assignment + u8 flags per pod)
+        assignment, _unsched, gang_dropped = handle.decisions()
+        assignment = assignment[: len(pending)]
+        gang_dropped = gang_dropped[: len(pending)]
         filter_names = framework.filter_names
         stats.gang_dropped = int(gang_dropped.sum())
-
-        # FailedScheduling attribution: under carry mode the cycle does
-        # not compute reject counts — the diagnosis program does, forced
-        # lazily the first time a loser needs reasons (its dispatch below
-        # overlaps the host-side bind loop)
-        diag_handle = None
-        if diag is not None and (assignment < 0).any():
-            if ext_mask is not None:
-                diag_handle = diag(
-                    wbuf, bbuf, stable, result.assignment,
-                    result.node_requested, result.pv_claimed, ext_mask,
-                )
-            else:
-                diag_handle = diag(
-                    wbuf, bbuf, stable, result.assignment,
-                    result.node_requested, result.pv_claimed,
-                )
-        _rej_box: list = []
-
-        def reject_counts_of(i: int):
-            if not _rej_box:
-                if diag_handle is not None:
-                    _rej_box.append(
-                        np.asarray(diag_handle)[: len(pending)]
-                    )
-                else:
-                    _rej_box.append(
-                        np.asarray(result.reject_counts)[: len(pending)]
-                    )
-            return _rej_box[0][i]
         t_device = self._now()
         self.metrics.cycle_duration.labels(phase="device").observe(
             t_device - t_encode
         )
         self.metrics.decisions.inc(len(pending) * len(nodes))
 
-        nominated = victims = None
+        # FailedScheduling attribution: under carry mode the cycle does
+        # not compute reject counts — the diagnosis program does,
+        # dispatched non-blocking here and forced lazily the first time
+        # a loser needs reasons (the loser pass runs AFTER winners bind,
+        # so the attribution program overlaps the host bind loop)
+        if diag is not None and (assignment < 0).any():
+            handle.dispatch_diagnosis()
+        _rej_box: list = []
+
+        def reject_counts_of(i: int):
+            if not _rej_box:
+                rc = (
+                    handle.reject_counts() if diag is not None else None
+                )
+                if rc is not None:
+                    _rej_box.append(rc[: len(pending)])
+                else:
+                    _rej_box.append(
+                        np.asarray(handle.result.reject_counts)[
+                            : len(pending)
+                        ]
+                    )
+            return _rej_box[0][i]
+
+        # preemption dispatched async too; its device time overlaps the
+        # winner bind loop below and is forced only before losers are
+        # processed (nominations/evictions are loser-side outputs)
+        pre_handle = None
         if ppreempt is not None and (assignment < 0).any():
             self.metrics.preemption_attempts.inc()
-            pre = ppreempt(wbuf, bbuf, result, stable)
-            nominated = np.asarray(pre.nominated)[: len(pending)]
-            victims = np.asarray(pre.victims)[: len(existing)]
-        t_post = self._now()
-        self.metrics.cycle_duration.labels(phase="postfilter").observe(
-            t_post - t_device
-        )
+            pre_handle = handle.dispatch_preemption()
 
-        # ---- apply: assume + bind winners, requeue losers ----
+        # ---- apply, split-phase: winners bind FIRST (no deferred
+        # output can block them), losers are processed after — their
+        # inputs (preemption nominations, diagnosis reject counts) were
+        # dispatched above and resolve while the bind loop runs ----
         # per-attempt latency is sampled at observation time so it includes
         # binding (upstream attempt duration = algorithm + bind)
         def per_pod_s() -> float:
@@ -615,116 +648,131 @@ class Scheduler:
 
         for i, pod in enumerate(pending):
             node_idx = int(assignment[i])
-            if node_idx >= 0:
-                node_name = nodes[node_idx].name
-                try:
-                    # a per-pod scheduling error (e.g. the uid raced to
-                    # bound via an informer echo mid-cycle) must not kill
-                    # the loop — upstream continues with the next pod
-                    self.cache.assume(pod, node_name)
-                except ValueError:
-                    stats.bind_errors += 1
-                    self.metrics.observe_attempt(
-                        "error", per_pod_s(), profile
-                    )
-                    continue
-                # Reserve -> Permit -> PreBind host extension points
-                try:
-                    run_reserve_permit_prebind(
-                        self.host_plugins, pod, node_name
-                    )
-                except HostPluginRejection as rej:
-                    self.cache.forget(pod.uid)
-                    if rej.point == "PreBind":
-                        # transient pre-bind failure: retry with backoff
-                        self.queue.requeue_backoff(pod)
-                        stats.bind_errors += 1
-                        self.metrics.observe_attempt(
-                            "error", per_pod_s(), profile
-                        )
-                    else:
-                        # Reserve/Permit veto: unschedulable, attributed
-                        # to the vetoing host plugin
-                        self.events.failed_scheduling(
-                            pod, f"{rej.plugin} rejected at {rej.point}: "
-                            f"{rej.reason}"
-                        )
-                        self.queue.requeue_unschedulable(
-                            pod, reasons=(rej.plugin,)
-                        )
-                        stats.unschedulable += 1
-                        self.metrics.observe_attempt(
-                            "unschedulable", per_pod_s(), profile
-                        )
-                    continue
-                t_bind = self._now()
-                try:
-                    self._bind(pod, node_name)
-                except Exception:
-                    run_unreserve(self.host_plugins, pod, node_name)
-                    self.cache.forget(pod.uid)
-                    self.queue.requeue_backoff(pod)
-                    stats.bind_errors += 1
-                    self.metrics.observe_attempt(
-                        "error", per_pod_s(), profile
-                    )
-                    continue
-                self.metrics.binding_duration.observe(self._now() - t_bind)
-                self.cache.finish_binding(pod.uid)
-                run_post_bind(self.host_plugins, pod, node_name)
-                self.events.scheduled(pod, node_name)
-                stats.scheduled += 1
-                self.metrics.pod_scheduling_attempts.observe(
-                    self.queue.attempts_of(pod.uid)
-                )
+            if node_idx < 0:
+                continue
+            node_name = nodes[node_idx].name
+            try:
+                # a per-pod scheduling error (e.g. the uid raced to
+                # bound via an informer echo mid-cycle) must not kill
+                # the loop — upstream continues with the next pod
+                self.cache.assume(pod, node_name)
+            except ValueError:
+                stats.bind_errors += 1
                 self.metrics.observe_attempt(
-                    "scheduled", per_pod_s(), profile
+                    "error", per_pod_s(), profile
                 )
-            else:
-                if i in extender_errors:
-                    # non-ignorable extender failure: retry with backoff
-                    # (transient webhook errors must not park the pod)
+                continue
+            # Reserve -> Permit -> PreBind host extension points
+            try:
+                run_reserve_permit_prebind(
+                    self.host_plugins, pod, node_name
+                )
+            except HostPluginRejection as rej:
+                self.cache.forget(pod.uid)
+                if rej.point == "PreBind":
+                    # transient pre-bind failure: retry with backoff
                     self.queue.requeue_backoff(pod)
                     stats.bind_errors += 1
                     self.metrics.observe_attempt(
                         "error", per_pod_s(), profile
-                    )
-                    continue
-                if nominated is not None and nominated[i] >= 0:
-                    pod.nominated_node_name = nodes[int(nominated[i])].name
-                    # in-place mutation: the delta encoder must re-read
-                    # this pod's slot next cycle (arena contract)
-                    self._nominated_mut[profile].add(id(pod))
-                    self.last_nominations.append(
-                        (pod, pod.nominated_node_name)
-                    )
-                    stats.preemptors += 1
-                if gang_dropped[i]:
-                    reasons = ("Coscheduling",)
-                    message = (
-                        f"pod group {pod.spec.pod_group!r} did not reach "
-                        "minMember; all-or-nothing placement rolled back"
                     )
                 else:
-                    per_plugin = list(
-                        zip(filter_names, reject_counts_of(i))
+                    # Reserve/Permit veto: unschedulable, attributed
+                    # to the vetoing host plugin
+                    self.events.failed_scheduling(
+                        pod, f"{rej.plugin} rejected at {rej.point}: "
+                        f"{rej.reason}"
                     )
-                    reasons = tuple(
-                        name for name, n in per_plugin if n > 0
+                    self.queue.requeue_unschedulable(
+                        pod, reasons=(rej.plugin,)
                     )
-                    message = failed_scheduling_message(
-                        len(nodes), per_plugin
+                    stats.unschedulable += 1
+                    self.metrics.observe_attempt(
+                        "unschedulable", per_pod_s(), profile
                     )
-                for r in reasons:
-                    self.metrics.unschedulable_reasons.labels(
-                        plugin=r, profile=profile
-                    ).inc()
-                self.events.failed_scheduling(pod, message)
-                self.queue.requeue_unschedulable(pod, reasons=reasons)
-                stats.unschedulable += 1
+                continue
+            t_bind = self._now()
+            try:
+                self._bind(pod, node_name)
+            except Exception:
+                run_unreserve(self.host_plugins, pod, node_name)
+                self.cache.forget(pod.uid)
+                self.queue.requeue_backoff(pod)
+                stats.bind_errors += 1
                 self.metrics.observe_attempt(
-                    "unschedulable", per_pod_s(), profile
+                    "error", per_pod_s(), profile
                 )
+                continue
+            self.metrics.binding_duration.observe(self._now() - t_bind)
+            self.cache.finish_binding(pod.uid)
+            run_post_bind(self.host_plugins, pod, node_name)
+            self.events.scheduled(pod, node_name)
+            stats.scheduled += 1
+            self.metrics.pod_scheduling_attempts.observe(
+                self.queue.attempts_of(pod.uid)
+            )
+            self.metrics.observe_attempt(
+                "scheduled", per_pod_s(), profile
+            )
+
+        # losers: force the (overlapped) preemption output now
+        t_winners = self._now()
+        nominated = victims = None
+        if pre_handle is not None:
+            nominated = np.asarray(pre_handle.nominated)[: len(pending)]
+            victims = np.asarray(pre_handle.victims)[: len(existing)]
+        t_post = self._now()
+        self.metrics.cycle_duration.labels(phase="postfilter").observe(
+            t_post - t_winners
+        )
+
+        for i, pod in enumerate(pending):
+            if int(assignment[i]) >= 0:
+                continue
+            if i in extender_errors:
+                # non-ignorable extender failure: retry with backoff
+                # (transient webhook errors must not park the pod)
+                self.queue.requeue_backoff(pod)
+                stats.bind_errors += 1
+                self.metrics.observe_attempt(
+                    "error", per_pod_s(), profile
+                )
+                continue
+            if nominated is not None and nominated[i] >= 0:
+                pod.nominated_node_name = nodes[int(nominated[i])].name
+                # in-place mutation: the delta encoder must re-read
+                # this pod's slot next cycle (arena contract)
+                self._nominated_mut[profile].add(id(pod))
+                self.last_nominations.append(
+                    (pod, pod.nominated_node_name)
+                )
+                stats.preemptors += 1
+            if gang_dropped[i]:
+                reasons = ("Coscheduling",)
+                message = (
+                    f"pod group {pod.spec.pod_group!r} did not reach "
+                    "minMember; all-or-nothing placement rolled back"
+                )
+            else:
+                per_plugin = list(
+                    zip(filter_names, reject_counts_of(i))
+                )
+                reasons = tuple(
+                    name for name, n in per_plugin if n > 0
+                )
+                message = failed_scheduling_message(
+                    len(nodes), per_plugin
+                )
+            for r in reasons:
+                self.metrics.unschedulable_reasons.labels(
+                    plugin=r, profile=profile
+                ).inc()
+            self.events.failed_scheduling(pod, message)
+            self.queue.requeue_unschedulable(pod, reasons=reasons)
+            stats.unschedulable += 1
+            self.metrics.observe_attempt(
+                "unschedulable", per_pod_s(), profile
+            )
 
         if victims is not None and victims.any():
             # victims belong to the preemptor nominated onto their node
@@ -743,8 +791,10 @@ class Scheduler:
             stats.victims += n_vict
             self.metrics.preemption_victims.observe(n_vict)
 
+        # apply = winner bind loop + loser requeue loop (the preemption
+        # force between them is the "postfilter" phase)
         self.metrics.cycle_duration.labels(phase="apply").observe(
-            self._now() - t_post
+            (t_winners - t_device) + (self._now() - t_post)
         )
 
     def _bind(self, pod: Pod, node_name: str) -> None:

@@ -16,6 +16,7 @@ import numpy as np
 from ..ops import images as images_ops
 from ..ops import labels as labels_ops
 from ..ops import ports as ports_ops
+from ..ops import preemption as preemption_ops
 from ..ops import resources as res_ops
 from ..ops import taints as taints_ops
 from .interfaces import CycleContext, PluginBase
@@ -460,8 +461,12 @@ class DefaultPreemption(PluginBase):
 
     def post_filter(self, ctx: CycleContext, assignment, node_requested,
                     gate_rows, excluded=None):
-        from ..ops import preemption as preemption_ops
-
+        # preemption_ops is imported at MODULE scope, never from inside
+        # this (traced) body: its module-level jnp constants (_BIG_I32)
+        # would otherwise be created under the first trace's context,
+        # and a later retrace of the same jitted post_filter (e.g. with
+        # a CycleDecision instead of a CycleResult) would read them as
+        # escaped tracers of a dead trace (UnexpectedTracerError)
         kw = {}
         if "budget" in self.args:
             kw["budget"] = int(self.args["budget"])

@@ -21,10 +21,16 @@ dashboards and alerts transfer unchanged:
 Batched-cycle additions (no upstream equivalent — the TPU design schedules
 the whole pending set per cycle):
 
-- scheduler_cycle_duration_seconds{phase} — encode / device / apply / total
+- scheduler_cycle_duration_seconds{phase} — encode / dispatch / device /
+  decision_fetch / postfilter / diag_lag / apply / total (dispatch,
+  decision_fetch and diag_lag are the split-phase serving-pipeline
+  stages: async program dispatch, the slimmed blocking decision
+  transfer, and how far FailedScheduling attribution trails the binds)
 - scheduler_cycle_pods (histogram) — pending-set size per cycle
 - scheduler_pod_node_decisions_total — P*N decisions evaluated (the
   north-star throughput numerator)
+- scheduler_decision_fetch_bytes_total — bytes moved device->host by the
+  blocking decision fetch (the slimmed payload; core/pipeline.py)
 
 Each `SchedulerMetrics` owns its own `CollectorRegistry`;
 `global_metrics()` returns the process-wide default instance, which is
@@ -144,8 +150,8 @@ class SchedulerMetrics:
         # ---- batched-cycle additions ----
         self.cycle_duration = Histogram(
             "scheduler_cycle_duration_seconds",
-            "Batched scheduling cycle latency by phase "
-            "(encode|device|apply|total).",
+            "Batched scheduling cycle latency by phase (encode|dispatch|"
+            "device|decision_fetch|postfilter|diag_lag|apply|total).",
             ["phase"],
             buckets=_DURATION_BUCKETS,
             registry=r,
@@ -167,6 +173,12 @@ class SchedulerMetrics:
             "Unschedulable attempts by first-rejecting plugin (per-pod "
             "failure attribution from the batched cycle).",
             ["plugin", "profile"],
+            registry=r,
+        )
+        self.decision_fetch_bytes = Counter(
+            "scheduler_decision_fetch_bytes_total",
+            "Bytes moved device->host by the blocking per-cycle decision "
+            "fetch (slimmed payload: i16 assignment + u8 flags per pod).",
             registry=r,
         )
         self.program_retry_strikes = Counter(

@@ -410,6 +410,13 @@ class SnapshotEncoder:
         # pod lists, and an MPN flip is a full regime change too. NOTE
         # the preemption what-if tables scale with MPN — size to the
         # realistic hot-node depth, not the worst case
+        pad_ma: int | None = None,  # pre-size the sticky MA pad (max
+        # (anti-)affinity/preferred terms per pod axis): MA buckets by 2,
+        # so a mid-serving arrival of a 3-4-term pod flips the regime
+        # (full ~100 s recompile) unless pre-sized — set to the largest
+        # term count the workload can carry (ADVICE r5)
+        pad_mc: int | None = None,  # pre-size the sticky MC pad
+        # (topology-spread constraints per pod) the same way
     ) -> None:
         self.strings = StringInterner()
         self.resource_names = list(resource_names)
@@ -417,6 +424,8 @@ class SnapshotEncoder:
         self.pad_nodes = pad_nodes
         self.pad_existing = pad_existing
         self.pad_pods_per_node = pad_pods_per_node
+        self.pad_ma = pad_ma
+        self.pad_mc = pad_mc
         # the profile's queueSort plugin (SURVEY §2 C11): owns the
         # pod_order rank both encode paths bake into the snapshot
         if queue_sort is None:
@@ -988,8 +997,14 @@ class SnapshotEncoder:
             # bucket 2, not 4: real pods rarely carry >2 terms per axis
             # and every per-slot loop in the dyn kernels (W builds,
             # spread-mask HIGH dots, update matmuls, preemption what-if)
-            # pays the pad directly; sticky growth keeps recompiles rare
-            "MA", _pad_dim(max([d["n_aff"] for d in all_rows] + [1]), 2)
+            # pays the pad directly; sticky growth keeps recompiles rare.
+            # pad_ma folds INTO the max (like pad_existing into E's
+            # bucket) so pre-sizing can never leave MA below what a real
+            # pod demands
+            "MA", _pad_dim(
+                max([d["n_aff"] for d in all_rows]
+                    + [1, self.pad_ma or 0]), 2
+            )
         )
 
         from .. import native
@@ -1460,8 +1475,12 @@ class SnapshotEncoder:
         pod_pref_aff_w = np.zeros((P, MA), np.float32)
 
         MC = self._stick(
-            "MC",  # bucket 2 like MA (same per-slot-loop cost argument)
-            _pad_dim(max([len(d["tsc_skew"]) for d in pend_rows] + [1]), 2),
+            "MC",  # bucket 2 like MA (same per-slot-loop cost argument);
+            # pad_mc pre-sizes like pad_ma above
+            _pad_dim(
+                max([len(d["tsc_skew"]) for d in pend_rows]
+                    + [1, self.pad_mc or 0]), 2
+            ),
         )
         pod_tsc = np.full((P, MC, 3), -1, np.int32)
         pod_tsc_skew = np.zeros((P, MC), np.int32)

@@ -491,10 +491,13 @@ def fold_pv_claims(snap, expr_mask, pv_claimed, accepted, node_of,
 def chosen_pv(snap, expr_mask, pv_claimed, node_of, active, j):
     """i32 [P]: the PV each active pod would claim for volume slot j at
     node `node_of` — the LOWEST-INDEX compatible available unclaimed PV
-    admissible on that node (the deterministic binder choice both
-    engines and the oracle share); -1 when the slot is not an unbound
-    static claim (incl. pods whose slot rides dynamic provisioning
-    because no static PV fits)."""
+    admissible on that node; -1 when the slot is not an unbound static
+    claim (incl. pods whose slot rides dynamic provisioning because no
+    static PV fits). SINGLE-VOLUME path only: with one slot per pod the
+    lowest-index choice is the deterministic binder choice both engines
+    and the oracle share; multi-volume pods use chosen_pv_sdr, whose
+    Hall-margin-preserving choice avoids the intra-pod dead-ends greedy
+    lowest-index claiming can hit."""
     V = snap.pv_avail.shape[0]
     pv_ok = (
         pv_node_table(snap, expr_mask) & ~pv_claimed[:, None]

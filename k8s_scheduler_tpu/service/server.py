@@ -13,6 +13,15 @@ assumption and requeues with backoff. If the confirmation never arrives the
 assumed-pod TTL expires and the pod is requeued (no double-bind either way
 — fault tests in tests/test_service.py).
 
+The driver underneath runs the split-phase serving pipeline
+(core/pipeline.py): inside `Cycle`, the response's `bindings` are
+collected from the winner bind loop, which blocks only on the slimmed
+decision fetch — preemption nominations, evictions, and FailedScheduling
+events ride the deferred programs that resolve while winners bind, so a
+mostly-schedulable cycle's bindings are never gated on diagnostics.
+`forced_sync` (config `forcedSync` or the serve() argument) restores
+strictly sequential execution for tests and latency measurement.
+
 The grpc servicer/stub glue is hand-written (the image has protoc for
 messages but no grpc_python_plugin); method handler wiring mirrors what
 grpc_tools would generate.
@@ -42,12 +51,14 @@ class SchedulerService:
     def __init__(self, config: SchedulerConfiguration | None = None,
                  scheduler: Scheduler | None = None,
                  profile_every: int = 0,
-                 metrics: SchedulerMetrics | None = None) -> None:
+                 metrics: SchedulerMetrics | None = None,
+                 forced_sync: bool | None = None) -> None:
         # the injectable binder collects into the in-progress response;
         # one cycle at a time (serialized by _cycle_lock)
         self._bindings: list[pb.Binding] = []
         self.scheduler = scheduler or Scheduler(
-            config=config, binder=self._collect_binding, metrics=metrics
+            config=config, binder=self._collect_binding, metrics=metrics,
+            forced_sync=forced_sync,
         )
         if scheduler is not None:
             scheduler.binder = self._collect_binding
@@ -208,10 +219,12 @@ def serve(
     max_workers: int = 4,
     profile_every: int = 0,
     metrics: SchedulerMetrics | None = None,
+    forced_sync: bool | None = None,
 ) -> tuple[grpc.Server, SchedulerService, int]:
     """Start the shim; returns (server, servicer, bound_port)."""
     service = SchedulerService(
-        config=config, profile_every=profile_every, metrics=metrics
+        config=config, profile_every=profile_every, metrics=metrics,
+        forced_sync=forced_sync,
     )
     # no SO_REUSEPORT: a second shim on the same address must fail loudly,
     # not silently split the accept queue with the first

@@ -335,3 +335,33 @@ def test_fold_unfold_float_exactness_under_inexact_requests():
         existing = existing[:12]  # completion batch
         d.step(nodes, pods, existing)
     assert d.a.fold_hits >= 6
+
+
+def test_pad_ma_mc_presize_keeps_regime_stable():
+    """ADVICE r5: MA/MC bucket by 2, so a mid-serving arrival of a
+    3-4-term affinity/spread pod flips the sticky regime (full recompile)
+    unless pad_ma/pad_mc pre-size it — mirroring pad_existing/MPN."""
+    nodes = [
+        MakeNode("n0").capacity({"cpu": "8"}).labels({"app": "x"}).obj()
+    ]
+
+    def aff_pod(name, terms):
+        p = MakePod(name).req({"cpu": "1"})
+        for _ in range(terms):
+            p = p.pod_affinity("kubernetes.io/hostname", {"app": "x"})
+        return p.spread(1, "kubernetes.io/hostname", {"app": "x"}).obj()
+
+    base = [aff_pod("p0", 1)]  # affinity/spread capability already on
+    rich = aff_pod("p1", 4)
+    unsized = SnapshotEncoder(pad_pods=8, pad_nodes=4)
+    _, _, s1, _, _ = unsized.encode_packed(nodes, base)
+    _, _, s1b, _, _ = unsized.encode_packed(nodes, base + [rich])
+    assert s1b.key() != s1.key()  # the flip the knob exists to prevent
+
+    sized = SnapshotEncoder(pad_pods=8, pad_nodes=4, pad_ma=4, pad_mc=4)
+    assert sized._sticky_dims == {}
+    _, _, s2, _, _ = sized.encode_packed(nodes, base)
+    assert sized._sticky_dims["MA"] == 4
+    assert sized._sticky_dims["MC"] == 4
+    _, _, s2b, _, _ = sized.encode_packed(nodes, base + [rich])
+    assert s2b.key() == s2.key()

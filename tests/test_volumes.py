@@ -290,9 +290,11 @@ def test_two_pvcs_one_pv_plus_provisioner_is_feasible():
 @pytest.mark.parametrize("mode", ["scan", "rounds"])
 def test_constrained_slot_claims_first_no_deadend(mode):
     """Greedy dead-end case: slot c0 (1 GiB) fits pv-0 (10 GiB) and
-    pv-1 (2 GiB); slot c1 (8 GiB) fits ONLY pv-0. Claiming c0 first
-    with lowest-index choice would take pv-0 and strand c1 — the
-    constrained-first ordering must assign c1=pv-0, c0=pv-1."""
+    pv-1 (2 GiB); slot c1 (8 GiB) fits ONLY pv-0. Naive lowest-index
+    claiming in slot order would give c0 pv-0 and strand c1 — the
+    SDR-safe choice (chosen_pv_sdr: each slot takes the lowest PV whose
+    removal keeps Hall's condition over the remaining needy slots) must
+    steer c0 to pv-1 so c1 gets pv-0."""
     nodes, pods, pvcs, pvs, classes = _joint_fixture(
         n_pvs=2, sizes=(1, 8), pv_caps=[10, 2]
     )
