@@ -1,18 +1,71 @@
-"""Health + metrics HTTP endpoints (SURVEY.md §2 C1, §5.5).
+"""Health + metrics + flight-recorder debug HTTP endpoints.
 
 The reference family serves /healthz and Prometheus /metrics from its
-secure port; dashboards and probes expect those paths. Served here with
-the stdlib http.server on a daemon thread — the payloads are tiny and
-low-rate (scrapes + probes), no framework needed."""
+secure port (SURVEY.md §2 C1, §5.5); dashboards and probes expect those
+paths. On top of them, the cycle flight recorder
+(core/flight_recorder.py) is exposed for production debugging:
+
+- `/debug/flightrecorder?last=N` — the last N cycle records as JSON
+  (phase marks, phase durations, counts) plus the derived window stats;
+- `/debug/trace?last=N` — a Chrome-trace/Perfetto JSON download
+  reconstructing the pipeline's overlapped lanes from real serving
+  timestamps (open in ui.perfetto.dev);
+- `/debug/pods/<uid>` — the per-pod scheduling timeline
+  (queued -> attempts -> bound/evicted, joined with the events ring).
+
+Served with the stdlib http.server on a daemon thread — the payloads are
+small and low-rate (scrapes + probes + on-demand debugging), no
+framework needed. HEAD is answered for every GET route (probes commonly
+use HEAD); any other method gets 405 with an Allow header.
+"""
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 from ..metrics import SchedulerMetrics
+
+
+def _parse_last(query: str, default: int = 128) -> int:
+    try:
+        v = int(urllib.parse.parse_qs(query).get("last", [default])[0])
+    except (TypeError, ValueError):
+        return default
+    return max(1, min(v, 65536))
+
+
+def staleness_healthz(
+    base: Callable[[], dict] | None,
+    recorder,
+    max_age_seconds: float,
+) -> Callable[[], tuple[bool, dict]]:
+    """Health closure with flight-recorder staleness: reports
+    `last_cycle_age_s` and flips to not-ok (503) once no scheduling
+    cycle completed within `max_age_seconds` (0 = never stale). Before
+    the FIRST cycle the age anchors at recorder creation, so a
+    scheduler wedged during startup also goes unhealthy instead of
+    reporting a static 200 forever."""
+
+    def healthz() -> tuple[bool, dict]:
+        detail = dict(base()) if base is not None else {}
+        ok = True
+        if recorder is not None:
+            age = recorder.last_cycle_age_s()
+            detail["last_cycle_age_s"] = round(age, 3)
+            detail["cycles"] = recorder.cycles
+            if max_age_seconds > 0 and age > max_age_seconds:
+                ok = False
+                detail["reason"] = (
+                    f"no cycle completed in {age:.1f}s "
+                    f"(deadline {max_age_seconds:g}s)"
+                )
+        return ok, detail
+
+    return healthz
 
 
 def start_http_server(
@@ -20,31 +73,112 @@ def start_http_server(
     port: int = 10251,
     host: str = "127.0.0.1",
     healthz: Callable[[], tuple[bool, dict]] | None = None,
+    recorder=None,  # core/flight_recorder.FlightRecorder | None
+    pod_timeline: Callable[[str], dict | None] | None = None,
 ) -> ThreadingHTTPServer:
-    """Serve /healthz, /readyz, /metrics; returns the running server
-    (bound port at `.server_address[1]`; pass port=0 for ephemeral)."""
+    """Serve /healthz, /readyz, /metrics and the /debug endpoints;
+    returns the running server (bound port at `.server_address[1]`;
+    pass port=0 for ephemeral). `recorder` enables /debug/flightrecorder
+    and /debug/trace; `pod_timeline` (usually Scheduler.pod_timeline)
+    enables /debug/pods/<uid>."""
     health_fn = healthz or (lambda: (True, {}))
 
     class Handler(BaseHTTPRequestHandler):
-        def do_GET(self):  # noqa: N802  (stdlib casing)
-            if self.path in ("/healthz", "/readyz", "/livez"):
+        # (status, content_type, body, extra_headers)
+        def _route(self) -> tuple[int, str, bytes, dict[str, str]]:
+            parts = urllib.parse.urlsplit(self.path)
+            path, query = parts.path, parts.query
+            if path in ("/healthz", "/readyz", "/livez"):
                 ok, detail = health_fn()
-                body = json.dumps({"ok": ok, **detail}).encode()
-                self.send_response(200 if ok else 503)
-                self.send_header("Content-Type", "application/json")
-            elif self.path == "/metrics":
-                body = metrics.expose()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                return (
+                    200 if ok else 503,
+                    "application/json",
+                    json.dumps({"ok": ok, **detail}).encode(),
+                    {},
                 )
-            else:
-                body = b"not found"
-                self.send_response(404)
-                self.send_header("Content-Type", "text/plain")
+            if path == "/metrics":
+                return (
+                    200,
+                    "text/plain; version=0.0.4; charset=utf-8",
+                    metrics.expose(),
+                    {},
+                )
+            if path == "/debug/flightrecorder" and recorder is not None:
+                last = _parse_last(query)
+                body = json.dumps(
+                    {
+                        "cycles": recorder.to_dicts(last=last),
+                        "derived": recorder.derived(last=last),
+                    }
+                ).encode()
+                return 200, "application/json", body, {}
+            if path == "/debug/trace" and recorder is not None:
+                from ..core.flight_recorder import to_chrome_trace
+
+                last = _parse_last(query)
+                trace = to_chrome_trace(
+                    recorder.snapshot(last=last), epoch=recorder.epoch
+                )
+                return (
+                    200,
+                    "application/json",
+                    json.dumps(trace).encode(),
+                    {
+                        "Content-Disposition":
+                        'attachment; filename="scheduler-trace.json"'
+                    },
+                )
+            if path.startswith("/debug/pods/") and pod_timeline is not None:
+                uid = urllib.parse.unquote(
+                    path[len("/debug/pods/"):]
+                )
+                tl = pod_timeline(uid) if uid else None
+                if tl is None:
+                    return (
+                        404,
+                        "application/json",
+                        json.dumps(
+                            {"error": f"pod {uid!r} not seen"}
+                        ).encode(),
+                        {},
+                    )
+                return 200, "application/json", json.dumps(tl).encode(), {}
+            return 404, "text/plain", b"not found", {}
+
+        def _respond(self, include_body: bool) -> None:
+            status, ctype, body, extra = self._route()
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in extra.items():
+                self.send_header(k, v)
+            self.end_headers()
+            if include_body:
+                self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802  (stdlib casing)
+            self._respond(include_body=True)
+
+        def do_HEAD(self):  # noqa: N802 — probes commonly use HEAD; the
+            # stdlib handler would 501 without this
+            self._respond(include_body=False)
+
+        def _method_not_allowed(self):
+            body = b"method not allowed"
+            self.send_response(405)
+            self.send_header("Allow", "GET, HEAD")
+            self.send_header("Content-Type", "text/plain")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        # every mutating verb is a client error on a read-only surface:
+        # 405 + Allow, not the stdlib's 501
+        do_POST = _method_not_allowed  # noqa: N815
+        do_PUT = _method_not_allowed  # noqa: N815
+        do_DELETE = _method_not_allowed  # noqa: N815
+        do_PATCH = _method_not_allowed  # noqa: N815
+        do_OPTIONS = _method_not_allowed  # noqa: N815
 
         def log_message(self, fmt, *args):  # probes are noisy
             pass

@@ -58,6 +58,25 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         "latency measurement — results are identical either way)",
     )
     ap.add_argument(
+        "--flight-record-n", type=int, default=-1,
+        help="cycle flight-recorder ring capacity (per-cycle phase "
+        "records behind /debug/flightrecorder, /debug/trace and the "
+        "derived pipeline gauges); 0 disables, -1 = keep config "
+        "flightRecorderSize (default 512)",
+    )
+    ap.add_argument(
+        "--trace-dir", default="",
+        help="on shutdown, dump the flight recorder's full ring as a "
+        "Chrome-trace/Perfetto JSON into this directory (live download: "
+        "/debug/trace?last=N)",
+    )
+    ap.add_argument(
+        "--health-max-cycle-age", type=float, default=-1.0,
+        help="/healthz reports 503 when no scheduling cycle completed "
+        "within this many seconds (staleness from the flight recorder; "
+        "0 disables, -1 = keep config healthMaxCycleAge)",
+    )
+    ap.add_argument(
         "--pad-ma", type=int, default=0,
         help="pre-size the sticky per-pod affinity-term pad (MA) so a "
         "mid-serving arrival of a many-term pod cannot flip the packed "
@@ -82,6 +101,22 @@ def main(argv: list[str] | None = None) -> int:
         config.pad_mc = args.pad_mc
     if args.forced_sync:
         config.forced_sync = True
+    if args.flight_record_n >= 0:
+        config.flight_recorder_size = args.flight_record_n
+    if args.health_max_cycle_age >= 0:
+        config.health_max_cycle_age_seconds = args.health_max_cycle_age
+    if (
+        config.health_max_cycle_age_seconds > 0
+        and config.flight_recorder_size <= 0
+    ):
+        # contradictory config: the staleness deadline reads the flight
+        # recorder's last-cycle age — with the recorder disabled it
+        # would be silently inert and /healthz would report 200 while
+        # wedged, the exact failure the deadline exists to catch
+        raise SystemExit(
+            "--health-max-cycle-age/healthMaxCycleAge requires the "
+            "flight recorder (--flight-record-n/flightRecorderSize > 0)"
+        )
 
     # multi-host (DCN) runtime: a no-op unless the launcher set the JAX
     # coordinator env vars (parallel/mesh.py initialize_distributed)
@@ -122,20 +157,32 @@ def main(argv: list[str] | None = None) -> int:
     )
     print(f"scheduler shim listening on port {port}", flush=True)
 
+    # health is no longer a static closure: staleness comes from the
+    # flight recorder, so a scheduler that stopped completing cycles
+    # (wedged device, deadlocked loop) flips /healthz to 503 instead of
+    # reporting healthy forever
+    from .httpserver import staleness_healthz
+
+    recorder = service.scheduler.flight
+    healthz = staleness_healthz(
+        lambda: {
+            "bootId": service.boot_id,
+            "leader": lease.is_leader() if lease else True,
+            "pending": service.scheduler.queue.pending_counts(),
+        },
+        recorder,
+        config.health_max_cycle_age_seconds,
+    )
+
     http_server = None
     if args.http_port >= 0:
         http_server = start_http_server(
             service.scheduler.metrics,
             port=args.http_port,
             host=args.http_host,
-            healthz=lambda: (
-                True,
-                {
-                    "bootId": service.boot_id,
-                    "leader": lease.is_leader() if lease else True,
-                    "pending": service.scheduler.queue.pending_counts(),
-                },
-            ),
+            healthz=healthz,
+            recorder=recorder,
+            pod_timeline=service.scheduler.pod_timeline,
         )
         print(
             "serving /healthz /metrics on port "
@@ -156,6 +203,27 @@ def main(argv: list[str] | None = None) -> int:
         server.stop(grace=2.0)
         if http_server is not None:
             http_server.shutdown()
+        if args.trace_dir and recorder is not None:
+            # post-mortem trace: the full ring as one Perfetto-loadable
+            # file (same payload as /debug/trace, taken at shutdown)
+            import json
+            import os
+            import time as _t
+
+            from ..core.flight_recorder import to_chrome_trace
+
+            os.makedirs(args.trace_dir, exist_ok=True)
+            path = os.path.join(
+                args.trace_dir, f"scheduler-trace-{int(_t.time())}.json"
+            )
+            with open(path, "w") as f:
+                json.dump(
+                    to_chrome_trace(
+                        recorder.snapshot(), epoch=recorder.epoch
+                    ),
+                    f,
+                )
+            print(f"flight-recorder trace written to {path}", flush=True)
         if lease is not None:
             lease.release()
     return 0

@@ -117,6 +117,16 @@ class SchedulerConfiguration:
     # production serving leaves this False and overlaps preemption/
     # diagnosis/transfer with host bind work (core/pipeline.py).
     forced_sync: bool = False
+    # cycle flight recorder (core/flight_recorder.py): ring capacity for
+    # per-cycle phase records — feeds /debug/flightrecorder, the
+    # /debug/trace Perfetto export, the per-pod timelines, and the
+    # derived pipeline gauges. 0 disables recording entirely.
+    flight_recorder_size: int = 512
+    # /healthz staleness deadline: report 503 when no scheduling cycle
+    # completed within this many seconds (0 = never go stale). Uses the
+    # flight recorder's last-cycle age, so a wedged scheduler stops
+    # reporting healthy (cmd/main.py).
+    health_max_cycle_age_seconds: float = 0.0
 
     def profile(self, scheduler_name: str = "default-scheduler") -> Profile:
         for p in self.profiles:
@@ -237,6 +247,10 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         pad_ma=int(data.get("padMa", 0)),
         pad_mc=int(data.get("padMc", 0)),
         forced_sync=bool(data.get("forcedSync", False)),
+        flight_recorder_size=int(data.get("flightRecorderSize", 512)),
+        health_max_cycle_age_seconds=_duration_seconds(
+            data.get("healthMaxCycleAge", 0.0)
+        ),
         extenders=[
             Extender(
                 url_prefix=e["urlPrefix"],

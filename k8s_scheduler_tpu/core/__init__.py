@@ -10,5 +10,9 @@ from .cycle import (  # noqa: F401
     build_preemption_fn,
     build_stable_state_fn,
 )
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    to_chrome_trace,
+)
 from .pipeline import ServingPipeline, build_decision_slim_fn  # noqa: F401
 from .scheduler import CycleStats, Scheduler  # noqa: F401

@@ -88,6 +88,14 @@ class EventRecorder:
         with self._lock:
             return list(self._ring)
 
+    def events_for(self, pod_uid: str) -> list[Event]:
+        """Events still in the ring for one pod — the events-ring half of
+        the per-pod scheduling timeline join (Scheduler.pod_timeline).
+        Empty after the gRPC shim drained the ring; the flight recorder's
+        own pod timeline is the durable half."""
+        with self._lock:
+            return [e for e in self._ring if e.pod_uid == pod_uid]
+
     def drain(self) -> list[Event]:
         """Pop everything recorded so far (the gRPC shim calls this per
         Cycle response so the agent can post real Kubernetes Events)."""

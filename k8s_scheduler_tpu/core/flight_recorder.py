@@ -1,0 +1,427 @@
+"""Cycle flight recorder: bounded per-cycle phase marks + pod timelines.
+
+Production serving needs to answer "which phase ate the cycle and which
+plugin rejected this pod" continuously, without stopping the scheduler
+and without reconstructing it from three independent probe runs. The
+Prometheus histograms aggregate away the per-cycle structure; this module
+keeps the structure:
+
+- `FlightRecorder` — a bounded ring of `CycleRecord`s. The scheduling
+  loop stamps each cycle with host-side `perf_counter` marks (encode,
+  dispatch, decision fetch, winner binds, postfilter, deferred-diagnosis
+  resolution) plus counts (pods, binds, preemptions, queue depths, retry
+  strikes, fetch bytes, pipeline slot). Writer cost is a handful of dict
+  writes and ONE list-slot store per cycle — no locks on the writer side;
+  publication is a seqlock-style monotonically increasing commit count
+  (`_commits`), which readers check around their ring copy and retry
+  until no commit tore the window.
+- `PodTimelines` — a bounded (LRU) per-pod event log:
+  queued -> attempts[{cycle, result, first-rejecting plugin}] ->
+  bound / evicted. Fed by the scheduler's informer handlers and the
+  winner/loser loops; joined with the events ring at query time
+  (Scheduler.pod_timeline).
+- `to_chrome_trace` — reconstructs the split-phase pipeline's overlapped
+  lanes (host encode/bind vs in-flight device cycle vs deferred
+  diagnosis) as a Chrome-trace/Perfetto JSON from the REAL serving
+  timestamps, so pipeline overlap is visible from production, not probe
+  medians. Download via `/debug/trace?last=N`, open in ui.perfetto.dev.
+
+Single-writer contract: records are started and committed by the
+scheduling loop only (one thread). Pod-timeline notes may arrive from
+informer threads and take a small lock. The module is stdlib-only (no
+jax/numpy) so tools and tests can import it without a backend.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time as _time
+from typing import Any, Callable, Iterable
+
+# chrome-trace lane (tid) layout: one process, three threads. Perfetto
+# renders each tid as its own track, so the overlap between the host
+# lane and the device/diagnosis lanes is visible directly.
+LANE_HOST = 1  # encode, dispatch call, winner binds, loser requeue
+LANE_DEVICE = 2  # dispatched cycle program -> slimmed decision fetch
+LANE_DIAG = 3  # deferred FailedScheduling attribution (diag lag)
+
+LANE_NAMES = {
+    LANE_HOST: "host (encode/bind)",
+    LANE_DEVICE: "device cycle (in flight)",
+    LANE_DIAG: "deferred diagnosis",
+}
+
+
+@dataclasses.dataclass
+class CycleRecord:
+    """One scheduling cycle's flight data (one per profile per cycle).
+
+    `marks` hold ABSOLUTE recorder-clock times (perf_counter seconds)
+    for phase boundaries; `phases` hold derived millisecond durations
+    (the ServingPipeline stage report plus scheduler-side phases);
+    `counts` hold integers (pods, binds, queue depths, fetch bytes...).
+    Records are immutable once committed — the ring replaces slots, it
+    never mutates them."""
+
+    seq: int
+    profile: str
+    t_start: float  # recorder clock (perf_counter)
+    wall_start: float  # time.time() anchor for log cross-referencing
+    slot: int = -1  # pipeline upload slot id
+    forced_sync: bool = False
+    t_end: float = 0.0
+    marks: dict[str, float] = dataclasses.field(default_factory=dict)
+    phases: dict[str, float] = dataclasses.field(default_factory=dict)
+    counts: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def mark(self, name: str, t: float) -> None:
+        self.marks[name] = t
+
+    def to_dict(self, epoch: float = 0.0) -> dict[str, Any]:
+        """JSON-ready dict; mark times rebased to `epoch` (seconds)."""
+        return {
+            "seq": self.seq,
+            "profile": self.profile,
+            "slot": self.slot,
+            "forced_sync": self.forced_sync,
+            "t_start_s": round(self.t_start - epoch, 6),
+            "t_end_s": round(self.t_end - epoch, 6),
+            "wall_start": self.wall_start,
+            "marks_s": {
+                k: round(v - epoch, 6) for k, v in self.marks.items()
+            },
+            "phases_ms": {k: round(v, 4) for k, v in self.phases.items()},
+            "counts": dict(self.counts),
+        }
+
+
+class PodTimelines:
+    """Bounded per-pod scheduling history (LRU on pod uid).
+
+    Each entry is `{"uid", "name", "events": [...]}` where every event
+    carries the recorder-clock time, wall time, a kind (Queued /
+    Attempt / Nominated / Bound / BindError / Unschedulable / Evicted /
+    Deleted), and kind-specific detail (cycle seq, node, first-rejecting
+    plugin). Thread-safe — informer handlers run on other threads than
+    the scheduling loop."""
+
+    def __init__(self, max_pods: int = 4096, max_events: int = 256):
+        self._lock = threading.Lock()
+        self._max_pods = max_pods
+        self._max_events = max_events
+        self._pods: collections.OrderedDict[str, dict] = (
+            collections.OrderedDict()
+        )
+
+    def note(
+        self, uid: str, name: str, kind: str, t: float, wall: float,
+        **detail: Any,
+    ) -> None:
+        ev = {"t_s": t, "wall": wall, "kind": kind, **detail}
+        with self._lock:
+            entry = self._pods.get(uid)
+            if entry is None:
+                entry = {"uid": uid, "name": name, "events": []}
+                self._pods[uid] = entry
+                while len(self._pods) > self._max_pods:
+                    self._pods.popitem(last=False)
+            else:
+                self._pods.move_to_end(uid)
+                if name:
+                    entry["name"] = name
+            events = entry["events"]
+            events.append(ev)
+            if len(events) > self._max_events:
+                del events[: len(events) - self._max_events]
+
+    def get(self, uid: str) -> dict | None:
+        with self._lock:
+            entry = self._pods.get(uid)
+            if entry is None:
+                return None
+            return {
+                "uid": entry["uid"],
+                "name": entry["name"],
+                "events": [dict(e) for e in entry["events"]],
+            }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pods)
+
+
+class FlightRecorder:
+    """Bounded ring of CycleRecords + pod timelines.
+
+    Hot-path cost: `start()` is one dataclass construction; `commit()`
+    is one list-slot store plus one int publish. Readers (`snapshot`)
+    copy the ring without blocking the writer and validate the copy
+    against the commit count (seqlock-style): a copy a commit landed in
+    is retried."""
+
+    def __init__(
+        self,
+        capacity: int = 512,
+        now: Callable[[], float] = _time.perf_counter,
+        wall: Callable[[], float] = _time.time,
+        max_pods: int = 4096,
+    ) -> None:
+        self.capacity = max(int(capacity), 1)
+        self.now = now
+        self._wall = wall
+        self._ring: list[CycleRecord | None] = [None] * self.capacity
+        # COMMIT count (monotonic): the seqlock generation readers check.
+        # Distinct from _seq — a started-but-never-committed record (an
+        # aborted cycle, e.g. a failed decision fetch) consumes a seq but
+        # must not inflate the committed-cycle count.
+        self._commits = 0
+        self._seq = 0  # next record's sequence number
+        self.epoch = now()
+        self.wall_epoch = wall()
+        self.pods = PodTimelines(max_pods=max_pods)
+
+    # ---- writer side (scheduling loop only) ------------------------------
+
+    def start(self, profile: str = "default-scheduler") -> CycleRecord:
+        rec = CycleRecord(
+            seq=self._seq,
+            profile=profile,
+            t_start=self.now(),
+            wall_start=self._wall(),
+        )
+        self._seq += 1
+        return rec
+
+    def commit(self, rec: CycleRecord) -> None:
+        if not rec.t_end:
+            rec.t_end = self.now()
+        self._ring[rec.seq % self.capacity] = rec
+        # publish AFTER the slot store: a reader that observes the new
+        # count is guaranteed to observe the new record (GIL-ordered)
+        self._commits += 1
+
+    def pod_event(
+        self, uid: str, name: str, kind: str, **detail: Any
+    ) -> None:
+        self.pods.note(
+            uid, name, kind, self.now() - self.epoch, self._wall(),
+            **detail,
+        )
+
+    # ---- reader side -----------------------------------------------------
+
+    @property
+    def cycles(self) -> int:
+        """Total committed records (not capped by capacity; aborted
+        starts do not count)."""
+        return self._commits
+
+    def snapshot(self, last: int | None = None) -> list[CycleRecord]:
+        """Consistent copy of the most recent `last` records (oldest
+        first; `last=0` is an empty window). Lock-free: the copy is
+        retried until no commit landed during it (the seqlock check —
+        commits are cycle-rate, the copy is microseconds, so this
+        converges immediately in practice); the fallback trims to the
+        newest run of seqs no commit could have torn."""
+        ring: list[CycleRecord | None] = []
+        for _ in range(8):
+            before = self._commits
+            ring = list(self._ring)  # atomic-enough slot copy under GIL
+            if self._commits == before:
+                break  # no commit during the copy: exactly consistent
+        recs = sorted(
+            (r for r in ring if r is not None), key=lambda r: r.seq
+        )
+        if recs:
+            # fallback consistency trim: a commit mid-copy can leave a
+            # stale slot (seq max-capacity) next to its replacement —
+            # keep only the trailing window every slot agrees on
+            recs = [
+                r for r in recs
+                if r.seq > recs[-1].seq - self.capacity
+            ]
+        if last is not None:
+            n = max(int(last), 0)
+            recs = recs[-n:] if n else []
+        return recs
+
+    def last_record(self) -> CycleRecord | None:
+        recs = self.snapshot(last=1)
+        return recs[-1] if recs else None
+
+    def last_cycle_age_s(self) -> float:
+        """Seconds since the newest committed cycle record — or since
+        the recorder was created when no cycle has EVER completed, so a
+        scheduler that wedged before its first cycle still ages out of
+        its health deadline instead of reporting healthy forever."""
+        rec = self.last_record()
+        anchor = rec.t_end if rec is not None else self.epoch
+        return max(0.0, self.now() - anchor)
+
+    def to_dicts(self, last: int | None = None) -> list[dict]:
+        return [r.to_dict(epoch=self.epoch) for r in self.snapshot(last)]
+
+    def derived(self, last: int = 64) -> dict[str, float]:
+        """Continuous pipeline gauges computed over the recent window —
+        the production replacement for the probe's three separated runs
+        (see core/profiling.overlap_from_records for the accounting)."""
+        from .profiling import overlap_from_records
+
+        recs = self.snapshot(last=last)
+        out = overlap_from_records(r.phases for r in recs)
+        out["cycles"] = float(self.cycles)
+        out["last_cycle_age_s"] = round(self.last_cycle_age_s(), 6)
+        return out
+
+
+# ---- Chrome-trace / Perfetto export ------------------------------------
+
+
+def _slice(
+    name: str, tid: int, t0: float, t1: float, epoch: float,
+    args: dict | None = None,
+) -> dict:
+    ev = {
+        "name": name,
+        "ph": "X",
+        "pid": 1,
+        "tid": tid,
+        "ts": round((t0 - epoch) * 1e6, 3),
+        "dur": round(max(t1 - t0, 0.0) * 1e6, 3),
+        "cat": "scheduler",
+    }
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def to_chrome_trace(
+    records: Iterable[CycleRecord], epoch: float = 0.0
+) -> dict:
+    """Chrome-trace (JSON object format) reconstruction of the serving
+    pipeline's lanes from committed records. Open the serialized dict in
+    ui.perfetto.dev or chrome://tracing.
+
+    Lane layout (one pid, three tids — see LANE_NAMES):
+
+    - host lane: `encode` -> `dispatch` -> `decision_wait` (the one
+      blocking fetch) -> `bind winners` -> `postfilter` -> `losers`;
+    - device lane: one `cycle[k]` slice spanning dispatch start ->
+      decision fetch end — the window the device (and the transfer) is
+      working while the host is free to do other work;
+    - diag lane: `diag lag` from decision-fetch end to the moment the
+      deferred FailedScheduling attribution was forced.
+
+    Under async serving the diag slice overlaps the host bind slice and
+    the device slice overlaps host dispatch-adjacent work; under
+    `forced_sync` every slice serializes — the visual proof either way
+    comes from real serving timestamps."""
+    events: list[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "args": {"name": "tpu-scheduler serving pipeline"},
+        }
+    ]
+    for tid, name in LANE_NAMES.items():
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": tid,
+                "args": {"name": name},
+            }
+        )
+        events.append(
+            {
+                "name": "thread_sort_index",
+                "ph": "M",
+                "pid": 1,
+                "tid": tid,
+                "args": {"sort_index": tid},
+            }
+        )
+
+    for rec in records:
+        m = rec.marks
+        args = {
+            "seq": rec.seq,
+            "profile": rec.profile,
+            "slot": rec.slot,
+            "forced_sync": rec.forced_sync,
+            **{k: v for k, v in rec.counts.items()},
+        }
+        t_enc0 = m.get("encode_start", rec.t_start)
+        t_disp0 = m.get("dispatch_start")
+        t_disp1 = m.get("dispatch_end")
+        t_dec0 = m.get("decision_start")
+        t_dec1 = m.get("decision_end")
+        # bind work starts at apply_start when stamped (after the
+        # deferred dispatches, which BLOCK under forced_sync)
+        t_apply = m.get("apply_start", m.get("decision_end"))
+        t_win = m.get("winners_end")
+        t_post = m.get("postfilter_end")
+        t_diag = m.get("diag_done")
+
+        # whole-cycle envelope on the host lane (parent slice: children
+        # below nest inside it on the same tid)
+        events.append(
+            _slice(
+                f"cycle[{rec.seq}]", LANE_HOST, rec.t_start, rec.t_end,
+                epoch, args,
+            )
+        )
+        if t_disp0 is not None:
+            events.append(
+                _slice("encode", LANE_HOST, t_enc0, t_disp0, epoch)
+            )
+        if t_disp0 is not None and t_disp1 is not None:
+            events.append(
+                _slice("dispatch", LANE_HOST, t_disp0, t_disp1, epoch)
+            )
+        if t_dec0 is not None and t_dec1 is not None:
+            events.append(
+                _slice(
+                    "decision_wait", LANE_HOST, t_dec0, t_dec1, epoch,
+                    {"fetch_bytes": rec.counts.get("fetch_bytes", 0)},
+                )
+            )
+        if t_apply is not None and t_win is not None:
+            events.append(
+                _slice("bind winners", LANE_HOST, t_apply, t_win, epoch)
+            )
+        if t_win is not None and t_post is not None:
+            events.append(
+                _slice("postfilter", LANE_HOST, t_win, t_post, epoch)
+            )
+        if t_post is not None:
+            events.append(
+                _slice("losers", LANE_HOST, t_post, rec.t_end, epoch)
+            )
+
+        # device lane: dispatched program in flight until the slimmed
+        # decision payload landed on the host
+        if t_disp0 is not None and t_dec1 is not None:
+            events.append(
+                _slice(
+                    f"device cycle[{rec.seq}] slot={rec.slot}",
+                    LANE_DEVICE, t_disp0, t_dec1, epoch,
+                    {"seq": rec.seq, "slot": rec.slot},
+                )
+            )
+
+        # diagnosis lane: how far FailedScheduling attribution trailed
+        # the binds (resolves while the host bind loop runs)
+        if t_dec1 is not None and t_diag is not None and t_diag > t_dec1:
+            events.append(
+                _slice(
+                    f"diag lag[{rec.seq}]", LANE_DIAG, t_dec1, t_diag,
+                    epoch, {"seq": rec.seq},
+                )
+            )
+
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

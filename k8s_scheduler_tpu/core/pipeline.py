@@ -95,6 +95,7 @@ class CycleHandle:
         if self._decisions is None:
             now = self._pipe._now
             t0 = now()
+            self._pipe.stats["t_decision_start"] = t0
             try:
                 a, flags = jax.device_get(self._slim)
             except Exception:
@@ -107,10 +108,12 @@ class CycleHandle:
                 # pipeline's guard forever (permanent serving outage).
                 self.fetched = True
                 self.release()
+                self._pipe._note_inflight()
                 raise
             self._t_decisions = now()
             st = self._pipe.stats
             st["decision_wait_ms"] = (self._t_decisions - t0) * 1e3
+            st["t_decision_end"] = self._t_decisions
             st["fetch_bytes"] = int(a.nbytes + flags.nbytes)
             # what the un-slimmed fetch of the same fields would move
             st["fetch_bytes_full"] = int(a.shape[0] * (4 + 1 + 1))
@@ -127,6 +130,7 @@ class CycleHandle:
                 (flags & 2) != 0,
             )
             self.fetched = True
+            self._pipe._note_inflight()
         return self._decisions
 
     # ---- deferred (off the bind path) -----------------------------------
@@ -165,6 +169,19 @@ class CycleHandle:
                 # the diagnosis program consumed (donated) the slot's
                 # packed buffers — nothing may reference them again
                 self._wbuf = self._bbuf = None
+            if self._pipe.forced_sync:
+                # strict sequential execution covers the deferred
+                # programs too: block here (before the caller's bind
+                # loop) and stamp availability now, so the flight
+                # recorder's diag lane serializes instead of riding the
+                # bind overlap
+                jax.block_until_ready(self._diag)
+                if self._t_decisions is not None:
+                    t_done = self._pipe._now()
+                    self._pipe.stats["diag_lag_ms"] = (
+                        t_done - self._t_decisions
+                    ) * 1e3
+                    self._pipe.stats["t_diag_done"] = t_done
         return self._diag
 
     def reject_counts(self):
@@ -176,13 +193,21 @@ class CycleHandle:
         if d is None:
             return None
         arr = np.asarray(d)
-        if self._t_decisions is not None:
-            lag = (self._pipe._now() - self._t_decisions) * 1e3
+        if (
+            self._t_decisions is not None
+            and "t_diag_done" not in self._pipe.stats
+        ):
+            # first force stamps availability; a forced_sync
+            # dispatch_diagnosis already did (earlier — see above)
+            t_done = self._pipe._now()
+            lag = (t_done - self._t_decisions) * 1e3
             self._pipe.stats["diag_lag_ms"] = lag
+            self._pipe.stats["t_diag_done"] = t_done
+        if self._t_decisions is not None:
             m = self._pipe._metrics
             if m is not None:
                 m.cycle_duration.labels(phase="diag_lag").observe(
-                    lag / 1e3
+                    self._pipe.stats.get("diag_lag_ms", 0.0) / 1e3
                 )
         return arr
 
@@ -195,6 +220,7 @@ class CycleHandle:
             # consumed, the guard releases (see decisions)
             self.fetched = True
             self.release()
+            self._pipe._note_inflight()
             raise
         return self
 
@@ -334,8 +360,16 @@ class ServingPipeline:
         self._slots[slot] = handle
         self._last = handle
         self._n += 1
-        dispatch_s = self._now() - t0
-        self.stats = {"dispatch_ms": dispatch_s * 1e3}
+        t1 = self._now()
+        dispatch_s = t1 - t0
+        # absolute marks (pipeline clock = perf_counter) feed the flight
+        # recorder's per-cycle trace lanes (core/flight_recorder.py)
+        self.stats = {
+            "dispatch_ms": dispatch_s * 1e3,
+            "slot": slot,
+            "t_dispatch_start": t0,
+            "t_dispatch_end": t1,
+        }
         if self._pending_encode_ms is not None:
             self.stats["encode_ms"] = self._pending_encode_ms
             self._pending_encode_ms = None
@@ -343,9 +377,27 @@ class ServingPipeline:
             self._metrics.cycle_duration.labels(phase="dispatch").observe(
                 dispatch_s
             )
+        self._note_inflight()
         if self.forced_sync:
             handle.block()
+            # sequential execution hides nothing: the device time sits
+            # inside dispatch_ms here, so the conservative
+            # encode-vs-decision-wait estimate would misread the tiny
+            # post-block fetch as "encode fully hidden" — pin it to 0
+            self.stats["encode_hidden_ms"] = 0.0
         return handle
+
+    def inflight(self) -> int:
+        """Dispatched cycles whose decisions were not fetched yet (0 or
+        1 under the strict-ordering guard)."""
+        return sum(
+            1 for h in self._slots if h is not None and not h.fetched
+        )
+
+    def _note_inflight(self) -> None:
+        g = getattr(self._metrics, "cycle_inflight", None)
+        if g is not None:
+            g.set(self.inflight())
 
     def stage_report(self) -> dict[str, float]:
         """Last-cycle per-stage breakdown: dispatch_ms, decision_wait_ms,
@@ -357,7 +409,8 @@ class ServingPipeline:
         per-cycle estimate; the probe/bench compute the exact overlap
         from separated encode/device baselines)."""
         st = dict(self.stats)
-        enc = st.get("encode_ms", 0.0)
-        wait = st.get("decision_wait_ms", 0.0)
-        st["encode_hidden_ms"] = max(0.0, enc - wait)
+        if "encode_hidden_ms" not in st:  # forced_sync pre-pins it to 0
+            enc = st.get("encode_ms", 0.0)
+            wait = st.get("decision_wait_ms", 0.0)
+            st["encode_hidden_ms"] = max(0.0, enc - wait)
         return st

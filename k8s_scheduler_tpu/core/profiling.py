@@ -21,7 +21,7 @@ Perfetto; `trace_cycle` below does that for one full fused cycle.
 from __future__ import annotations
 
 import time as _time
-from typing import Any
+from typing import Any, Iterable
 
 import jax
 import jax.numpy as jnp
@@ -246,6 +246,43 @@ def overlap_stats(
         "pipelined_ms": round(pipelined_s * 1e3, 3),
         "encode_hidden_ms": round(min(hidden, encode_s) * 1e3, 3),
         "overlap_pct": round(min(pct, 100.0), 1),
+    }
+
+
+def overlap_from_records(
+    phase_dicts: "Iterable[dict[str, float]]",
+) -> dict[str, float]:
+    """Continuous overlap accounting from flight-recorder records —
+    the production counterpart of `overlap_stats`, which needs three
+    separated probe runs. Each input dict is a CycleRecord's `phases`
+    (the ServingPipeline stage report: encode_ms, decision_wait_ms,
+    encode_hidden_ms, diag_lag_ms, ...).
+
+    `overlap_ratio` = hidden encode / total encode over the window,
+    using the pipeline's conservative per-cycle estimate
+    (hidden = max(0, encode - decision_wait)); 0.0 = fully serial
+    (forced_sync), 1.0 = every encode ran in the device's shadow.
+    Pure python — safe to call from endpoints at serving rate."""
+    n = 0
+    enc = hidden = wait = diag = diag_n = 0.0
+    for ph in phase_dicts:
+        n += 1
+        e = ph.get("encode_ms", 0.0)
+        w = ph.get("decision_wait_ms", 0.0)
+        enc += e
+        wait += w
+        hidden += ph.get("encode_hidden_ms", max(0.0, e - w))
+        if "diag_lag_ms" in ph:
+            diag += ph["diag_lag_ms"]
+            diag_n += 1
+    return {
+        "window": float(n),
+        "encode_ms_mean": round(enc / n, 4) if n else 0.0,
+        "decision_wait_ms_mean": round(wait / n, 4) if n else 0.0,
+        "encode_hidden_ms_mean": round(hidden / n, 4) if n else 0.0,
+        "diag_lag_ms_mean": round(diag / diag_n, 4) if diag_n else 0.0,
+        "overlap_ratio": round(min(hidden / enc, 1.0), 4) if enc > 0
+        else 0.0,
     }
 
 

@@ -58,6 +58,7 @@ from .cycle import (
     build_stable_state_fn,
 )
 from .events import EventRecorder, failed_scheduling_message
+from .flight_recorder import FlightRecorder
 
 # binder(pod, node_name) -> None; raise to signal bind failure
 Binder = Callable[[Pod, str], None]
@@ -96,6 +97,8 @@ class Scheduler:
         forced_sync: bool | None = None,  # None = config.forced_sync;
         # True blocks every pipeline dispatch to completion (strict
         # sequential execution — the tests/measurement escape hatch)
+        flight_recorder: FlightRecorder | None = None,  # None = build
+        # from config.flight_recorder_size (0 disables recording)
     ) -> None:
         self.config = config or SchedulerConfiguration()
         # one Framework per profile (SURVEY.md §2 C12 / §5.6: multiple
@@ -130,6 +133,22 @@ class Scheduler:
         self.binder = binder or (lambda pod, node: None)
         self.evictor = evictor or (lambda pod, node: None)
         self.events = events or EventRecorder()
+        # cycle flight recorder: per-cycle phase marks + pod timelines
+        # (core/flight_recorder.py); None when disabled by config
+        if flight_recorder is not None:
+            self.flight: FlightRecorder | None = flight_recorder
+        elif self.config.flight_recorder_size > 0:
+            self.flight = FlightRecorder(
+                capacity=self.config.flight_recorder_size
+            )
+        else:
+            self.flight = None
+        if self.flight is not None:
+            # live staleness at scrape time (not at cycle end — a wedged
+            # scheduler must show a GROWING age on /metrics)
+            self.metrics.last_cycle_age.set_function(
+                self.flight.last_cycle_age_s
+            )
         self._now = now
         self._pad_bucket = pad_bucket
         self._profile_name = self.config.profiles[0].scheduler_name  # legacy alias
@@ -326,21 +345,35 @@ class Scheduler:
             self.queue.delete(pod.uid)
             self.cache.add_pod(pod, node_name)
             self.queue.move_all_to_active_or_backoff(EVENT_POD_ADD)
+            if self.flight is not None:
+                self.flight.pod_event(
+                    pod.uid, pod.name, "BoundObserved", node=node_name
+                )
         else:
             self.queue.add(pod)
+            if self.flight is not None:
+                self.flight.pod_event(pod.uid, pod.name, "Queued")
 
     def on_pod_update(self, pod: Pod, node_name: str = "") -> None:
         if node_name:
             self.queue.delete(pod.uid)
             self.cache.add_pod(pod, node_name)
             self.queue.move_all_to_active_or_backoff(EVENT_POD_UPDATE)
+            if self.flight is not None:
+                self.flight.pod_event(
+                    pod.uid, pod.name, "BoundObserved", node=node_name
+                )
         else:
             self.queue.update(pod)
+            if self.flight is not None:
+                self.flight.pod_event(pod.uid, pod.name, "Updated")
 
     def on_pod_delete(self, pod_uid: str) -> None:
         self.cache.remove_pod(pod_uid)
         self.queue.delete(pod_uid)
         self.queue.move_all_to_active_or_backoff(EVENT_POD_DELETE)
+        if self.flight is not None:
+            self.flight.pod_event(pod_uid, "", "Deleted")
 
     def on_node_add(self, node: Node) -> None:
         self.cache.add_node(node)
@@ -455,6 +488,15 @@ class Scheduler:
     ) -> None:
         framework = self.frameworks[profile]
         encoder = self._encoders[profile]
+        fr = self.flight
+        rec = fr.start(profile) if fr is not None else None
+        if rec is not None:
+            rec.mark("encode_start", rec.t_start)
+            # per-profile deltas: CycleStats accumulates across profiles
+            _before = (
+                stats.scheduled, stats.unschedulable, stats.bind_errors,
+                stats.preemptors, stats.victims,
+            )
         nodes = self.cache.nodes()
         existing = self.cache.existing_pods()
         # bucketed pod/node padding keeps jit caches warm across cycles
@@ -630,6 +672,11 @@ class Scheduler:
         if ppreempt is not None and (assignment < 0).any():
             self.metrics.preemption_attempts.inc()
             pre_handle = handle.dispatch_preemption()
+        if rec is not None:
+            # bind work starts here: under forced_sync the deferred
+            # dispatches above BLOCKED, and the trace's bind slice must
+            # not swallow that wait (the diag lane would fake overlap)
+            rec.mark("apply_start", fr.now())
 
         # ---- apply, split-phase: winners bind FIRST (no deferred
         # output can block them), losers are processed after — their
@@ -639,6 +686,14 @@ class Scheduler:
         # binding (upstream attempt duration = algorithm + bind)
         def per_pod_s() -> float:
             return (self._now() - t0) / max(len(pending), 1)
+
+        # per-pod timeline notes (flight recorder): every attempt outcome
+        # carries the cycle seq so timelines join back to cycle records
+        def _pev(pod, kind: str, **detail) -> None:
+            if fr is not None:
+                fr.pod_event(
+                    pod.uid, pod.name, kind, cycle=rec.seq, **detail
+                )
         from ..framework.host import (
             HostPluginRejection,
             run_post_bind,
@@ -658,6 +713,7 @@ class Scheduler:
                 self.cache.assume(pod, node_name)
             except ValueError:
                 stats.bind_errors += 1
+                _pev(pod, "BindError", node=node_name, stage="assume")
                 self.metrics.observe_attempt(
                     "error", per_pod_s(), profile
                 )
@@ -673,6 +729,10 @@ class Scheduler:
                     # transient pre-bind failure: retry with backoff
                     self.queue.requeue_backoff(pod)
                     stats.bind_errors += 1
+                    _pev(
+                        pod, "BindError", node=node_name,
+                        stage="PreBind", plugin=rej.plugin,
+                    )
                     self.metrics.observe_attempt(
                         "error", per_pod_s(), profile
                     )
@@ -687,6 +747,10 @@ class Scheduler:
                         pod, reasons=(rej.plugin,)
                     )
                     stats.unschedulable += 1
+                    _pev(
+                        pod, "Rejected", node=node_name,
+                        stage=rej.point, plugin=rej.plugin,
+                    )
                     self.metrics.observe_attempt(
                         "unschedulable", per_pod_s(), profile
                     )
@@ -699,6 +763,7 @@ class Scheduler:
                 self.cache.forget(pod.uid)
                 self.queue.requeue_backoff(pod)
                 stats.bind_errors += 1
+                _pev(pod, "BindError", node=node_name, stage="bind")
                 self.metrics.observe_attempt(
                     "error", per_pod_s(), profile
                 )
@@ -707,6 +772,7 @@ class Scheduler:
             self.cache.finish_binding(pod.uid)
             run_post_bind(self.host_plugins, pod, node_name)
             self.events.scheduled(pod, node_name)
+            _pev(pod, "Bound", node=node_name)
             stats.scheduled += 1
             self.metrics.pod_scheduling_attempts.observe(
                 self.queue.attempts_of(pod.uid)
@@ -717,11 +783,15 @@ class Scheduler:
 
         # losers: force the (overlapped) preemption output now
         t_winners = self._now()
+        if rec is not None:
+            rec.mark("winners_end", fr.now())
         nominated = victims = None
         if pre_handle is not None:
             nominated = np.asarray(pre_handle.nominated)[: len(pending)]
             victims = np.asarray(pre_handle.victims)[: len(existing)]
         t_post = self._now()
+        if rec is not None:
+            rec.mark("postfilter_end", fr.now())
         self.metrics.cycle_duration.labels(phase="postfilter").observe(
             t_post - t_winners
         )
@@ -734,12 +804,14 @@ class Scheduler:
                 # (transient webhook errors must not park the pod)
                 self.queue.requeue_backoff(pod)
                 stats.bind_errors += 1
+                _pev(pod, "BindError", stage="extender")
                 self.metrics.observe_attempt(
                     "error", per_pod_s(), profile
                 )
                 continue
             if nominated is not None and nominated[i] >= 0:
                 pod.nominated_node_name = nodes[int(nominated[i])].name
+                _pev(pod, "Nominated", node=pod.nominated_node_name)
                 # in-place mutation: the delta encoder must re-read
                 # this pod's slot next cycle (arena contract)
                 self._nominated_mut[profile].add(id(pod))
@@ -767,6 +839,10 @@ class Scheduler:
                 self.metrics.unschedulable_reasons.labels(
                     plugin=r, profile=profile
                 ).inc()
+            _pev(
+                pod, "Unschedulable",
+                plugin=reasons[0] if reasons else "",
+            )
             self.events.failed_scheduling(pod, message)
             self.queue.requeue_unschedulable(pod, reasons=reasons)
             stats.unschedulable += 1
@@ -784,6 +860,10 @@ class Scheduler:
                 vpod, vnode = existing[int(e)]
                 self.evictor(vpod, vnode)
                 self.last_evictions.append((vpod, vnode))
+                _pev(
+                    vpod, "Evicted", node=vnode,
+                    preemptor=preemptor_by_node.get(vnode, ""),
+                )
                 self.events.preempted(
                     vpod, preemptor_by_node.get(vnode, "<pending>")
                 )
@@ -796,6 +876,47 @@ class Scheduler:
         self.metrics.cycle_duration.labels(phase="apply").observe(
             (t_winners - t_device) + (self._now() - t_post)
         )
+
+        # ---- flight record: assemble + commit (one list store) ----
+        if rec is not None:
+            from .cycle import RESILIENT_STRIKES
+
+            st = pipe.stage_report()
+            rec.slot = int(st.get("slot", -1))
+            rec.forced_sync = bool(self.forced_sync)
+            # absolute pipeline marks (same perf_counter clock as the
+            # recorder) -> trace lanes; "t_dispatch_start" -> mark
+            # "dispatch_start" etc.
+            for k, v in st.items():
+                if k.startswith("t_"):
+                    rec.mark(k[2:], v)
+            rec.phases.update(
+                {
+                    k: float(v)
+                    for k, v in st.items()
+                    if k.endswith("_ms")
+                }
+            )
+            qc = self.queue.pending_counts()
+            sb, ub, bb, pb, vb = _before
+            rec.counts.update(
+                pods=len(pending),
+                nodes=len(nodes),
+                scheduled=stats.scheduled - sb,
+                unschedulable=stats.unschedulable - ub,
+                bind_errors=stats.bind_errors - bb,
+                preemptors=stats.preemptors - pb,
+                victims=stats.victims - vb,
+                gang_dropped=int(stats.gang_dropped),
+                fetch_bytes=int(st.get("fetch_bytes", 0)),
+                retry_strikes_total=sum(RESILIENT_STRIKES.values()),
+                queue_active=qc.get("active", 0),
+                queue_backoff=qc.get("backoff", 0),
+                queue_unschedulable=qc.get("unschedulable", 0),
+            )
+            fr.commit(rec)
+            if "diag_lag_ms" in st:
+                self.metrics.diag_lag.observe(st["diag_lag_ms"] / 1e3)
 
     def _bind(self, pod: Pod, node_name: str) -> None:
         """Bind, delegating to the first bind-verb extender (upstream: an
@@ -816,6 +937,57 @@ class Scheduler:
             c.get("bound", 0) + c.get("assumed", 0),
             c.get("assumed", 0),
         )
+        # flight-recorder derived gauges: the continuous overlap story
+        # (scheduler_pipeline_overlap_ratio) computed from the recent
+        # cycle window instead of separated probe runs
+        if self.flight is not None and self.flight.cycles:
+            d = self.flight.derived()
+            self.metrics.pipeline_overlap.set(d["overlap_ratio"])
+
+    def pod_timeline(self, uid: str) -> dict | None:
+        """The per-pod scheduling timeline: the flight recorder's pod
+        events (queued -> attempts -> bound/evicted) joined with
+        whatever is still in the events ring (the shim drains the ring
+        per Cycle, so the recorder half is the durable one). Returns
+        None for a pod neither side has seen."""
+        tl = (
+            self.flight.pods.get(uid) if self.flight is not None else None
+        )
+        ring = self.events.events_for(uid)
+        if tl is None and not ring:
+            return None
+        out = tl or {"uid": uid, "name": "", "events": []}
+        # cycle attempts in order: every outcome note carries its cycle
+        # seq, which joins back to /debug/flightrecorder records
+        attempt_kinds = {
+            "Bound", "Unschedulable", "BindError", "Rejected",
+        }
+        out["attempts"] = [
+            {
+                "cycle": e.get("cycle", -1),
+                "result": e["kind"],
+                **{
+                    k: e[k]
+                    for k in ("plugin", "node", "stage")
+                    if k in e
+                },
+            }
+            for e in out["events"]
+            if e["kind"] in attempt_kinds
+        ]
+        terminal = [
+            e for e in out["events"]
+            if e["kind"] in ("Bound", "Evicted", "Deleted",
+                             "BoundObserved")
+        ]
+        out["state"] = (
+            terminal[-1]["kind"] if terminal
+            else ("Unschedulable" if any(
+                e["kind"] == "Unschedulable" for e in out["events"]
+            ) else "Pending")
+        )
+        out["ring_events"] = [dataclasses.asdict(e) for e in ring]
+        return out
 
     def profile_cycle(self, repeats: int = 3) -> dict:
         """Sampled per-plugin observability pass (SURVEY.md §5.1): times

@@ -31,6 +31,24 @@ the whole pending set per cycle):
   north-star throughput numerator)
 - scheduler_decision_fetch_bytes_total — bytes moved device->host by the
   blocking decision fetch (the slimmed payload; core/pipeline.py)
+- scheduler_unschedulable_reasons_total{plugin,profile} — unschedulable
+  attempts by first-rejecting plugin
+- scheduler_program_retry_strikes_total{program,kind} — compiled-program
+  retries absorbed by the resilience wrapper (core/cycle.py _Resilient)
+
+Flight-recorder derived gauges (core/flight_recorder.py): continuous
+pipeline-health signals computed from the cycle ring each cycle, so the
+overlap story needs no probe runs:
+
+- scheduler_pipeline_overlap_ratio — fraction of host encode time hidden
+  behind in-flight device work over the recent cycle window (0 = fully
+  serial, e.g. forcedSync; 1 = encode fully hidden)
+- scheduler_cycle_inflight — dispatched-but-unfetched pipeline cycles
+  right now (0 or 1 per pipeline under the ordering guard)
+- scheduler_diag_lag_seconds — summary of how far the deferred
+  FailedScheduling attribution trailed each cycle's decision fetch
+- scheduler_last_cycle_age_seconds — seconds since the last completed
+  cycle record (the /healthz staleness signal)
 
 Each `SchedulerMetrics` owns its own `CollectorRegistry`;
 `global_metrics()` returns the process-wide default instance, which is
@@ -50,6 +68,7 @@ from prometheus_client import (
     Counter,
     Gauge,
     Histogram,
+    Summary,
     generate_latest,
 )
 
@@ -179,6 +198,30 @@ class SchedulerMetrics:
             "scheduler_decision_fetch_bytes_total",
             "Bytes moved device->host by the blocking per-cycle decision "
             "fetch (slimmed payload: i16 assignment + u8 flags per pod).",
+            registry=r,
+        )
+        # ---- flight-recorder derived gauges (core/flight_recorder.py) ----
+        self.pipeline_overlap = Gauge(
+            "scheduler_pipeline_overlap_ratio",
+            "Fraction of host encode time hidden behind in-flight device "
+            "work over the recent flight-recorder window (0 = serial).",
+            registry=r,
+        )
+        self.cycle_inflight = Gauge(
+            "scheduler_cycle_inflight",
+            "Dispatched-but-unfetched serving-pipeline cycles right now.",
+            registry=r,
+        )
+        self.diag_lag = Summary(
+            "scheduler_diag_lag_seconds",
+            "How far the deferred FailedScheduling attribution trailed "
+            "the cycle's blocking decision fetch.",
+            registry=r,
+        )
+        self.last_cycle_age = Gauge(
+            "scheduler_last_cycle_age_seconds",
+            "Seconds since the last completed scheduling cycle record "
+            "(the /healthz staleness signal).",
             registry=r,
         )
         self.program_retry_strikes = Counter(

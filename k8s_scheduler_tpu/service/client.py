@@ -48,6 +48,11 @@ class SchedulerClient:
             request_serializer=pb.MetricsRequest.SerializeToString,
             response_deserializer=pb.MetricsResponse.FromString,
         )
+        self._inspect = mk(
+            f"/{SERVICE_NAME}/Inspect",
+            request_serializer=pb.InspectRequest.SerializeToString,
+            response_deserializer=pb.InspectResponse.FromString,
+        )
 
     def update(self, request: pb.UpdateRequest, timeout: float = 10.0):
         return self._update(request, timeout=timeout)
@@ -60,6 +65,26 @@ class SchedulerClient:
 
     def metrics_text(self, timeout: float = 10.0) -> bytes:
         return self._metrics(pb.MetricsRequest(), timeout=timeout).prometheus_text
+
+    def inspect(
+        self,
+        kind: str = "flightrecorder",
+        last: int = 0,
+        pod_uid: str = "",
+        timeout: float = 10.0,
+    ) -> dict:
+        """Pull flight-recorder data (cycle records / Perfetto trace /
+        per-pod timeline) decoded from the JSON payload; raises
+        RuntimeError when the server reports an inspection error."""
+        import json
+
+        resp = self._inspect(
+            pb.InspectRequest(kind=kind, last=last, pod_uid=pod_uid),
+            timeout=timeout,
+        )
+        if not resp.ok:
+            raise RuntimeError(f"Inspect({kind!r}): {resp.error}")
+        return json.loads(resp.json.decode())
 
     def close(self) -> None:
         self.channel.close()

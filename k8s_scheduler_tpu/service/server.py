@@ -190,12 +190,58 @@ class SchedulerService:
             prometheus_text=self.scheduler.metrics.expose()
         )
 
+    def Inspect(self, request: pb.InspectRequest, context) -> pb.InspectResponse:
+        """Flight-recorder introspection over the agent's channel: the
+        same payloads the /debug HTTP endpoints serve (cycle records,
+        Perfetto trace, per-pod timeline), JSON-encoded."""
+        import json
+
+        fr = self.scheduler.flight
+        kind = request.kind or "flightrecorder"
+        last = request.last if request.last > 0 else 128
+        # kind="pod" stays available with the recorder disabled — the
+        # timeline join degrades to the events-ring half, exactly like
+        # the /debug/pods HTTP endpoint
+        if fr is None and kind in ("flightrecorder", "trace"):
+            return pb.InspectResponse(
+                ok=False, error="flight recorder disabled "
+                "(flightRecorderSize: 0)",
+            )
+        if kind == "flightrecorder":
+            payload = {
+                "cycles": fr.to_dicts(last=last),
+                "derived": fr.derived(last=last),
+            }
+        elif kind == "trace":
+            from ..core.flight_recorder import to_chrome_trace
+
+            payload = to_chrome_trace(
+                fr.snapshot(last=last), epoch=fr.epoch
+            )
+        elif kind == "pod":
+            payload = self.scheduler.pod_timeline(request.pod_uid)
+            if payload is None:
+                return pb.InspectResponse(
+                    ok=False,
+                    error=f"pod {request.pod_uid!r} not seen",
+                )
+        else:
+            return pb.InspectResponse(
+                ok=False,
+                error=f"unknown kind {kind!r} "
+                "(flightrecorder | trace | pod)",
+            )
+        return pb.InspectResponse(
+            ok=True, json=json.dumps(payload).encode()
+        )
+
 
 _RPCS = {
     "Update": (pb.UpdateRequest, pb.UpdateResponse),
     "Cycle": (pb.CycleRequest, pb.CycleResponse),
     "Health": (pb.HealthRequest, pb.HealthResponse),
     "Metrics": (pb.MetricsRequest, pb.MetricsResponse),
+    "Inspect": (pb.InspectRequest, pb.InspectResponse),
 }
 
 

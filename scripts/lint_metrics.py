@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Metric-inventory drift check.
+
+Every metric registered by `SchedulerMetrics` (metrics/metrics.py) must
+be listed in BOTH documentation surfaces:
+
+- the `metrics/metrics.py` module docstring (the in-code inventory), and
+- the README "Observability" metric table;
+
+and neither surface may name a metric that is no longer registered.
+Dashboards are built from the docs — silent drift in either direction is
+exactly the kind of rot this repo's PARITY/measurement-honesty rules
+exist to prevent.
+
+Runs standalone (exit 1 + a diff on drift):
+
+    JAX_PLATFORMS=cpu python scripts/lint_metrics.py
+
+and as a tier-1-adjacent test (tests/test_metrics.py imports
+`check_inventory`). Counter families are normalized to their exposition
+names (`*_total`); histogram/summary families are listed by their base
+name (the `_bucket`/`_count`/`_sum` series are implied).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_NAME_RE = re.compile(r"\bscheduler_[a-z0-9_]+\b")
+
+
+def registered_names() -> set[str]:
+    """Metric families registered on a fresh SchedulerMetrics, in
+    Prometheus exposition naming (counters get their _total suffix)."""
+    from k8s_scheduler_tpu.metrics import SchedulerMetrics
+
+    names: set[str] = set()
+    for fam in SchedulerMetrics().registry.collect():
+        name = fam.name
+        if fam.type == "counter":
+            name += "_total"
+        names.add(name)
+    return names
+
+
+def _strip_series_suffixes(names: set[str], families: set[str]) -> set[str]:
+    """Collapse `foo_bucket`/`foo_count`/`foo_sum`/`foo_created` doc
+    mentions onto their family name so prose quoting a specific series
+    does not count as a phantom metric."""
+    out = set()
+    for n in names:
+        base = re.sub(r"_(bucket|count|sum|created)$", "", n)
+        out.add(base if base in families and n not in families else n)
+    return out
+
+
+def docstring_names() -> set[str]:
+    import k8s_scheduler_tpu.metrics.metrics as mod
+
+    return set(_NAME_RE.findall(mod.__doc__ or ""))
+
+
+def readme_names() -> set[str]:
+    path = os.path.join(REPO, "README.md")
+    with open(path) as f:
+        text = f.read()
+    m = re.search(r"^## Observability\b(.*?)(?=^## |\Z)", text,
+                  re.M | re.S)
+    if m is None:
+        return set()
+    return set(_NAME_RE.findall(m.group(1)))
+
+
+def check_inventory() -> list[str]:
+    """Returns a list of human-readable drift complaints (empty = ok)."""
+    reg = registered_names()
+    problems: list[str] = []
+    for surface, found in (
+        ("metrics/metrics.py docstring", docstring_names()),
+        ('README "## Observability" section', readme_names()),
+    ):
+        found = _strip_series_suffixes(found, reg)
+        missing = sorted(reg - found)
+        phantom = sorted(found - reg)
+        if not found:
+            problems.append(f"{surface}: no metric names found at all")
+        if missing:
+            problems.append(
+                f"{surface}: registered but undocumented: {missing}"
+            )
+        if phantom:
+            problems.append(
+                f"{surface}: documented but not registered: {phantom}"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = check_inventory()
+    if problems:
+        for p in problems:
+            print(f"lint_metrics: {p}", file=sys.stderr)
+        return 1
+    print(f"lint_metrics: ok ({len(registered_names())} metric families "
+          "documented in both surfaces)")
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

@@ -44,6 +44,130 @@ def test_http_endpoints_serve_health_and_metrics():
         server.shutdown()
 
 
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _request(url, method):
+    req = urllib.request.Request(url, method=method)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_http_head_answered_and_mutations_405():
+    """Probes commonly use HEAD (the stdlib handler would 501); any
+    mutating verb on the read-only surface gets 405 + Allow."""
+    m = SchedulerMetrics()
+    server = start_http_server(m, port=0, healthz=lambda: (True, {}))
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        for path in ("/healthz", "/metrics"):
+            gs, gh, gbody = _request(f"{base}{path}", "GET")
+            hs, hh, hbody = _request(f"{base}{path}", "HEAD")
+            assert (gs, hs) == (200, 200)
+            assert hbody == b""  # HEAD: headers only
+            # HEAD advertises the same payload size GET serves
+            assert hh["Content-Length"] == str(len(gbody))
+        hs, _, _ = _request(f"{base}/nope", "HEAD")
+        assert hs == 404
+        for method in ("POST", "PUT", "DELETE", "PATCH"):
+            st, headers, _ = _request(f"{base}/metrics", method)
+            assert st == 405, method
+            assert headers["Allow"] == "GET, HEAD"
+    finally:
+        server.shutdown()
+
+
+def test_debug_endpoints_serve_flightrecorder_trace_and_pods():
+    from k8s_scheduler_tpu.core.flight_recorder import FlightRecorder
+
+    fr = FlightRecorder(capacity=16)
+    for i in range(4):
+        rec = fr.start()
+        rec.mark("dispatch_start", rec.t_start + 0.001)
+        rec.mark("decision_end", rec.t_start + 0.004)
+        rec.phases["encode_ms"] = 1.0
+        rec.counts["pods"] = 3 + i
+        fr.commit(rec)
+    fr.pod_event("uid-1", "pod-1", "Queued")
+    fr.pod_event("uid-1", "pod-1", "Bound", cycle=3, node="n1")
+    timelines = {
+        "uid-1": {"uid": "uid-1", "name": "pod-1", "state": "Bound"}
+    }
+    server = start_http_server(
+        SchedulerMetrics(), port=0, recorder=fr,
+        pod_timeline=timelines.get,
+    )
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        st, _, body = _get(f"{base}/debug/flightrecorder?last=2")
+        payload = json.loads(body)
+        assert st == 200
+        assert [c["seq"] for c in payload["cycles"]] == [2, 3]
+        assert payload["derived"]["cycles"] == 4.0
+        st, headers, body = _get(f"{base}/debug/trace?last=4")
+        assert st == 200
+        assert "attachment" in headers["Content-Disposition"]
+        trace = json.loads(body)
+        assert any(
+            e["ph"] == "X" and e["name"].startswith("device cycle")
+            for e in trace["traceEvents"]
+        )
+        st, _, body = _get(f"{base}/debug/pods/uid-1")
+        assert st == 200 and json.loads(body)["state"] == "Bound"
+        st, _, _ = _request(f"{base}/debug/pods/ghost", "GET")
+        assert st == 404
+        # malformed ?last falls back instead of erroring
+        st, _, _ = _get(f"{base}/debug/flightrecorder?last=banana")
+        assert st == 200
+    finally:
+        server.shutdown()
+
+
+def test_healthz_staleness_503_when_cycles_stop():
+    from k8s_scheduler_tpu.cmd.httpserver import staleness_healthz
+    from k8s_scheduler_tpu.core.flight_recorder import FlightRecorder
+
+    t = {"now": 0.0}
+    fr = FlightRecorder(capacity=4, now=lambda: t["now"])
+    health = staleness_healthz(lambda: {"bootId": "b"}, fr, 5.0)
+    server = start_http_server(SchedulerMetrics(), port=0, healthz=health)
+    port = server.server_address[1]
+    url = f"http://127.0.0.1:{port}/healthz"
+    try:
+        # no cycle ever completed: fresh process is healthy...
+        t["now"] = 1.0
+        st, _, body = _request(url, "GET")
+        assert st == 200 and json.loads(body)["last_cycle_age_s"] == 1.0
+        # ...but ages into 503 if the first cycle never lands (wedged)
+        t["now"] = 6.0
+        st, _, body = _request(url, "GET")
+        assert st == 503
+        assert "no cycle completed" in json.loads(body)["reason"]
+        # a completed cycle resets the age
+        rec = fr.start()
+        rec.t_end = t["now"]
+        fr.commit(rec)
+        st, _, body = _request(url, "GET")
+        assert st == 200 and json.loads(body)["cycles"] == 1
+        # and stopping again goes stale again
+        t["now"] = 20.0
+        st, _, _ = _request(url, "GET")
+        assert st == 503
+        # deadline 0 = never stale (the config default)
+        never = staleness_healthz(None, fr, 0.0)
+        ok, detail = never()
+        assert ok and detail["last_cycle_age_s"] == 14.0
+    finally:
+        server.shutdown()
+
+
 def _hold_lease(path, hold_seconds, acquired):
     lease = FileLease(path, identity="other")
     assert lease.try_acquire()
