@@ -11,7 +11,10 @@ paths. On top of them, the cycle flight recorder
   reconstructing the pipeline's overlapped lanes from real serving
   timestamps (open in ui.perfetto.dev);
 - `/debug/pods/<uid>` — the per-pod scheduling timeline
-  (queued -> attempts -> bound/evicted, joined with the events ring).
+  (queued -> attempts -> bound/evicted, joined with the events ring);
+- `/debug/state` — durable-state health (journal lag/segments, fsync
+  latency, last snapshot and last restore stats) when `--state-dir`
+  is configured.
 
 Served with the stdlib http.server on a daemon thread — the payloads are
 small and low-rate (scrapes + probes + on-demand debugging), no
@@ -75,12 +78,15 @@ def start_http_server(
     healthz: Callable[[], tuple[bool, dict]] | None = None,
     recorder=None,  # core/flight_recorder.FlightRecorder | None
     pod_timeline: Callable[[str], dict | None] | None = None,
+    state=None,  # state.DurableState | None
 ) -> ThreadingHTTPServer:
     """Serve /healthz, /readyz, /metrics and the /debug endpoints;
     returns the running server (bound port at `.server_address[1]`;
     pass port=0 for ephemeral). `recorder` enables /debug/flightrecorder
     and /debug/trace; `pod_timeline` (usually Scheduler.pod_timeline)
-    enables /debug/pods/<uid>."""
+    enables /debug/pods/<uid>; `state` (DurableState) enables
+    /debug/state (journal lag, segment counts, snapshot + restore
+    stats)."""
     health_fn = healthz or (lambda: (True, {}))
 
     class Handler(BaseHTTPRequestHandler):
@@ -127,6 +133,13 @@ def start_http_server(
                         "Content-Disposition":
                         'attachment; filename="scheduler-trace.json"'
                     },
+                )
+            if path == "/debug/state" and state is not None:
+                return (
+                    200,
+                    "application/json",
+                    json.dumps(state.status()).encode(),
+                    {},
                 )
             if path.startswith("/debug/pods/") and pod_timeline is not None:
                 uid = urllib.parse.unquote(

@@ -134,6 +134,29 @@ class FileLease:
     def is_leader(self) -> bool:
         return self._fd is not None
 
+    def lease_age_seconds(self) -> float:
+        """Seconds since the advisory heartbeat was last renewed (0.0
+        when no heartbeat is readable). For the holder this tracks its
+        own renew cadence; for a standby it grows past
+        leaseDurationSeconds when the active stops renewing — the
+        failover signal the scheduler_leader_lease_age_seconds gauge
+        exports."""
+        info = self.holder()
+        if not info or "renewTime" not in info:
+            return 0.0
+        return max(0.0, _time.time() - float(info["renewTime"]))
+
+    def describe(self) -> dict:
+        """Lease identity/age view for /healthz and dashboards."""
+        info = self.holder() or {}
+        return {
+            "leader": self.is_leader(),
+            "holder": info.get("holderIdentity", ""),
+            "age_s": round(self.lease_age_seconds(), 3),
+            "lease_duration_s": info.get("leaseDurationSeconds"),
+            "path": self.path,
+        }
+
     def release(self) -> None:
         self._stop.set()
         if self._renewer is not None:

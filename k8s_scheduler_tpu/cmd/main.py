@@ -87,6 +87,19 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         help="pre-size the sticky per-pod topology-spread-constraint pad "
         "(MC) the same way (overrides config padMc; 0 = keep config)",
     )
+    ap.add_argument(
+        "--state-dir", default="",
+        help="durable scheduler state: write-ahead journal + snapshots "
+        "of the queue/cache live here (config stateDir). A process "
+        "starting against a non-empty dir — e.g. a standby that just "
+        "won the lease — restores the exact pre-crash state before its "
+        "first cycle. Empty = durability disabled",
+    )
+    ap.add_argument(
+        "--snapshot-interval", type=float, default=-1.0,
+        help="seconds between journal-compacting snapshots (config "
+        "snapshotInterval; 0 = journal only, -1 = keep config)",
+    )
     return ap
 
 
@@ -105,6 +118,10 @@ def main(argv: list[str] | None = None) -> int:
         config.flight_recorder_size = args.flight_record_n
     if args.health_max_cycle_age >= 0:
         config.health_max_cycle_age_seconds = args.health_max_cycle_age
+    if args.state_dir:
+        config.state_dir = args.state_dir
+    if args.snapshot_interval >= 0:
+        config.snapshot_interval_seconds = args.snapshot_interval
     if (
         config.health_max_cycle_age_seconds > 0
         and config.flight_recorder_size <= 0
@@ -149,13 +166,49 @@ def main(argv: list[str] | None = None) -> int:
     # registry by default — only the CLI opts into the global one.
     from ..metrics.metrics import global_metrics
 
+    gm = global_metrics()
+
+    # leader gauges evaluate at scrape so a failover is visible the
+    # moment it happens, not at the next heartbeat write
+    gm.leader_state.set_function(
+        lambda: 1.0 if (lease.is_leader() if lease else True) else 0.0
+    )
+    gm.leader_lease_age.set_function(
+        lambda: lease.lease_age_seconds() if lease else 0.0
+    )
+
+    # durable state: created AFTER the lease is won — a standby must not
+    # touch (or journal into) the shared state dir while the active owns
+    # it. Scheduler.__init__ restores snapshot+tail before its first
+    # cycle, so a takeover resumes with the dead active's exact queue/
+    # cache state instead of an empty rebuild.
+    state = None
+    if config.state_dir:
+        from ..state import DurableState
+
+        state = DurableState(
+            config.state_dir,
+            snapshot_interval_seconds=config.snapshot_interval_seconds,
+            metrics=gm,
+        )
+
     server, service, port = serve(
         args.address,
         config=config,
         profile_every=args.profile_every,
-        metrics=global_metrics(),
+        metrics=gm,
+        state=state,
     )
     print(f"scheduler shim listening on port {port}", flush=True)
+    if state is not None:
+        r = state.last_restore
+        print(
+            "durable state: restored "
+            f"snapshot={r.get('snapshot')} "
+            f"replayed={r.get('records_replayed')} records "
+            f"pending={r.get('pending')} cache={r.get('cache')}",
+            flush=True,
+        )
 
     # health is no longer a static closure: staleness comes from the
     # flight recorder, so a scheduler that stopped completing cycles
@@ -168,6 +221,9 @@ def main(argv: list[str] | None = None) -> int:
         lambda: {
             "bootId": service.boot_id,
             "leader": lease.is_leader() if lease else True,
+            # lease identity + heartbeat age so probes/dashboards see
+            # WHO leads and how fresh the lease is, not just a boolean
+            **({"lease": lease.describe()} if lease else {}),
             "pending": service.scheduler.queue.pending_counts(),
         },
         recorder,
@@ -183,6 +239,7 @@ def main(argv: list[str] | None = None) -> int:
             healthz=healthz,
             recorder=recorder,
             pod_timeline=service.scheduler.pod_timeline,
+            state=state,
         )
         print(
             "serving /healthz /metrics on port "
@@ -203,6 +260,22 @@ def main(argv: list[str] | None = None) -> int:
         server.stop(grace=2.0)
         if http_server is not None:
             http_server.shutdown()
+        if state is not None:
+            # seal the journal: a final clean-shutdown snapshot (same
+            # pattern as the --trace-dir dump below) so the next start
+            # — or the standby about to win the lease — restores from
+            # one file with an empty tail. Guarded: a failing seal
+            # (disk full) must not abort the rest of shutdown — the
+            # journal tail already written is the fallback.
+            try:
+                state.seal()
+                print(
+                    "durable state sealed: "
+                    f"{state.last_snapshot.get('path')}",
+                    flush=True,
+                )
+            except Exception as e:
+                print(f"durable state seal FAILED: {e}", flush=True)
         if args.trace_dir and recorder is not None:
             # post-mortem trace: the full ring as one Perfetto-loadable
             # file (same payload as /debug/trace, taken at shutdown)

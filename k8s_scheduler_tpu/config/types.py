@@ -127,6 +127,14 @@ class SchedulerConfiguration:
     # flight recorder's last-cycle age, so a wedged scheduler stops
     # reporting healthy (cmd/main.py).
     health_max_cycle_age_seconds: float = 0.0
+    # durable scheduler state (state/ package): directory for the
+    # write-ahead journal + snapshots. "" disables durability — a
+    # takeover then rebuilds only what informer events re-deliver,
+    # losing backoff deadlines, attempt counts, and assumed pods.
+    state_dir: str = ""
+    # snapshot cadence: how often the journal is compacted into a full
+    # snapshot (seconds; 0 = journal only, never compact)
+    snapshot_interval_seconds: float = 60.0
 
     def profile(self, scheduler_name: str = "default-scheduler") -> Profile:
         for p in self.profiles:
@@ -250,6 +258,10 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         flight_recorder_size=int(data.get("flightRecorderSize", 512)),
         health_max_cycle_age_seconds=_duration_seconds(
             data.get("healthMaxCycleAge", 0.0)
+        ),
+        state_dir=str(data.get("stateDir", "")),
+        snapshot_interval_seconds=_duration_seconds(
+            data.get("snapshotInterval", 60.0)
         ),
         extenders=[
             Extender(

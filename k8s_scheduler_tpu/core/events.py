@@ -26,6 +26,9 @@ log = logging.getLogger("k8s_scheduler_tpu.events")
 SCHEDULED = "Scheduled"
 FAILED_SCHEDULING = "FailedScheduling"
 PREEMPTED = "Preempted"
+# batched-cycle addition: the assumed-pod TTL sweep used to drop pods
+# silently — this reason makes the expiry explainable per pod
+ASSUME_EXPIRED = "AssumeExpired"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,6 +85,13 @@ class EventRecorder:
         self.record(
             "Normal", PREEMPTED, victim,
             f"Preempted by pod {preemptor_name}",
+        )
+
+    def assume_expired(self, pod: Pod, node_name: str) -> None:
+        self.record(
+            "Warning", ASSUME_EXPIRED, pod,
+            f"assumed binding to {node_name} expired without bind "
+            "confirmation; pod requeued with backoff",
         )
 
     def events(self) -> list[Event]:

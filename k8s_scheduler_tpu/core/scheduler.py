@@ -99,6 +99,10 @@ class Scheduler:
         # sequential execution — the tests/measurement escape hatch)
         flight_recorder: FlightRecorder | None = None,  # None = build
         # from config.flight_recorder_size (0 disables recording)
+        state: "object | None" = None,  # state.DurableState | None:
+        # durable queue/cache journal + snapshots; attach() below
+        # restores any existing state BEFORE the first cycle (the
+        # standby-takeover path) and starts journaling mutations
     ) -> None:
         self.config = config or SchedulerConfiguration()
         # one Framework per profile (SURVEY.md §2 C12 / §5.6: multiple
@@ -132,6 +136,19 @@ class Scheduler:
         )
         self.binder = binder or (lambda pod, node: None)
         self.evictor = evictor or (lambda pod, node: None)
+        # durable state (state/ package): restore-then-journal. Attach
+        # happens here — after queue/cache exist, before any cycle — so
+        # a standby that just won the FileLease resumes with the exact
+        # backoff deadlines / attempt counts / assumed pods the dead
+        # active had journaled.
+        self.state = state
+        if state is not None:
+            state.attach(self.queue, self.cache)
+            # pods that were mid-cycle when the previous leader died
+            # have no outcome records — requeue them (journaled), or the
+            # first pop_ready would drop them with no informer to
+            # re-deliver
+            self.queue.recover_in_flight()
         self.events = events or EventRecorder()
         # cycle flight recorder: per-cycle phase marks + pod timelines
         # (core/flight_recorder.py); None when disabled by config
@@ -434,8 +451,17 @@ class Scheduler:
         stats = CycleStats()
         self.last_nominations = []
         self.last_evictions = []
-        for pod in self.cache.cleanup_expired():
+        for pod, node in self.cache.cleanup_expired():
+            # TTL expiry used to drop the pod without a trace
+            # (/debug/pods showed an assumed pod simply vanishing):
+            # leave an events-ring entry + an `Expired` timeline attempt
+            # explaining the requeue before backoff takes it
             self.queue.requeue_backoff(pod, event="AssumeExpired")
+            self.events.assume_expired(pod, node)
+            if self.flight is not None:
+                self.flight.pod_event(
+                    pod.uid, pod.name, "Expired", node=node
+                )
         self.queue.flush_unschedulable_timeout()
 
         pending_all = self.queue.pop_ready()
@@ -443,6 +469,8 @@ class Scheduler:
             # gauges must track deletions/moves that happen between
             # non-empty cycles, so update them on the empty path too
             self._update_gauges()
+            if self.state is not None:
+                self.state.maybe_snapshot()
             return stats
         stats.attempted = len(pending_all)
         self.metrics.cycle_pods.observe(len(pending_all))
@@ -480,6 +508,11 @@ class Scheduler:
             stats.cycle_seconds
         )
         self._update_gauges()
+        if self.state is not None:
+            # interval-gated journal compaction, deliberately AFTER
+            # cycle_seconds is stamped: snapshots ride between cycles,
+            # never inside the per-profile bind path
+            self.state.maybe_snapshot()
         return stats
 
     def _schedule_profile(
@@ -960,7 +993,7 @@ class Scheduler:
         # cycle attempts in order: every outcome note carries its cycle
         # seq, which joins back to /debug/flightrecorder records
         attempt_kinds = {
-            "Bound", "Unschedulable", "BindError", "Rejected",
+            "Bound", "Unschedulable", "BindError", "Rejected", "Expired",
         }
         out["attempts"] = [
             {

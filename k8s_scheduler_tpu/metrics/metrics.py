@@ -50,6 +50,29 @@ overlap story needs no probe runs:
 - scheduler_last_cycle_age_seconds — seconds since the last completed
   cycle record (the /healthz staleness signal)
 
+Durable-state families (state/ package — write-ahead journal, snapshots,
+restore) and leader election:
+
+- scheduler_journal_appends_total{op} — journal records appended, by
+  logical operation (q.add, q.pop, c.assume, ...)
+- scheduler_journal_bytes_total — encoded journal bytes written to disk
+- scheduler_journal_fsync_seconds — group-commit fsync latency (one
+  fsync per drained batch, writer thread only — never the bind path)
+- scheduler_journal_buffer_depth — records appended but not yet durable
+  (the journal lag; grows if the disk can't keep up)
+- scheduler_journal_segments — journal segment files on disk
+- scheduler_snapshot_writes_total — snapshot compactions written
+- scheduler_snapshot_duration_seconds — dump+write+prune latency
+- scheduler_snapshot_last_bytes — size of the newest snapshot
+- scheduler_snapshot_last_restore_records — journal records replayed by
+  the most recent restore (0 after a clean-shutdown takeover)
+- scheduler_snapshot_last_restore_seconds — how long that restore took
+- scheduler_leader_state — 1 = this process holds the leader lease
+  (or runs without election), 0 = standby (evaluated at scrape)
+- scheduler_leader_lease_age_seconds — age of the lease heartbeat as
+  this process observes it (standbys watch this to detect a dead
+  active; dashboards see failovers)
+
 Each `SchedulerMetrics` owns its own `CollectorRegistry`;
 `global_metrics()` returns the process-wide default instance, which is
 also what a Scheduler constructed without an explicit `metrics=` serves
@@ -222,6 +245,75 @@ class SchedulerMetrics:
             "scheduler_last_cycle_age_seconds",
             "Seconds since the last completed scheduling cycle record "
             "(the /healthz staleness signal).",
+            registry=r,
+        )
+        # ---- durable state (state/: journal + snapshots + restore) ----
+        self.journal_appends = Counter(
+            "scheduler_journal_appends_total",
+            "Write-ahead-journal records appended, by logical op.",
+            ["op"],
+            registry=r,
+        )
+        self.journal_bytes = Counter(
+            "scheduler_journal_bytes_total",
+            "Encoded journal bytes written to segment files.",
+            registry=r,
+        )
+        self.journal_fsync = Histogram(
+            "scheduler_journal_fsync_seconds",
+            "Group-commit fsync latency (one fsync per drained batch, "
+            "issued only by the journal writer thread).",
+            buckets=_DURATION_BUCKETS,
+            registry=r,
+        )
+        self.journal_buffer = Gauge(
+            "scheduler_journal_buffer_depth",
+            "Journal records appended but not yet durable (journal lag).",
+            registry=r,
+        )
+        self.journal_segments = Gauge(
+            "scheduler_journal_segments",
+            "Journal segment files currently on disk.",
+            registry=r,
+        )
+        self.snapshot_writes = Counter(
+            "scheduler_snapshot_writes_total",
+            "Snapshot compactions written durably.",
+            registry=r,
+        )
+        self.snapshot_duration = Histogram(
+            "scheduler_snapshot_duration_seconds",
+            "Snapshot dump+write+prune latency.",
+            buckets=_DURATION_BUCKETS,
+            registry=r,
+        )
+        self.snapshot_bytes = Gauge(
+            "scheduler_snapshot_last_bytes",
+            "Size of the newest durable snapshot.",
+            registry=r,
+        )
+        self.restore_records = Gauge(
+            "scheduler_snapshot_last_restore_records",
+            "Journal records replayed by the most recent restore "
+            "(0 after a clean-shutdown takeover).",
+            registry=r,
+        )
+        self.restore_duration = Gauge(
+            "scheduler_snapshot_last_restore_seconds",
+            "Duration of the most recent snapshot+tail restore.",
+            registry=r,
+        )
+        # ---- leader election (cmd/leaderelection.py FileLease) ----
+        self.leader_state = Gauge(
+            "scheduler_leader_state",
+            "1 = this process holds the leader lease (or runs without "
+            "election), 0 = standby. Evaluated at scrape time.",
+            registry=r,
+        )
+        self.leader_lease_age = Gauge(
+            "scheduler_leader_lease_age_seconds",
+            "Age of the lease heartbeat as observed by this process "
+            "(grows past leaseDuration when the active is dead).",
             registry=r,
         )
         self.program_retry_strikes = Counter(

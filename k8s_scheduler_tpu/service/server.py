@@ -52,13 +52,14 @@ class SchedulerService:
                  scheduler: Scheduler | None = None,
                  profile_every: int = 0,
                  metrics: SchedulerMetrics | None = None,
-                 forced_sync: bool | None = None) -> None:
+                 forced_sync: bool | None = None,
+                 state=None) -> None:
         # the injectable binder collects into the in-progress response;
         # one cycle at a time (serialized by _cycle_lock)
         self._bindings: list[pb.Binding] = []
         self.scheduler = scheduler or Scheduler(
             config=config, binder=self._collect_binding, metrics=metrics,
-            forced_sync=forced_sync,
+            forced_sync=forced_sync, state=state,
         )
         if scheduler is not None:
             scheduler.binder = self._collect_binding
@@ -266,11 +267,12 @@ def serve(
     profile_every: int = 0,
     metrics: SchedulerMetrics | None = None,
     forced_sync: bool | None = None,
+    state=None,  # state.DurableState | None (restore-then-journal)
 ) -> tuple[grpc.Server, SchedulerService, int]:
     """Start the shim; returns (server, servicer, bound_port)."""
     service = SchedulerService(
         config=config, profile_every=profile_every, metrics=metrics,
-        forced_sync=forced_sync,
+        forced_sync=forced_sync, state=state,
     )
     # no SO_REUSEPORT: a second shim on the same address must fail loudly,
     # not silently split the accept queue with the first

@@ -33,6 +33,26 @@ sys.path.insert(0, REPO)
 
 _NAME_RE = re.compile(r"\bscheduler_[a-z0-9_]+\b")
 
+# Families that MUST exist: the durable-state (journal/snapshot) and
+# leader-election surfaces are operational contracts — dashboards and
+# the failover runbook depend on them, so their silent removal from the
+# registry is a lint failure even though the two-way doc check above
+# would only notice if the docs were cleaned up in the same commit.
+REQUIRED_FAMILIES = {
+    "scheduler_journal_appends_total",
+    "scheduler_journal_bytes_total",
+    "scheduler_journal_fsync_seconds",
+    "scheduler_journal_buffer_depth",
+    "scheduler_journal_segments",
+    "scheduler_snapshot_writes_total",
+    "scheduler_snapshot_duration_seconds",
+    "scheduler_snapshot_last_bytes",
+    "scheduler_snapshot_last_restore_records",
+    "scheduler_snapshot_last_restore_seconds",
+    "scheduler_leader_state",
+    "scheduler_leader_lease_age_seconds",
+}
+
 
 def registered_names() -> set[str]:
     """Metric families registered on a fresh SchedulerMetrics, in
@@ -80,6 +100,12 @@ def check_inventory() -> list[str]:
     """Returns a list of human-readable drift complaints (empty = ok)."""
     reg = registered_names()
     problems: list[str] = []
+    gone = sorted(REQUIRED_FAMILIES - reg)
+    if gone:
+        problems.append(
+            "required durable-state/leader metric families no longer "
+            f"registered: {gone}"
+        )
     for surface, found in (
         ("metrics/metrics.py docstring", docstring_names()),
         ('README "## Observability" section', readme_names()),

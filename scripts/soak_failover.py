@@ -1,0 +1,382 @@
+#!/usr/bin/env python
+"""Failover soak: kill the active at random points, assert the standby
+resumes losslessly.
+
+Each round spawns a CHILD process that plays "active scheduler": it
+attaches `DurableState` to a fresh queue/cache pair (restoring whatever
+the previous round left in the shared state dir), then applies a seeded
+random mutation stream — pod adds, cycle pops, assume/finish/confirm,
+requeues, deletes, node churn, TTL sweeps — journaling every op. After
+EVERY op the child appends a line `<op_index> <digest>` to a digest log
+(its own fsync'd side file), so the parent knows the canonical state
+digest at every op boundary; every FLUSH_EVERY ops it calls
+`journal.flush()` and records the durability watermark.
+
+The PARENT kills the child with SIGKILL at a random moment, then plays
+"standby that just won the lease": restore into fresh queue/cache and
+assert
+
+1. restore never raises (torn final record handling),
+2. the restored digest appears in the child's digest log — i.e. the
+   survived journal prefix reproduces EXACTLY the state the active had
+   at some op boundary: nothing lost, nothing duplicated, nothing
+   half-applied,
+3. that boundary is >= the child's last flushed watermark: everything
+   the active was TOLD was durable survived the kill.
+
+(2) is strictly stronger than "no lost or duplicated pods" — the digest
+covers tier membership, attempt counts, backoff expiries, in-flight
+sets, and assumed-pod deadlines bit-for-bit.
+
+Standalone:
+
+    JAX_PLATFORMS=cpu python scripts/soak_failover.py --rounds 10
+
+A smoke-tier subset runs as tests/test_state_failover.py::
+test_soak_failover_smoke (marked slow).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FLUSH_EVERY = 16
+
+
+class Clock:
+    """Monotonic-anchored controllable clock: real monotonic plus a
+    skew the driver advances, so backoff expiries both order correctly
+    and actually expire during the soak."""
+
+    def __init__(self) -> None:
+        self.skew = 0.0
+
+    def advance(self, dt: float) -> None:
+        self.skew += dt
+
+    def __call__(self) -> float:
+        return time.monotonic() + self.skew
+
+
+def make_pair(clock):
+    from k8s_scheduler_tpu.internal.cache import SchedulerCache
+    from k8s_scheduler_tpu.internal.queue import SchedulingQueue
+
+    q = SchedulingQueue(
+        initial_backoff_seconds=0.05, max_backoff_seconds=0.4,
+        unschedulable_timeout_seconds=2.0, now=clock,
+    )
+    c = SchedulerCache(assumed_pod_ttl_seconds=0.3, now=clock)
+    return q, c
+
+
+def apply_random_op(rng: random.Random, clock, q, c, i: int) -> None:
+    """One step of the scheduler-shaped mutation stream. Mirrors what
+    the real driver does to the queue/cache around a cycle: intake,
+    pop, assume/finish/confirm/forget, requeue tiers, deletes, node
+    churn, sweeps."""
+    from k8s_scheduler_tpu.models import MakeNode, MakePod
+
+    clock.advance(rng.random() * 0.05)
+    roll = rng.randrange(12)
+    if roll <= 2:  # intake (weighted: arrivals dominate)
+        # deterministic names: the delete/update arms below must be able
+        # to hit REAL uids, or those replay paths go untested (a re-add
+        # of a restored round's uid is just an informer re-add)
+        pod = MakePod(f"p{rng.randrange(max(2 * i, 1))}").req(
+            {"cpu": str(1 + rng.randrange(4))}
+        ).obj()
+        if rng.random() < 0.2:
+            pod.spec.priority = rng.randrange(10)
+        q.add(pod)
+    elif roll == 3:
+        c.add_node(
+            MakeNode(f"n{rng.randrange(8)}").capacity({"cpu": "64"}).obj()
+        )
+    elif roll == 4:  # a scheduling cycle: pop + split outcomes
+        pods = q.pop_ready()
+        for j, p in enumerate(pods):
+            k = rng.randrange(4)
+            if k == 0:
+                try:
+                    c.assume(p, f"n{rng.randrange(8)}")
+                except ValueError:
+                    continue
+                c.finish_binding(p.uid)
+                if rng.random() < 0.5:
+                    c.confirm(p.uid)
+            elif k == 1:
+                q.requeue_backoff(p)
+            elif k == 2:
+                q.requeue_unschedulable(
+                    p, reasons=rng.choice(
+                        [("NodeResourcesFit",), ("NodeAffinity",), ()]
+                    ),
+                )
+            # k == 3: dropped on the floor (stays only in-flight)
+    elif roll == 5:
+        q.flush_backoff()
+    elif roll == 6:
+        q.move_all_to_active_or_backoff(
+            rng.choice(["NodeAdd", "PodDelete", "NodeUpdate"])
+        )
+    elif roll == 7:
+        q.flush_unschedulable_timeout()
+    elif roll == 8:
+        for p, n in c.cleanup_expired():
+            q.requeue_backoff(p, event="AssumeExpired")
+    elif roll == 9:
+        uid = f"default/p{rng.randrange(max(2 * i, 1))}"
+        q.delete(uid)
+        if rng.random() < 0.5:
+            c.remove_pod(uid)
+    elif roll == 10:
+        c.remove_node(f"n{rng.randrange(8)}")
+    else:
+        # spec update hitting a REAL uid lands in whatever tier (or the
+        # in-flight set) the pod currently occupies; a miss exercises
+        # the fresh-add fallback
+        q.update(
+            MakePod(f"p{rng.randrange(max(2 * i, 1))}").req(
+                {"cpu": "2"}
+            ).obj()
+        )
+
+
+# ---------------------------------------------------------------------------
+# child: the active
+# ---------------------------------------------------------------------------
+
+
+# every public mutator of each object — the wrapped set must cover
+# everything apply_random_op touches, and none of these call each other
+# (internal helpers are underscore-named and unwrapped)
+_Q_MUTATORS = (
+    "add", "update", "delete", "pop_ready", "requeue_unschedulable",
+    "requeue_backoff", "flush_backoff", "flush_unschedulable_timeout",
+    "move_all_to_active_or_backoff", "recover_in_flight",
+)
+_C_MUTATORS = (
+    "add_node", "update_node", "remove_node", "add_pod", "remove_pod",
+    "assume", "finish_binding", "confirm", "forget", "cleanup_expired",
+)
+
+
+def run_child(state_dir: str, seed: int, ops: int, digest_log: str,
+              hold: bool) -> int:
+    from k8s_scheduler_tpu.state import DurableState, state_digest
+
+    clock = Clock()
+    q, c = make_pair(clock)
+    st = DurableState(state_dir, snapshot_interval_seconds=0)
+    st.attach(q, c)
+    # test-only determinism knob: drain the journal ONLY at flush()
+    # barriers (flush notifies past the poll), so no record can become
+    # durable before its digest line below is already fsync'd — every
+    # restorable boundary is guaranteed to be logged
+    st.journal._poll_s = 60.0
+    rng = random.Random(seed)
+    f = open(digest_log, "a")
+
+    def log_line(kind: str, idx: int, dig: str) -> None:
+        f.write(f"{kind} {idx} {dig}\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+    # digest after EVERY public mutation, not every apply_random_op
+    # step: one mutation == at most one journal record, so a SIGKILL
+    # landing mid-step (after the pop persisted, before the assumes)
+    # still restores onto a logged boundary — the invariant is
+    # record-granular, matching what the journal can actually lose
+    counter = {"i": 0}
+
+    def _wrap(obj, name):
+        orig = getattr(obj, name)
+
+        def wrapped(*a, **k):
+            r = orig(*a, **k)
+            counter["i"] += 1
+            log_line("op", counter["i"], state_digest(q, c))
+            return r
+
+        setattr(obj, name, wrapped)
+
+    for name in _Q_MUTATORS:
+        _wrap(q, name)
+    for name in _C_MUTATORS:
+        _wrap(c, name)
+
+    log_line("start", 0, state_digest(q, c))
+    # the takeover step a real standby performs (Scheduler ctor):
+    # requeue pods the dead leader had in flight — wrapped above, so
+    # the post-recovery state is a logged (and journaled) boundary
+    q.recover_in_flight()
+    for i in range(1, ops + 1):
+        apply_random_op(rng, clock, q, c, i)
+        if i % FLUSH_EVERY == 0:
+            st.journal.flush()
+            log_line("flushed", counter["i"], state_digest(q, c))
+        # occasional snapshot compaction mid-stream (exercises the
+        # cut/prune path under kills)
+        if i % 97 == 0:
+            st.snapshot()
+    st.journal.flush()
+    log_line("done", counter["i"], state_digest(q, c))
+    if hold:
+        # fast-test mode: quiesce so the parent's SIGKILL lands at a
+        # known boundary ("died mid-cycle while idle")
+        while True:
+            time.sleep(0.2)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: the standby
+# ---------------------------------------------------------------------------
+
+
+def read_digest_log(path: str):
+    """(digests_by_index, last_flushed_index). Tolerates a torn final
+    line — the child may die mid-write."""
+    digests: dict[int, str] = {}
+    flushed = 0
+    try:
+        with open(path) as f:
+            for line in f:
+                parts = line.strip().split()
+                if len(parts) != 3 or len(parts[2]) != 64:
+                    continue  # torn tail
+                kind, idx, dig = parts[0], int(parts[1]), parts[2]
+                digests[idx] = dig
+                if kind in ("flushed", "done"):
+                    flushed = max(flushed, idx)
+    except FileNotFoundError:
+        pass
+    return digests, flushed
+
+
+def restore_and_check(state_dir: str, digest_log: str) -> dict:
+    from k8s_scheduler_tpu.state import DurableState, state_digest
+
+    clock = Clock()
+    q, c = make_pair(clock)
+    st = DurableState(state_dir, snapshot_interval_seconds=0)
+    stats = st.restore_into(q, c)
+    dig = state_digest(q, c)
+    digests, flushed = read_digest_log(digest_log)
+    if dig not in digests.values():
+        raise AssertionError(
+            f"restored digest {dig[:12]}... matches NO op boundary the "
+            f"active recorded ({len(digests)} boundaries) — state was "
+            "lost, duplicated, or partially applied"
+        )
+    boundary = max(i for i, d in digests.items() if d == dig)
+    if flushed and boundary < flushed:
+        raise AssertionError(
+            f"restore landed at op {boundary} but the active had flushed "
+            f"through op {flushed} — acknowledged-durable records were lost"
+        )
+    st.journal.close()
+    return {
+        "boundary": boundary,
+        "flushed_watermark": flushed,
+        "replayed": stats["records_replayed"],
+        "snapshot": stats["snapshot"],
+        "digest": dig[:12],
+    }
+
+
+def soak(state_dir: str, rounds: int = 5, ops: int = 400,
+         seed: int = 0, verbose: bool = True) -> list[dict]:
+    """The soak loop: child mutates+journals, parent SIGKILLs at a
+    random moment, standby restores, invariants checked; the next round
+    continues from the restored state dir."""
+    results = []
+    digest_log = os.path.join(state_dir, "digests.txt")
+    for r in range(rounds):
+        # fresh digest log per round: digests are only comparable
+        # within one child's lifetime (the stream continues from the
+        # restored state, re-logged from its own boundary 0)
+        if os.path.exists(digest_log):
+            os.unlink(digest_log)
+        child = subprocess.Popen(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--child", "--state-dir", state_dir,
+                "--seed", str(seed + r), "--ops", str(ops),
+                "--digest-log", digest_log,
+            ],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        # the child pays several seconds of interpreter/jax import
+        # before its first op — wait for the digest log's first line so
+        # the kill lands inside the mutation stream, then at a random
+        # point of the child's useful life
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if os.path.exists(digest_log) and os.path.getsize(digest_log):
+                break
+            if child.poll() is not None:
+                raise RuntimeError(
+                    f"soak child exited early (rc={child.returncode})"
+                )
+            time.sleep(0.02)
+        time.sleep(random.Random(seed + r).random() * 1.2)
+        child.send_signal(signal.SIGKILL)
+        child.wait()
+        res = restore_and_check(state_dir, digest_log)
+        res["round"] = r
+        results.append(res)
+        if verbose:
+            print(json.dumps(res), flush=True)
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--state-dir", default="")
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--ops", type=int, default=400)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--child", action="store_true", help="internal")
+    ap.add_argument("--digest-log", default="")
+    ap.add_argument("--hold", action="store_true",
+                    help="child idles after finishing (internal)")
+    args = ap.parse_args()
+    if args.child:
+        return run_child(
+            args.state_dir, args.seed, args.ops,
+            args.digest_log or os.path.join(args.state_dir, "digests.txt"),
+            args.hold,
+        )
+    state_dir = args.state_dir
+    if not state_dir:
+        import tempfile
+
+        state_dir = tempfile.mkdtemp(prefix="soak-failover-")
+        print(f"state dir: {state_dir}", flush=True)
+    results = soak(state_dir, rounds=args.rounds, ops=args.ops,
+                   seed=args.seed)
+    exact = sum(1 for r in results if r["boundary"] > 0)
+    print(
+        f"soak_failover: {len(results)} kills survived, "
+        f"{exact} with non-trivial restored state — no lost or "
+        "duplicated pods",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

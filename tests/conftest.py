@@ -57,6 +57,9 @@ _SLOW_TESTS = {
     "test_volume_binding_over_the_wire",
     "test_scheduler_node_delete_requeues",
     "test_scheduler_gang_requeue",
+    # durable-state failover tests that spawn jax-importing subprocesses
+    "test_kill9_failover_digest_matches_pre_kill",
+    "test_soak_failover_smoke",
 }
 _SLOW_MODULES = {"tests.test_concurrency"}
 

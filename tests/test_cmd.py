@@ -233,6 +233,78 @@ def test_lease_heartbeat_renews(tmp_path):
         lease.release()
 
 
+def test_lease_describe_and_leader_gauges(tmp_path):
+    """scheduler_leader_state / scheduler_leader_lease_age_seconds are
+    scrape-time views of the FileLease, and /healthz-style describe()
+    surfaces identity + heartbeat age — not just a boolean."""
+    path = str(tmp_path / "lease")
+    leader = FileLease(path, identity="the-leader")
+    standby = FileLease(path, identity="the-standby")
+    assert leader.try_acquire()
+    try:
+        d = leader.describe()
+        assert d["leader"] and d["holder"] == "the-leader"
+        assert d["age_s"] >= 0.0 and d["path"] == path
+        ds = standby.describe()
+        assert not ds["leader"] and ds["holder"] == "the-leader"
+        # the gauges evaluate the SAME lease at scrape time
+        m = SchedulerMetrics()
+        m.leader_state.set_function(
+            lambda: 1.0 if leader.is_leader() else 0.0
+        )
+        m.leader_lease_age.set_function(leader.lease_age_seconds)
+        text = m.expose().decode()
+        assert "scheduler_leader_state 1.0" in text
+        assert "scheduler_leader_lease_age_seconds" in text
+        ms = SchedulerMetrics()
+        ms.leader_state.set_function(
+            lambda: 1.0 if standby.is_leader() else 0.0
+        )
+        assert "scheduler_leader_state 0.0" in ms.expose().decode()
+    finally:
+        leader.release()
+    # no lease file content at all: age reads 0, no crash
+    ghost = FileLease(str(tmp_path / "nope"))
+    assert ghost.lease_age_seconds() == 0.0
+
+
+def test_debug_state_endpoint(tmp_path):
+    """/debug/state serves the DurableState status payload (journal
+    lag/segments, snapshot + restore stats); absent without state."""
+    from k8s_scheduler_tpu.internal.cache import SchedulerCache
+    from k8s_scheduler_tpu.internal.queue import SchedulingQueue
+    from k8s_scheduler_tpu.models import MakePod
+    from k8s_scheduler_tpu.state import DurableState
+
+    st = DurableState(str(tmp_path), snapshot_interval_seconds=0)
+    q, c = SchedulingQueue(), SchedulerCache()
+    st.attach(q, c)
+    q.add(MakePod("p").obj())
+    st.journal.flush()
+    server = start_http_server(SchedulerMetrics(), port=0, state=st)
+    port = server.server_address[1]
+    try:
+        st_, _, body = _get(f"http://127.0.0.1:{port}/debug/state")
+        payload = json.loads(body)
+        assert st_ == 200
+        assert payload["journal"]["appended"] == 1
+        assert payload["journal"]["fsync"] is True
+        assert payload["last_restore"]["records_replayed"] == 0
+    finally:
+        server.shutdown()
+    # without durable state the route 404s like other absent debug routes
+    bare = start_http_server(SchedulerMetrics(), port=0)
+    bport = bare.server_address[1]
+    try:
+        code, _, _ = _request(
+            f"http://127.0.0.1:{bport}/debug/state", "GET"
+        )
+        assert code == 404
+    finally:
+        bare.shutdown()
+    st.journal.close()
+
+
 def test_pad_presizing_flows_from_yaml_to_encoder():
     """padExisting / padPodsPerNode (PERF.md 'fold-mode rig wedge'
     avoidance) must reach the per-profile encoders, and the encoded

@@ -173,7 +173,7 @@ def test_cache_assumed_ttl_expiry():
     c.finish_binding(pod.uid)
     clock.tick(31.0)
     expired = c.cleanup_expired()
-    assert [p.name for p in expired] == ["p"]
+    assert [(p.name, n) for p, n in expired] == [("p", "n0")]
     assert c.counts()["assumed"] == 0
 
 

@@ -1,0 +1,396 @@
+"""Failover exactness: differential replay over randomized mutation
+traces, kill -9 subprocess takeover, clean-shutdown seal, and the
+assumed-pod TTL expiry observability satellite (state/ package +
+scripts/soak_failover.py)."""
+
+import importlib.util
+import os
+import pathlib
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from k8s_scheduler_tpu.internal.cache import SchedulerCache
+from k8s_scheduler_tpu.internal.queue import SchedulingQueue
+from k8s_scheduler_tpu.models import MakeNode, MakePod
+from k8s_scheduler_tpu.state import DurableState, state_digest
+
+_SOAK_PATH = (
+    pathlib.Path(__file__).resolve().parent.parent
+    / "scripts" / "soak_failover.py"
+)
+
+
+def _soak_module():
+    spec = importlib.util.spec_from_file_location(
+        "soak_failover", _SOAK_PATH
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> None:
+        self.t += dt
+
+
+def _fresh_pair(clock):
+    q = SchedulingQueue(
+        initial_backoff_seconds=0.5, max_backoff_seconds=4.0,
+        unschedulable_timeout_seconds=30.0, now=clock,
+    )
+    c = SchedulerCache(assumed_pod_ttl_seconds=2.0, now=clock)
+    return q, c
+
+
+@pytest.mark.parametrize("seed", [0, 1, 7])
+def test_differential_random_trace_restores_identical_digest(
+    tmp_path, seed
+):
+    """The tentpole acceptance: a randomized mutation trace journaled
+    live, then replayed into a FRESH queue/cache, produces a
+    bit-identical state digest — attempt counts, backoff expiries,
+    tier order, in-flight sets, assumed-pod deadlines and all."""
+    soak = _soak_module()
+    d = str(tmp_path / f"s{seed}")
+    clock = FakeClock()
+    q, c = _fresh_pair(clock)
+    st = DurableState(d, snapshot_interval_seconds=0)
+    st.attach(q, c)
+    rng = random.Random(seed)
+
+    class SkewClock:  # adapt FakeClock to the soak driver's interface
+        def advance(self, dt):
+            clock.tick(dt)
+
+        def __call__(self):
+            return clock()
+
+    sk = SkewClock()
+    for i in range(250):
+        soak.apply_random_op(rng, sk, q, c, i)
+        if i in (80, 160):
+            # mid-trace snapshot compactions must not perturb replay
+            st.snapshot()
+    st.journal.flush()
+    live = state_digest(q, c)
+
+    q2, c2 = _fresh_pair(FakeClock())
+    st2 = DurableState(d, snapshot_interval_seconds=0)
+    stats = st2.restore_into(q2, c2)
+    assert state_digest(q2, c2) == live
+    assert stats["snapshot"] is True  # compaction was actually used
+    # determinism: a second independent restore agrees
+    q3, c3 = _fresh_pair(FakeClock())
+    DurableState(d, snapshot_interval_seconds=0).restore_into(q3, c3)
+    assert state_digest(q3, c3) == live
+
+
+def test_restore_preserves_backoff_and_attempts_exactly(tmp_path):
+    """Focused version of the digest test: the concrete fields a
+    takeover used to lose (SURVEY §5 'stateless standby')."""
+    d = str(tmp_path)
+    clock = FakeClock()
+    q, c = _fresh_pair(clock)
+    st = DurableState(d, snapshot_interval_seconds=0)
+    st.attach(q, c)
+    pod = MakePod("flappy").req({"cpu": "1"}).obj()
+    q.add(pod)
+    for _ in range(3):  # three failed attempts -> exponential backoff
+        clock.tick(10.0)
+        q.pop_ready()
+        q.requeue_backoff(pod)
+    c.add_node(MakeNode("n0").capacity({"cpu": "8"}).obj())
+    ass = MakePod("assumed").req({"cpu": "1"}).obj()
+    q.add(ass)
+    q.pop_ready()
+    c.assume(ass, "n0")
+    c.finish_binding(ass.uid)
+    st.journal.flush()
+
+    q2, c2 = _fresh_pair(FakeClock())
+    DurableState(d, snapshot_interval_seconds=0).restore_into(q2, c2)
+    # attempts carried over: 3 pops happened (the 4th attempt is next)
+    e_live = q._backoff[pod.uid]
+    e_rest = q2._backoff[pod.uid]
+    assert e_rest.attempts == e_live.attempts == 3
+    assert e_rest.backoff_expiry == e_live.backoff_expiry
+    # assumed pod still assumed, with the SAME TTL deadline
+    assert c2.is_assumed(ass.uid)
+    assert c2._assumed[ass.uid].deadline == c._assumed[ass.uid].deadline
+    assert c2.counts() == c.counts()
+
+
+def test_torn_tail_never_resurrects_into_state(tmp_path):
+    """Truncate the live journal at every byte of its final record:
+    restore must never raise, and the restored state must equal the
+    state BEFORE the final op — the torn record is discarded whole."""
+    from k8s_scheduler_tpu.state.journal import (
+        segment_indices,
+        segment_path,
+    )
+
+    d = str(tmp_path / "live")
+    clock = FakeClock()
+    q, c = _fresh_pair(clock)
+    st = DurableState(d, snapshot_interval_seconds=0)
+    st.attach(q, c)
+    q.add(MakePod("a").req({"cpu": "1"}).obj())
+    clock.tick(1)
+    q.add(MakePod("b").req({"cpu": "1"}).obj())
+    digest_before_final = state_digest(q, c)
+    clock.tick(1)
+    q.add(MakePod("final").req({"cpu": "1"}).obj())
+    st.journal.flush()
+    (idx,) = segment_indices(d)
+    blob = open(segment_path(d, idx), "rb").read()
+    # the final record's frame: find its start by replaying sizes
+    from k8s_scheduler_tpu.state.codec import pod_to_state
+    from k8s_scheduler_tpu.state.journal import encode_record
+
+    final_rec = encode_record(
+        "q.add", clock(), {"pod": pod_to_state(MakePod("final").req(
+            {"cpu": "1"}).obj())}
+    )
+    start = len(blob) - len(final_rec)
+    assert blob[start:] == final_rec  # framing sanity
+    for cut in range(start, len(blob)):
+        tdir = str(tmp_path / f"torn{cut}")
+        os.makedirs(tdir)
+        with open(segment_path(tdir, idx), "wb") as f:
+            f.write(blob[:cut])
+        q2, c2 = _fresh_pair(FakeClock())
+        DurableState(tdir, snapshot_interval_seconds=0).restore_into(
+            q2, c2
+        )
+        assert state_digest(q2, c2) == digest_before_final, (
+            f"cut at byte {cut}"
+        )
+
+
+def test_seal_then_takeover_replays_nothing(tmp_path):
+    d = str(tmp_path)
+    clock = FakeClock()
+    q, c = _fresh_pair(clock)
+    st = DurableState(d, snapshot_interval_seconds=0)
+    st.attach(q, c)
+    for i in range(10):
+        q.add(MakePod(f"p{i}").req({"cpu": "1"}).obj())
+    st.seal()  # the SIGTERM path: clean-shutdown snapshot
+    q2, c2 = _fresh_pair(FakeClock())
+    stats = DurableState(d, snapshot_interval_seconds=0).restore_into(
+        q2, c2
+    )
+    assert stats["clean_shutdown"] is True
+    assert stats["records_replayed"] == 0
+    assert state_digest(q2, c2) == state_digest(q, c)
+
+
+def test_kill9_failover_digest_matches_pre_kill(tmp_path):
+    """The ISSUE satellite: a subprocess active dies on SIGKILL after
+    flushing; the standby restores and its queue/cache digest equals
+    the active's last recorded digest — nothing lost, nothing
+    duplicated."""
+    soak = _soak_module()
+    d = str(tmp_path / "state")
+    os.makedirs(d)
+    digest_log = os.path.join(d, "digests.txt")
+    child = subprocess.Popen(
+        [
+            sys.executable, str(_SOAK_PATH), "--child",
+            "--state-dir", d, "--seed", "3", "--ops", "120",
+            "--digest-log", digest_log, "--hold",
+        ],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        # wait for the child's "done" marker: ops applied + journal
+        # flushed, now idling in --hold — the SIGKILL lands on a fully
+        # durable boundary
+        deadline = time.monotonic() + 120
+        done = False
+        while time.monotonic() < deadline:
+            try:
+                with open(digest_log) as fh:
+                    done = any(
+                        line.startswith("done ") for line in fh
+                    )
+            except FileNotFoundError:
+                pass
+            if done:
+                break
+            assert child.poll() is None, "soak child died early"
+            time.sleep(0.05)
+        assert done, "child never reached its final flush"
+    finally:
+        child.send_signal(signal.SIGKILL)
+        child.wait()
+    res = soak.restore_and_check(d, digest_log)
+    digests, flushed = soak.read_digest_log(digest_log)
+    # everything durable at the done marker survived the SIGKILL
+    assert res["boundary"] == flushed
+    assert res["digest"] == digests[flushed][:12]
+
+
+def test_soak_failover_smoke(tmp_path):
+    """Smoke-tier subset of scripts/soak_failover.py: random-point
+    SIGKILLs, restore invariants checked each round (marked slow in
+    conftest — subprocess jax imports dominate)."""
+    soak = _soak_module()
+    results = soak.soak(
+        str(tmp_path), rounds=2, ops=250, seed=11, verbose=False
+    )
+    assert len(results) == 2
+    for r in results:
+        assert r["boundary"] >= r["flushed_watermark"]
+
+
+def test_scheduler_ctor_attaches_and_standby_restores(tmp_path):
+    """End-to-end wiring: a Scheduler built with state= journals its
+    informer-driven mutations, and a second Scheduler (the standby that
+    just won the lease) built against the same dir restores the exact
+    state in its constructor — before any cycle could run."""
+    from k8s_scheduler_tpu.core import Scheduler
+
+    d = str(tmp_path)
+    clock = FakeClock()
+    active = Scheduler(
+        now=clock, state=DurableState(d, snapshot_interval_seconds=0)
+    )
+    active.on_node_add(MakeNode("n0").capacity({"cpu": "8"}).obj())
+    for i in range(5):
+        clock.tick(0.5)
+        active.on_pod_add(MakePod(f"p{i}").req({"cpu": "1"}).obj())
+    active.on_pod_add(
+        MakePod("bound").req({"cpu": "1"}).obj(), node_name="n0"
+    )
+    active.on_pod_delete("default/p3")
+    active.state.journal.flush()
+    live = state_digest(active.queue, active.cache)
+
+    standby = Scheduler(
+        now=FakeClock(), state=DurableState(
+            d, snapshot_interval_seconds=0
+        )
+    )
+    assert state_digest(standby.queue, standby.cache) == live
+    assert standby.queue.pending_counts()["active"] == 4
+    assert standby.cache.counts() == {"nodes": 1, "bound": 1, "assumed": 0}
+
+
+def test_records_after_seal_survive_the_next_takeover(tmp_path):
+    """Regression: after a seal prunes every wal segment, the next
+    process's journal must number segments ABOVE the snapshot's
+    journal_from — records written below it would sit outside the
+    restore tail and be silently skipped by the takeover after next."""
+    d = str(tmp_path)
+    clock = FakeClock()
+    q, c = _fresh_pair(clock)
+    st = DurableState(d, snapshot_interval_seconds=0)
+    st.attach(q, c)
+    q.add(MakePod("a").req({"cpu": "1"}).obj())
+    st.seal()  # process A: clean shutdown, only a snapshot remains
+
+    q2, c2 = _fresh_pair(FakeClock(2000.0))
+    st2 = DurableState(d, snapshot_interval_seconds=0)
+    st2.attach(q2, c2)
+    q2.add(MakePod("b").req({"cpu": "1"}).obj())
+    st2.journal.flush()  # process B: 'b' acknowledged durable, then dies
+
+    q3, c3 = _fresh_pair(FakeClock(3000.0))
+    st3 = DurableState(d, snapshot_interval_seconds=0)
+    stats = st3.restore_into(q3, c3)
+    assert stats["records_replayed"] == 1
+    assert q3.pending_counts()["active"] == 2  # both a AND b survive
+    assert state_digest(q3, c3) == state_digest(q2, c2)
+
+
+def test_in_flight_pods_recovered_on_takeover(tmp_path):
+    """A pod popped for a cycle whose outcome never reached the journal
+    (leader died mid-cycle) must be requeued by the standby — there is
+    no informer to re-deliver it, so dropping it would lose it forever."""
+    from k8s_scheduler_tpu.core import Scheduler
+
+    d = str(tmp_path)
+    clock = FakeClock()
+    q, c = _fresh_pair(clock)
+    st = DurableState(d, snapshot_interval_seconds=0)
+    st.attach(q, c)
+    q.add(MakePod("mid-cycle").req({"cpu": "1"}).obj())
+    q.add(MakePod("gone").req({"cpu": "1"}).obj())
+    popped = q.pop_ready()  # both in flight; outcomes never journaled
+    assert len(popped) == 2
+    q.delete("default/gone")  # informer delete raced the crash
+    st.journal.flush()
+
+    standby = Scheduler(
+        now=FakeClock(), state=DurableState(
+            d, snapshot_interval_seconds=0
+        )
+    )
+    counts = standby.queue.pending_counts()
+    assert counts["active"] == 1  # recovered, minus the deleted one
+    entry = standby.queue._active["default/mid-cycle"]
+    assert entry.attempts == 1  # the crashed attempt stays counted
+    assert "default/gone" not in standby.queue._active
+    # and the recovery itself was journaled: a second takeover agrees
+    standby.state.journal.flush()
+    third = Scheduler(
+        now=FakeClock(), state=DurableState(
+            d, snapshot_interval_seconds=0
+        )
+    )
+    assert state_digest(third.queue, third.cache) == state_digest(
+        standby.queue, standby.cache
+    )
+
+
+def test_config_state_dir_and_snapshot_interval_load():
+    from k8s_scheduler_tpu.config.types import load_config
+
+    cfg = load_config("stateDir: /var/lib/sched\nsnapshotInterval: 90s\n")
+    assert cfg.state_dir == "/var/lib/sched"
+    assert cfg.snapshot_interval_seconds == 90.0
+    # defaults: durability off, 60s cadence once enabled
+    dflt = load_config("{}")
+    assert dflt.state_dir == ""
+    assert dflt.snapshot_interval_seconds == 60.0
+
+
+def test_assumed_ttl_expiry_leaves_a_trace(tmp_path):
+    """ISSUE satellite: TTL expiry used to drop assumed pods silently —
+    now it must leave an events-ring entry and an 'Expired' pod-timeline
+    attempt so /debug/pods/<uid> explains the disappearance."""
+    from k8s_scheduler_tpu.core import Scheduler
+
+    clock = FakeClock()
+    sched = Scheduler(now=clock)
+    pod = MakePod("ghost").req({"cpu": "1"}).obj()
+    sched.cache.assume(pod, "n3")
+    sched.cache.finish_binding(pod.uid)
+    clock.tick(60.0)  # past the assumed-pod TTL
+    stats = sched.schedule_cycle()  # empty cycle still sweeps
+    assert stats.attempted == 0
+    # requeued with backoff, not silently dropped
+    assert pod.uid in sched.queue._backoff
+    # events ring explains it
+    ring = sched.events.events_for(pod.uid)
+    assert any(e.reason == "AssumeExpired" for e in ring)
+    msg = [e for e in ring if e.reason == "AssumeExpired"][0].message
+    assert "n3" in msg and "expired" in msg
+    # pod timeline shows an Expired attempt with the node
+    tl = sched.pod_timeline(pod.uid)
+    assert tl is not None
+    expired = [a for a in tl["attempts"] if a["result"] == "Expired"]
+    assert expired and expired[0]["node"] == "n3"
