@@ -1,0 +1,51 @@
+"""schedlint: repo-native static analysis for the invariants this
+codebase keeps rediscovering the hard way.
+
+The scheduler is a JAX+threads hybrid: invariants like "no imports under
+a trace" (PR 1's UnexpectedTracerError), the `queue -> cache -> journal`
+lock order, and the journal's "one clock read, one record per mutator"
+contract (state/manager.py) are load-bearing but invisible to Python
+itself — upstream kube-scheduler gets the equivalent protection from
+Go's race detector and vet. This package is the vet analogue: an
+AST-based pass framework (registry mirroring framework/registry.py)
+with inline `# schedlint: disable=CODE` suppressions and a committed
+baseline for grandfathered findings, driven by scripts/schedlint.py and
+a tier-1 test (tests/test_schedlint.py).
+
+Passes (see each module's docstring for codes):
+
+- TRACE-SAFETY   (trace_safety.py)    TS0xx — impure Python reachable
+  from the jitted cycle programs / plugin compute fns
+- LOCK-DISCIPLINE (lock_discipline.py) LD0xx — lock-order inversions and
+  blocking calls under the scheduler's state locks
+- JOURNAL-EMIT-ONCE (journal_emit.py)  JE0xx — the durable-state
+  clock-once / record-once mutator contract
+- INVENTORY-DRIFT (inventory.py)       ID0xx — metrics/config/CLI/README
+  documentation drift (absorbs scripts/lint_metrics.py)
+- HYGIENE        (hygiene.py)          HY0xx — unused module-level
+  imports
+"""
+
+from .core import (
+    Finding,
+    LintContext,
+    SourceFile,
+    load_baseline,
+    load_tree,
+    run_lint,
+    write_baseline,
+)
+from .registry import PassBase, PassRegistry, default_registry
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "PassBase",
+    "PassRegistry",
+    "SourceFile",
+    "default_registry",
+    "load_baseline",
+    "load_tree",
+    "run_lint",
+    "write_baseline",
+]

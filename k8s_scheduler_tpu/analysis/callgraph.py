@@ -1,0 +1,420 @@
+"""Lightweight intra-repo code index + call graph for schedlint passes.
+
+Pure-AST, no imports of the analyzed code. Precision model (documented
+so pass authors know what they're standing on):
+
+- Every function, method, nested function, and lambda is indexed with a
+  stable id `"<rel path>::<qualname>"`.
+- A function's *references* are every Name load and attribute chain in
+  its own body (nested function bodies belong to the nested function,
+  but their default args and decorators evaluate in the enclosing
+  scope and are credited there).
+- Resolution is name-based and deliberately OVER-approximate for
+  reachability (a static-safety walk must not miss an edge):
+    * bare names resolve through the lexical scope chain (own nested
+      defs -> enclosing functions -> module functions -> `from X
+      import f` aliases);
+    * dotted chains rooted at an import alias resolve exactly into the
+      target module;
+    * `self.m` / `cls.m` resolves through the enclosing class and its
+      by-name base chain;
+    * anything else falls back to "every indexed function named m",
+      EXCEPT names in _GENERIC_ATTRS (list.append, dict.get, ...),
+      which would connect the graph through builtin-container noise.
+- Functions merely *referenced* (passed as callbacks to lax.scan /
+  lax.cond / Thread(target=...)) count as called — that is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable
+
+from .core import SourceFile
+
+# attribute names whose by-name fallback would wire the graph through
+# builtin containers / file objects / locks rather than real calls
+_GENERIC_ATTRS = frozenset({
+    "append", "add", "get", "pop", "update", "clear", "copy", "items",
+    "keys", "values", "extend", "insert", "remove", "sort", "split",
+    "join", "strip", "read", "write", "open", "close", "flush", "set",
+    "inc", "observe", "start", "commit", "note", "mark", "wait",
+    "notify", "notify_all", "release", "acquire", "put", "encode",
+    "decode", "dump", "dumps", "load", "loads", "run", "stop", "send",
+    "main", "setdefault", "discard", "count", "index", "format",
+    "replace", "lower", "upper", "popitem", "move_to_end", "group",
+    "match", "search", "findall", "pack", "unpack", "unpack_from",
+})
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    id: str
+    file: SourceFile
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    name: str  # "<lambda>" for lambdas
+    qualname: str
+    cls: str | None  # enclosing class name (methods only)
+    parent: str | None  # enclosing function id (nested defs/lambdas)
+    lineno: int
+
+    @property
+    def module(self) -> str:
+        return self.file.module
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    module: str
+    name: str
+    bases: list[str]  # base names (last attribute component)
+    methods: dict[str, str]  # method name -> func id
+    lineno: int
+
+
+def attribute_chain(node: ast.AST) -> tuple[str, ...] | None:
+    """('a','b','c') for `a.b.c` when rooted at a Name, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def own_body_nodes(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """Every AST node that executes in `fn_node`'s own frame — nested
+    function/lambda bodies are excluded (they are indexed separately),
+    but their decorators and default-argument expressions, which
+    evaluate in THIS frame, are included."""
+    if isinstance(fn_node, ast.Lambda):
+        stack: list[ast.AST] = [fn_node.body]
+    else:
+        stack = list(fn_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(node.decorator_list)
+            stack.extend(node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+            continue
+        if isinstance(node, ast.Lambda):
+            stack.extend(node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+            continue
+        if isinstance(node, ast.ClassDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _Indexer(ast.NodeVisitor):
+    def __init__(self, index: "CodeIndex", sf: SourceFile) -> None:
+        self.index = index
+        self.sf = sf
+        self.scope: list[str] = []  # qualname parts
+        self.cls_stack: list[ClassInfo] = []
+        self.fn_stack: list[FuncInfo] = []
+        self.lambda_counter = 0
+
+    def _add_func(self, node, name: str) -> FuncInfo:
+        qual = ".".join(self.scope + [name])
+        info = FuncInfo(
+            id=f"{self.sf.rel}::{qual}",
+            file=self.sf,
+            node=node,
+            name=name,
+            qualname=qual,
+            cls=self.cls_stack[-1].name
+            if self.cls_stack and self.scope
+            and self.scope[-1] == self.cls_stack[-1].name else None,
+            parent=self.fn_stack[-1].id if self.fn_stack else None,
+            lineno=node.lineno,
+        )
+        self.index._register_func(info)
+        return info
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        bases = []
+        for b in node.bases:
+            chain = attribute_chain(b)
+            if chain:
+                bases.append(chain[-1])
+        ci = ClassInfo(
+            module=self.sf.module, name=node.name, bases=bases,
+            methods={}, lineno=node.lineno,
+        )
+        self.index._register_class(ci)
+        self.cls_stack.append(ci)
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+        self.cls_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        info = self._add_func(node, node.name)
+        if (
+            self.cls_stack and self.scope
+            and self.scope[-1] == self.cls_stack[-1].name
+        ):
+            self.cls_stack[-1].methods[node.name] = info.id
+        self.scope.append(node.name)
+        self.fn_stack.append(info)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+        self.scope.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.lambda_counter += 1
+        self._add_func(node, f"<lambda-{self.lambda_counter}>")
+        self.generic_visit(node)
+
+
+class CodeIndex:
+    def __init__(self, files: list[SourceFile]) -> None:
+        self.files = files
+        self.funcs: dict[str, FuncInfo] = {}
+        self.classes: dict[tuple[str, str], ClassInfo] = {}
+        # module -> {module-level function name -> id}
+        self.module_funcs: dict[str, dict[str, str]] = {}
+        # function name -> ids (the by-name fallback table)
+        self.by_name: dict[str, set[str]] = {}
+        # (rel, lineno, col) -> func id, for node -> info lookups
+        self._by_pos: dict[tuple[str, int, int], str] = {}
+        # parent func id -> {nested def name -> id} (lexical scope table)
+        self._children: dict[str, dict[str, str]] = {}
+        # per-file import alias tables
+        self._aliases: dict[str, dict[str, tuple[str, str | None]]] = {}
+        self._modules = set()
+        for sf in files:
+            self._modules.add(sf.module)
+        for sf in files:
+            _Indexer(self, sf).visit(sf.tree)
+            self._aliases[sf.rel] = self._collect_aliases(sf)
+        self._resolved: dict[str, frozenset[str]] = {}
+
+    # ---- construction ----------------------------------------------------
+
+    def _register_func(self, info: FuncInfo) -> None:
+        self.funcs[info.id] = info
+        self._by_pos[
+            (info.file.rel, info.node.lineno, info.node.col_offset)
+        ] = info.id
+        if info.parent is None and info.cls is None:
+            self.module_funcs.setdefault(info.module, {})[info.name] = info.id
+        if info.parent is not None:
+            self._children.setdefault(info.parent, {})[info.name] = info.id
+        if not info.name.startswith("<lambda"):
+            self.by_name.setdefault(info.name, set()).add(info.id)
+
+    def _register_class(self, ci: ClassInfo) -> None:
+        self.classes[(ci.module, ci.name)] = ci
+
+    def _collect_aliases(
+        self, sf: SourceFile
+    ) -> dict[str, tuple[str, str | None]]:
+        """alias -> (module, symbol|None). symbol None = the alias IS a
+        module; otherwise it is `symbol` inside `module`. Only aliases
+        that resolve into the indexed file set are kept."""
+        out: dict[str, tuple[str, str | None]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in self._modules:
+                        out[a.asname or a.name.split(".")[0]] = (
+                            a.name if a.asname else a.name.split(".")[0],
+                            None,
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from(sf, node)
+                if base is None:
+                    continue
+                for a in node.names:
+                    target = f"{base}.{a.name}" if base else a.name
+                    bound = a.asname or a.name
+                    if target in self._modules:
+                        out[bound] = (target, None)
+                    elif base in self._modules:
+                        out[bound] = (base, a.name)
+        return out
+
+    def _resolve_from(
+        self, sf: SourceFile, node: ast.ImportFrom
+    ) -> str | None:
+        """Absolute dotted module for a `from ... import` statement."""
+        if node.level == 0:
+            return node.module
+        pkg = sf.module.split(".")
+        if not sf.rel.endswith("__init__.py"):
+            pkg = pkg[:-1]
+        drop = node.level - 1
+        if drop > len(pkg):
+            return None
+        base = pkg[: len(pkg) - drop] if drop else pkg
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    # ---- lookups ---------------------------------------------------------
+
+    def func_at(self, rel: str, node: ast.AST) -> FuncInfo | None:
+        fid = self._by_pos.get((rel, node.lineno, node.col_offset))
+        return self.funcs.get(fid) if fid else None
+
+    def subclasses_of(self, *base_names: str) -> list[ClassInfo]:
+        """Classes deriving (transitively, by base NAME) from any of the
+        given names — including name-only matches across modules."""
+        want = set(base_names)
+        changed = True
+        while changed:
+            changed = False
+            for ci in self.classes.values():
+                if ci.name in want:
+                    continue
+                if any(b in want for b in ci.bases):
+                    want.add(ci.name)
+                    changed = True
+        return [
+            ci for ci in self.classes.values()
+            if ci.name in want and ci.name not in base_names
+        ] + [ci for ci in self.classes.values() if ci.name in base_names]
+
+    def class_method(
+        self, module: str, cls_name: str, method: str
+    ) -> set[str]:
+        """Resolve a method through the class + its by-name base chain."""
+        seen: set[str] = set()
+        queue = [(module, cls_name)]
+        while queue:
+            key = queue.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            ci = self.classes.get(key)
+            if ci is None:
+                # base defined in another module: match by name anywhere
+                cands = [
+                    c for c in self.classes.values() if c.name == key[1]
+                ]
+                if not cands:
+                    continue
+                for c in cands:
+                    queue.append((c.module, c.name))
+                continue
+            if method in ci.methods:
+                return {ci.methods[method]}
+            for b in ci.bases:
+                queue.append((ci.module, b))
+        return set()
+
+    # ---- reference resolution --------------------------------------------
+
+    def resolve_name(self, f: FuncInfo, name: str) -> set[str]:
+        """Bare-name reference from inside `f`."""
+        # lexical chain: own + enclosing functions' direct nested defs
+        cur: FuncInfo | None = f
+        while cur is not None:
+            hit = self._children.get(cur.id, {}).get(name)
+            if hit is not None:
+                return {hit}
+            cur = self.funcs.get(cur.parent) if cur.parent else None
+        mod = self.module_funcs.get(f.module, {})
+        if name in mod:
+            return {mod[name]}
+        alias = self._aliases.get(f.file.rel, {}).get(name)
+        if alias:
+            amod, sym = alias
+            if sym is not None:
+                target = self.module_funcs.get(amod, {}).get(sym)
+                if target:
+                    return {target}
+                # imported class: its __init__ runs
+                return self.class_method(amod, sym, "__init__")
+        return set()
+
+    def resolve_chain(
+        self, f: FuncInfo, chain: tuple[str, ...]
+    ) -> set[str]:
+        """Dotted-chain reference from inside `f` (see module docstring
+        for the precision ladder)."""
+        if len(chain) == 1:
+            return self.resolve_name(f, chain[0])
+        head, rest = chain[0], chain[1:]
+        alias = self._aliases.get(f.file.rel, {}).get(head)
+        if alias and alias[1] is None:
+            # module alias: walk submodule components exactly
+            mod = alias[0]
+            i = 0
+            while i < len(rest) - 1 and f"{mod}.{rest[i]}" in self._modules:
+                mod = f"{mod}.{rest[i]}"
+                i += 1
+            name = rest[i]
+            target = self.module_funcs.get(mod, {}).get(name)
+            if target:
+                out = {target}
+            else:
+                out = self.class_method(mod, name, "__init__")
+            # Plugin().method(...) style chains keep resolving by name
+            for extra in rest[i + 1:]:
+                out |= self._fallback(extra)
+            return out
+        if head in ("self", "cls") and f.cls is not None:
+            hit = self.class_method(f.module, f.cls, rest[0])
+            if hit:
+                return hit
+        return self._fallback(chain[-1])
+
+    def _fallback(self, name: str) -> set[str]:
+        if name in _GENERIC_ATTRS:
+            return set()
+        return set(self.by_name.get(name, ()))
+
+    def references(self, f: FuncInfo) -> frozenset[str]:
+        """Every function id referenced from `f`'s own frame (memoized)."""
+        hit = self._resolved.get(f.id)
+        if hit is not None:
+            return hit
+        out: set[str] = set()
+        for node in own_body_nodes(f.node):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ):
+                out |= self.resolve_name(f, node.id)
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                chain = attribute_chain(node)
+                if chain is not None:
+                    out |= self.resolve_chain(f, chain)
+                else:
+                    out |= self._fallback(node.attr)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                pass
+        # nested defs referenced by Name load are covered above; a nested
+        # Lambda expression is a reference by construction (it is built,
+        # and virtually always invoked, where it appears)
+        for name, fid in self._children.get(f.id, {}).items():
+            if name.startswith("<lambda"):
+                out.add(fid)
+        result = frozenset(out - {f.id})
+        self._resolved[f.id] = result
+        return result
+
+    def reachable(self, roots: Iterable[str]) -> set[str]:
+        seen: set[str] = set()
+        stack = [r for r in roots if r in self.funcs]
+        while stack:
+            fid = stack.pop()
+            if fid in seen:
+                continue
+            seen.add(fid)
+            for ref in self.references(self.funcs[fid]):
+                if ref not in seen:
+                    stack.append(ref)
+        return seen

@@ -1,0 +1,290 @@
+"""schedlint core: source model, findings, suppressions, baseline.
+
+A `Finding` is (file, line, code, message). Its *identity* for baseline
+purposes is (file, code, message) — line numbers churn with unrelated
+edits, so a committed baseline entry grandfathers a finding wherever it
+moves within its file as long as the message is unchanged.
+
+Suppression syntax (checked on the finding's own line):
+
+    something_flagged()  # schedlint: disable=TS001
+    another_thing()      # schedlint: disable=TS002,LD002 -- why it's ok
+    legacy_module_wide   # schedlint: disable-file=HY001 (anywhere in file)
+
+`disable=all` silences every code on that line. A suppression SHOULD
+carry a trailing justification; the framework doesn't parse it, review
+does.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import tokenize
+from io import StringIO
+from typing import Any, Iterable
+
+# codes are letter(s)+digits (TS001) or the literal `all`; the list
+# stops at the first non-code token so a justification written without
+# the `--` separator can't silently void the suppression
+_SUPPRESS_RE = re.compile(
+    r"#\s*schedlint:\s*(disable|disable-file)="
+    r"((?:[A-Za-z]+\d+|all)(?:\s*,\s*(?:[A-Za-z]+\d+|all))*)"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    file: str  # repo-relative, forward slashes
+    line: int  # 1-indexed
+    code: str  # e.g. "TS001"
+    message: str
+
+    def key(self) -> tuple[str, str, str]:
+        """Baseline identity: line-independent (see module docstring)."""
+        return (self.file, self.code, self.message)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: {self.code} {self.message}"
+
+
+class SourceFile:
+    """One parsed module: path, text, AST, and its suppression table."""
+
+    def __init__(self, path: str, rel: str, text: str) -> None:
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.tree = ast.parse(text, filename=rel)
+        # line -> set of codes (or {"all"}); "file" key = whole-file codes
+        self.line_suppressions: dict[int, set[str]] = {}
+        self.file_suppressions: set[str] = set()
+        self._parse_suppressions()
+
+    @property
+    def module(self) -> str:
+        """Dotted module name relative to the scanned root."""
+        mod = self.rel[:-3] if self.rel.endswith(".py") else self.rel
+        mod = mod.replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        return mod
+
+    def _parse_suppressions(self) -> None:
+        # tokenize so a '#' inside a string literal can't fake a pragma
+        try:
+            tokens = tokenize.generate_tokens(StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                codes = {
+                    c.strip() for c in m.group(2).split(",") if c.strip()
+                }
+                if m.group(1) == "disable-file":
+                    self.file_suppressions |= codes
+                else:
+                    self.line_suppressions.setdefault(
+                        tok.start[0], set()
+                    ).update(codes)
+        except tokenize.TokenError:
+            pass  # ast.parse already accepted it; pragmas best-effort
+
+    def suppressed(self, line: int, code: str) -> bool:
+        if {code, "all"} & self.file_suppressions:
+            return True
+        codes = self.line_suppressions.get(line)
+        return bool(codes and ({code, "all"} & codes))
+
+
+def load_tree(
+    root: str, paths: Iterable[str] | None = None
+) -> list[SourceFile]:
+    """Parse every .py under `paths` (files or directories, relative to
+    `root`; default: the k8s_scheduler_tpu package + scripts/)."""
+    root = os.path.abspath(root)
+    if paths is None:
+        paths = ["k8s_scheduler_tpu", "scripts"]
+    out: list[SourceFile] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            out.append(_load_one(root, full))
+            continue
+        if not os.path.isdir(full):
+            # a typo'd path silently scanning 0 files would turn the
+            # lint permanently green; fail loudly instead
+            raise FileNotFoundError(
+                f"schedlint: path {p!r} does not exist under {root}"
+            )
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", ".pytest_cache")
+            )
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(_load_one(root, os.path.join(dirpath, name)))
+    return out
+
+
+def _load_one(root: str, full: str) -> SourceFile:
+    with open(full, encoding="utf-8") as f:
+        text = f.read()
+    rel = os.path.relpath(full, root)
+    return SourceFile(full, rel, text)
+
+
+class LintContext:
+    """What a pass gets to look at: the parsed file set + the shared
+    call-graph index (built lazily — only passes that walk reachability
+    pay for it)."""
+
+    def __init__(self, root: str, files: list[SourceFile]) -> None:
+        self.root = os.path.abspath(root)
+        self.files = files
+        self._by_rel = {f.rel: f for f in files}
+        self._by_module = {f.module: f for f in files}
+        self._index = None
+
+    def file(self, rel: str) -> SourceFile | None:
+        return self._by_rel.get(rel.replace(os.sep, "/"))
+
+    def module(self, name: str) -> SourceFile | None:
+        return self._by_module.get(name)
+
+    @property
+    def index(self):
+        if self._index is None:
+            from .callgraph import CodeIndex
+
+            self._index = CodeIndex(self.files)
+        return self._index
+
+
+# ---- baseline ------------------------------------------------------------
+
+
+def load_baseline(path: str) -> list[dict]:
+    """The committed grandfather list: [{"file", "code", "message"}, ...].
+    A missing file is an empty baseline."""
+    if not path or not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return list(data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    data = {
+        "comment": (
+            "schedlint grandfathered findings — entries match on "
+            "(file, code, message), line-independent. Regenerate with "
+            "scripts/schedlint.py --write-baseline; shrink it, don't "
+            "grow it."
+        ),
+        "findings": [
+            {"file": f.file, "code": f.code, "message": f.message}
+            for f in sorted(findings, key=lambda f: (f.file, f.code, f.line))
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: list[dict]
+) -> tuple[list[Finding], list[Finding]]:
+    """Split into (new, grandfathered). Matching is count-aware: two
+    identical findings need two baseline entries."""
+    budget: dict[tuple[str, str, str], int] = {}
+    for e in baseline:
+        k = (e.get("file", ""), e.get("code", ""), e.get("message", ""))
+        budget[k] = budget.get(k, 0) + 1
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# ---- driver --------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]  # unsuppressed, non-baselined (the failures)
+    suppressed: list[Finding]
+    grandfathered: list[Finding]
+    files_scanned: int
+    passes_run: list[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "passes": self.passes_run,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "grandfathered": [f.to_dict() for f in self.grandfathered],
+        }
+
+
+def run_lint(
+    root: str,
+    paths: Iterable[str] | None = None,
+    registry=None,
+    passes: Iterable[str] | None = None,
+    pass_args: dict[str, dict] | None = None,
+    baseline_path: str | None = None,
+) -> LintResult:
+    """Parse, run the (selected) passes, apply suppressions + baseline."""
+    from .registry import default_registry
+
+    registry = registry or default_registry()
+    files = load_tree(root, paths)
+    ctx = LintContext(root, files)
+    names = list(passes) if passes else registry.names()
+    pass_args = pass_args or {}
+    raw: list[Finding] = []
+    for name in names:
+        p = registry.make(name, pass_args.get(name))
+        raw.extend(p.run(ctx))
+    raw.sort(key=lambda f: (f.file, f.line, f.code, f.message))
+
+    live: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in raw:
+        sf = ctx.file(f.file)
+        if sf is not None and sf.suppressed(f.line, f.code):
+            suppressed.append(f)
+        else:
+            live.append(f)
+
+    baseline = load_baseline(baseline_path) if baseline_path else []
+    new, grandfathered = apply_baseline(live, baseline)
+    return LintResult(
+        findings=new,
+        suppressed=suppressed,
+        grandfathered=grandfathered,
+        files_scanned=len(files),
+        passes_run=names,
+    )

@@ -1,0 +1,351 @@
+"""INVENTORY-DRIFT (ID0xx): code <-> documentation surface cross-checks.
+
+The generalization of scripts/lint_metrics.py (now a shim over this
+pass): dashboards, runbooks, and the README are built from inventories
+that silently rot when code moves. Three inventories are checked, each
+in BOTH directions:
+
+- ID001  metric families registered on SchedulerMetrics vs the
+         metrics/metrics.py docstring and the README "## Observability"
+         table, plus the REQUIRED_FAMILIES floor (the durable-state /
+         leader families operations depends on)
+- ID002  SchedulerConfiguration fields vs the camelCase YAML keys
+         load_config() reads (a field without a key is dead config; a
+         key without a field is a silent no-op in every user's YAML)
+- ID003  cmd/main.py: `config.X` attribute writes must name real
+         SchedulerConfiguration fields, `args.Y` reads must name real
+         argparse flags (a typo'd override silently keeps the default)
+- ID004  every YAML config key and every CLI flag is mentioned
+         somewhere in README.md (the operator-facing surface)
+
+The metric-registry half (ID001) imports the live package; pass
+`{"metrics_runtime": False}` to skip it when linting fixture trees.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Finding, LintContext
+from .registry import PassBase
+
+_NAME_RE = re.compile(r"\bscheduler_[a-z0-9_]+\b")
+
+# Families that MUST exist: the durable-state (journal/snapshot) and
+# leader-election surfaces are operational contracts — dashboards and
+# the failover runbook depend on them, so their silent removal from the
+# registry is a lint failure even though the two-way doc check would
+# only notice if the docs were cleaned up in the same commit.
+REQUIRED_FAMILIES = {
+    "scheduler_journal_appends_total",
+    "scheduler_journal_bytes_total",
+    "scheduler_journal_fsync_seconds",
+    "scheduler_journal_buffer_depth",
+    "scheduler_journal_segments",
+    "scheduler_snapshot_writes_total",
+    "scheduler_snapshot_duration_seconds",
+    "scheduler_snapshot_last_bytes",
+    "scheduler_snapshot_last_restore_records",
+    "scheduler_snapshot_last_restore_seconds",
+    "scheduler_leader_state",
+    "scheduler_leader_lease_age_seconds",
+}
+
+# dataclass fields that are structured sub-configs, not flat YAML keys
+_STRUCTURED_FIELDS = {"profiles", "extenders"}
+# top-level YAML keys that feed the structured fields above
+_STRUCTURED_KEYS = {"profiles", "extenders"}
+
+
+def camel(field: str) -> str:
+    parts = field.split("_")
+    return parts[0] + "".join(p.capitalize() for p in parts[1:])
+
+
+def _key_matches(field: str, keys: set[str]) -> bool:
+    if camel(field) in keys:
+        return True
+    if field.endswith("_seconds") and camel(field[: -len("_seconds")]) in keys:
+        return True
+    return False
+
+
+def _field_matches(key: str, fields: set[str]) -> bool:
+    snake = re.sub(r"([A-Z])", lambda m: "_" + m.group(1).lower(), key)
+    return snake in fields or f"{snake}_seconds" in fields
+
+
+class InventoryDriftPass(PassBase):
+    name = "INVENTORY-DRIFT"
+    codes = {
+        "ID001": "metric registry drifted from docstring/README/"
+                 "required-families inventory",
+        "ID002": "SchedulerConfiguration fields drifted from "
+                 "load_config YAML keys",
+        "ID003": "cmd/main.py references an unknown config field or "
+                 "CLI flag",
+        "ID004": "config key / CLI flag undocumented in README",
+    }
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        findings: list[Finding] = []
+        types_sf = self._find(ctx, "config/types.py")
+        main_sf = self._find(ctx, "cmd/main.py")
+        fields = self._config_fields(types_sf) if types_sf else {}
+        keys = self._yaml_keys(types_sf) if types_sf else {}
+        if types_sf:
+            findings += self._check_config(types_sf, fields, keys)
+        if main_sf:
+            flags = self._cli_flags(main_sf)
+            findings += self._check_main(main_sf, fields, flags)
+            findings += self._check_readme(
+                ctx, types_sf, main_sf, keys, flags
+            )
+        if self.args.get("metrics_runtime", True) and self._find(
+            ctx, "metrics/metrics.py"
+        ):
+            findings += self._check_metrics(ctx)
+        return findings
+
+    @staticmethod
+    def _find(ctx: LintContext, suffix: str):
+        for sf in ctx.files:
+            if sf.rel.endswith(suffix):
+                return sf
+        return None
+
+    # ---- ID002: config fields <-> YAML keys ------------------------------
+
+    @staticmethod
+    def _config_fields(sf) -> dict[str, int]:
+        """SchedulerConfiguration field -> lineno."""
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == (
+                "SchedulerConfiguration"
+            ):
+                return {
+                    st.target.id: st.lineno
+                    for st in node.body
+                    if isinstance(st, ast.AnnAssign)
+                    and isinstance(st.target, ast.Name)
+                }
+        return {}
+
+    @staticmethod
+    def _yaml_keys(sf) -> dict[str, int]:
+        """Top-level `data.get("...")` keys in load_config -> lineno."""
+        out: dict[str, int] = {}
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.FunctionDef)
+                and node.name == "load_config"
+            ):
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                fn = call.func
+                if (
+                    isinstance(fn, ast.Attribute) and fn.attr == "get"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "data"
+                    and call.args
+                    and isinstance(call.args[0], ast.Constant)
+                    and isinstance(call.args[0].value, str)
+                ):
+                    out.setdefault(call.args[0].value, call.lineno)
+        return out
+
+    def _check_config(self, sf, fields, keys) -> list[Finding]:
+        findings = []
+        for field, line in sorted(fields.items()):
+            if field in _STRUCTURED_FIELDS:
+                continue
+            if not _key_matches(field, set(keys)):
+                findings.append(Finding(
+                    sf.rel, line, "ID002",
+                    f"SchedulerConfiguration.{field} has no matching "
+                    f"YAML key in load_config (expected "
+                    f"{camel(field)!r}): the field is dead in every "
+                    "config file",
+                ))
+        for key, line in sorted(keys.items()):
+            if key in _STRUCTURED_KEYS:
+                continue
+            if not _field_matches(key, set(fields)):
+                findings.append(Finding(
+                    sf.rel, line, "ID002",
+                    f"load_config reads YAML key {key!r} with no "
+                    "matching SchedulerConfiguration field: the key "
+                    "parses into nothing",
+                ))
+        return findings
+
+    # ---- ID003: cmd/main.py coherence ------------------------------------
+
+    @staticmethod
+    def _cli_flags(sf) -> dict[str, int]:
+        """'--flag-name' -> lineno for every add_argument call."""
+        out: dict[str, int] = {}
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("--")
+            ):
+                out[node.args[0].value] = node.lineno
+        return out
+
+    def _check_main(self, sf, fields, flags) -> list[Finding]:
+        findings = []
+        dests = {
+            flag[2:].replace("-", "_") for flag in flags
+        }
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "config"
+                and fields and node.attr not in fields
+            ):
+                findings.append(Finding(
+                    sf.rel, node.lineno, "ID003",
+                    f"cmd/main.py references config.{node.attr}, which "
+                    "is not a SchedulerConfiguration field: the "
+                    "override writes into nothing",
+                ))
+            elif (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "args"
+                and dests and node.attr not in dests
+            ):
+                findings.append(Finding(
+                    sf.rel, node.lineno, "ID003",
+                    f"cmd/main.py reads args.{node.attr}, which no "
+                    "add_argument flag defines",
+                ))
+        return findings
+
+    # ---- ID004: README coverage ------------------------------------------
+
+    def _check_readme(
+        self, ctx, types_sf, main_sf, keys, flags
+    ) -> list[Finding]:
+        path = os.path.join(ctx.root, "README.md")
+        if not os.path.exists(path):
+            return []
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        findings = []
+        for key, line in sorted(keys.items()):
+            if key in _STRUCTURED_KEYS:
+                continue
+            if key not in text:
+                findings.append(Finding(
+                    types_sf.rel, line, "ID004",
+                    f"YAML config key {key!r} is not documented "
+                    "anywhere in README.md",
+                ))
+        for flag, line in sorted(flags.items()):
+            if flag not in text:
+                findings.append(Finding(
+                    main_sf.rel, line, "ID004",
+                    f"CLI flag {flag!r} is not documented anywhere in "
+                    "README.md",
+                ))
+        return findings
+
+    # ---- ID001: metric inventory (runtime) -------------------------------
+
+    def _check_metrics(self, ctx: LintContext) -> list[Finding]:
+        problems = metric_inventory_problems(ctx.root)
+        metrics_rel = self._find(ctx, "metrics/metrics.py").rel
+        return [
+            Finding(metrics_rel, 1, "ID001", p) for p in problems
+        ]
+
+
+# ---- the lint_metrics.py logic, kept importable for the shim -------------
+
+
+def registered_names() -> set[str]:
+    """Metric families registered on a fresh SchedulerMetrics, in
+    Prometheus exposition naming (counters get their _total suffix)."""
+    from k8s_scheduler_tpu.metrics import SchedulerMetrics
+
+    names: set[str] = set()
+    for fam in SchedulerMetrics().registry.collect():
+        name = fam.name
+        if fam.type == "counter":
+            name += "_total"
+        names.add(name)
+    return names
+
+
+def _strip_series_suffixes(names: set[str], families: set[str]) -> set[str]:
+    """Collapse `foo_bucket`/`foo_count`/`foo_sum`/`foo_created` doc
+    mentions onto their family name so prose quoting a specific series
+    does not count as a phantom metric."""
+    out = set()
+    for n in names:
+        base = re.sub(r"_(bucket|count|sum|created)$", "", n)
+        out.add(base if base in families and n not in families else n)
+    return out
+
+
+def docstring_names() -> set[str]:
+    import k8s_scheduler_tpu.metrics.metrics as mod
+
+    return set(_NAME_RE.findall(mod.__doc__ or ""))
+
+
+def readme_names(root: str | None = None) -> set[str]:
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    path = os.path.join(root, "README.md")
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    m = re.search(r"^## Observability\b(.*?)(?=^## |\Z)", text,
+                  re.M | re.S)
+    if m is None:
+        return set()
+    return set(_NAME_RE.findall(m.group(1)))
+
+
+def metric_inventory_problems(root: str | None = None) -> list[str]:
+    """Human-readable metric-inventory drift complaints (empty = ok)."""
+    reg = registered_names()
+    problems: list[str] = []
+    gone = sorted(REQUIRED_FAMILIES - reg)
+    if gone:
+        problems.append(
+            "required durable-state/leader metric families no longer "
+            f"registered: {gone}"
+        )
+    for surface, found in (
+        ("metrics/metrics.py docstring", docstring_names()),
+        ('README "## Observability" section', readme_names(root)),
+    ):
+        found = _strip_series_suffixes(found, reg)
+        missing = sorted(reg - found)
+        phantom = sorted(found - reg)
+        if not found:
+            problems.append(f"{surface}: no metric names found at all")
+        if missing:
+            problems.append(
+                f"{surface}: registered but undocumented: {missing}"
+            )
+        if phantom:
+            problems.append(
+                f"{surface}: documented but not registered: {phantom}"
+            )
+    return problems

@@ -1,0 +1,156 @@
+"""JOURNAL-EMIT-ONCE (JE0xx): the durable-state mutator contract.
+
+state/manager.py's replay exactness rests on a contract the queue and
+cache docstrings state but nothing checks: every journaled public
+mutator reads the clock EXACTLY ONCE, applies its change through
+non-emitting internal helpers, and emits EXACTLY ONE record carrying
+that clock value. Two clock reads can stamp a record with a time the
+mutation didn't use (replay then derives different backoff/TTL
+deadlines); two emission sites can double-apply an op on replay;
+a clock read or emission inside an internal helper reintroduces both
+hazards through composition.
+
+Scope: every class that defines `set_journal` (the durable-state wiring
+point — SchedulingQueue and SchedulerCache today, any future journaled
+store automatically). Emission funnels (`_emit` / `_emit_node` methods)
+are the sanctioned single emission/clock point and are exempt from the
+helper rule; their reads/emits are charged to their callers.
+
+- JE001  a journaled public mutator's clock-read count != 1
+- JE002  a journaled public mutator has more than one emission site
+- JE003  an internal helper (non-funnel `_`-method) reads the clock or
+         emits a journal record
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import attribute_chain, own_body_nodes
+from .core import Finding, LintContext
+from .registry import PassBase
+
+_FUNNELS = frozenset({"_emit", "_emit_node"})
+
+
+class JournalEmitOncePass(PassBase):
+    name = "JOURNAL-EMIT-ONCE"
+    codes = {
+        "JE001": "journaled mutator must read the clock exactly once",
+        "JE002": "journaled mutator must emit exactly one record",
+        "JE003": "internal helper must not read the clock or emit",
+    }
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        index = ctx.index
+        findings: list[Finding] = []
+        for ci in index.classes.values():
+            if "set_journal" not in ci.methods:
+                continue
+            findings.extend(self._check_class(index, ci))
+        return findings
+
+    def _check_class(self, index, ci) -> list[Finding]:
+        funcs = {
+            m: index.funcs[fid] for m, fid in ci.methods.items()
+        }
+        direct: dict[str, dict] = {}
+        for m, f in funcs.items():
+            direct[m] = self._direct_counts(f)
+
+        # charge funnel clock reads / emissions to callers; fold in
+        # self-calls to other emitting methods (memoized, cycle-safe)
+        totals: dict[str, tuple[int, int]] = {}
+
+        def total(m: str, seen: frozenset = frozenset()) -> tuple[int, int]:
+            if m in totals:
+                return totals[m]
+            if m in seen or m not in direct:
+                return (0, 0)
+            d = direct[m]
+            clock, emits = d["clock"], d["emits"]
+            for callee, n in d["self_calls"].items():
+                if callee == m or callee not in direct:
+                    continue
+                if callee in _FUNNELS:
+                    c, e = direct[callee]["clock"], 1
+                    # a funnel call IS one emission; its internal clock
+                    # read is the mutator's one sanctioned read
+                    clock += n * c
+                    emits += n * e
+                else:
+                    c, e = total(callee, seen | {m})
+                    clock += n * c
+                    emits += n * e
+            # cache only top-level results: a value computed under a
+            # non-empty seen set may have had a cycle edge truncated to
+            # (0, 0), and caching the undercount would leak it into the
+            # callee's own top-level evaluation (mutually-recursive
+            # mutators would then dodge JE001/JE002)
+            if not seen:
+                totals[m] = (clock, emits)
+            return clock, emits
+
+        findings: list[Finding] = []
+        for m, f in sorted(funcs.items()):
+            if m in _FUNNELS or m == "set_journal":
+                continue
+            if m.startswith("_"):
+                d = direct[m]
+                hemits = d["emits"] + sum(
+                    n for c, n in d["self_calls"].items() if c in _FUNNELS
+                )
+                if d["clock"] or hemits:
+                    what = []
+                    if d["clock"]:
+                        what.append(f"reads the clock {d['clock']}x")
+                    if hemits:
+                        what.append(f"emits {hemits} record(s)")
+                    findings.append(Finding(
+                        f.file.rel, f.lineno, "JE003",
+                        f"internal helper {ci.name}.{m} "
+                        f"{' and '.join(what)}: helpers must stay "
+                        "non-emitting and clock-free so mutators "
+                        "compose without double-stamping (durability "
+                        "contract, state/manager.py)",
+                    ))
+                continue
+            clock, emits = total(m)
+            if emits == 0:
+                continue  # not a journaled mutator
+            if emits > 1:
+                findings.append(Finding(
+                    f.file.rel, f.lineno, "JE002",
+                    f"journaled mutator {ci.name}.{m} has {emits} "
+                    "journal emission sites: exactly one record per "
+                    "public entry point, or replay double-applies",
+                ))
+            if clock != 1:
+                findings.append(Finding(
+                    f.file.rel, f.lineno, "JE001",
+                    f"journaled mutator {ci.name}.{m} reads the clock "
+                    f"{clock} times: the contract is ONE read whose "
+                    "value both mutates state and stamps the record "
+                    "(replay pins its clock to that t)",
+                ))
+        return findings
+
+    @staticmethod
+    def _direct_counts(f) -> dict:
+        clock = 0
+        emits = 0
+        self_calls: dict[str, int] = {}
+        for node in own_body_nodes(f.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attribute_chain(node.func)
+            if chain is None or len(chain) != 2 or chain[0] != "self":
+                continue
+            attr = chain[1]
+            if attr == "_now":
+                clock += 1
+            elif attr == "_journal":
+                emits += 1
+            else:
+                self_calls[attr] = self_calls.get(attr, 0) + 1
+        return {"clock": clock, "emits": emits, "self_calls": self_calls}

@@ -1,0 +1,288 @@
+"""LOCK-DISCIPLINE (LD0xx): lock order + no blocking under a state lock.
+
+PR 3 documented a strict acquisition order for the scheduler's state
+locks — queue -> cache -> journal (state/manager.py docstring) — and a
+bind-path rule that the journal append is a pure buffer push: fsync,
+sleep, and file I/O belong to the writer thread, never to code holding
+a state lock. Nothing enforced either; this pass does.
+
+Per function (scoped to internal/, state/, core/flight_recorder by
+default) it tracks the `with <lock>` nesting, resolves calls within the
+scoped file set (name-based), and propagates each callee's transitive
+acquisitions and blocking effects to its callers:
+
+- LD001  acquiring a ranked lock while holding a higher-ranked one
+         (an inversion of queue -> cache -> journal is an ABBA deadlock
+         with the snapshot path, which holds queue+cache)
+- LD002  a blocking call — os.fsync, time.sleep, open()/os file ops, a
+         condition/event wait — made while any tracked lock is held
+
+Lock identity is structural: an attribute chain ending in `_lock` /
+`_cond` is a lock; a chain component naming queue/cache/journal (or
+the defining module's basename) gives its rank. Unranked locks (e.g.
+the flight recorder's timeline lock) still count as "held" for LD002.
+Re-acquiring an already-held lock is allowed (queue/cache are RLocks).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .callgraph import FuncInfo, attribute_chain
+from .core import Finding, LintContext
+from .registry import PassBase
+from .trace_safety import _module_aliases
+
+_DEFAULT_SCOPE = ("internal/", "state/", "core/flight_recorder")
+_RANK = {"queue": 0, "cache": 1, "journal": 2}
+_BASENAME_OWNER = {
+    "queue.py": "queue", "cache.py": "cache", "journal.py": "journal",
+}
+_LOCK_SUFFIXES = ("_lock", "_cond", "_condition")
+
+# (dotted chain) -> human description of the blocking primitive
+_BLOCKING_CHAINS = {
+    ("os", "fsync"): "os.fsync",
+    ("os", "replace"): "os.replace",
+    ("os", "rename"): "os.rename",
+    ("os", "unlink"): "os.unlink",
+    ("os", "listdir"): "os.listdir",
+    ("os", "makedirs"): "os.makedirs",
+    ("os", "open"): "os.open",
+    ("os", "fdopen"): "os.fdopen",
+    ("socket", "create_connection"): "socket.create_connection",
+    ("subprocess", "run"): "subprocess.run",
+}
+
+
+def lock_identity(
+    chain: tuple[str, ...], rel: str
+) -> str | None:
+    """Lock name for an attribute chain, or None if it isn't one.
+    Ranked locks return "queue"/"cache"/"journal"; everything else gets
+    a stable unranked identity."""
+    if not chain or not chain[-1].endswith(_LOCK_SUFFIXES):
+        return None
+    for part in chain[:-1]:
+        low = part.lower()
+        for owner in _RANK:
+            if owner in low:
+                return owner
+    owner = _BASENAME_OWNER.get(os.path.basename(rel))
+    if owner:
+        return owner
+    return f"{os.path.basename(rel)}:{'.'.join(chain)}"
+
+
+class _Summary:
+    __slots__ = ("acquires", "blocking")
+
+    def __init__(self) -> None:
+        # locks this function (transitively) acquires
+        self.acquires: set[str] = set()
+        # (description, waits_on_lock_or_None) blocking effects
+        self.blocking: set[tuple[str, str | None]] = set()
+
+
+class LockDisciplinePass(PassBase):
+    name = "LOCK-DISCIPLINE"
+    codes = {
+        "LD001": "lock acquisition inverts the queue -> cache -> "
+                 "journal order",
+        "LD002": "blocking call (fsync/sleep/file I/O/wait) while "
+                 "holding a state lock",
+    }
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        scope = tuple(self.args.get("scope", _DEFAULT_SCOPE))
+        index = ctx.index
+        self._index = index
+        self._scoped = {
+            fid: f for fid, f in index.funcs.items()
+            if any(s in f.file.rel for s in scope)
+        }
+        # name -> candidate funcs within scope (no generic blocklist:
+        # the scoped set is small enough that name matches are signal)
+        self._by_name: dict[str, list[FuncInfo]] = {}
+        for f in self._scoped.values():
+            if not f.name.startswith("<lambda"):
+                self._by_name.setdefault(f.name, []).append(f)
+        self._time_aliases = {}
+        for sf in ctx.files:
+            self._time_aliases[sf.rel] = _module_aliases(
+                sf, {"time": "time"}
+            )
+        self._summaries: dict[str, _Summary] = {}
+        self._in_progress: set[str] = set()
+        findings: list[Finding] = []
+        for fid in sorted(self._scoped):
+            self._walk_function(self._scoped[fid], findings)
+        return findings
+
+    # ---- summaries (transitive effects) ----------------------------------
+
+    def _summary(self, f: FuncInfo) -> _Summary:
+        hit = self._summaries.get(f.id)
+        if hit is not None:
+            return hit
+        if f.id in self._in_progress:  # recursion: break the cycle
+            return _Summary()
+        self._in_progress.add(f.id)
+        s = _Summary()
+        self._walk(f, list(f.node.body) if not isinstance(
+            f.node, ast.Lambda) else [f.node.body], [], None, s)
+        self._in_progress.discard(f.id)
+        self._summaries[f.id] = s
+        return s
+
+    def _walk_function(
+        self, f: FuncInfo, findings: list[Finding]
+    ) -> None:
+        s = _Summary()
+        body = [f.node.body] if isinstance(f.node, ast.Lambda) \
+            else list(f.node.body)
+        self._walk(f, body, [], findings, s)
+        self._summaries[f.id] = s
+
+    # ---- the walk --------------------------------------------------------
+
+    def _walk(
+        self, f: FuncInfo, nodes: list[ast.AST], held: list[str],
+        findings: list[Finding] | None, summary: _Summary,
+    ) -> None:
+        for node in nodes:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                       ast.ClassDef)
+            ):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                # items acquire LEFT TO RIGHT: each item's check must see
+                # the locks earlier items took (`with a, b:` is the same
+                # ABBA surface as nested withs)
+                cur_held = list(held)
+                for item in node.items:
+                    self._walk(
+                        f, [item.context_expr], cur_held, findings,
+                        summary,
+                    )
+                    chain = attribute_chain(item.context_expr)
+                    lock = lock_identity(chain, f.file.rel) \
+                        if chain else None
+                    if lock is not None:
+                        self._note_acquire(
+                            f, lock, node.lineno, cur_held, findings,
+                            summary,
+                        )
+                        cur_held = cur_held + [lock]
+                self._walk(
+                    f, list(node.body), cur_held, findings, summary
+                )
+                continue
+            if isinstance(node, ast.Call):
+                self._classify_call(f, node, held, findings, summary)
+            self._walk(
+                f, list(ast.iter_child_nodes(node)), held, findings,
+                summary,
+            )
+
+    def _note_acquire(
+        self, f: FuncInfo, lock: str, line: int, held: list[str],
+        findings: list[Finding] | None, summary: _Summary,
+        via: str | None = None,
+    ) -> None:
+        summary.acquires.add(lock)
+        if lock in held:
+            return  # re-entrant acquisition (RLocks)
+        rank = _RANK.get(lock)
+        if rank is None or findings is None:
+            return
+        above = [h for h in held if _RANK.get(h, -1) > rank]
+        if above:
+            tail = f" (via {via})" if via else ""
+            findings.append(Finding(
+                f.file.rel, line, "LD001",
+                f"{f.qualname} acquires the {lock} lock while holding "
+                f"{' + '.join(above)}{tail}: inverts the documented "
+                "queue -> cache -> journal order (ABBA deadlock with "
+                "the snapshot path)",
+            ))
+
+    def _note_blocking(
+        self, f: FuncInfo, desc: str, waits_on: str | None, line: int,
+        held: list[str], findings: list[Finding] | None,
+        summary: _Summary, via: str | None = None,
+    ) -> None:
+        summary.blocking.add((desc, waits_on))
+        if findings is None:
+            return
+        blockers = [h for h in held if h != waits_on]
+        if blockers:
+            tail = f" (via {via})" if via else ""
+            findings.append(Finding(
+                f.file.rel, line, "LD002",
+                f"{f.qualname} makes a blocking call ({desc}){tail} "
+                f"while holding the {' + '.join(blockers)} lock"
+                f"{'s' if len(blockers) > 1 else ''}: blocking work "
+                "belongs off the locked path (writer thread / after "
+                "release)",
+            ))
+
+    def _classify_call(
+        self, f: FuncInfo, node: ast.Call, held: list[str],
+        findings: list[Finding] | None, summary: _Summary,
+    ) -> None:
+        chain = attribute_chain(node.func)
+        if chain is None:
+            return
+        # direct blocking primitives
+        if chain == ("open",):
+            self._note_blocking(
+                f, "open()", None, node.lineno, held, findings, summary
+            )
+            return
+        if chain in _BLOCKING_CHAINS:
+            self._note_blocking(
+                f, _BLOCKING_CHAINS[chain], None, node.lineno, held,
+                findings, summary,
+            )
+            return
+        aliases = self._time_aliases.get(f.file.rel, {})
+        if (
+            len(chain) == 2 and aliases.get(chain[0]) == "time"
+            and chain[1] == "sleep"
+        ) or (len(chain) == 1 and aliases.get(chain[0]) == "time.sleep"):
+            self._note_blocking(
+                f, "time.sleep", None, node.lineno, held, findings,
+                summary,
+            )
+            return
+        if len(chain) >= 2 and chain[-1] == "wait":
+            lock = lock_identity(chain[:-1], f.file.rel)
+            self._note_blocking(
+                f, f"{'.'.join(chain)} wait", lock, node.lineno, held,
+                findings, summary,
+            )
+            return
+        # callee resolution within the scoped file set
+        name = chain[-1]
+        if name == "_journal":
+            # the injected journal emitter: DurableState._emit at runtime
+            name = "_emit"
+        for target in self._by_name.get(name, ()):
+            if target.id == f.id:
+                continue
+            ts = self._summary(target)
+            for lock in sorted(ts.acquires):
+                self._note_acquire(
+                    f, lock, node.lineno, held, findings, summary,
+                    via=target.qualname,
+                )
+            for desc, waits_on in sorted(
+                ts.blocking, key=lambda x: (x[0], x[1] or "")
+            ):
+                self._note_blocking(
+                    f, desc, waits_on, node.lineno, held, findings,
+                    summary, via=target.qualname,
+                )

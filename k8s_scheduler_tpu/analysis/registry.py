@@ -1,0 +1,76 @@
+"""Analysis-pass registry: name -> factory, the same plugin aesthetic as
+framework/registry.py — out-of-tree passes register exactly like the
+defaults, and scripts/schedlint.py drives whatever is registered."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .core import Finding, LintContext
+
+
+class PassBase:
+    """A schedlint pass. Subclasses set `name` (the registry key, also
+    the ISSUE-facing pass name like "TRACE-SAFETY"), `codes` (code ->
+    one-line description, the documentation surface README renders), and
+    implement `run`."""
+
+    name: str = ""
+    codes: dict[str, str] = {}
+
+    def __init__(self, args: dict | None = None):
+        self.args = args or {}
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        raise NotImplementedError
+
+
+Factory = Callable[[dict], PassBase]
+
+
+class PassRegistry:
+    def __init__(self) -> None:
+        self._factories: dict[str, Factory] = {}
+
+    def register(self, name: str, factory: Factory) -> None:
+        if name in self._factories:
+            raise ValueError(f"pass {name!r} already registered")
+        self._factories[name] = factory
+
+    def make(self, name: str, args: dict | None = None) -> PassBase:
+        if name not in self._factories:
+            raise KeyError(f"unknown pass {name!r}; registered: "
+                           f"{sorted(self._factories)}")
+        return self._factories[name](args or {})
+
+    def names(self) -> list[str]:
+        return sorted(self._factories)
+
+
+def default_registry() -> PassRegistry:
+    from .hygiene import HygienePass
+    from .inventory import InventoryDriftPass
+    from .journal_emit import JournalEmitOncePass
+    from .lock_discipline import LockDisciplinePass
+    from .trace_safety import TraceSafetyPass
+
+    r = PassRegistry()
+    for cls in (
+        TraceSafetyPass,
+        LockDisciplinePass,
+        JournalEmitOncePass,
+        InventoryDriftPass,
+        HygienePass,
+    ):
+        r.register(cls.name, lambda args, _cls=cls: _cls(args))
+    return r
+
+
+def all_codes(registry: PassRegistry | None = None) -> dict[str, str]:
+    """code -> description across every registered pass (the README
+    table's source of truth)."""
+    registry = registry or default_registry()
+    out: dict[str, str] = {}
+    for name in registry.names():
+        out.update(registry.make(name).codes)
+    return out

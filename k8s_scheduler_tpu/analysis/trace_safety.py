@@ -1,0 +1,320 @@
+"""TRACE-SAFETY (TS0xx): impure Python reachable from jitted programs.
+
+PR 1's worst bug was a lazy `from ..ops import preemption` INSIDE the
+traced post_filter: the module's top-level `jnp` constants were created
+under the active trace, and a later retrace read them as escaped
+tracers of a dead trace (UnexpectedTracerError, ~25 tests down). This
+pass walks the call graph from every jit entry point — the first
+argument of `_jit(...)`/`jax.jit(...)` calls, plus every compute hook
+of `PluginBase` subclasses (the plugin kernels are traced by
+definition) — and flags Python that must not run under a trace:
+
+- TS001  import statement inside a traced-reachable function (the PR 1
+         class; the message escalates when the imported module holds
+         module-level jnp constants)
+- TS002  host-impure call under trace: time.*, datetime.now/utcnow/
+         today/fromtimestamp, random.*, numpy.random.*, print
+- TS003  `global` declaration (module-state mutation) under trace
+- TS004  jnp.array/asarray over a Python literal list/tuple under trace
+         (a fresh device constant re-materialized per trace; hoist it
+         to module scope)
+
+The walk is deliberately over-approximate (see analysis/callgraph.py):
+a function passed as a callback (lax.scan/cond bodies, plugin hooks
+dispatched through the Framework) counts as called.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import CodeIndex, FuncInfo, attribute_chain, own_body_nodes
+from .core import Finding, LintContext, SourceFile
+from .registry import PassBase
+
+# the PluginBase hooks that are traced inside the cycle programs
+TRACED_PLUGIN_METHODS = frozenset({
+    "static_mask", "static_score", "dyn_mask", "dyn_score",
+    "extra_init", "extra_update", "dyn_mask_batched", "dyn_score_batched",
+    "extra_update_batched", "score_node_anchor", "post_filter",
+})
+
+_JIT_NAMES = frozenset({"jit", "pjit", "pmap", "_jit"})
+
+_DATETIME_IMPURE = frozenset({"now", "utcnow", "today", "fromtimestamp"})
+
+
+def _module_aliases(sf: SourceFile, targets: dict[str, str]) -> dict:
+    """alias -> canonical target for stdlib-ish modules we care about
+    (`targets` maps real module name -> canonical tag)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in targets:
+                    out[a.asname or a.name.split(".")[0]] = targets[a.name]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0 and node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":  # from jax import numpy as jnp
+                        out[a.asname or a.name] = "jnp"
+            elif node.level == 0 and node.module in targets:
+                tag = targets[node.module]
+                for a in node.names:
+                    if tag in ("time", "random"):
+                        # from time import monotonic -> bare-name call
+                        out[a.asname or a.name] = f"{tag}.{a.name}"
+                    elif tag == "datetime":
+                        # from datetime import datetime/date: the bound
+                        # class carries the impure .now()/.today()
+                        out[a.asname or a.name] = "datetime"
+    return out
+
+
+_ALIAS_TARGETS = {
+    "time": "time",
+    "datetime": "datetime",
+    "random": "random",
+    "numpy": "np",
+    "jax.numpy": "jnp",
+}
+
+
+def module_jnp_constants(sf: SourceFile) -> list[int]:
+    """Lines of module-level assignments whose value calls into jnp —
+    the constants that make a lazy import of this module trace-fatal."""
+    aliases = _module_aliases(sf, _ALIAS_TARGETS)
+    out = []
+    for stmt in sf.tree.body:
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            continue
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                chain = attribute_chain(node.func)
+                if chain and aliases.get(chain[0]) == "jnp":
+                    out.append(stmt.lineno)
+                    break
+    return out
+
+
+def _is_literal_array(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_literal_array(e) for e in node.elts)
+    return isinstance(node, ast.Constant)
+
+
+class TraceSafetyPass(PassBase):
+    name = "TRACE-SAFETY"
+    codes = {
+        "TS001": "import executed inside a jit-traced function",
+        "TS002": "host-impure call (time/datetime/random/print) under "
+                 "trace",
+        "TS003": "global-state mutation declared under trace",
+        "TS004": "jnp constant built from a Python literal under trace",
+    }
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        index = ctx.index
+        roots = self._roots(index)
+        reachable = index.reachable(roots)
+        findings: list[Finding] = []
+        for fid in sorted(reachable):
+            f = index.funcs[fid]
+            findings.extend(self._check_function(ctx, index, f))
+        return findings
+
+    # ---- root discovery --------------------------------------------------
+
+    def _roots(self, index: CodeIndex) -> set[str]:
+        roots: set[str] = set()
+        # 1) first argument of jit-wrapping calls — inside any function,
+        #    and at module scope (`cycle = jax.jit(fn)` in a script)
+        for f in index.funcs.values():
+            for node in own_body_nodes(f.node):
+                if isinstance(node, ast.Call):
+                    roots |= self._jit_call_targets(index, f, node)
+        for sf in index.files:
+            shim = FuncInfo(
+                id=f"{sf.rel}::<module>", file=sf, node=sf.tree,
+                name="<module>", qualname="<module>", cls=None,
+                parent=None, lineno=1,
+            )
+            for node in own_body_nodes(sf.tree):
+                if isinstance(node, ast.Call):
+                    roots |= self._jit_call_targets(index, shim, node)
+        # 2) decorator-form jit: @jax.jit / @jit / @partial(jax.jit, ..)
+        for f in index.funcs.values():
+            node = f.node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(self._is_jit_expr(d) for d in node.decorator_list):
+                    roots.add(f.id)
+        # 3) every compute hook of a PluginBase-derived class
+        for ci in index.subclasses_of("PluginBase"):
+            for mname, fid in ci.methods.items():
+                if mname in TRACED_PLUGIN_METHODS:
+                    roots.add(fid)
+        return roots
+
+    @staticmethod
+    def _is_jit_expr(expr: ast.AST) -> bool:
+        chain = attribute_chain(expr)
+        if chain and chain[-1] in _JIT_NAMES:
+            return True
+        if isinstance(expr, ast.Call):
+            fchain = attribute_chain(expr.func)
+            if fchain and fchain[-1] in _JIT_NAMES:
+                return True  # @jax.jit(static_argnums=...) factory form
+            if fchain and fchain[-1] == "partial" and expr.args:
+                achain = attribute_chain(expr.args[0])
+                return bool(achain and achain[-1] in _JIT_NAMES)
+        return False
+
+    def _jit_call_targets(
+        self, index: CodeIndex, f, node: ast.Call
+    ) -> set[str]:
+        chain = attribute_chain(node.func)
+        if not chain or chain[-1] not in _JIT_NAMES or not node.args:
+            return set()
+        return self._resolve_target(index, f, node.args[0])
+
+    def _resolve_target(self, index: CodeIndex, f, target) -> set[str]:
+        if isinstance(target, ast.Name):
+            return index.resolve_name(f, target.id)
+        if isinstance(target, ast.Lambda):
+            info = index.func_at(f.file.rel, target)
+            return {info.id} if info is not None else set()
+        if isinstance(target, ast.Attribute):
+            tchain = attribute_chain(target)
+            if tchain is not None:
+                return index.resolve_chain(f, tchain)
+            return set()
+        if isinstance(target, ast.Call):
+            # jax.jit(functools.partial(fn, ...)): trace through partial
+            fchain = attribute_chain(target.func)
+            if fchain and fchain[-1] == "partial" and target.args:
+                return self._resolve_target(index, f, target.args[0])
+        return set()
+
+    # ---- per-function checks ---------------------------------------------
+
+    def _check_function(
+        self, ctx: LintContext, index: CodeIndex, f: FuncInfo
+    ) -> list[Finding]:
+        sf = f.file
+        aliases = _module_aliases(sf, _ALIAS_TARGETS)
+        label = f.qualname
+        out: list[Finding] = []
+
+        def emit(code: str, line: int, msg: str) -> None:
+            out.append(Finding(sf.rel, line, code, msg))
+
+        for node in own_body_nodes(f.node):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                out.extend(self._import_finding(ctx, index, f, node))
+            elif isinstance(node, ast.Global):
+                emit(
+                    "TS003", node.lineno,
+                    f"`global {', '.join(node.names)}` in traced "
+                    f"function {label}: module state mutated under "
+                    "trace is trace-order-dependent",
+                )
+            elif isinstance(node, ast.Call):
+                chain = attribute_chain(node.func)
+                if chain is None:
+                    continue
+                tag = aliases.get(chain[0])
+                if chain == ("print",):
+                    emit(
+                        "TS002", node.lineno,
+                        f"print() in traced function {label}: runs at "
+                        "trace time only (use jax.debug.print)",
+                    )
+                elif tag == "time" and len(chain) > 1:
+                    emit(
+                        "TS002", node.lineno,
+                        f"time.{chain[-1]}() in traced function "
+                        f"{label}: clock reads freeze into the compiled "
+                        "program as trace-time constants",
+                    )
+                elif tag and tag.startswith("time.") and len(chain) == 1:
+                    emit(
+                        "TS002", node.lineno,
+                        f"{tag}() in traced function {label}: clock "
+                        "reads freeze into the compiled program",
+                    )
+                elif tag == "datetime" and chain[-1] in _DATETIME_IMPURE:
+                    emit(
+                        "TS002", node.lineno,
+                        f"datetime {chain[-1]}() in traced function "
+                        f"{label}: wall-clock under trace",
+                    )
+                elif tag == "random" and len(chain) > 1:
+                    emit(
+                        "TS002", node.lineno,
+                        f"random.{chain[-1]}() in traced function "
+                        f"{label}: host RNG under trace (use jax.random "
+                        "with an explicit key)",
+                    )
+                elif tag and tag.startswith("random.") and len(chain) == 1:
+                    emit(
+                        "TS002", node.lineno,
+                        f"{tag}() in traced function {label}: host RNG "
+                        "under trace (use jax.random)",
+                    )
+                elif (
+                    tag == "np" and len(chain) >= 3
+                    and chain[1] == "random"
+                ):
+                    emit(
+                        "TS002", node.lineno,
+                        f"numpy.random.{chain[-1]}() in traced function "
+                        f"{label}: host RNG under trace",
+                    )
+                elif (
+                    tag == "jnp" and len(chain) == 2
+                    and chain[1] in ("array", "asarray")
+                    and node.args and _is_literal_array(node.args[0])
+                ):
+                    emit(
+                        "TS004", node.lineno,
+                        f"jnp.{chain[1]}(<literal>) in traced function "
+                        f"{label}: hoist the constant to module scope",
+                    )
+        return out
+
+    def _import_finding(
+        self, ctx: LintContext, index: CodeIndex, f: FuncInfo,
+        node: ast.Import | ast.ImportFrom,
+    ) -> list[Finding]:
+        sf = f.file
+        if isinstance(node, ast.Import):
+            targets = [a.name for a in node.names]
+            shown = ", ".join(targets)
+        else:
+            base = index._resolve_from(sf, node) or (node.module or "")
+            targets = []
+            for a in node.names:
+                cand = f"{base}.{a.name}" if base else a.name
+                if ctx.module(cand) is not None:
+                    targets.append(cand)
+                elif base:
+                    targets.append(base)
+            shown = f"{'.' * node.level}{node.module or ''} import " + \
+                ", ".join(a.name for a in node.names)
+        extra = ""
+        for t in targets:
+            target_sf = ctx.module(t)
+            if target_sf is not None and module_jnp_constants(target_sf):
+                extra = (
+                    f" — {t} holds module-level jnp constants, which "
+                    "would be created under the active trace and read "
+                    "as escaped tracers on retrace (the PR 1 "
+                    "UnexpectedTracerError class)"
+                )
+                break
+        return [Finding(
+            sf.rel, node.lineno, "TS001",
+            f"import inside traced function {f.qualname} (from {shown})"
+            ": a first import under trace runs arbitrary module-level "
+            f"code inside the jit{extra}; import at module scope",
+        )]

@@ -30,6 +30,7 @@ from ..framework.runtime import Framework
 from ..models.encoding import ClusterSnapshot
 from ..ops import commit as commit_ops
 from ..ops import rounds as rounds_ops
+from ..ops import volumes as volumes_ops
 
 
 @jax.tree_util.register_dataclass
@@ -298,7 +299,6 @@ def _make_pv_choice_fn(ctx: CycleContext):
     VolumeBinding extra state. None when the snapshot has no volumes."""
     if not ctx.snap.has_volumes:
         return None
-    from ..ops import volumes as volumes_ops
 
     def pv_choice_fn(vsnap, node_of, live, ext_state):
         claimed = ext_state.get("VolumeBinding")
@@ -346,7 +346,6 @@ def _pv_claimed_after_unwind(snap, ctx, extra, assignment, dropped):
         return pv
     if not snap.has_volumes:
         return pv
-    from ..ops import volumes as volumes_ops
 
     def refold(_):
         accepted = snap.pod_valid & (assignment >= 0)  # post-unwind

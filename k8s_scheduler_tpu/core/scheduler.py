@@ -28,7 +28,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time as _time
-from typing import Callable, Sequence
+from typing import Callable
 
 import numpy as np
 
@@ -667,7 +667,10 @@ class Scheduler:
         assignment = assignment[: len(pending)]
         gang_dropped = gang_dropped[: len(pending)]
         filter_names = framework.filter_names
-        stats.gang_dropped = int(gang_dropped.sum())
+        # accumulate like every sibling counter: in a multi-profile
+        # cycle `=` would report only the LAST profile's gang drops
+        profile_gang_dropped = int(gang_dropped.sum())
+        stats.gang_dropped += profile_gang_dropped
         t_device = self._now()
         self.metrics.cycle_duration.labels(phase="device").observe(
             t_device - t_encode
@@ -940,7 +943,7 @@ class Scheduler:
                 bind_errors=stats.bind_errors - bb,
                 preemptors=stats.preemptors - pb,
                 victims=stats.victims - vb,
-                gang_dropped=int(stats.gang_dropped),
+                gang_dropped=profile_gang_dropped,
                 fetch_bytes=int(st.get("fetch_bytes", 0)),
                 retry_strikes_total=sum(RESILIENT_STRIKES.values()),
                 queue_active=qc.get("active", 0),

@@ -35,6 +35,7 @@ from typing import Any, Protocol, runtime_checkable
 import jax.numpy as jnp
 
 from ..models.encoding import ClusterSnapshot
+from ..ops import interpod, labels
 
 
 class CycleContext:
@@ -55,25 +56,17 @@ class CycleContext:
 
     @property
     def expr_node_mask(self) -> jnp.ndarray:  # bool [Ex, N]
-        from ..ops import labels
-
         return self.get("expr_node_mask", labels.expr_node_mask)
 
     @property
     def matched_pending(self) -> jnp.ndarray:  # bool [S, P]
-        from ..ops import interpod
-
         return self.get("matched_pending", interpod.matched_pending)
 
     @property
     def matched_existing(self) -> jnp.ndarray:  # bool [S, E]
-        from ..ops import interpod
-
         return self.get("matched_existing", interpod.matched_existing)
 
     def initial_affinity_state(self):
-        from ..ops import interpod
-
         return self.get(
             "initial_affinity_state",
             lambda s: interpod.initial_state(s, self.matched_existing),

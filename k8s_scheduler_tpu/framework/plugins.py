@@ -14,11 +14,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import images as images_ops
+from ..ops import interpod as interpod_ops
 from ..ops import labels as labels_ops
 from ..ops import ports as ports_ops
 from ..ops import preemption as preemption_ops
 from ..ops import resources as res_ops
 from ..ops import taints as taints_ops
+from ..ops import volumes as volumes_ops
 from .interfaces import CycleContext, PluginBase
 
 
@@ -231,8 +233,6 @@ class VolumeBinding(PluginBase):
     name = "VolumeBinding"
 
     def static_mask(self, ctx: CycleContext):
-        from ..ops import volumes as volumes_ops
-
         if not ctx.snap.has_volumes:
             return None
         return volumes_ops.volume_mask(ctx.snap, ctx.expr_node_mask)
@@ -243,15 +243,11 @@ class VolumeBinding(PluginBase):
         return bool(snap.has_volumes and snap.pv_avail.shape[0] > 0)
 
     def extra_init(self, ctx: CycleContext):
-        import jax.numpy as jnp
-
         if not self._has_static_claims(ctx.snap):
             return None
         return jnp.zeros((ctx.snap.pv_avail.shape[0],), bool)
 
     def dyn_mask(self, ctx: CycleContext, p, node_requested, extra):
-        from ..ops import volumes as volumes_ops
-
         if not self._has_static_claims(ctx.snap):
             return None
         # per-pod ROW form: the scan calls this once per step, and the
@@ -262,8 +258,6 @@ class VolumeBinding(PluginBase):
 
     def dyn_mask_batched(self, ctx: CycleContext, node_requested, extra,
                          shared):
-        from ..ops import volumes as volumes_ops
-
         if not self._has_static_claims(ctx.snap):
             return None
         return volumes_ops.volume_mask_unbound(
@@ -271,12 +265,8 @@ class VolumeBinding(PluginBase):
         )
 
     def extra_update(self, ctx: CycleContext, extra, p, node, committed):
-        import jax.numpy as jnp
-
         if extra is None:
             return extra
-        from ..ops import volumes as volumes_ops
-
         snap = ctx.snap
         claimed = extra
         MVol = snap.pod_vol_mode.shape[1]
@@ -305,8 +295,6 @@ class VolumeBinding(PluginBase):
                              node_of):
         if extra is None:
             return extra
-        from ..ops import volumes as volumes_ops
-
         snap = ctx.snap
         # fixed-point fold: exact for ANY batch (diagnosis replays a
         # whole cycle's placements at once, where same-class claimants
@@ -359,8 +347,6 @@ def _affinity_state(ctx: CycleContext, extra):
 
 
 def _update_affinity_state(ctx: CycleContext, name, state, p, node, committed):
-    from ..ops import interpod as interpod_ops
-
     if ctx._cache.get(_AFFINITY_OWNER_KEY) != name:
         return state
     return interpod_ops.affinity_update(
@@ -370,8 +356,6 @@ def _update_affinity_state(ctx: CycleContext, name, state, p, node, committed):
 
 def _update_affinity_state_batched(ctx: CycleContext, name, state, accepted,
                                    node_of):
-    from ..ops import interpod as interpod_ops
-
     if ctx._cache.get(_AFFINITY_OWNER_KEY) != name:
         return state
     return interpod_ops.affinity_update_batched(
@@ -382,8 +366,6 @@ def _update_affinity_state_batched(ctx: CycleContext, name, state, accepted,
 def _shared_cbn(ctx: CycleContext, state, shared):
     """counts-by-node [K*S, N] for the current round, computed once and
     shared between InterPodAffinity and PodTopologySpread."""
-    from ..ops import interpod as interpod_ops
-
     if "cbn" not in shared:
         shared["cbn"] = interpod_ops.counts_by_node(ctx.snap, state)
     return shared["cbn"]
@@ -399,8 +381,6 @@ class InterPodAffinity(PluginBase):
         return _claim_affinity_state(ctx, self.name)
 
     def dyn_mask(self, ctx: CycleContext, p, node_requested, extra):
-        from ..ops import interpod as interpod_ops
-
         if not ctx.snap.has_inter_pod_affinity:
             return None
         return interpod_ops.affinity_dyn_mask(
@@ -408,8 +388,6 @@ class InterPodAffinity(PluginBase):
         )
 
     def dyn_score(self, ctx: CycleContext, p, node_requested, extra, feasible):
-        from ..ops import interpod as interpod_ops
-
         if not ctx.snap.has_inter_pod_affinity:
             return None
         return interpod_ops.affinity_dyn_score(
@@ -421,8 +399,6 @@ class InterPodAffinity(PluginBase):
 
     def dyn_mask_batched(self, ctx: CycleContext, node_requested, extra,
                          shared):
-        from ..ops import interpod as interpod_ops
-
         if not ctx.snap.has_inter_pod_affinity:
             return None
         state = _affinity_state(ctx, extra)
@@ -433,8 +409,6 @@ class InterPodAffinity(PluginBase):
 
     def dyn_score_batched(self, ctx: CycleContext, node_requested, extra,
                           feasible, shared):
-        from ..ops import interpod as interpod_ops
-
         if not ctx.snap.has_inter_pod_affinity:
             return None
         state = _affinity_state(ctx, extra)
@@ -489,8 +463,6 @@ class PodTopologySpread(PluginBase):
         return _claim_affinity_state(ctx, self.name)
 
     def dyn_mask(self, ctx: CycleContext, p, node_requested, extra):
-        from ..ops import interpod as interpod_ops
-
         if not ctx.snap.has_topology_spread:
             return None
         return interpod_ops.spread_dyn_mask(
@@ -498,8 +470,6 @@ class PodTopologySpread(PluginBase):
         )
 
     def dyn_score(self, ctx: CycleContext, p, node_requested, extra, feasible):
-        from ..ops import interpod as interpod_ops
-
         if not ctx.snap.has_topology_spread:
             return None
         return interpod_ops.spread_dyn_score(
@@ -511,8 +481,6 @@ class PodTopologySpread(PluginBase):
 
     def dyn_mask_batched(self, ctx: CycleContext, node_requested, extra,
                          shared):
-        from ..ops import interpod as interpod_ops
-
         if not ctx.snap.has_topology_spread:
             return None
         state = _affinity_state(ctx, extra)
@@ -525,8 +493,6 @@ class PodTopologySpread(PluginBase):
 
     def dyn_score_batched(self, ctx: CycleContext, node_requested, extra,
                           feasible, shared):
-        from ..ops import interpod as interpod_ops
-
         if not ctx.snap.has_topology_spread:
             return None
         state = _affinity_state(ctx, extra)

@@ -20,8 +20,6 @@ from .api import (
     NodeAffinity,
     NodeSelectorRequirement,
     NodeSelectorTerm,
-    NodeSpec,
-    NodeStatus,
     ObjectMeta,
     Pod,
     PodAffinity,

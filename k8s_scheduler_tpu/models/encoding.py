@@ -31,7 +31,7 @@ every pod's encoded label list.
 from __future__ import annotations
 
 import dataclasses
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, NamedTuple, Sequence
 
 import numpy as np
@@ -1815,10 +1815,16 @@ class SnapshotEncoder:
     ):
         """Encode + pack in one step: returns an EncodedFrame whose
         wbuf/bbuf are the persistent arena buffers (valid until the NEXT
-        encode call — consumers must dispatch/copy before then; JAX
-        copies host arguments synchronously at call time), `snap` is a
-        ClusterSnapshot whose array fields are views into them, and
-        `dirty` names the rewritten pod slots (None = full rebuild)."""
+        encode call). Consumers must have FETCHED an in-flight program's
+        outputs before the next encode rewrites the arena: jax's CPU
+        backend copies a jit's numpy arguments asynchronously on the
+        dispatch thread, so a rewrite racing a dispatch can tear the
+        copy (reproduced with a 15-line pure-jax loop). The serving
+        pipeline provides exactly this ordering — dispatch k+1 is
+        refused until cycle k's decisions were fetched
+        (ServingPipeline.dispatch). `snap` is a ClusterSnapshot whose
+        array fields are views into the buffers, and `dirty` names the
+        rewritten pod slots (None = full rebuild)."""
         ds = self._delta_state
         if ds is not None and self._arena_spec is not None:
             ok = self._delta_precheck(

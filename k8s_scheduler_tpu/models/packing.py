@@ -22,6 +22,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .encoding import ClusterSnapshot
@@ -88,9 +90,6 @@ def pack(snap: ClusterSnapshot, spec: PackSpec):
 
 def unpack(wbuf, bbuf, spec: PackSpec) -> ClusterSnapshot:
     """Rebuild the snapshot inside a trace from the packed buffers."""
-    import jax
-    import jax.numpy as jnp
-
     kw = dict(spec.aux)
     for name, dt, shape, off in spec.words:
         n = int(np.prod(shape, dtype=np.int64)) if shape else 1

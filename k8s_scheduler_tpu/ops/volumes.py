@@ -44,6 +44,8 @@ crossing sets beyond 7 slots only).
 
 from __future__ import annotations
 
+import itertools
+
 import jax
 import jax.numpy as jnp
 
@@ -84,8 +86,6 @@ def _hall_subsets(MVol: int):
     >6-slot pods with CROSSING sets (not currently producible) retain a
     residual (PARITY #8). MVol is a sticky pad dim with bucket 2; real
     pods rarely mount > 4 PVCs."""
-    import itertools
-
     if MVol <= 6:
         sizes = range(2, MVol + 1)
     else:
@@ -312,8 +312,6 @@ def _sdr_other_subsets(MVol: int, j: int):
     adds the per-pod dominance groups, which keep the capped regime
     exact for laminar candidate families at any slot count (crossing
     sets beyond 7 slots remain a PARITY #8 residual)."""
-    import itertools
-
     others = [t for t in range(MVol) if t != j]
     if len(others) <= 6:
         return [

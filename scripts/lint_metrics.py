@@ -1,129 +1,46 @@
 #!/usr/bin/env python
-"""Metric-inventory drift check.
+"""Metric-inventory drift check — THIN SHIM.
 
-Every metric registered by `SchedulerMetrics` (metrics/metrics.py) must
-be listed in BOTH documentation surfaces:
-
-- the `metrics/metrics.py` module docstring (the in-code inventory), and
-- the README "Observability" metric table;
-
-and neither surface may name a metric that is no longer registered.
-Dashboards are built from the docs — silent drift in either direction is
-exactly the kind of rot this repo's PARITY/measurement-honesty rules
-exist to prevent.
-
-Runs standalone (exit 1 + a diff on drift):
+The real check moved into the schedlint framework as the
+INVENTORY-DRIFT pass (`k8s_scheduler_tpu/analysis/inventory.py`), which
+also cross-checks config keys <-> CLI flags <-> the README tables. This
+path keeps the historical entry point working:
 
     JAX_PLATFORMS=cpu python scripts/lint_metrics.py
 
-and as a tier-1-adjacent test (tests/test_metrics.py imports
-`check_inventory`). Counter families are normalized to their exposition
-names (`*_total`); histogram/summary families are listed by their base
-name (the `_bucket`/`_count`/`_sum` series are implied).
+and `tests/test_metrics.py` keeps importing `check_inventory` from
+here. Prefer `python scripts/schedlint.py` (optionally
+`--passes INVENTORY-DRIFT`) for the full surface.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-_NAME_RE = re.compile(r"\bscheduler_[a-z0-9_]+\b")
+from k8s_scheduler_tpu.analysis.inventory import (  # noqa: E402
+    REQUIRED_FAMILIES,
+    docstring_names,
+    metric_inventory_problems,
+    readme_names,
+    registered_names,
+)
 
-# Families that MUST exist: the durable-state (journal/snapshot) and
-# leader-election surfaces are operational contracts — dashboards and
-# the failover runbook depend on them, so their silent removal from the
-# registry is a lint failure even though the two-way doc check above
-# would only notice if the docs were cleaned up in the same commit.
-REQUIRED_FAMILIES = {
-    "scheduler_journal_appends_total",
-    "scheduler_journal_bytes_total",
-    "scheduler_journal_fsync_seconds",
-    "scheduler_journal_buffer_depth",
-    "scheduler_journal_segments",
-    "scheduler_snapshot_writes_total",
-    "scheduler_snapshot_duration_seconds",
-    "scheduler_snapshot_last_bytes",
-    "scheduler_snapshot_last_restore_records",
-    "scheduler_snapshot_last_restore_seconds",
-    "scheduler_leader_state",
-    "scheduler_leader_lease_age_seconds",
-}
-
-
-def registered_names() -> set[str]:
-    """Metric families registered on a fresh SchedulerMetrics, in
-    Prometheus exposition naming (counters get their _total suffix)."""
-    from k8s_scheduler_tpu.metrics import SchedulerMetrics
-
-    names: set[str] = set()
-    for fam in SchedulerMetrics().registry.collect():
-        name = fam.name
-        if fam.type == "counter":
-            name += "_total"
-        names.add(name)
-    return names
-
-
-def _strip_series_suffixes(names: set[str], families: set[str]) -> set[str]:
-    """Collapse `foo_bucket`/`foo_count`/`foo_sum`/`foo_created` doc
-    mentions onto their family name so prose quoting a specific series
-    does not count as a phantom metric."""
-    out = set()
-    for n in names:
-        base = re.sub(r"_(bucket|count|sum|created)$", "", n)
-        out.add(base if base in families and n not in families else n)
-    return out
-
-
-def docstring_names() -> set[str]:
-    import k8s_scheduler_tpu.metrics.metrics as mod
-
-    return set(_NAME_RE.findall(mod.__doc__ or ""))
-
-
-def readme_names() -> set[str]:
-    path = os.path.join(REPO, "README.md")
-    with open(path) as f:
-        text = f.read()
-    m = re.search(r"^## Observability\b(.*?)(?=^## |\Z)", text,
-                  re.M | re.S)
-    if m is None:
-        return set()
-    return set(_NAME_RE.findall(m.group(1)))
+__all__ = [
+    "REQUIRED_FAMILIES",
+    "check_inventory",
+    "docstring_names",
+    "readme_names",
+    "registered_names",
+]
 
 
 def check_inventory() -> list[str]:
     """Returns a list of human-readable drift complaints (empty = ok)."""
-    reg = registered_names()
-    problems: list[str] = []
-    gone = sorted(REQUIRED_FAMILIES - reg)
-    if gone:
-        problems.append(
-            "required durable-state/leader metric families no longer "
-            f"registered: {gone}"
-        )
-    for surface, found in (
-        ("metrics/metrics.py docstring", docstring_names()),
-        ('README "## Observability" section', readme_names()),
-    ):
-        found = _strip_series_suffixes(found, reg)
-        missing = sorted(reg - found)
-        phantom = sorted(found - reg)
-        if not found:
-            problems.append(f"{surface}: no metric names found at all")
-        if missing:
-            problems.append(
-                f"{surface}: registered but undocumented: {missing}"
-            )
-        if phantom:
-            problems.append(
-                f"{surface}: documented but not registered: {phantom}"
-            )
-    return problems
+    return metric_inventory_problems(REPO)
 
 
 def main() -> int:

@@ -21,7 +21,7 @@ from k8s_scheduler_tpu.utils.compilation_cache import enable_compilation_cache
 enable_compilation_cache()
 
 from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
-from k8s_scheduler_tpu.core import build_cycle_fn, build_preemption_fn
+from k8s_scheduler_tpu.core import build_cycle_fn
 from k8s_scheduler_tpu.models import SnapshotEncoder
 
 

@@ -6,7 +6,6 @@ Run:  python scripts/probe_shortlist_prims.py
 """
 
 import sys
-import time
 
 sys.path.insert(0, ".")
 

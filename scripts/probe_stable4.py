@@ -13,7 +13,6 @@ import jax
 from k8s_scheduler_tpu.utils.compilation_cache import enable_compilation_cache
 
 enable_compilation_cache()
-import numpy as np
 
 from bench_suite import make_config_base, make_config_workload, _pad
 from devtime import report

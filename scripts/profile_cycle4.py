@@ -18,12 +18,10 @@ import time
 sys.path.insert(0, ".")
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
 from k8s_scheduler_tpu.core import build_cycle_fn, build_preemption_fn
-from k8s_scheduler_tpu.core.cycle import sampling_mask
 from k8s_scheduler_tpu.framework.interfaces import CycleContext
 from k8s_scheduler_tpu.framework.runtime import Framework
 from k8s_scheduler_tpu.models import SnapshotEncoder

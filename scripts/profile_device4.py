@@ -12,8 +12,6 @@ import jax
 from k8s_scheduler_tpu.utils.compilation_cache import enable_compilation_cache
 
 enable_compilation_cache()
-import jax.numpy as jnp
-import numpy as np
 
 from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
 from devtime import report

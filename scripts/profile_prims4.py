@@ -9,7 +9,6 @@ sys.path.insert(0, ".")
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from bench_suite import make_config_base, make_config_workload, _pad
 from devtime import report

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""schedlint: run the repo's static-analysis passes.
+
+    JAX_PLATFORMS=cpu python scripts/schedlint.py            # lint the tree
+    python scripts/schedlint.py --json                       # machine output
+    python scripts/schedlint.py --passes TRACE-SAFETY        # one pass
+    python scripts/schedlint.py --list-codes                 # code inventory
+    python scripts/schedlint.py --write-baseline             # regrandfather
+
+Exit status: 0 = no unsuppressed, non-baselined findings; 1 = findings;
+2 = usage error. The committed baseline is .schedlint-baseline.json at
+the repo root (line-independent entries; shrink it, don't grow it).
+See README "Static analysis" for pass/code docs and the
+`# schedlint: disable=CODE` suppression syntax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, ".schedlint-baseline.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="schedlint",
+        description="repo-native static analysis (trace safety, lock "
+        "discipline, journal emit-once, inventory drift, hygiene)",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/dirs to lint (default: k8s_scheduler_tpu + scripts)",
+    )
+    ap.add_argument(
+        "--passes", default="",
+        help="comma-separated pass names (default: all registered)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit one JSON object (findings + suppressed + "
+        "grandfathered counts) so drivers can diff across PRs",
+    )
+    ap.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="baseline file ('' = none)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current unsuppressed findings as the new "
+        "baseline and exit 0",
+    )
+    ap.add_argument(
+        "--list-codes", action="store_true",
+        help="print every registered pass + finding code and exit",
+    )
+    args = ap.parse_args(argv)
+
+    from k8s_scheduler_tpu.analysis import (
+        default_registry,
+        run_lint,
+        write_baseline,
+    )
+
+    registry = default_registry()
+    if args.list_codes:
+        for name in registry.names():
+            p = registry.make(name)
+            print(name)
+            for code, desc in sorted(p.codes.items()):
+                print(f"  {code}  {desc}")
+        return 0
+
+    passes = None
+    if args.passes:
+        passes = [p.strip() for p in args.passes.split(",") if p.strip()]
+        unknown = sorted(set(passes) - set(registry.names()))
+        if unknown:
+            print(
+                f"schedlint: unknown pass(es) {unknown}; registered: "
+                f"{registry.names()}", file=sys.stderr,
+            )
+            return 2
+
+    try:
+        result = run_lint(
+            REPO,
+            paths=args.paths or None,
+            registry=registry,
+            passes=passes,
+            baseline_path="" if args.write_baseline else args.baseline,
+        )
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if result.files_scanned == 0:
+        print(
+            "schedlint: 0 files scanned — nothing to lint is a "
+            "configuration error, not a pass", file=sys.stderr,
+        )
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.baseline or DEFAULT_BASELINE, result.findings)
+        print(
+            f"schedlint: baseline written with {len(result.findings)} "
+            f"finding(s) -> {args.baseline or DEFAULT_BASELINE}"
+        )
+        return 0
+
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2))
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(str(f), file=sys.stderr)
+    tail = []
+    if result.suppressed:
+        tail.append(f"{len(result.suppressed)} suppressed")
+    if result.grandfathered:
+        tail.append(f"{len(result.grandfathered)} grandfathered")
+    suffix = f" ({', '.join(tail)})" if tail else ""
+    if result.findings:
+        print(
+            f"schedlint: {len(result.findings)} finding(s) over "
+            f"{result.files_scanned} files{suffix}", file=sys.stderr,
+        )
+        return 1
+    print(
+        f"schedlint: ok — {result.files_scanned} files, passes: "
+        f"{', '.join(result.passes_run)}{suffix}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

@@ -8,7 +8,7 @@ from k8s_scheduler_tpu.utils.compilation_cache import enable_compilation_cache
 
 enable_compilation_cache()
 import numpy as np
-from bench_suite import make_config_base, make_config_workload, CONFIG_SHAPES, _pad
+from bench_suite import make_config_base, make_config_workload, _pad
 from k8s_scheduler_tpu.core import build_packed_cycle_carry_fn, build_stable_state_fn
 from k8s_scheduler_tpu.core.cycle import CarryKeeper
 from k8s_scheduler_tpu.models import SnapshotEncoder

@@ -16,7 +16,6 @@ from devtime import devtime
 from k8s_scheduler_tpu.core.cycle import build_cycle_fn
 from k8s_scheduler_tpu.models import SnapshotEncoder
 from k8s_scheduler_tpu.framework.runtime import Framework
-from k8s_scheduler_tpu.framework.interfaces import CycleContext
 from k8s_scheduler_tpu.ops import rounds as rounds_ops
 
 

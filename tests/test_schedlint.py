@@ -1,0 +1,531 @@
+"""schedlint framework tests: known-bad fixture snippets per pass —
+including a reproduction of PR 1's lazy-import-under-trace bug and a
+cache -> queue lock inversion — plus suppression/baseline round-trips
+and the tier-1 gate that keeps the real tree clean.
+
+Fixture trees are written under tmp_path and linted with
+`run_lint(root=tmp_path, paths=["."])`; the passes detect their targets
+structurally (jit entry points, PluginBase subclasses, `set_journal`
+classes, lock attribute chains), so the fixtures need no imports of the
+real package.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from k8s_scheduler_tpu.analysis import (
+    default_registry,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from k8s_scheduler_tpu.analysis.registry import PassRegistry, all_codes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_fixture(tmp_path, files: dict[str, str], passes=None,
+                 baseline_path=None):
+    for rel, src in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(textwrap.dedent(src))
+    return run_lint(
+        str(tmp_path),
+        paths=["."],
+        passes=passes,
+        pass_args={"INVENTORY-DRIFT": {"metrics_runtime": False}},
+        baseline_path=baseline_path,
+    )
+
+
+def codes_at(result, code):
+    return [f for f in result.findings if f.code == code]
+
+
+# ---- TRACE-SAFETY --------------------------------------------------------
+
+
+def test_trace_safety_catches_pr1_lazy_import_under_trace(tmp_path):
+    """The exact PR 1 bug shape: a PostFilter plugin lazily imports an
+    ops module whose module-level jnp constants would be created under
+    the active trace (UnexpectedTracerError on retrace)."""
+    result = lint_fixture(tmp_path, {
+        "pkg/ops/preemption.py": """\
+            import jax.numpy as jnp
+
+            _BIG_I32 = jnp.int32(2**31 - 1)
+
+
+            def run_preemption(ctx):
+                return _BIG_I32
+        """,
+        "pkg/plugins.py": """\
+            class PluginBase:
+                def post_filter(self, ctx):
+                    return None
+
+
+            class DefaultPreemption(PluginBase):
+                def post_filter(self, ctx):
+                    from .ops import preemption as preemption_ops
+                    return preemption_ops.run_preemption(ctx)
+        """,
+    }, passes=["TRACE-SAFETY"])
+    (f,) = codes_at(result, "TS001")
+    assert f.file == "pkg/plugins.py"
+    assert f.line == 8  # the lazy import inside the traced post_filter
+    assert "jnp constants" in f.message
+    assert "UnexpectedTracerError" in f.message
+
+
+def test_trace_safety_walks_call_graph_from_jit_entry(tmp_path):
+    """time/global/literal-constant violations in a helper are caught
+    because the helper is reachable from a jax.jit'd closure — and NOT
+    flagged in host-side build code."""
+    result = lint_fixture(tmp_path, {
+        "prog.py": """\
+            import time
+
+            import jax
+            import jax.numpy as jnp
+
+
+            def helper(x):
+                return x + time.monotonic()
+
+
+            def build():
+                import math  # host side: runs at build, never traced
+
+                def cycle(x):
+                    global _COUNT
+                    k = jnp.array([1, 2, 3])
+                    return helper(x) + k.sum() + math.pi
+
+                return jax.jit(cycle)
+        """,
+    }, passes=["TRACE-SAFETY"])
+    (ts2,) = codes_at(result, "TS002")
+    assert (ts2.file, ts2.line) == ("prog.py", 8)
+    assert "time.monotonic" in ts2.message
+    (ts3,) = codes_at(result, "TS003")
+    assert ts3.line == 15
+    (ts4,) = codes_at(result, "TS004")
+    assert ts4.line == 16
+    # the host-side `import math` inside build() must NOT be flagged
+    assert codes_at(result, "TS001") == []
+
+
+def test_trace_safety_covers_plugin_compute_hooks(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "plug.py": """\
+            import random
+
+
+            class PluginBase:
+                def static_mask(self, ctx):
+                    return None
+
+
+            class Jittery(PluginBase):
+                def static_mask(self, ctx):
+                    return random.random()
+
+                def host_helper(self):
+                    return random.random()  # not a compute hook: fine
+        """,
+    }, passes=["TRACE-SAFETY"])
+    (f,) = codes_at(result, "TS002")
+    assert f.line == 11
+    assert "random" in f.message
+
+
+def test_trace_safety_decorator_and_module_level_jit(tmp_path):
+    """Roots are also found in decorator form (@partial(jax.jit, ...))
+    and at module scope (`X = jax.jit(fn)`)."""
+    result = lint_fixture(tmp_path, {
+        "prog.py": """\
+            import time
+            from functools import partial
+
+            import jax
+
+
+            @partial(jax.jit, static_argnums=0)
+            def decorated(n, x):
+                return x + time.time()
+
+
+            def module_target(x):
+                return x + time.perf_counter()
+
+
+            MODULE_JIT = jax.jit(module_target)
+        """,
+    }, passes=["TRACE-SAFETY"])
+    assert sorted(f.line for f in codes_at(result, "TS002")) == [9, 13]
+
+
+def test_trace_safety_from_datetime_import(tmp_path):
+    """`from datetime import datetime` is the common import style; the
+    bound class's .now() must still be caught under trace."""
+    result = lint_fixture(tmp_path, {
+        "prog.py": """\
+            from datetime import datetime
+
+            import jax
+
+
+            def cycle(x):
+                return x + datetime.now().timestamp()
+
+
+            F = jax.jit(cycle)
+        """,
+    }, passes=["TRACE-SAFETY"])
+    (f,) = codes_at(result, "TS002")
+    assert f.line == 7 and "datetime" in f.message
+
+
+# ---- LOCK-DISCIPLINE -----------------------------------------------------
+
+
+def test_lock_discipline_catches_cache_queue_inversion(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "internal/bad.py": """\
+            class Mgr:
+                def snapshot_good(self):
+                    with self._queue._lock:
+                        with self._cache._lock:
+                            pass
+
+                def snapshot_bad(self):
+                    with self._cache._lock:
+                        with self._queue._lock:
+                            pass
+        """,
+    }, passes=["LOCK-DISCIPLINE"])
+    (f,) = codes_at(result, "LD001")
+    assert (f.file, f.line) == ("internal/bad.py", 9)
+    assert "queue" in f.message and "cache" in f.message
+
+
+def test_lock_discipline_catches_blocking_under_lock(tmp_path):
+    """Direct fsync under the queue lock, and a transitive one through
+    a helper (the propagation that makes the pass interprocedural)."""
+    result = lint_fixture(tmp_path, {
+        "state/bad.py": """\
+            import os
+
+
+            def fsync_helper(fh):
+                os.fsync(fh)
+
+
+            class Mgr:
+                def emit_bad(self, fh):
+                    with self._queue._lock:
+                        os.fsync(fh)
+
+                def flush_bad(self, fh):
+                    with self.journal._cond:
+                        fsync_helper(fh)
+
+                def writer_ok(self, fh):
+                    os.fsync(fh)  # no lock held: the writer-thread shape
+        """,
+    }, passes=["LOCK-DISCIPLINE"])
+    found = codes_at(result, "LD002")
+    assert [(f.line) for f in found] == [11, 15]
+    assert "via fsync_helper" in found[1].message
+
+
+def test_lock_discipline_catches_single_statement_inversion(tmp_path):
+    """`with a, b:` acquires left-to-right — the one-line form of the
+    inversion must be caught exactly like the nested form."""
+    result = lint_fixture(tmp_path, {
+        "internal/bad.py": """\
+            class Mgr:
+                def snapshot_bad(self):
+                    with self._cache._lock, self._queue._lock:
+                        pass
+        """,
+    }, passes=["LOCK-DISCIPLINE"])
+    (f,) = codes_at(result, "LD001")
+    assert f.line == 3 and "queue" in f.message
+
+
+def test_lock_discipline_allows_documented_order(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "state/good.py": """\
+            class Mgr:
+                def snapshot(self):
+                    with self._queue._lock:
+                        with self._cache._lock:
+                            with self.journal._cond:
+                                pass
+        """,
+    }, passes=["LOCK-DISCIPLINE"])
+    assert result.findings == []
+
+
+# ---- JOURNAL-EMIT-ONCE ---------------------------------------------------
+
+_QUEUE_FIXTURE = """\
+    class BadQueue:
+        def set_journal(self, journal):
+            self._journal = journal
+
+        def _emit(self, op, t, data):
+            if self._journal is not None:
+                self._journal(op, t, data)
+
+        def double_clock(self, pod):
+            now = self._now()
+            self._emit("q.add", self._now(), {})
+
+        def double_emit(self, pod):
+            now = self._now()
+            self._emit("q.a", now, {})
+            self._emit("q.b", now, {})
+
+        def _sneaky_helper(self):
+            self._emit("q.c", self._now(), {})
+
+        def good(self, pod):
+            now = self._now()
+            self._emit("q.ok", now, {})
+"""
+
+
+def test_journal_emit_once_fixture(tmp_path):
+    result = lint_fixture(
+        tmp_path, {"q.py": _QUEUE_FIXTURE}, passes=["JOURNAL-EMIT-ONCE"]
+    )
+    je1 = codes_at(result, "JE001")
+    assert [f.line for f in je1] == [9]  # double_clock (def line)
+    assert "2 times" in je1[0].message
+    (je2,) = codes_at(result, "JE002")
+    assert je2.line == 13 and "2 journal emission sites" in je2.message
+    (je3,) = codes_at(result, "JE003")
+    assert je3.line == 18 and "_sneaky_helper" in je3.message
+    # `good` and the funnel itself are silent
+    assert all(f.line not in (5, 22) for f in result.findings)
+
+
+def test_journal_emit_once_mutually_recursive_mutators(tmp_path):
+    """Mutators that call each other must BOTH be flagged — the memo
+    must not cache a cycle-truncated undercount (order-dependent false
+    negative)."""
+    result = lint_fixture(tmp_path, {
+        "q.py": """\
+            class CyclicQueue:
+                def set_journal(self, journal):
+                    self._journal = journal
+
+                def _emit(self, op, t, data):
+                    self._journal(op, t, data)
+
+                def alpha(self, pod):
+                    self._emit("q.a", self._now(), {})
+                    self.beta(pod)
+
+                def beta(self, pod):
+                    self._emit("q.b", self._now(), {})
+                    self.alpha(pod)
+        """,
+    }, passes=["JOURNAL-EMIT-ONCE"])
+    je2_lines = sorted(f.line for f in codes_at(result, "JE002"))
+    assert je2_lines == [8, 12]  # both alpha and beta over-emit
+
+
+# ---- INVENTORY-DRIFT -----------------------------------------------------
+
+
+def test_inventory_drift_config_and_cli_cross_checks(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "config/types.py": """\
+            class SchedulerConfiguration:
+                foo_bar: int = 0
+                lost_field: int = 0
+                grace_seconds: float = 1.0
+
+
+            def load_config(data):
+                return SchedulerConfiguration(
+                    foo_bar=data.get("fooBar", 0),
+                    grace=data.get("grace", 1.0),
+                    orphan=data.get("orphanKey", None),
+                )
+        """,
+        "cmd/main.py": """\
+            def new_scheduler_command(ap):
+                ap.add_argument("--foo-bar", type=int)
+                return ap
+
+
+            def main(args, config):
+                if args.foo_bar:
+                    config.foo_bar = args.foo_bar
+                if args.typo_flag:
+                    config.not_a_field = 1
+        """,
+    }, passes=["INVENTORY-DRIFT"])
+    id2 = codes_at(result, "ID002")
+    assert {f.message.split()[0] for f in id2} == {
+        "SchedulerConfiguration.lost_field", "load_config",
+    }
+    # grace_seconds <-> "grace" matches via the _seconds-stripping rule
+    assert not any("grace" in f.message for f in id2)
+    id3 = codes_at(result, "ID003")
+    assert sorted(m.message.split(",")[0] for m in id3) == [
+        "cmd/main.py reads args.typo_flag",
+        "cmd/main.py references config.not_a_field",
+    ]
+    # no README.md in the fixture tree -> ID004 is skipped
+    assert codes_at(result, "ID004") == []
+
+
+# ---- HYGIENE -------------------------------------------------------------
+
+
+def test_hygiene_unused_import_and_dead_constant(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "mod.py": """\
+            import os
+            import sys
+
+            _DEAD = 42
+            _ALIVE = 43
+
+
+            def use():
+                return sys.argv, _ALIVE
+        """,
+    }, passes=["HYGIENE"])
+    (hy1,) = codes_at(result, "HY001")
+    assert hy1.line == 1 and "'os'" in hy1.message
+    (hy2,) = codes_at(result, "HY002")
+    assert hy2.line == 4 and "_DEAD" in hy2.message
+
+
+# ---- suppressions & baseline --------------------------------------------
+
+
+def test_inline_suppression_and_disable_file(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "mod.py": """\
+            import os  # schedlint: disable=HY001 -- kept for doc example
+            import sys
+        """,
+        "legacy.py": """\
+            # schedlint: disable-file=HY001
+            import os
+            import sys
+        """,
+    }, passes=["HYGIENE"])
+    assert [f.file for f in codes_at(result, "HY001")] == ["mod.py"]
+    assert len(result.suppressed) == 3
+    (live,) = result.findings
+    assert "'sys'" in live.message and live.line == 2
+
+
+def test_hygiene_counts_string_annotation_use(tmp_path):
+    """A name referenced only inside a quoted annotation is a use (the
+    false positive that briefly deleted profiling.py's Iterable)."""
+    result = lint_fixture(tmp_path, {
+        "mod.py": """\
+            from typing import Iterable
+
+
+            def f(x: "Iterable[int]") -> "Iterable[int]":
+                return x
+        """,
+    }, passes=["HYGIENE"])
+    assert result.findings == []
+
+
+def test_suppression_without_separator_still_applies(tmp_path):
+    """A justification written without `--` must not be absorbed into
+    the code list and void the suppression."""
+    result = lint_fixture(tmp_path, {
+        "mod.py": "import os  # schedlint: disable=HY001 kept on purpose\n",
+    }, passes=["HYGIENE"])
+    assert result.findings == [] and len(result.suppressed) == 1
+
+
+def test_baseline_round_trip(tmp_path):
+    files = {"mod.py": "import os\nimport sys\n"}
+    first = lint_fixture(tmp_path, files, passes=["HYGIENE"])
+    assert len(first.findings) == 2
+    baseline = tmp_path / "baseline.json"
+    write_baseline(str(baseline), first.findings)
+    assert len(load_baseline(str(baseline))) == 2
+    second = lint_fixture(
+        tmp_path, files, passes=["HYGIENE"], baseline_path=str(baseline)
+    )
+    assert second.findings == [] and len(second.grandfathered) == 2
+    # a NEW finding still fails even with the old baseline in place
+    third = lint_fixture(
+        tmp_path, {"mod.py": "import os\nimport sys\nimport json\n"},
+        passes=["HYGIENE"], baseline_path=str(baseline),
+    )
+    assert len(third.findings) == 1 and "'json'" in third.findings[0].message
+
+
+def test_registry_mirrors_framework_semantics():
+    reg = default_registry()
+    assert reg.names() == sorted([
+        "TRACE-SAFETY", "LOCK-DISCIPLINE", "JOURNAL-EMIT-ONCE",
+        "INVENTORY-DRIFT", "HYGIENE",
+    ])
+    with pytest.raises(KeyError):
+        reg.make("NOPE")
+    dup = PassRegistry()
+    dup.register("X", lambda args: None)
+    with pytest.raises(ValueError):
+        dup.register("X", lambda args: None)
+    codes = all_codes(reg)
+    assert codes["TS001"].startswith("import executed")
+
+
+# ---- the tier-1 gate: the real tree lints clean --------------------------
+
+
+def test_tree_is_clean():
+    """All passes over the real package + scripts: zero unsuppressed,
+    non-baselined findings. A finding here means new code broke a
+    machine-checked invariant (or needs an inline justification)."""
+    result = run_lint(
+        REPO,
+        baseline_path=os.path.join(REPO, ".schedlint-baseline.json"),
+    )
+    assert result.findings == [], "\n".join(str(f) for f in result.findings)
+    assert result.files_scanned > 90
+
+
+def test_schedlint_cli_json_mode(tmp_path, capsys):
+    """The acceptance-criterion invocation, via the CLI entry point:
+    exit 0 on the tree and a --json payload drivers can diff."""
+    import importlib.util
+
+    path = os.path.join(REPO, "scripts", "schedlint.py")
+    spec = importlib.util.spec_from_file_location("schedlint_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["ok"] is True and out["findings"] == []
+    assert set(out) >= {"files_scanned", "passes", "suppressed",
+                        "grandfathered"}
+    # a typo'd path must be a usage error (exit 2), never a green run
+    # over zero files
+    assert mod.main(["k8s_scheduler_tpuu"]) == 2
+    capsys.readouterr()
