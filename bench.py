@@ -216,6 +216,14 @@ def main() -> None:
             # bytes (the slimmed payload the bind path blocks on)
             "ov": round(r.get("overlap_pct", 0.0)),
             "fb": r.get("fetch_bytes", 0),
+            # stall transparency, promoted from bench detail to the
+            # headline rows so the 28 s-outlier class diffs across
+            # BENCH_rN artifacts (scripts/bench_diff.py): raw >10x-p50
+            # cycle count, the tunnel round-trip p99, and the
+            # production classifier's anomaly counts by class
+            "stall": r.get("stall_cycles", 0),
+            "trt99": round(r.get("tunnel_rt_p99_ms", 0.0), 1),
+            "anom": r.get("anomalies", {}),
             "sched": r.get("scheduled", 0),
             "unsched": r.get("unschedulable", 0),
         }

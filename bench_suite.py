@@ -520,12 +520,21 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
 
     # fixed tunnel round-trip: a no-op program on DEVICE-RESIDENT data
     # (numpy args would re-upload the 8MB buffer per call and pollute the
-    # fixed-cost estimate)
+    # fixed-cost estimate). Sampled several times so the artifact also
+    # carries the round-trip TAIL (tunnel_rt_p99_ms): the rig's stall
+    # class lives in exactly this path, and a single draw can land on a
+    # stall (or miss one) and skew every derived device_ms number. The
+    # fixed-cost estimate below uses the MEDIAN sample — robust to one
+    # stalled draw where the old single draw was not.
     dev_w = jax.device_put(first_bufs[0])
     np.asarray(noop(dev_w))
-    t0 = time.perf_counter()
-    np.asarray(noop(dev_w))
-    tunnel_rt = time.perf_counter() - t0
+    tunnel_samples = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        np.asarray(noop(dev_w))
+        tunnel_samples.append(time.perf_counter() - t0)
+    tunnel_rt = _percentile(tunnel_samples, 50)
+    tunnel_rt_p99 = _percentile(tunnel_samples, 99)
 
     # the throughput loop measures pure decision throughput over a FIXED
     # existing set (see fold note above): drop any folded residue first
@@ -647,6 +656,14 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     # 10x p50 are counted so a stall-inflated p99 is identifiable
     # without excluding anything from the reported percentiles
     stall_cycles = sum(1 for t in times if p50 > 0 and t > 10 * p50)
+    # ...and the same latency series through the PRODUCTION anomaly
+    # classifier (core/observe.py): each forced-sync cycle ends in the
+    # blocking tunnel read, so the runtime sentinel's stall rule applies
+    # verbatim. `anomalies: {class: count}` makes the 28 s-outlier class
+    # diffable across BENCH_rN artifacts (scripts/bench_diff.py).
+    from k8s_scheduler_tpu.core.observe import classify_latency_series
+
+    anomalies = classify_latency_series(times)
     return {
         "config": cfg,
         "commit_mode": mode,
@@ -660,12 +677,14 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
         "p50_ms": round(p50 * 1e3, 3),
         "p99_ms": round(p99 * 1e3, 3),
         "stall_cycles": stall_cycles,
+        "anomalies": anomalies,
         "device_ms": round(device_s * 1e3, 3),
         "diag_ms": round(diag_ms, 3),
         "fetch_bytes": fetch_bytes,
         "overlap_pct": ov["overlap_pct"],
         "encode_hidden_ms": ov["encode_hidden_ms"],
         "tunnel_rt_ms": round(tunnel_rt * 1e3, 3),
+        "tunnel_rt_p99_ms": round(tunnel_rt_p99 * 1e3, 3),
         "encode_p50_ms": round(_percentile(encode_times, 50) * 1e3, 3),
         "compile_seconds": round(compile_s, 2),
         "distinct_shapes": len(shape_keys),

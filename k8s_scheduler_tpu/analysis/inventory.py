@@ -17,9 +17,17 @@ in BOTH directions:
          argparse flags (a typo'd override silently keeps the default)
 - ID004  every YAML config key and every CLI flag is mentioned
          somewhere in README.md (the operator-facing surface)
+- ID005  the cycle-phase inventory: every phase name in
+         core/observe.PHASES must appear in the flight recorder's
+         chrome-trace lane mapping (TRACE_LANE_FOR_PHASE, and vice
+         versa), in the metrics/metrics.py docstring entry for
+         scheduler_cycle_phase_seconds, and in the README
+         "## Observability" section — the recorder, the metrics, and
+         the trace export cannot disagree about what a phase is
 
 The metric-registry half (ID001) imports the live package; pass
 `{"metrics_runtime": False}` to skip it when linting fixture trees.
+ID005 is pure AST + file reads, so it runs on fixture trees too.
 """
 
 from __future__ import annotations
@@ -87,6 +95,9 @@ class InventoryDriftPass(PassBase):
         "ID003": "cmd/main.py references an unknown config field or "
                  "CLI flag",
         "ID004": "config key / CLI flag undocumented in README",
+        "ID005": "cycle-phase inventory drifted between observe.PHASES, "
+                 "the trace lane mapping, the metrics docstring, and "
+                 "the README",
     }
 
     def run(self, ctx: LintContext) -> list[Finding]:
@@ -107,6 +118,7 @@ class InventoryDriftPass(PassBase):
             ctx, "metrics/metrics.py"
         ):
             findings += self._check_metrics(ctx)
+        findings += self._check_phases(ctx)
         return findings
 
     @staticmethod
@@ -260,6 +272,112 @@ class InventoryDriftPass(PassBase):
                     f"CLI flag {flag!r} is not documented anywhere in "
                     "README.md",
                 ))
+        return findings
+
+    # ---- ID005: cycle-phase inventory ------------------------------------
+
+    @staticmethod
+    def _module_const(sf, name: str):
+        """AST value of a module-level `NAME = <literal>` assignment:
+        tuples of strings -> set of strings, dict literals -> set of
+        string keys; None when absent or non-literal."""
+        for node in sf.tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+            ):
+                continue
+            v = node.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return {
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                }, node.lineno
+            if isinstance(v, ast.Dict):
+                return {
+                    k.value for k in v.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }, node.lineno
+        return None, 0
+
+    def _check_phases(self, ctx: LintContext) -> list[Finding]:
+        obs_sf = self._find(ctx, "core/observe.py")
+        if obs_sf is None:
+            return []
+        phases, obs_line = self._module_const(obs_sf, "PHASES")
+        if not phases:
+            return [Finding(
+                obs_sf.rel, 1, "ID005",
+                "core/observe.py defines no literal PHASES tuple — the "
+                "phase inventory every surface is checked against",
+            )]
+        findings: list[Finding] = []
+
+        fr_sf = self._find(ctx, "core/flight_recorder.py")
+        if fr_sf is not None:
+            lanes, fr_line = self._module_const(
+                fr_sf, "TRACE_LANE_FOR_PHASE"
+            )
+            if lanes is None:
+                findings.append(Finding(
+                    fr_sf.rel, 1, "ID005",
+                    "core/flight_recorder.py has no literal "
+                    "TRACE_LANE_FOR_PHASE mapping: the trace export "
+                    "cannot be checked against observe.PHASES",
+                ))
+            else:
+                for p in sorted(phases - lanes):
+                    findings.append(Finding(
+                        fr_sf.rel, fr_line, "ID005",
+                        f"phase {p!r} (observe.PHASES) is missing from "
+                        "TRACE_LANE_FOR_PHASE: the trace export does "
+                        "not know where to render it",
+                    ))
+                for p in sorted(lanes - phases):
+                    findings.append(Finding(
+                        fr_sf.rel, fr_line, "ID005",
+                        f"TRACE_LANE_FOR_PHASE maps {p!r}, which is not "
+                        "an observe.PHASES phase: stale lane mapping",
+                    ))
+
+        met_sf = self._find(ctx, "metrics/metrics.py")
+        if met_sf is not None:
+            doc = ast.get_docstring(met_sf.tree) or ""
+            # scope to the scheduler_cycle_phase_seconds bullet so an
+            # incidental word elsewhere cannot satisfy the check
+            i = doc.find("scheduler_cycle_phase_seconds")
+            region = doc[i:] if i >= 0 else ""
+            j = region.find("\n- scheduler_")
+            if j > 0:
+                region = region[:j]
+            for p in sorted(phases):
+                if not re.search(rf"\b{re.escape(p)}\b", region):
+                    findings.append(Finding(
+                        met_sf.rel, 1, "ID005",
+                        f"phase {p!r} (observe.PHASES) is not named in "
+                        "the metrics docstring entry for "
+                        "scheduler_cycle_phase_seconds",
+                    ))
+
+        path = os.path.join(ctx.root, "README.md")
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            m = re.search(
+                r"^## Observability\b(.*?)(?=^## |\Z)", text, re.M | re.S
+            )
+            section = m.group(1) if m else ""
+            for p in sorted(phases):
+                if not re.search(rf"\b{re.escape(p)}\b", section):
+                    findings.append(Finding(
+                        obs_sf.rel, obs_line, "ID005",
+                        f"phase {p!r} (observe.PHASES) is not documented "
+                        'in the README "## Observability" section',
+                    ))
         return findings
 
     # ---- ID001: metric inventory (runtime) -------------------------------

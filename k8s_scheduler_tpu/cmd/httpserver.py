@@ -9,9 +9,16 @@ paths. On top of them, the cycle flight recorder
   (phase marks, phase durations, counts) plus the derived window stats;
 - `/debug/trace?last=N` — a Chrome-trace/Perfetto JSON download
   reconstructing the pipeline's overlapped lanes from real serving
-  timestamps (open in ui.perfetto.dev);
+  timestamps (open in ui.perfetto.dev); `/debug/trace?pod=<uid>` slices
+  the trace to the cycles that touched that pod (joined through the
+  pod timeline's per-attempt cycle seqs);
 - `/debug/pods/<uid>` — the per-pod scheduling timeline
   (queued -> attempts -> bound/evicted, joined with the events ring);
+- `/debug/anomalies?last=N` — the cycle observer's typed anomaly ring
+  (tunnel_stall / fetch_stall / recompile / fold_miss /
+  wedge_precursor), each event carrying the cycle seq that links it to
+  `/debug/flightrecorder` and the matching `/debug/trace` window, plus
+  per-class counts, per-phase quantiles, and the SLO burn status;
 - `/debug/state` — durable-state health (journal lag/segments, fsync
   latency, last snapshot and last restore stats) when `--state-dir`
   is configured.
@@ -45,13 +52,17 @@ def staleness_healthz(
     base: Callable[[], dict] | None,
     recorder,
     max_age_seconds: float,
+    observer=None,  # core/observe.CycleObserver | None
 ) -> Callable[[], tuple[bool, dict]]:
     """Health closure with flight-recorder staleness: reports
     `last_cycle_age_s` and flips to not-ok (503) once no scheduling
     cycle completed within `max_age_seconds` (0 = never stale). Before
     the FIRST cycle the age anchors at recorder creation, so a
     scheduler wedged during startup also goes unhealthy instead of
-    reporting a static 200 forever."""
+    reporting a static 200 forever. With an `observer`, the payload
+    additionally carries the SLO burn status and `degraded: true` on a
+    fast-window burn — still 200: budget burn is a paging signal, and
+    killing the pod does not refill an error budget."""
 
     def healthz() -> tuple[bool, dict]:
         detail = dict(base()) if base is not None else {}
@@ -66,6 +77,8 @@ def staleness_healthz(
                     f"no cycle completed in {age:.1f}s "
                     f"(deadline {max_age_seconds:g}s)"
                 )
+        if observer is not None:
+            detail.update(observer.healthz_detail())
         return ok, detail
 
     return healthz
@@ -79,14 +92,16 @@ def start_http_server(
     recorder=None,  # core/flight_recorder.FlightRecorder | None
     pod_timeline: Callable[[str], dict | None] | None = None,
     state=None,  # state.DurableState | None
+    observer=None,  # core/observe.CycleObserver | None
 ) -> ThreadingHTTPServer:
     """Serve /healthz, /readyz, /metrics and the /debug endpoints;
     returns the running server (bound port at `.server_address[1]`;
     pass port=0 for ephemeral). `recorder` enables /debug/flightrecorder
     and /debug/trace; `pod_timeline` (usually Scheduler.pod_timeline)
-    enables /debug/pods/<uid>; `state` (DurableState) enables
-    /debug/state (journal lag, segment counts, snapshot + restore
-    stats)."""
+    enables /debug/pods/<uid> and the /debug/trace?pod= filter; `state`
+    (DurableState) enables /debug/state (journal lag, segment counts,
+    snapshot + restore stats); `observer` (CycleObserver) enables
+    /debug/anomalies."""
     health_fn = healthz or (lambda: (True, {}))
 
     class Handler(BaseHTTPRequestHandler):
@@ -121,10 +136,42 @@ def start_http_server(
             if path == "/debug/trace" and recorder is not None:
                 from ..core.flight_recorder import to_chrome_trace
 
-                last = _parse_last(query)
-                trace = to_chrome_trace(
-                    recorder.snapshot(last=last), epoch=recorder.epoch
-                )
+                qs = urllib.parse.parse_qs(query)
+                pod_uid = (qs.get("pod") or [""])[0]
+                # a pod-filtered trace defaults to the WHOLE ring (the
+                # pod's cycles are sparse); unfiltered keeps the usual
+                # last=128 window
+                if "last" in qs:
+                    last: int | None = _parse_last(query)
+                else:
+                    last = None if pod_uid else 128
+                recs = recorder.snapshot(last=last)
+                if pod_uid:
+                    # slice to the cycles that touched this pod: every
+                    # timeline attempt carries its cycle seq, which is
+                    # the join key back to the flight records
+                    if pod_timeline is None:
+                        return (
+                            404, "text/plain",
+                            b"pod filter needs the pod timeline", {},
+                        )
+                    tl = pod_timeline(pod_uid)
+                    if tl is None:
+                        return (
+                            404,
+                            "application/json",
+                            json.dumps(
+                                {"error": f"pod {pod_uid!r} not seen"}
+                            ).encode(),
+                            {},
+                        )
+                    seqs = {
+                        e["cycle"]
+                        for e in tl.get("events", ())
+                        if e.get("cycle", -1) >= 0
+                    }
+                    recs = [r for r in recs if r.seq in seqs]
+                trace = to_chrome_trace(recs, epoch=recorder.epoch)
                 return (
                     200,
                     "application/json",
@@ -134,6 +181,15 @@ def start_http_server(
                         'attachment; filename="scheduler-trace.json"'
                     },
                 )
+            if path == "/debug/anomalies" and observer is not None:
+                last = _parse_last(query)
+                body = json.dumps(
+                    {
+                        "anomalies": observer.anomalies(last=last),
+                        **observer.status(),
+                    }
+                ).encode()
+                return 200, "application/json", body, {}
             if path == "/debug/state" and state is not None:
                 return (
                     200,

@@ -88,6 +88,14 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         "(MC) the same way (overrides config padMc; 0 = keep config)",
     )
     ap.add_argument(
+        "--slo-p99-ms", type=float, default=-1.0,
+        help="latency SLO objective: at most 1%% of cycles in the "
+        "sloWindowCycles window may exceed this many milliseconds of "
+        "cycle wall time; drives scheduler_slo_burn_rate{window}, "
+        "scheduler_slo_budget_remaining and the /healthz degraded flag "
+        "(config sloP99Ms; 0 disables, -1 = keep config)",
+    )
+    ap.add_argument(
         "--state-dir", default="",
         help="durable scheduler state: write-ahead journal + snapshots "
         "of the queue/cache live here (config stateDir). A process "
@@ -118,6 +126,8 @@ def main(argv: list[str] | None = None) -> int:
         config.flight_recorder_size = args.flight_record_n
     if args.health_max_cycle_age >= 0:
         config.health_max_cycle_age_seconds = args.health_max_cycle_age
+    if args.slo_p99_ms >= 0:
+        config.slo_p99_ms = args.slo_p99_ms
     if args.state_dir:
         config.state_dir = args.state_dir
     if args.snapshot_interval >= 0:
@@ -217,6 +227,7 @@ def main(argv: list[str] | None = None) -> int:
     from .httpserver import staleness_healthz
 
     recorder = service.scheduler.flight
+    observer = service.scheduler.observer
     healthz = staleness_healthz(
         lambda: {
             "bootId": service.boot_id,
@@ -228,6 +239,7 @@ def main(argv: list[str] | None = None) -> int:
         },
         recorder,
         config.health_max_cycle_age_seconds,
+        observer=observer,
     )
 
     http_server = None
@@ -240,6 +252,7 @@ def main(argv: list[str] | None = None) -> int:
             recorder=recorder,
             pod_timeline=service.scheduler.pod_timeline,
             state=state,
+            observer=observer,
         )
         print(
             "serving /healthz /metrics on port "

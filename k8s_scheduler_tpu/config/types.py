@@ -127,6 +127,14 @@ class SchedulerConfiguration:
     # flight recorder's last-cycle age, so a wedged scheduler stops
     # reporting healthy (cmd/main.py).
     health_max_cycle_age_seconds: float = 0.0
+    # latency SLO (core/observe.py): objective "p99 of cycle wall time
+    # <= sloP99Ms over sloWindowCycles cycles" (i.e. at most 1% of the
+    # window's cycles may exceed the bound). Drives the
+    # scheduler_slo_burn_rate{window} / scheduler_slo_budget_remaining
+    # gauges and the /healthz degraded flag on a fast-window burn.
+    # 0 disables the objective (attribution + anomalies still run).
+    slo_p99_ms: float = 0.0
+    slo_window_cycles: int = 1024
     # durable scheduler state (state/ package): directory for the
     # write-ahead journal + snapshots. "" disables durability — a
     # takeover then rebuilds only what informer events re-deliver,
@@ -259,6 +267,8 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         health_max_cycle_age_seconds=_duration_seconds(
             data.get("healthMaxCycleAge", 0.0)
         ),
+        slo_p99_ms=float(data.get("sloP99Ms", 0.0)),
+        slo_window_cycles=int(data.get("sloWindowCycles", 1024)),
         state_dir=str(data.get("stateDir", "")),
         snapshot_interval_seconds=_duration_seconds(
             data.get("snapshotInterval", 60.0)

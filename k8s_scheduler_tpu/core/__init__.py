@@ -14,5 +14,13 @@ from .flight_recorder import (  # noqa: F401
     FlightRecorder,
     to_chrome_trace,
 )
+from .observe import (  # noqa: F401
+    ANOMALY_CLASSES,
+    PHASES,
+    CycleObserver,
+    SloEngine,
+    classify_latency_series,
+    phase_seconds,
+)
 from .pipeline import ServingPipeline, build_decision_slim_fn  # noqa: F401
 from .scheduler import CycleStats, Scheduler  # noqa: F401

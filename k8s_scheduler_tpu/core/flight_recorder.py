@@ -53,6 +53,26 @@ LANE_NAMES = {
     LANE_DIAG: "deferred diagnosis",
 }
 
+# Where each attribution phase (core/observe.PHASES) renders in the
+# chrome-trace export: phase -> (lane tid, slice name). Phases that ride
+# inside a parent slice (fold inside encode, compile inside the flip
+# cycle's dispatch) map to that parent. `to_chrome_trace` reads its lane
+# ids from here, and schedlint's ID005 check enforces that this mapping,
+# observe.PHASES, the scheduler_cycle_phase_seconds docstring entry, and
+# the README phase table never drift apart.
+TRACE_LANE_FOR_PHASE = {
+    "total": (LANE_HOST, "cycle[seq]"),
+    "encode": (LANE_HOST, "encode"),
+    "fold": (LANE_HOST, "encode"),
+    "dispatch": (LANE_HOST, "dispatch"),
+    "compile": (LANE_HOST, "dispatch"),
+    "decision_fetch": (LANE_HOST, "decision_wait"),
+    "bind": (LANE_HOST, "bind winners"),
+    "postfilter": (LANE_HOST, "postfilter"),
+    "device": (LANE_DEVICE, "device cycle[seq]"),
+    "diag_lag": (LANE_DIAG, "diag lag[seq]"),
+}
+
 
 @dataclasses.dataclass
 class CycleRecord:
@@ -75,6 +95,11 @@ class CycleRecord:
     marks: dict[str, float] = dataclasses.field(default_factory=dict)
     phases: dict[str, float] = dataclasses.field(default_factory=dict)
     counts: dict[str, int] = dataclasses.field(default_factory=dict)
+    # padded-shape signature of the cycle's packed regime, as a sorted
+    # tuple of (dim, size) pairs (models/packing.shape_signature): the
+    # observer diffs consecutive signatures to attribute WHICH pad
+    # dimension (E/MPN/MA/MC/P/N) flipped on a recompile anomaly
+    sig: tuple | None = None
 
     def mark(self, name: str, t: float) -> None:
         self.marks[name] = t
@@ -94,6 +119,10 @@ class CycleRecord:
             },
             "phases_ms": {k: round(v, 4) for k, v in self.phases.items()},
             "counts": dict(self.counts),
+            **(
+                {"sig": {k: v for k, v in self.sig}}
+                if self.sig is not None else {}
+            ),
         }
 
 
@@ -181,6 +210,11 @@ class FlightRecorder:
         self.epoch = now()
         self.wall_epoch = wall()
         self.pods = PodTimelines(max_pods=max_pods)
+        # publish-time consumers (core/observe.CycleObserver.observe):
+        # called synchronously after each commit with the record. A
+        # failing observer is logged once and detached — observability
+        # must never take the scheduling loop down with it.
+        self.observers: list[Callable[[CycleRecord], None]] = []
 
     # ---- writer side (scheduling loop only) ------------------------------
 
@@ -201,6 +235,16 @@ class FlightRecorder:
         # publish AFTER the slot store: a reader that observes the new
         # count is guaranteed to observe the new record (GIL-ordered)
         self._commits += 1
+        for cb in list(self.observers):
+            try:
+                cb(rec)
+            except Exception:  # noqa: BLE001 — see observers docstring
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "flight-recorder observer %r failed; detaching", cb
+                )
+                self.observers.remove(cb)
 
     def pod_event(
         self, uid: str, name: str, kind: str, **detail: Any
@@ -377,26 +421,44 @@ def to_chrome_trace(
         )
         if t_disp0 is not None:
             events.append(
-                _slice("encode", LANE_HOST, t_enc0, t_disp0, epoch)
+                _slice(
+                    TRACE_LANE_FOR_PHASE["encode"][1],
+                    TRACE_LANE_FOR_PHASE["encode"][0],
+                    t_enc0, t_disp0, epoch,
+                )
             )
         if t_disp0 is not None and t_disp1 is not None:
             events.append(
-                _slice("dispatch", LANE_HOST, t_disp0, t_disp1, epoch)
+                _slice(
+                    TRACE_LANE_FOR_PHASE["dispatch"][1],
+                    TRACE_LANE_FOR_PHASE["dispatch"][0],
+                    t_disp0, t_disp1, epoch,
+                )
             )
         if t_dec0 is not None and t_dec1 is not None:
             events.append(
                 _slice(
-                    "decision_wait", LANE_HOST, t_dec0, t_dec1, epoch,
+                    TRACE_LANE_FOR_PHASE["decision_fetch"][1],
+                    TRACE_LANE_FOR_PHASE["decision_fetch"][0],
+                    t_dec0, t_dec1, epoch,
                     {"fetch_bytes": rec.counts.get("fetch_bytes", 0)},
                 )
             )
         if t_apply is not None and t_win is not None:
             events.append(
-                _slice("bind winners", LANE_HOST, t_apply, t_win, epoch)
+                _slice(
+                    TRACE_LANE_FOR_PHASE["bind"][1],
+                    TRACE_LANE_FOR_PHASE["bind"][0],
+                    t_apply, t_win, epoch,
+                )
             )
         if t_win is not None and t_post is not None:
             events.append(
-                _slice("postfilter", LANE_HOST, t_win, t_post, epoch)
+                _slice(
+                    TRACE_LANE_FOR_PHASE["postfilter"][1],
+                    TRACE_LANE_FOR_PHASE["postfilter"][0],
+                    t_win, t_post, epoch,
+                )
             )
         if t_post is not None:
             events.append(
@@ -409,7 +471,8 @@ def to_chrome_trace(
             events.append(
                 _slice(
                     f"device cycle[{rec.seq}] slot={rec.slot}",
-                    LANE_DEVICE, t_disp0, t_dec1, epoch,
+                    TRACE_LANE_FOR_PHASE["device"][0],
+                    t_disp0, t_dec1, epoch,
                     {"seq": rec.seq, "slot": rec.slot},
                 )
             )
@@ -419,7 +482,9 @@ def to_chrome_trace(
         if t_dec1 is not None and t_diag is not None and t_diag > t_dec1:
             events.append(
                 _slice(
-                    f"diag lag[{rec.seq}]", LANE_DIAG, t_dec1, t_diag,
+                    f"diag lag[{rec.seq}]",
+                    TRACE_LANE_FOR_PHASE["diag_lag"][0],
+                    t_dec1, t_diag,
                     epoch, {"seq": rec.seq},
                 )
             )

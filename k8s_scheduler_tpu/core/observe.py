@@ -1,0 +1,686 @@
+"""Streaming latency attribution, anomaly sentinel, and SLO burn rate.
+
+PR 2's flight recorder keeps the per-cycle *structure* (phase marks,
+counts, pod timelines); this module is the layer that turns each record
+into *answers* at publish time — the role kube-scheduler's
+`scheduling_duration_seconds` phase breakdown and SLO dashboards play,
+rebuilt TPU-natively on top of the recorder:
+
+- **Phase attribution** (`phase_seconds`): every committed CycleRecord
+  is decomposed into the named phase windows in `PHASES` (encode, fold,
+  dispatch, device, decision_fetch, bind, postfilter, diag_lag, compile,
+  total) and fed into fixed-bucket streaming histograms, exported as the
+  `scheduler_cycle_phase_seconds{phase=...}` histogram family plus
+  per-phase p50/p99 gauges evaluated at scrape time. The windows are
+  measurement lenses, not a strict partition: `device` (dispatch return
+  -> decision landed) CONTAINS `decision_fetch` (the blocking wait), and
+  on this rig both embed one tunnel round-trip — which is exactly why
+  the stall classes below watch them.
+- **Anomaly sentinel**: EWMA + streaming-quantile baselines per phase
+  classify outlier cycles into typed anomalies (`ANOMALY_CLASSES`):
+
+  * `tunnel_stall`   — the device round-trip window stalled (the 28 s
+    outlier class ROUND5.md could only count, not attribute);
+  * `fetch_stall`    — the blocking decision fetch crawled while the
+    round-trip window was otherwise unremarkable (slow transfer, not a
+    stalled dispatch);
+  * `recompile`      — the encoder's padded-shape signature flipped
+    between consecutive cycles; the flipping dimensions (E/MPN/MA/MC/
+    P/N, models/packing.shape_signature) are attributed by diffing, so
+    "which pad regime moved" no longer needs a probe run;
+  * `fold_miss`      — a warm cycle fell off the delta/fold encode path
+    into a full re-encode (without a regime flip to explain it);
+  * `wedge_precursor`— `_Resilient` absorbed new retry strikes this
+    cycle (core/cycle.py): the strike classes that precede the rig's
+    executable-cache wedge.
+
+  Each anomaly is a structured ring event carrying the cycle `seq`, so
+  `/debug/anomalies?last=N` links straight to the flight record and the
+  matching `/debug/trace` Perfetto window, and each is counted in
+  `scheduler_anomalies_total{class=...}`.
+- **SLO engine** (`SloEngine`): a configurable latency objective —
+  config `sloP99Ms`/`sloWindowCycles`, CLI `--slo-p99-ms` — tracked as
+  "at most 1% of cycles may exceed the objective" over fast/slow cycle
+  windows, exported as `scheduler_slo_burn_rate{window=...}` and
+  `scheduler_slo_budget_remaining`; `/healthz` reports a fast-window
+  burn above `fast_burn_degraded` as `degraded: true` (the probe stays
+  200 — budget burn is a paging signal, not a liveness failure).
+
+Stdlib-only, like the recorder it consumes: tools and tests import it
+without a jax backend. Thread model: `observe()` runs on the scheduling
+loop (via FlightRecorder.observers at commit — a dozen histogram
+increments under one small lock, microseconds next to a cycle); readers
+(scrape-time gauge closures, /debug/anomalies) take the same lock.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import threading
+from typing import Any, Iterable
+
+# The canonical phase inventory. schedlint's ID005 check enforces that
+# this tuple, the flight recorder's chrome-trace lane mapping
+# (flight_recorder.TRACE_LANE_FOR_PHASE), the metrics/metrics.py
+# docstring entry for scheduler_cycle_phase_seconds, and the README
+# phase table never drift apart.
+PHASES = (
+    "total",          # t_start -> t_end (the whole profile cycle)
+    "encode",         # host snapshot encode, minus the fold share below
+    "fold",           # incremental existing-fold inside the encode
+    "dispatch",       # async program dispatch (host side)
+    "device",         # dispatch returned -> decision payload landed
+    "decision_fetch", # the ONE blocking device->host wait
+    "bind",           # winner bind loop
+    "postfilter",     # preemption force between winners and losers
+    "diag_lag",       # deferred FailedScheduling attribution lag
+    "compile",        # packed-program (re)build on a regime flip
+)
+
+ANOMALY_CLASSES = (
+    "tunnel_stall",
+    "fetch_stall",
+    "recompile",
+    "fold_miss",
+    "wedge_precursor",
+)
+
+# Fixed log-ish bucket edges (seconds) for the streaming phase
+# histograms: sub-ms TPU phases up through multi-second tunnel stalls
+# (the observed 28 s outlier lands in the top finite bucket).
+PHASE_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def phase_seconds(rec) -> dict[str, float]:
+    """Decompose one CycleRecord into `{phase: seconds}` windows.
+
+    Only phases whose source data exists in the record are emitted (a
+    cycle with no deferred diagnosis has no `diag_lag`; `compile`
+    appears only on regime-flip cycles) so absent work never pollutes
+    the histograms with zeros."""
+    m, ph = rec.marks, rec.phases
+    out: dict[str, float] = {}
+    total = rec.t_end - rec.t_start
+    if total > 0:
+        out["total"] = total
+
+    fold = ph.get("fold_ms", 0.0) / 1e3
+    if "encode_ms" in ph:
+        # the fold ran INSIDE the encode window: attribute it separately
+        # and keep `encode` as the non-fold remainder
+        out["encode"] = max(ph["encode_ms"] / 1e3 - fold, 0.0)
+    if fold > 0.0:
+        out["fold"] = fold
+    if "dispatch_ms" in ph:
+        out["dispatch"] = ph["dispatch_ms"] / 1e3
+    if "decision_wait_ms" in ph:
+        out["decision_fetch"] = ph["decision_wait_ms"] / 1e3
+    d0, d1 = m.get("dispatch_end"), m.get("decision_end")
+    if d0 is not None and d1 is not None and d1 >= d0:
+        out["device"] = d1 - d0
+    a0, a1 = m.get("apply_start"), m.get("winners_end")
+    if a0 is not None and a1 is not None and a1 >= a0:
+        out["bind"] = a1 - a0
+    p1 = m.get("postfilter_end")
+    if a1 is not None and p1 is not None and p1 >= a1:
+        out["postfilter"] = p1 - a1
+    if "diag_lag_ms" in ph:
+        out["diag_lag"] = ph["diag_lag_ms"] / 1e3
+    if "compile_ms" in ph:
+        out["compile"] = ph["compile_ms"] / 1e3
+    return out
+
+
+class StreamHist:
+    """Fixed-bucket streaming histogram with interpolated quantiles.
+
+    O(len(buckets)) memory forever; `observe` is one bisect + two adds.
+    Quantiles interpolate linearly inside the owning bucket — exact
+    enough for p50/p99 gauges over latency-shaped data, and immune to
+    the unbounded-memory failure of keeping raw samples."""
+
+    __slots__ = ("edges", "counts", "n", "total", "max_seen")
+
+    def __init__(self, edges: Iterable[float] = PHASE_BUCKETS_S) -> None:
+        self.edges = tuple(edges)
+        self.counts = [0] * (len(self.edges) + 1)
+        self.n = 0
+        self.total = 0.0
+        self.max_seen = 0.0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.edges, v)] += 1
+        self.n += 1
+        self.total += v
+        if v > self.max_seen:
+            self.max_seen = v
+
+    def quantile(self, q: float) -> float:
+        if self.n == 0:
+            return 0.0
+        target = q * self.n
+        seen = 0.0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if seen + c >= target:
+                lo = self.edges[i - 1] if i > 0 else 0.0
+                hi = (
+                    self.edges[i] if i < len(self.edges)
+                    else max(self.max_seen, lo)
+                )
+                frac = (target - seen) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            seen += c
+        return self.max_seen
+
+    def mean(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+
+class PhaseBaseline:
+    """EWMA mean + EWMA absolute deviation + a streaming histogram —
+    the per-phase "normal" an outlier is judged against. Anomalous
+    samples update the baseline winsorized BELOW the threshold that
+    flagged them (at threshold/mult — see CycleObserver), so a 28 s
+    stall cannot drag its own baseline up and mask the next stall."""
+
+    __slots__ = ("hist", "ewma", "ewdev", "n", "alpha")
+
+    def __init__(self, alpha: float = 0.05):
+        self.hist = StreamHist()
+        self.ewma = 0.0
+        self.ewdev = 0.0
+        self.n = 0
+        self.alpha = alpha
+
+    def update(self, v: float) -> None:
+        self.hist.observe(v)
+        if self.n == 0:
+            self.ewma = v
+        else:
+            dev = abs(v - self.ewma)
+            self.ewdev += self.alpha * (dev - self.ewdev)
+            self.ewma += self.alpha * (v - self.ewma)
+        self.n += 1
+
+    def threshold(
+        self, mult: float, k_dev: float, floor_s: float
+    ) -> float:
+        """The outlier boundary: `mult` x the larger of (EWMA + k_dev
+        sigma-ish) and the streaming p99, floored at `floor_s`."""
+        base = max(
+            self.ewma + k_dev * self.ewdev, self.hist.quantile(0.99)
+        )
+        return max(floor_s, mult * base)
+
+
+class SloEngine:
+    """Multi-window burn-rate tracking for a cycle-latency objective.
+
+    Objective: at most `budget_fraction` (default 1%, i.e. a p99
+    objective) of cycles may exceed `p99_ms`. Burn rate over a window =
+    observed violation fraction / budget fraction: 1.0 burns the budget
+    exactly at the sustainable rate, N burns it N times too fast. Two
+    windows — `fast` (window/16, floor 16 cycles: pages quickly) and
+    `slow` (`sloWindowCycles`: the budget window itself) — the standard
+    multi-window shape, with cycles as the time base because cycle rate
+    IS the serving rate here."""
+
+    def __init__(
+        self,
+        p99_ms: float,
+        window_cycles: int = 1024,
+        budget_fraction: float = 0.01,
+        fast_burn_degraded: float = 6.0,
+    ) -> None:
+        self.p99_ms = float(p99_ms)
+        self.window_cycles = max(int(window_cycles), 16)
+        self.budget_fraction = budget_fraction
+        self.fast_burn_degraded = fast_burn_degraded
+        self.windows: dict[str, collections.deque] = {
+            "fast": collections.deque(
+                maxlen=max(16, self.window_cycles // 16)
+            ),
+            "slow": collections.deque(maxlen=self.window_cycles),
+        }
+        self.cycles = 0
+        self.violations = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.p99_ms > 0
+
+    def note(self, total_s: float) -> bool:
+        violated = self.enabled and total_s * 1e3 > self.p99_ms
+        for w in self.windows.values():
+            w.append(1 if violated else 0)
+        self.cycles += 1
+        self.violations += int(violated)
+        return violated
+
+    def burn_rate(self, window: str) -> float:
+        w = self.windows[window]
+        if not self.enabled or not w:
+            return 0.0
+        return (sum(w) / len(w)) / self.budget_fraction
+
+    def budget_remaining(self) -> float:
+        """Fraction of the slow window's violation budget left (1.0 =
+        untouched; negative = overspent). Sized against the window
+        CAPACITY so early violations spend the same budget they would
+        in steady state."""
+        if not self.enabled:
+            return 1.0
+        w = self.windows["slow"]
+        budget = self.budget_fraction * w.maxlen
+        return (budget - sum(w)) / budget
+
+    def degraded(self) -> bool:
+        return (
+            self.enabled
+            and self.burn_rate("fast") >= self.fast_burn_degraded
+        )
+
+    def status(self) -> dict[str, Any]:
+        return {
+            "p99_ms": self.p99_ms,
+            "window_cycles": self.window_cycles,
+            "enabled": self.enabled,
+            "cycles": self.cycles,
+            "violations": self.violations,
+            "burn_rate": {
+                name: round(self.burn_rate(name), 4)
+                for name in self.windows
+            },
+            "budget_remaining": round(self.budget_remaining(), 4),
+            "degraded": self.degraded(),
+        }
+
+
+class CycleObserver:
+    """The streaming consumer wired into `FlightRecorder.observers`:
+    every committed record is attributed, baselined, anomaly-classified,
+    and SLO-accounted — within the same cycle it was published in.
+
+    Tuning attributes (set before traffic; tests shrink the floors):
+    `stall_mult` / `stall_k_dev` / `stall_floor_s` shape the outlier
+    threshold (PhaseBaseline.threshold), `warmup_cycles` is how many
+    samples a phase needs before it can be judged at all."""
+
+    def __init__(
+        self,
+        metrics=None,
+        slo_p99_ms: float = 0.0,
+        slo_window_cycles: int = 1024,
+        ring: int = 256,
+        warmup_cycles: int = 8,
+        stall_mult: float = 4.0,
+        stall_k_dev: float = 6.0,
+        stall_floor_s: float = 0.25,
+        fast_burn_degraded: float = 6.0,
+    ) -> None:
+        self._lock = threading.Lock()
+        self.warmup_cycles = warmup_cycles
+        self.stall_mult = stall_mult
+        self.stall_k_dev = stall_k_dev
+        self.stall_floor_s = stall_floor_s
+        self.baselines = {p: PhaseBaseline() for p in PHASES}
+        # unwinsorized per-phase histograms: the exported p50/p99
+        # gauges and status() read THESE — the baselines' winsorized
+        # hists exist to keep the outlier threshold honest, and would
+        # report a near-normal tail during an active stall episode
+        self.raw = {p: StreamHist() for p in PHASES}
+        self.slo = SloEngine(
+            slo_p99_ms,
+            window_cycles=slo_window_cycles,
+            fast_burn_degraded=fast_burn_degraded,
+        )
+        self.anomaly_counts = {c: 0 for c in ANOMALY_CLASSES}
+        self.ring: collections.deque = collections.deque(maxlen=ring)
+        self.cycles = 0
+        self.epoch = 0.0  # recorder clock epoch (set by the scheduler)
+        # per-profile memory: last shape signature + monotonic counters
+        # (per-profile encoder full_encodes) for deltas
+        self._prof: dict[str, dict[str, Any]] = {}
+        # process-global monotonic counters (retry_strikes_total from
+        # RESILIENT_STRIKES): every profile's record carries the same
+        # sum, so the delta must be tracked once or N profiles would
+        # each raise the same strike
+        self._global_counts: dict[str, int] = {}
+        self._metrics = metrics
+        if metrics is not None:
+            self._bind_metrics(metrics)
+
+    # ---- metrics wiring --------------------------------------------------
+
+    def _bind_metrics(self, m) -> None:
+        """Register the scrape-time closures: per-phase p50/p99 (from
+        the RAW streaming histograms — the winsorized baselines would
+        hide the tail during a stall episode) and the SLO burn gauges
+        evaluate live at scrape, not at cycle end."""
+        # metrics.py keeps a LITERAL copy of PHASE_BUCKETS_S (so it
+        # stays importable without the core package); retuning one
+        # without the other would make the exported histogram and the
+        # streaming p50/p99 gauges disagree at exactly the bucket
+        # boundaries histogram_quantile interpolates over — refuse at
+        # wiring time instead of drifting silently
+        exported = getattr(m.cycle_phase, "_upper_bounds", None)
+        if exported is not None:
+            finite = tuple(
+                e for e in exported if e != float("inf")
+            )
+            if finite != PHASE_BUCKETS_S:
+                raise ValueError(
+                    "scheduler_cycle_phase_seconds bucket edges "
+                    f"{finite} drifted from observe.PHASE_BUCKETS_S "
+                    f"{PHASE_BUCKETS_S}: retune both or neither"
+                )
+        for p in PHASES:
+            m.cycle_phase_p50.labels(phase=p).set_function(
+                lambda p=p: self.quantile(p, 0.5)
+            )
+            m.cycle_phase_p99.labels(phase=p).set_function(
+                lambda p=p: self.quantile(p, 0.99)
+            )
+        for w in self.slo.windows:
+            m.slo_burn_rate.labels(window=w).set_function(
+                lambda w=w: self.slo_burn_rate(w)
+            )
+        m.slo_budget_remaining.set_function(self.slo_budget_remaining)
+
+    # ---- the per-record hook (scheduling loop) ---------------------------
+
+    def observe(self, rec) -> list[dict]:
+        """Consume one committed CycleRecord; returns the anomalies it
+        raised (also pushed onto the ring + counters + metrics)."""
+        phases = phase_seconds(rec)
+        return self.observe_phases(
+            phases,
+            counts=rec.counts,
+            sig=getattr(rec, "sig", None),
+            profile=rec.profile,
+            seq=rec.seq,
+            t_s=rec.t_end - self.epoch,
+            wall=rec.wall_start,
+        )
+
+    def observe_phases(
+        self,
+        phases: dict[str, float],
+        counts: dict[str, int] | None = None,
+        sig: tuple | None = None,
+        profile: str = "default-scheduler",
+        seq: int = -1,
+        t_s: float = 0.0,
+        wall: float = 0.0,
+    ) -> list[dict]:
+        """The sentinel core, usable without a CycleRecord (bench_suite
+        feeds plain latency series through classify_latency_series)."""
+        counts = counts or {}
+        anomalies: list[dict] = []
+        with self._lock:
+            prof = self._prof.setdefault(
+                profile, {"sig": None, "counts": {}, "cycles": 0}
+            )
+            first = prof["cycles"] == 0
+
+            def raise_anomaly(
+                cls: str, phase: str = "", value_s: float = 0.0,
+                baseline_s: float = 0.0, **detail: Any,
+            ) -> None:
+                ev = {
+                    "seq": seq,
+                    "profile": profile,
+                    "t_s": round(t_s, 6),
+                    "wall": wall,
+                    "class": cls,
+                    "phase": phase,
+                    "value_ms": round(value_s * 1e3, 3),
+                    "baseline_ms": round(baseline_s * 1e3, 3),
+                    "detail": detail,
+                }
+                anomalies.append(ev)
+                self.ring.append(ev)
+                self.anomaly_counts[cls] += 1
+
+            # -- stall classes: judge BEFORE the update, so an outlier
+            # is measured against the baseline it violated. During
+            # warmup an over-threshold sample is winsorized but NOT
+            # classified (too little history to page on) — feeding it
+            # raw would park the p99 term at the stall value and mask
+            # the whole class for the next ~100 cycles.
+            stall_phase = {}
+            warm_cap: dict[str, float] = {}
+            for phase in ("device", "decision_fetch"):
+                v = phases.get(phase)
+                if v is None:
+                    continue
+                # no b.n == 0 special case: with no history the
+                # threshold degrades to stall_floor_s, so a stall on
+                # the VERY FIRST cycle (exactly when the rig is
+                # startup-flaky) is still winsorized below — seeding
+                # the baseline raw would park ewma and the p99 term at
+                # the stall value and mask the class post-warmup
+                b = self.baselines[phase]
+                thr = b.threshold(
+                    self.stall_mult, self.stall_k_dev,
+                    self.stall_floor_s,
+                )
+                if v > thr:
+                    if b.n >= self.warmup_cycles:
+                        stall_phase[phase] = (v, thr, b)
+                    else:
+                        warm_cap[phase] = thr
+            if "device" in stall_phase:
+                v, thr, b = stall_phase["device"]
+                raise_anomaly(
+                    "tunnel_stall", phase="device", value_s=v,
+                    baseline_s=b.ewma, threshold_ms=round(thr * 1e3, 3),
+                )
+            elif "decision_fetch" in stall_phase:
+                # the fetch alone crawled while the round-trip window
+                # stayed unremarkable: a transfer stall, not a tunnel
+                # dispatch stall (precedence documented in ANOMALY
+                # class docs above)
+                v, thr, b = stall_phase["decision_fetch"]
+                raise_anomaly(
+                    "fetch_stall", phase="decision_fetch", value_s=v,
+                    baseline_s=b.ewma, threshold_ms=round(thr * 1e3, 3),
+                )
+
+            # -- recompile: a genuine packed-program rebuild this cycle
+            # (regime_flip is stamped only on a _packed_fns memo miss),
+            # with the flipping pad dimensions attributed by diffing
+            # consecutive shape signatures. A signature flip WITHOUT a
+            # rebuild is a memoized regime switch — a pad flip-flop
+            # riding the scheduler's _packed cache, costing no compile —
+            # so it raises nothing (it would otherwise spam the ring
+            # every cycle of an oscillating workload); the sig diff
+            # still suppresses fold_miss below, because the shape
+            # change legitimately full-encodes.
+            flipped: list[str] = []
+            pd: dict = {}
+            nd: dict = {}
+            if sig is not None:
+                prev = prof["sig"]
+                if prev is not None and sig != prev:
+                    pd, nd = dict(prev), dict(sig)
+                    flipped = sorted(
+                        k for k in (set(pd) | set(nd))
+                        if pd.get(k) != nd.get(k)
+                    )
+                prof["sig"] = sig
+            if not first and counts.get("regime_flip"):
+                detail: dict[str, Any] = (
+                    {
+                        "dims": flipped,
+                        "from_sig": {k: pd.get(k) for k in flipped},
+                        "to_sig": {k: nd.get(k) for k in flipped},
+                    }
+                    if flipped
+                    # dictionary-growth recompile: spec.key() changed
+                    # while every named pad size stayed identical
+                    # (grow-only interning dimensions) — no signature
+                    # diff to show, but the rebuild cost is just as real
+                    else {"dims": [], "growth": "interning"}
+                )
+                raise_anomaly(
+                    "recompile",
+                    phase="compile",
+                    value_s=phases.get(
+                        "compile", phases.get("dispatch", 0.0)
+                    ),
+                    **detail,
+                )
+
+            # -- monotonic-counter deltas: full encodes (fold miss,
+            # per-profile encoder) and _Resilient strikes (wedge
+            # precursor, process-global)
+            pc = prof["counts"]
+            if "full_encodes" in counts:
+                prev_v = pc.get("full_encodes")
+                delta = (
+                    counts["full_encodes"] - prev_v
+                    if prev_v is not None else 0
+                )
+                pc["full_encodes"] = counts["full_encodes"]
+                if (
+                    delta > 0 and not first and not flipped
+                    and not counts.get("regime_flip")
+                ):
+                    # a regime flip legitimately full-encodes; only an
+                    # UNexplained fall off the delta path is a fold
+                    # miss. regime_flip covers dictionary-growth
+                    # recompiles too — spec.key() changed while the six
+                    # named pad sizes stayed identical, so `flipped`
+                    # alone cannot see them
+                    raise_anomaly(
+                        "fold_miss",
+                        phase="encode",
+                        value_s=phases.get("encode", 0.0),
+                        full_encodes=delta,
+                    )
+            if "retry_strikes_total" in counts:
+                prev_v = self._global_counts.get("retry_strikes_total")
+                delta = (
+                    counts["retry_strikes_total"] - prev_v
+                    if prev_v is not None else 0
+                )
+                self._global_counts["retry_strikes_total"] = counts[
+                    "retry_strikes_total"
+                ]
+                if delta > 0:
+                    raise_anomaly("wedge_precursor", strikes=delta)
+
+            # -- feed histograms/baselines (winsorized for flagged
+            # stall phases) and the SLO accounting
+            for phase, v in phases.items():
+                self.raw[phase].observe(v)
+                cap = (
+                    stall_phase[phase][1] if phase in stall_phase
+                    else warm_cap.get(phase)
+                )
+                if cap is not None:
+                    # winsorize at the PRE-multiplier base, not the
+                    # threshold itself: threshold-level samples feed the
+                    # p99 term, which the next threshold multiplies by
+                    # stall_mult again — a run of identical stalls would
+                    # background itself within a handful of cycles
+                    v = min(v, cap / self.stall_mult)
+                self.baselines[phase].update(v)
+            if "total" in phases:
+                self.slo.note(phases["total"])
+            self.cycles += 1
+            prof["cycles"] += 1
+
+        m = self._metrics
+        if m is not None:
+            for phase, v in phases.items():
+                m.cycle_phase.labels(phase=phase).observe(v)
+            for ev in anomalies:
+                m.anomalies.labels(ev["class"]).inc()
+        return anomalies
+
+    # ---- readers ---------------------------------------------------------
+
+    def quantile(self, phase: str, q: float) -> float:
+        with self._lock:
+            return self.raw[phase].quantile(q)
+
+    # locked SloEngine reads: the scrape-time gauge closures must not
+    # iterate the burn-window deques while the scheduling loop appends
+    # (deques raise "mutated during iteration" mid-scrape)
+    def slo_burn_rate(self, window: str) -> float:
+        with self._lock:
+            return self.slo.burn_rate(window)
+
+    def slo_budget_remaining(self) -> float:
+        with self._lock:
+            return self.slo.budget_remaining()
+
+    def anomalies(self, last: int | None = None) -> list[dict]:
+        with self._lock:
+            evs = list(self.ring)
+        if last is not None:
+            n = max(int(last), 0)
+            evs = evs[-n:] if n else []
+        return [dict(e) for e in evs]
+
+    def status(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "cycles": self.cycles,
+                "anomaly_counts": dict(self.anomaly_counts),
+                "phase_p50_ms": {
+                    p: round(self.raw[p].quantile(0.5) * 1e3, 3)
+                    for p in PHASES
+                    if self.raw[p].n
+                },
+                "phase_p99_ms": {
+                    p: round(self.raw[p].quantile(0.99) * 1e3, 3)
+                    for p in PHASES
+                    if self.raw[p].n
+                },
+                "slo": self.slo.status(),
+            }
+
+    def healthz_detail(self) -> dict[str, Any]:
+        """The /healthz enrichment: SLO burn + degraded flag. Degraded
+        is reported, not 503'd — killing the pod does not refill an
+        error budget."""
+        with self._lock:
+            out: dict[str, Any] = {"slo": self.slo.status()}
+            if self.slo.degraded():
+                out["degraded"] = True
+                out["degraded_reason"] = (
+                    f"slo fast-burn {self.slo.burn_rate('fast'):.1f}x "
+                    f">= {self.slo.fast_burn_degraded:g}x "
+                    f"(objective p99 <= {self.slo.p99_ms:g} ms)"
+                )
+            return out
+
+
+def classify_latency_series(
+    samples_s: Iterable[float], **observer_kw: Any
+) -> dict[str, int]:
+    """Run the runtime sentinel's outlier rule over a plain forced-sync
+    latency series (bench_suite's per-cycle times, where the blocking
+    read IS the tunnel round-trip window) and return anomaly counts by
+    class. Only the stall classes can fire on a bare series — there is
+    no signature or strike stream in it — so the result is exactly the
+    "which cycles stalled, by the production classifier" count the
+    BENCH artifacts carry next to the raw percentiles."""
+    obs = CycleObserver(metrics=None, **observer_kw)
+    for i, t in enumerate(samples_s):
+        obs.observe_phases(
+            {"total": t, "device": t, "decision_fetch": t},
+            profile="bench", seq=i,
+        )
+    return {
+        c: n for c, n in obs.anomaly_counts.items() if n
+    }

@@ -166,6 +166,29 @@ class Scheduler:
             self.metrics.last_cycle_age.set_function(
                 self.flight.last_cycle_age_s
             )
+        # streaming latency attribution + anomaly sentinel + SLO engine
+        # (core/observe.py): consumes every flight record at publish
+        # time; None when the recorder is disabled (no records to read)
+        if self.flight is not None:
+            from .observe import CycleObserver
+
+            self.observer: CycleObserver | None = CycleObserver(
+                metrics=self.metrics,
+                slo_p99_ms=self.config.slo_p99_ms,
+                slo_window_cycles=self.config.slo_window_cycles,
+            )
+            self.observer.epoch = self.flight.epoch
+            self.flight.observers.append(self.observer.observe)
+        else:
+            self.observer = None
+            if self.config.slo_p99_ms > 0:
+                logging.getLogger(__name__).warning(
+                    "sloP99Ms=%g is configured but the flight recorder "
+                    "is disabled (flightRecorderSize=0): the observer "
+                    "has no records to consume, so the SLO engine, the "
+                    "anomaly sentinel, and /debug/anomalies are all "
+                    "off", self.config.slo_p99_ms,
+                )
         self._now = now
         self._pad_bucket = pad_bucket
         self._profile_name = self.config.profiles[0].scheduler_name  # legacy alias
@@ -220,6 +243,12 @@ class Scheduler:
         # bucket changes) reuse earlier compilations
         self._packed: dict = {}
         self._dev_stable: dict = {}
+        # regime-flip accounting for the observer: _packed_fns bumps the
+        # build count on every memo miss and records how long the host-
+        # side program (re)build took — the XLA compile itself rides the
+        # first dispatch, which the recompile anomaly attributes
+        self._packed_builds = 0
+        self._last_build_s = 0.0
         # carry mode (rounds only; extender verdicts replace snapshot
         # fields, which the arena spec does not carry): the [P,N] static
         # base + [S,P] matched-pending persist on device and are updated
@@ -276,6 +305,7 @@ class Scheduler:
         key = (spec.key(), profile)
         hit = self._packed.get(key)
         if hit is None:
+            t_build = self._now()
             if self._use_carry:
                 from .cycle import (
                     CarryKeeper,
@@ -316,6 +346,8 @@ class Scheduler:
                 build_stable_state_fn(spec),
                 keeper, diag, ext_keeper, pipe,
             )
+            self._packed_builds += 1
+            self._last_build_s = self._now() - t_build
             self._packed[key] = hit
             # bounded: grow-only interning dimensions make old regimes
             # permanently dead — keep only the recent few (pad-bucket
@@ -523,6 +555,7 @@ class Scheduler:
         encoder = self._encoders[profile]
         fr = self.flight
         rec = fr.start(profile) if fr is not None else None
+        builds_before = self._packed_builds
         if rec is not None:
             rec.mark("encode_start", rec.t_start)
             # per-profile deltas: CycleStats accumulates across profiles
@@ -933,6 +966,20 @@ class Scheduler:
                     if k.endswith("_ms")
                 }
             )
+            # latency-attribution enrichment (core/observe.py reads
+            # these at publish): the pad-regime signature for recompile
+            # dimension attribution, the encoder's incremental-fold
+            # share of the encode, and the program-(re)build cost when
+            # this cycle flipped regimes
+            from ..models import packing as _packing
+
+            rec.sig = _packing.shape_signature(spec)
+            fold_ms = encoder.delta_profile.get("fold")
+            if fold_ms:
+                rec.phases["fold_ms"] = float(fold_ms)
+            if self._packed_builds > builds_before:
+                rec.phases["compile_ms"] = self._last_build_s * 1e3
+                rec.counts["regime_flip"] = 1
             qc = self.queue.pending_counts()
             sb, ub, bb, pb, vb = _before
             rec.counts.update(
@@ -946,6 +993,12 @@ class Scheduler:
                 gang_dropped=profile_gang_dropped,
                 fetch_bytes=int(st.get("fetch_bytes", 0)),
                 retry_strikes_total=sum(RESILIENT_STRIKES.values()),
+                # monotonic encoder counters: the observer diffs them
+                # per profile to classify fold_miss (an unexplained
+                # fall off the delta/fold encode path)
+                full_encodes=int(encoder.full_encodes),
+                delta_hits=int(encoder.delta_hits),
+                fold_hits=int(getattr(encoder, "fold_hits", 0)),
                 queue_active=qc.get("active", 0),
                 queue_backoff=qc.get("backoff", 0),
                 queue_unschedulable=qc.get("unschedulable", 0),

@@ -50,6 +50,27 @@ overlap story needs no probe runs:
 - scheduler_last_cycle_age_seconds — seconds since the last completed
   cycle record (the /healthz staleness signal)
 
+Latency-attribution / anomaly / SLO families (core/observe.py — the
+streaming consumer of every flight record):
+
+- scheduler_cycle_phase_seconds{phase} — streaming per-phase latency
+  attribution of every committed cycle record; phases: total, encode,
+  fold, dispatch, device, decision_fetch, bind, postfilter, diag_lag,
+  compile (the inventory is core/observe.PHASES, machine-checked by
+  schedlint ID005 against the trace lane mapping and the README)
+- scheduler_cycle_phase_p50_seconds{phase} /
+  scheduler_cycle_phase_p99_seconds{phase} — per-phase quantiles from
+  the observer's streaming histograms, evaluated at scrape time
+- scheduler_anomalies_total{class} — typed anomaly detections
+  (tunnel_stall | fetch_stall | recompile | fold_miss |
+  wedge_precursor); each increment has a matching structured event in
+  /debug/anomalies carrying the cycle seq
+- scheduler_slo_burn_rate{window} — latency-SLO burn rate over the
+  fast/slow cycle windows (1.0 = burning the error budget exactly at
+  the sustainable rate), 0 when no sloP99Ms objective is configured
+- scheduler_slo_budget_remaining — fraction of the slow window's
+  violation budget left (1.0 = untouched, negative = overspent)
+
 Durable-state families (state/ package — write-ahead journal, snapshots,
 restore) and leader election:
 
@@ -245,6 +266,59 @@ class SchedulerMetrics:
             "scheduler_last_cycle_age_seconds",
             "Seconds since the last completed scheduling cycle record "
             "(the /healthz staleness signal).",
+            registry=r,
+        )
+        # ---- latency attribution / anomalies / SLO (core/observe.py) ----
+        # same edge family as observe.PHASE_BUCKETS_S (kept literal here
+        # so this module stays importable without the core package):
+        # sub-ms TPU phases up through multi-second tunnel stalls
+        phase_buckets = (
+            0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+            0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+        )
+        self.cycle_phase = Histogram(
+            "scheduler_cycle_phase_seconds",
+            "Per-phase latency attribution of every committed cycle "
+            "record (phases: total, encode, fold, dispatch, device, "
+            "decision_fetch, bind, postfilter, diag_lag, compile).",
+            ["phase"],
+            buckets=phase_buckets,
+            registry=r,
+        )
+        self.cycle_phase_p50 = Gauge(
+            "scheduler_cycle_phase_p50_seconds",
+            "Streaming per-phase p50 from the cycle observer, evaluated "
+            "at scrape time.",
+            ["phase"],
+            registry=r,
+        )
+        self.cycle_phase_p99 = Gauge(
+            "scheduler_cycle_phase_p99_seconds",
+            "Streaming per-phase p99 from the cycle observer, evaluated "
+            "at scrape time.",
+            ["phase"],
+            registry=r,
+        )
+        self.anomalies = Counter(
+            "scheduler_anomalies_total",
+            "Typed anomaly detections from the cycle observer "
+            "(tunnel_stall | fetch_stall | recompile | fold_miss | "
+            "wedge_precursor); each has a structured /debug/anomalies "
+            "event carrying the cycle seq.",
+            ["class"],
+            registry=r,
+        )
+        self.slo_burn_rate = Gauge(
+            "scheduler_slo_burn_rate",
+            "Latency-SLO burn rate over the fast/slow cycle windows "
+            "(1.0 = burning budget at exactly the sustainable rate).",
+            ["window"],
+            registry=r,
+        )
+        self.slo_budget_remaining = Gauge(
+            "scheduler_slo_budget_remaining",
+            "Fraction of the slow-window SLO violation budget left "
+            "(1.0 = untouched, negative = overspent).",
             registry=r,
         )
         # ---- durable state (state/: journal + snapshots + restore) ----

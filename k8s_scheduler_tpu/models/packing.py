@@ -44,6 +44,42 @@ class PackSpec:
         return (self.words, self.bools, self.aux)
 
 
+# The pad dimensions whose mid-serving flips force a full recompile
+# (and have wedged the rig backend — PERF.md "fold-mode rig wedge"):
+# name -> (snapshot field, axis) to read the padded size from. P/N are
+# the pod/node pads, E the existing-pod pad, MPN the per-node victim
+# depth, MA the per-pod (anti-)affinity term pad, MC the per-pod
+# topology-spread-constraint pad. core/observe.py diffs consecutive
+# signatures to attribute WHICH dimension flipped on a recompile.
+SIGNATURE_DIMS = (
+    ("P", "pod_valid", 0),
+    ("N", "node_valid", 0),
+    ("E", "exist_valid", 0),
+    ("MPN", "node_pods", 1),
+    ("MA", "pod_aff_terms", 1),
+    ("MC", "pod_tsc", 1),
+)
+
+
+def shape_signature(spec: PackSpec) -> tuple[tuple[str, int], ...]:
+    """Named pad-regime signature of a PackSpec: a stable tuple of
+    (dimension, padded size) pairs. Two cycles whose specs differ have
+    (at least) one differing signature entry whenever the flip is one
+    of the named regime dimensions; dictionary-growth recompiles (spec
+    key change with an identical signature) are still visible to the
+    observer via the regime_flip count."""
+    shapes: dict[str, tuple[int, ...]] = {
+        name: shape for name, _dt, shape, _off in spec.words
+    }
+    shapes.update({name: shape for name, shape, _off in spec.bools})
+    out = []
+    for dim, field, axis in SIGNATURE_DIMS:
+        shp = shapes.get(field)
+        if shp is not None and len(shp) > axis:
+            out.append((dim, int(shp[axis])))
+    return tuple(out)
+
+
 def make_spec(snap: ClusterSnapshot) -> PackSpec:
     words = []
     bools = []

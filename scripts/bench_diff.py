@@ -1,0 +1,324 @@
+#!/usr/bin/env python
+"""bench_diff: compare two BENCH_*.json headline artifacts for
+regressions — the CI tripwire the perf rounds read instead of eyeballing
+JSON blobs.
+
+    python scripts/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python scripts/bench_diff.py --json old.json new.json
+    python scripts/bench_diff.py --max-p50-rise 10 old.json new.json
+
+Accepts every artifact shape the repo produces:
+
+- driver-wrapped rounds artifacts (`BENCH_rN.json`: {"tail", "parsed"})
+  — uses `parsed.configs` (the compact headline rows) when the driver
+  managed to parse the line, and otherwise SCANS the recorded stdout/
+  stderr tail for embedded `{"config": N, ...}` records (r02/r04 came
+  back with `parsed: null` because the tail window truncated the line;
+  the per-config records inside the tail are still recoverable);
+- the full detail file (`BENCH_DETAIL.json`: {"configs": [...]});
+- a bare bench_suite JSON-lines dump (one record per line).
+
+Compared per config present in BOTH artifacts, each with its own
+threshold flag (percent):
+
+    dps            decisions/s        regression = drop  > --max-dps-drop
+    p50_ms         cycle latency p50  regression = rise  > --max-p50-rise
+    p99_ms         cycle latency p99  regression = rise  > --max-p99-rise
+                   (looser by default: ROUND5.md p99 embeds tunnel
+                   stalls that come and go between runs)
+    device_ms      device compute     regression = rise  > --max-device-rise
+    encode_p50_ms  host encode p50    regression = rise  > --max-encode-rise
+    stall_cycles   >10x-p50 cycles    regression = new > old + --allow-stalls
+    anomalies      classifier total   regression = new > old + --allow-stalls
+
+Millisecond metrics additionally ignore absolute deltas below
+--min-ms-delta (CPU smoke configs sit at sub-ms device times where a
+percentage gate is pure noise). Exit status: 0 = clean, 1 = regression,
+2 = usage/parse error. `--json` emits the full comparison object.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# metric -> (kind, long key, compact key)
+_METRICS = {
+    "dps": ("higher", "decisions_per_sec", "dps"),
+    "p50_ms": ("lower", "p50_ms", "p50"),
+    "p99_ms": ("lower", "p99_ms", "p99"),
+    "device_ms": ("lower", "device_ms", "dev"),
+    "encode_p50_ms": ("lower", "encode_p50_ms", "enc"),
+}
+_COUNT_METRICS = ("stall_cycles", "anomalies_total")
+
+
+def _scan_tail(text: str) -> list[dict]:
+    """Recover per-config records from a (possibly truncated) recorded
+    stdout/stderr tail: raw-decode a JSON object at every '{"config"'
+    (long rows) and '{"c"' (compact rows); torn objects are skipped."""
+    dec = json.JSONDecoder()
+    rows: list[dict] = []
+    for needle in ('{"config"', '{"c"'):
+        start = 0
+        while True:
+            i = text.find(needle, start)
+            if i < 0:
+                break
+            try:
+                obj, _end = dec.raw_decode(text[i:])
+            except ValueError:
+                start = i + 1
+                continue
+            if isinstance(obj, dict):
+                rows.append(obj)
+            start = i + 1
+    return rows
+
+
+def _normalize(row: dict) -> dict | None:
+    """One per-config record (long or compact keys) -> canonical dict."""
+    cfg = row.get("config", row.get("c"))
+    if cfg is None:
+        return None
+    out: dict = {"config": int(cfg)}
+    for name, (_kind, long_k, short_k) in _METRICS.items():
+        v = row.get(long_k, row.get(short_k))
+        if v is not None:
+            out[name] = float(v)
+    # stall/anomaly keys are emitted only when the SOURCE row carries
+    # them: a pre-PR5 compact row following the detail line in a tail
+    # must not clobber the detail's real counts with defaults
+    stall = row.get("stall_cycles", row.get("stall"))
+    if stall is not None:
+        out["stall_cycles"] = int(stall)
+    anom = row.get("anomalies", row.get("anom"))
+    if anom is not None:
+        out["anomalies"] = dict(anom)
+        out["anomalies_total"] = int(sum(anom.values()))
+    # require at least one real metric besides the config id, so a torn
+    # tail fragment can't masquerade as a record
+    if not any(k in out for k in _METRICS):
+        return None
+    return out
+
+
+def load_configs(path: str) -> dict[int, dict]:
+    """-> {config_number: normalized record}; later records win (the
+    detail line in a tail is followed by the compact headline line —
+    both describe the same run)."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    rows: list[dict] = []
+    try:
+        data = json.loads(text)
+    except ValueError:
+        data = None
+    if isinstance(data, dict):
+        parsed = data.get("parsed")
+        if isinstance(parsed, dict) and parsed.get("configs"):
+            rows = list(parsed["configs"])
+        elif data.get("configs"):
+            rows = list(data["configs"])
+        elif isinstance(data.get("tail"), str):
+            rows = _scan_tail(data["tail"])
+        elif "config" in data or "c" in data:
+            rows = [data]
+    elif isinstance(data, list):
+        rows = [r for r in data if isinstance(r, dict)]
+    else:
+        # JSON-lines (bench_suite standalone) or arbitrary text: scan
+        rows = _scan_tail(text)
+    out: dict[int, dict] = {}
+    for row in rows:
+        norm = _normalize(row)
+        if norm is not None:
+            # merge: a later row for the same config fills gaps but a
+            # compact row must not erase the long row's extra fields
+            out.setdefault(norm["config"], {}).update(
+                {k: v for k, v in norm.items() if v is not None}
+            )
+    return out
+
+
+def compare(
+    old: dict[int, dict],
+    new: dict[int, dict],
+    thresholds: dict[str, float],
+    allow_stalls: int,
+    min_ms_delta: float,
+) -> dict:
+    checks: list[dict] = []
+    regressions: list[dict] = []
+    common = sorted(set(old) & set(new))
+    for cfg in common:
+        o, n = old[cfg], new[cfg]
+        for name, (kind, _lk, _sk) in _METRICS.items():
+            if name not in o or name not in n:
+                continue
+            ov, nv = o[name], n[name]
+            limit = thresholds[name]
+            if ov:
+                delta_pct = (nv - ov) / ov * 100.0
+                worse = -delta_pct if kind == "higher" else delta_pct
+                regressed = worse > limit
+            else:
+                # zero baseline (compact rows round sub-0.05ms values
+                # to 0.0): percentages are undefined, and `x/0-guarded
+                # -> 0%` would let an unbounded rise through. A
+                # lower-is-better metric leaving 0 regresses on the
+                # absolute gate below; higher-is-better leaving 0 is an
+                # improvement.
+                delta_pct = None
+                regressed = kind == "lower" and nv > 0
+            if regressed and name.endswith("_ms"):
+                if abs(nv - ov) < min_ms_delta:
+                    regressed = False  # sub-noise absolute move
+            check = {
+                "config": cfg,
+                "metric": name,
+                "old": ov,
+                "new": nv,
+                "delta_pct": (
+                    round(delta_pct, 2) if delta_pct is not None
+                    else None
+                ),
+                "limit_pct": limit,
+                "regressed": regressed,
+            }
+            checks.append(check)
+            if regressed:
+                regressions.append(check)
+        for name in _COUNT_METRICS:
+            ov, nv = o.get(name, 0), n.get(name, 0)
+            regressed = nv > ov + allow_stalls
+            check = {
+                "config": cfg,
+                "metric": name,
+                "old": ov,
+                "new": nv,
+                "allow": allow_stalls,
+                "regressed": regressed,
+            }
+            if name == "anomalies_total":
+                check["classes"] = {
+                    "old": o.get("anomalies", {}),
+                    "new": n.get("anomalies", {}),
+                }
+            checks.append(check)
+            if regressed:
+                regressions.append(check)
+    return {
+        "configs_compared": common,
+        "only_old": sorted(set(old) - set(new)),
+        "only_new": sorted(set(new) - set(old)),
+        "checks": checks,
+        "regressions": regressions,
+        "ok": not regressions,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_diff",
+        description="diff two BENCH_*.json artifacts; non-zero exit on "
+        "regression (thresholds in percent)",
+    )
+    ap.add_argument("old")
+    ap.add_argument("new")
+    # Default calibration: loose enough that a known-good round pair
+    # with a methodology change between them diffs clean (r04 -> r05
+    # turned on fold-mode benching, which moved real incremental-fold
+    # cost into encode_p50_ms and shifted per-config device_ms), tight
+    # enough that a 2x phase regression or a dps drop still trips.
+    # Rounds comparing like-for-like runs should pass tighter values.
+    ap.add_argument("--max-dps-drop", type=float, default=10.0)
+    ap.add_argument("--max-p50-rise", type=float, default=20.0)
+    ap.add_argument("--max-p99-rise", type=float, default=50.0)
+    ap.add_argument("--max-device-rise", type=float, default=35.0)
+    ap.add_argument("--max-encode-rise", type=float, default=60.0)
+    ap.add_argument(
+        "--allow-stalls", type=int, default=1,
+        help="stall/anomaly count may grow by this many before it "
+        "counts as a regression (one stall is a known rig flake — "
+        "ROUND5.md's 28 s outlier was absent on rerun; two is a trend)",
+    )
+    ap.add_argument(
+        "--min-ms-delta", type=float, default=2.0,
+        help="ignore millisecond-metric regressions smaller than this "
+        "absolute delta (CPU smoke noise floor)",
+    )
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    try:
+        old = load_configs(args.old)
+        new = load_configs(args.new)
+    except OSError as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    if not old or not new:
+        print(
+            f"bench_diff: no per-config records found "
+            f"(old: {len(old)}, new: {len(new)}) — nothing to compare "
+            "is a parse error, not a pass",
+            file=sys.stderr,
+        )
+        return 2
+
+    result = compare(
+        old, new,
+        thresholds={
+            "dps": args.max_dps_drop,
+            "p50_ms": args.max_p50_rise,
+            "p99_ms": args.max_p99_rise,
+            "device_ms": args.max_device_rise,
+            "encode_p50_ms": args.max_encode_rise,
+        },
+        allow_stalls=args.allow_stalls,
+        min_ms_delta=args.min_ms_delta,
+    )
+    if args.json:
+        print(json.dumps(result, indent=2))
+        return 0 if result["ok"] else 1
+
+    for c in result["checks"]:
+        flag = "REGRESSED" if c["regressed"] else "ok"
+        if "delta_pct" in c:
+            dp = (
+                f"{c['delta_pct']:+7.2f}%"
+                if c["delta_pct"] is not None else "   n/a  "
+            )
+            print(
+                f"config {c['config']:>2} {c['metric']:<14} "
+                f"{c['old']:>14.3f} -> {c['new']:>14.3f} "
+                f"({dp} vs ±{c['limit_pct']:g}%) "
+                f"{flag}"
+            )
+        else:
+            print(
+                f"config {c['config']:>2} {c['metric']:<14} "
+                f"{c['old']:>14d} -> {c['new']:>14d} "
+                f"(allow +{c['allow']}) {flag}"
+            )
+    for side, cfgs in (("old", result["only_old"]),
+                       ("new", result["only_new"])):
+        if cfgs:
+            print(f"note: configs only in {side} artifact: {cfgs}")
+    if result["regressions"]:
+        print(
+            f"bench_diff: {len(result['regressions'])} regression(s) "
+            f"across configs {result['configs_compared']}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"bench_diff: clean — configs {result['configs_compared']}, "
+        f"{len(result['checks'])} checks"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -392,6 +392,71 @@ def test_inventory_drift_config_and_cli_cross_checks(tmp_path):
     assert codes_at(result, "ID004") == []
 
 
+def test_inventory_drift_phase_inventory_id005(tmp_path):
+    """ID005: the cycle-phase inventory cannot drift between
+    observe.PHASES, the trace lane mapping, the metrics docstring, and
+    the README Observability section — each surface is checked with a
+    seeded drift."""
+    result = lint_fixture(tmp_path, {
+        "core/observe.py": """\
+            PHASES = ("total", "encode", "device")
+        """,
+        # drifted both ways: 'device' missing, stale 'fetch' mapped
+        "core/flight_recorder.py": """\
+            TRACE_LANE_FOR_PHASE = {
+                "total": (1, "cycle"),
+                "encode": (1, "encode"),
+                "fetch": (1, "decision_wait"),
+            }
+        """,
+        # the scheduler_cycle_phase_seconds entry names no 'encode';
+        # the stray mention under ANOTHER family must not satisfy it
+        "metrics/metrics.py": '''\
+            """Families:
+
+            - scheduler_cycle_phase_seconds{phase} — total, device
+            - scheduler_other_total — counts encode events
+            """
+        ''',
+        "README.md": """\
+            # fixture
+
+            ## Observability
+
+            phases: total, encode (the third one goes undocumented)
+        """,
+    }, passes=["INVENTORY-DRIFT"])
+    msgs = [f.message for f in codes_at(result, "ID005")]
+    assert sum("missing from TRACE_LANE_FOR_PHASE" in m for m in msgs) == 1
+    assert any("'device'" in m and "TRACE_LANE_FOR_PHASE" in m
+               for m in msgs)
+    assert any("'fetch'" in m and "stale lane mapping" in m for m in msgs)
+    assert any("'encode'" in m and "metrics docstring" in m for m in msgs)
+    assert any("'device'" in m and "README" in m for m in msgs)
+    assert len(msgs) == 4
+
+    # a consistent tree lints clean
+    clean = lint_fixture(tmp_path / "clean", {
+        "core/observe.py": 'PHASES = ("total",)\n',
+        "core/flight_recorder.py":
+            'TRACE_LANE_FOR_PHASE = {"total": (1, "cycle")}\n',
+        "metrics/metrics.py":
+            '"""- scheduler_cycle_phase_seconds{phase} — total"""\n',
+        "README.md": "## Observability\n\ntotal\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert codes_at(clean, "ID005") == []
+
+    # no literal PHASES tuple at all: the inventory anchor itself is
+    # flagged (every other surface check would silently vanish with it)
+    anchorless = lint_fixture(tmp_path / "anchorless", {
+        "core/observe.py": "PHASES = tuple(x for x in ())\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "no literal PHASES tuple" in f.message
+        for f in codes_at(anchorless, "ID005")
+    )
+
+
 # ---- HYGIENE -------------------------------------------------------------
 
 
