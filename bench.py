@@ -226,6 +226,16 @@ def main() -> None:
             "anom": r.get("anomalies", {}),
             "sched": r.get("scheduled", 0),
             "unsched": r.get("unschedulable", 0),
+            # multi-cycle K-sweep headline (BENCH_MULTI_K): amortization
+            # factor vs the single dispatch and the best-K effective
+            # per-cycle p50 — both diffed directionally by bench_diff
+            **(
+                {
+                    "amort": r["tunnel_amortization"],
+                    "effp50": r["effective_cycle_p50_ms"],
+                }
+                if "tunnel_amortization" in r else {}
+            ),
         }
 
     line = {

@@ -180,6 +180,32 @@ def _draw_pending(cfg: int, i: int, prev: list | None, churn: float):
     return out, groups
 
 
+def _parse_multi_k_env() -> "list[int]":
+    """Parse BENCH_MULTI_K ("1,4,8,16"; "1" or empty disables). Raises
+    a named error on a typo — callers invoke this BEFORE the timed
+    measurement loop so a malformed value cannot throw away minutes of
+    completed device time at artifact-assembly."""
+    mk_env = os.environ.get("BENCH_MULTI_K", "")
+    if not mk_env:
+        return []
+    try:
+        ks = sorted(
+            {max(int(x), 1) for x in mk_env.split(",") if x.strip()}
+        )
+    except ValueError as e:
+        raise SystemExit(
+            f"BENCH_MULTI_K={mk_env!r} is not a comma list of ints: {e}"
+        ) from None
+    if not ks or ks == [1]:
+        # "1" disables as documented — a K=[1] "sweep" would emit
+        # tunnel_amortization=1.0 and trip bench_diff's amortization
+        # tripwire against a real-sweep baseline
+        return []
+    if 1 not in ks:
+        ks = [1] + ks  # the sweep needs its own baseline
+    return ks
+
+
 def run_config(cfg: int, snapshots: int = 50) -> dict:
     import jax
     import numpy as np
@@ -189,6 +215,7 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     )
 
     enable_compilation_cache()
+    multi_ks = _parse_multi_k_env()  # fail fast on a typo'd env var
 
     from k8s_scheduler_tpu.models import SnapshotEncoder
 
@@ -664,7 +691,27 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     from k8s_scheduler_tpu.core.observe import classify_latency_series
 
     anomalies = classify_latency_series(times)
+    # multi-cycle K-sweep (BENCH_MULTI_K="1,4,8,16" or "1" to disable):
+    # effective per-cycle RT of a K-cycle device batch vs the single
+    # dispatch, surfaced as tunnel_amortization / effective_cycle_p50_ms
+    # so scripts/bench_diff.py can tripwire them directionally
+    multi: dict | None = None
+    if multi_ks:
+        multi = run_multicycle_config(cfg, k_values=tuple(multi_ks))
     return {
+        **(
+            {
+                "multi_cycle": multi,
+                **{
+                    k: multi[k]
+                    for k in (
+                        "tunnel_amortization", "effective_cycle_p50_ms"
+                    )
+                    if k in multi
+                },
+            }
+            if multi is not None else {}
+        ),
         "config": cfg,
         "commit_mode": mode,
         "name": CONFIG_NAMES[cfg],
@@ -700,6 +747,131 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
         ),
         **{k: v // max(snapshots, 1) for k, v in totals.items()},
     }
+
+
+def run_multicycle_config(
+    cfg: int,
+    k_values=(1, 4, 8, 16),
+    batches: int = 6,
+    group_pods: int = 64,
+) -> dict:
+    """The multi-cycle K-sweep axis (ROADMAP item 1): effective
+    per-cycle round trip of a K-cycle device-resident batch
+    (core/cycle.build_packed_multicycle_fn) over SMALL-DELTA arrival
+    groups, against the K=1 single-dispatch baseline.
+
+    Reports, per K: the forced-sync batch p50 (encode K groups + one
+    dispatch + the one stacked slimmed fetch) and the EFFECTIVE
+    per-cycle round trip `batch_p50 / K` — the number the amortization
+    story is about (`tunnel_rt / K` instead of `tunnel_rt` per cycle).
+    `tunnel_amortization` = K=1 effective p50 / best-K effective p50.
+
+    Only configs whose workload sits inside the exactness envelope
+    sweep (no inter-pod affinity/spread/volumes/ports — configs 3/4
+    report `skipped` with the gating capability, exactly like the
+    serving fallback); config 5's gang draw has no small-group shape.
+    """
+    import jax
+    import numpy as np
+
+    from k8s_scheduler_tpu.core.cycle import (
+        build_packed_multicycle_fn,
+        multicycle_unsupported_reason,
+    )
+    from k8s_scheduler_tpu.core.pipeline import build_multicycle_slim_fn
+    from k8s_scheduler_tpu.models import SnapshotEncoder, packing
+
+    if cfg == 5:
+        return {"skipped": "gang_group_draw"}
+    _P_real, N_real = CONFIG_SHAPES[cfg]
+    base_nodes, base_existing = make_config_base(cfg)
+    enc = SnapshotEncoder(
+        pad_pods=_pad(group_pods, 64), pad_nodes=_pad(N_real)
+    )
+
+    def draw_group(seed: int):
+        pods, _g = make_config_pending(
+            cfg, seed=seed, count=group_pods, name_prefix=f"mc{seed}-"
+        )
+        return enc.encode(base_nodes, pods, base_existing)
+
+    snap0 = draw_group(0)
+    reason = multicycle_unsupported_reason(snap0)
+    if reason is not None:
+        return {"skipped": reason}
+    spec = packing.make_spec(snap0)
+    max_k = max(k_values)
+    # one spec for the whole sweep: pre-encode max_k x batches groups,
+    # verify the regime never flips (grow-only dictionaries settle
+    # after the first draws), pack once
+    packed = [packing.pack(snap0, spec)]
+    for s in range(1, max_k * batches):
+        snap = draw_group(s)
+        sp = packing.make_spec(snap)
+        if sp.key() != spec.key():
+            # re-encode the settled regime from the top
+            spec = sp
+            packed = [
+                packing.pack(draw_group(j), spec)
+                for j in range(s + 1)
+            ]
+        else:
+            packed.append(packing.pack(snap, spec))
+    slim = build_multicycle_slim_fn(N_real)
+    per_k: dict[str, dict] = {}
+    baseline_eff = None
+    best_eff = None
+    best_k = 1
+    for k in sorted(k_values):
+        mfn = build_packed_multicycle_fn(spec, k=k)
+        # warmup/compile outside the timed window
+        w0 = np.stack([packed[j % len(packed)][0] for j in range(k)])
+        b0 = np.stack([packed[j % len(packed)][1] for j in range(k)])
+        res = mfn(jax.device_put(w0), jax.device_put(b0), None,
+                  np.int32(k))
+        jax.device_get(
+            slim(res.assignment, res.unschedulable, res.gang_dropped,
+                 res.attempted, res.cycles_run)
+        )
+        times = []
+        for bi in range(batches):
+            rows = [
+                packed[(bi * k + j) % len(packed)] for j in range(k)
+            ]
+            t0 = time.perf_counter()
+            wb = jax.device_put(np.stack([w for w, _ in rows]))
+            bb = jax.device_put(np.stack([b for _, b in rows]))
+            res = mfn(wb, bb, None, np.int32(k))
+            a, flags, ran = jax.device_get(
+                slim(res.assignment, res.unschedulable,
+                     res.gang_dropped, res.attempted, res.cycles_run)
+            )
+            times.append(time.perf_counter() - t0)
+            assert int(ran) == k
+        batch_p50 = _percentile(times, 50)
+        eff = batch_p50 / k
+        stall = sum(
+            1 for t in times if batch_p50 > 0 and t > 10 * batch_p50
+        )
+        per_k[str(k)] = {
+            "batch_p50_ms": round(batch_p50 * 1e3, 3),
+            "effective_p50_ms": round(eff * 1e3, 3),
+            "stall_cycles": stall,
+        }
+        if k == 1:
+            baseline_eff = eff
+        if best_eff is None or eff < best_eff:
+            best_eff, best_k = eff, k
+    out = {
+        "group_pods": group_pods,
+        "batches": batches,
+        "per_k": per_k,
+        "best_k": best_k,
+    }
+    if baseline_eff and best_eff:
+        out["tunnel_amortization"] = round(baseline_eff / best_eff, 2)
+        out["effective_cycle_p50_ms"] = round(best_eff * 1e3, 3)
+    return out
 
 
 def run_suite(configs=(1, 2, 3, 4, 5), snapshots: int = 50) -> list[dict]:

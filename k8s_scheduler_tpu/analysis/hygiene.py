@@ -1,4 +1,4 @@
-"""HYGIENE (HY0xx): dead module-level names.
+"""HYGIENE (HY0xx): dead module-level names and script-layer sprawl.
 
 The probe/profiling script layer accretes imports and private constants
 that outlive the experiment that needed them; in the package they also
@@ -9,6 +9,11 @@ cost import time. Conservative by construction:
          point — and for names listed in __all__)
 - HY002  a module-level `_private` assignment never referenced again
          (underscore names only: public constants may be external API)
+- HY003  scripts/ inventory drift: a `scripts/*.py` not named in
+         SCRIPT_ALLOWLIST (one-off probe/bisect/trace scripts
+         historically accumulated 25 deep before ISSUE 6 pruned them —
+         adding a script now requires the deliberate act of listing it
+         here), or an allowlist entry whose file no longer exists
 """
 
 from __future__ import annotations
@@ -19,22 +24,68 @@ import re
 from .core import Finding, LintContext
 from .registry import PassBase
 
+# The maintained scripts/ inventory. Everything here is referenced by
+# the README, the test suite, or CI; a new script joins by being added
+# HERE in the same commit (HY003 fails otherwise), which is the review
+# hook that keeps dead one-off probes from accumulating silently again.
+SCRIPT_ALLOWLIST = frozenset({
+    "scripts/bench_diff.py",      # BENCH artifact CI tripwire
+    "scripts/lint_metrics.py",    # metric-inventory shim (tests)
+    "scripts/probe_pipeline.py",  # CPU-runnable pipeline smoke probe
+    "scripts/schedlint.py",       # this framework's CLI
+    "scripts/soak_differential.py",  # slow-marked differential soak
+    "scripts/soak_failover.py",   # slow-marked kill -9 failover soak
+})
+
 
 class HygienePass(PassBase):
     name = "HYGIENE"
     codes = {
         "HY001": "unused module-level import",
         "HY002": "dead private module-level constant",
+        "HY003": "scripts/ inventory drift (not in SCRIPT_ALLOWLIST)",
     }
 
     def run(self, ctx: LintContext) -> list[Finding]:
         findings: list[Finding] = []
+        seen_scripts: set[str] = set()
         for sf in ctx.files:
+            rel = sf.rel.replace("\\", "/")
+            if rel.startswith("scripts/"):
+                seen_scripts.add(rel)
+                if rel not in SCRIPT_ALLOWLIST:
+                    findings.append(Finding(
+                        sf.rel, 1, "HY003",
+                        f"{rel} is not in analysis/hygiene.py's "
+                        "SCRIPT_ALLOWLIST — list it deliberately or "
+                        "remove the script (one-off probes accumulate)",
+                    ))
             if sf.rel.endswith("__init__.py"):
                 continue
             if sf.rel.endswith("_pb2.py"):
                 continue  # generated protobuf output, not hand-written
             findings.extend(self._check(sf))
+        # dangling allowlist entries: judged against the DISK, not the
+        # scanned set — a path-scoped scan of one script must not
+        # report every other (existing) entry as stale. Gated on the
+        # scan having covered either scripts/ or this pass's own module
+        # (any real-repo scan has one of the two): fixture trees that
+        # contain neither must not be judged against the repo's
+        # inventory, but "scripts/ was deleted wholesale while the
+        # allowlist still names it" — seen_scripts empty — must be
+        if seen_scripts or ctx.file(
+            "k8s_scheduler_tpu/analysis/hygiene.py"
+        ) is not None:
+            import os
+
+            for rel in sorted(SCRIPT_ALLOWLIST - seen_scripts):
+                if not os.path.exists(os.path.join(ctx.root, rel)):
+                    findings.append(Finding(
+                        "k8s_scheduler_tpu/analysis/hygiene.py", 1,
+                        "HY003",
+                        f"SCRIPT_ALLOWLIST names {rel} but no such "
+                        "file exists — remove the stale entry",
+                    ))
         return findings
 
     def _check(self, sf) -> list[Finding]:

@@ -88,6 +88,22 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         "(MC) the same way (overrides config padMc; 0 = keep config)",
     )
     ap.add_argument(
+        "--multi-cycle-k", type=int, default=0,
+        help="multi-cycle on-device serving: coalesce up to K arrival "
+        "groups into one device dispatch running K scheduling cycles in "
+        "a device-resident loop (amortizes the dispatch round trip "
+        "K-fold for small-delta cycles; config multiCycleK; 1 disables, "
+        "0 = keep config). Workloads outside the exactness envelope "
+        "fall back to sequential single-cycle dispatches",
+    )
+    ap.add_argument(
+        "--multi-cycle-max-wait-ms", type=float, default=-1.0,
+        help="latency bound on the multi-cycle coalescing buffer: a "
+        "delta group is never held back longer than this many ms "
+        "waiting for the batch to fill (config multiCycleMaxWaitMs; "
+        "-1 = keep config)",
+    )
+    ap.add_argument(
         "--slo-p99-ms", type=float, default=-1.0,
         help="latency SLO objective: at most 1%% of cycles in the "
         "sloWindowCycles window may exceed this many milliseconds of "
@@ -128,6 +144,10 @@ def main(argv: list[str] | None = None) -> int:
         config.health_max_cycle_age_seconds = args.health_max_cycle_age
     if args.slo_p99_ms >= 0:
         config.slo_p99_ms = args.slo_p99_ms
+    if args.multi_cycle_k > 0:
+        config.multi_cycle_k = args.multi_cycle_k
+    if args.multi_cycle_max_wait_ms >= 0:
+        config.multi_cycle_max_wait_ms = args.multi_cycle_max_wait_ms
     if args.state_dir:
         config.state_dir = args.state_dir
     if args.snapshot_interval >= 0:

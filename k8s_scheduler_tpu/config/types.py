@@ -135,6 +135,19 @@ class SchedulerConfiguration:
     # 0 disables the objective (attribution + anomalies still run).
     slo_p99_ms: float = 0.0
     slo_window_cycles: int = 1024
+    # multi-cycle on-device serving (core/cycle.build_packed_multicycle_fn):
+    # coalesce up to K per-cycle arrival groups into ONE device dispatch
+    # running K scheduling cycles in a device-resident loop, amortizing
+    # the remote-dispatch round trip K-fold for small-delta cycles.
+    # 1 disables batching (every cycle dispatches alone). Workloads
+    # outside the exactness envelope (inter-pod affinity, topology
+    # spread, volumes, pending host ports, extenders) automatically fall
+    # back to sequential single-cycle dispatches.
+    multi_cycle_k: int = 1
+    # latency bound on the coalescing buffer: a delta group is never
+    # held back longer than this many milliseconds waiting for the
+    # batch to fill (an idle pop also flushes immediately)
+    multi_cycle_max_wait_ms: float = 5.0
     # durable scheduler state (state/ package): directory for the
     # write-ahead journal + snapshots. "" disables durability — a
     # takeover then rebuilds only what informer events re-deliver,
@@ -269,6 +282,8 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         ),
         slo_p99_ms=float(data.get("sloP99Ms", 0.0)),
         slo_window_cycles=int(data.get("sloWindowCycles", 1024)),
+        multi_cycle_k=int(data.get("multiCycleK", 1)),
+        multi_cycle_max_wait_ms=float(data.get("multiCycleMaxWaitMs", 5.0)),
         state_dir=str(data.get("stateDir", "")),
         snapshot_interval_seconds=_duration_seconds(
             data.get("snapshotInterval", 60.0)

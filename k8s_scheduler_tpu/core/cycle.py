@@ -24,6 +24,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.interfaces import CycleContext
 from ..framework.runtime import Framework
@@ -76,6 +77,69 @@ class CycleDecision:
     node_requested: jnp.ndarray  # f32 [N, R] post-cycle (the carry)
     unschedulable: jnp.ndarray  # bool [P] valid pod that found no node
     gang_dropped: jnp.ndarray  # bool [P] placed, then unwound
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MultiCycleResult:
+    """Stacked decisions of one multi-cycle dispatch (K inner cycles,
+    build_packed_multicycle_fn). Rows past `cycles_run` were never
+    executed (early exit on drain) and carry the init fill (-1 / False /
+    zeros)."""
+
+    assignment: jnp.ndarray  # i32 [K, P] node index or -1
+    unschedulable: jnp.ndarray  # bool [K, P]
+    gang_dropped: jnp.ndarray  # bool [K, P]
+    attempted: jnp.ndarray  # bool [K, P] inner cycle i's pod_valid — the
+    # host maps row i's leading slots back onto delta group i's pods
+    node_requested: jnp.ndarray  # f32 [K, N, R] POST-inner-cycle state:
+    # row i feeds that inner cycle's deferred diagnosis/preemption
+    # programs (device-resident; never part of the slimmed fetch)
+    cycles_run: jnp.ndarray  # i32 [] inner cycles actually executed
+
+
+def multicycle_unsupported_reason(snap: ClusterSnapshot) -> str | None:
+    """Why this snapshot is outside the multi-cycle envelope (None = in).
+
+    The device-resident K-cycle loop carries exactly two pieces of
+    cross-cycle state: `node_requested` and the per-group placed-member
+    counts. That is EXACT — bit-identical to K sequential dispatches
+    with host bind-folding between them — precisely when no enabled
+    capability reads any other existing-pod-derived state. Capabilities
+    that do (and therefore fall back to sequential single-cycle
+    dispatches, scheduler-side):
+
+    - inter-pod affinity / topology spread: a bind changes the
+      matched-existing tables and domain counts the next cycle reads;
+    - volumes: a bind claims PVs the next cycle's VolumeBinding state
+      must see;
+    - host ports: a bind occupies ports in the node port bitmap;
+    - extenders: verdicts are consulted per host cycle, not per inner
+      device cycle.
+
+    The flags are per-SNAPSHOT capabilities (what the pending/existing
+    pods actually carry), not per-config — a default plugin set serving
+    an affinity-free workload stays in the envelope."""
+    if snap.has_extender:
+        return "extender"
+    if snap.has_inter_pod_affinity:
+        return "inter_pod_affinity"
+    if snap.has_topology_spread:
+        return "topology_spread"
+    if snap.has_volumes:
+        return "volumes"
+    # host ports: only PENDING pods that actually request a port can
+    # occupy one — port-free binds leave the node port bitmaps
+    # untouched, so a port-free pending set stays exact regardless of
+    # what existing pods hold. (num_distinct_ports is a sticky padded
+    # dictionary size with a nonzero floor — useless as a signal.)
+    # pod_port_ids is an ARRAY field: concrete on the host-side
+    # snapshots this gate runs on, a tracer inside the compiled loop —
+    # where the host has already gated, so the check is skipped.
+    ports = snap.pod_port_ids
+    if isinstance(ports, np.ndarray) and bool((ports >= 0).any()):
+        return "host_ports"
+    return None
 
 
 def sampling_mask(snap: ClusterSnapshot, pct: int) -> jnp.ndarray:
@@ -385,52 +449,22 @@ def _gang_unwind(snap: ClusterSnapshot, result):
     return result, dropped
 
 
-def build_cycle_fn(
-    framework: Framework | None = None,
-    gang_scheduling: bool = True,
-    commit_mode: str = "scan",
-    max_rounds: int = 64,
-    percentage_of_nodes_to_score: int = 0,  # 0 = adaptive (upstream default)
-    rounds_kw: dict | None = None,  # compact/passes/shortlist overrides
-    outputs: str = "full",  # "full" -> CycleResult, "latency" ->
-    # CycleDecision: only the decision carry is computed; reject
-    # attribution / per-round diagnostics / pv_claimed move off the
-    # decision path (build_diagnosis_fn is the deferred companion)
-) -> Callable[[ClusterSnapshot], CycleResult]:
-    """Compile the cycle for a framework (default: the default plugin set).
-    The returned callable is jitted; snapshots with identical padded shapes
-    reuse the compiled program.
-
-    `outputs` selects the split-phase axis: "full" returns the classic
-    CycleResult (diagnostic outputs fused into the decision program);
-    "latency" returns a CycleDecision whose compiled program contains ONLY
-    the work needed to decide placements — the parity contract (enforced
-    by tests/test_pipeline.py) is that its assignment/node_requested/
-    unschedulable/gang_dropped are bit-identical to the monolithic
-    program's in both commit modes.
-
-    `commit_mode` selects the in-cycle commitment engine:
-      - "scan": the strict sequential scan (ops/commit.py) — exact
-        one-pod-at-a-time ScheduleOne semantics, one lax.scan step per
-        pod. Best for small pending sets and for differential parity.
-      - "rounds": the round-based batched commit (ops/rounds.py) — a few
-        MXU-wide rounds instead of P sequential steps; the production
-        mode at 10k-pod scale (~1000x faster on TPU; see ops/rounds.py
-        for the documented semantics contract).
-
-    With `gang_scheduling` (the Coscheduling plugin analogue, SURVEY.md §2
-    C14), pods carrying a pod-group whose placed-member count stays below
-    the group's minMember are rolled back after the commit scan — the
-    all-or-nothing semantics upstream gets from Permit-and-wait, here a
-    single batched unwind. minMember counts pods placed THIS cycle;
-    already-running members are bound facts, not waiters."""
-    fw = framework or Framework.from_config()
-    if commit_mode not in ("scan", "rounds"):
-        raise ValueError(f"unknown commit_mode {commit_mode!r}")
-    if outputs not in ("full", "latency"):
-        raise ValueError(f"unknown outputs {outputs!r}")
-    if commit_mode == "rounds":
-        fw.check_batched_parity()
+def _make_cycle_body(
+    fw: Framework,
+    gang_scheduling: bool,
+    commit_mode: str,
+    max_rounds: int,
+    percentage_of_nodes_to_score: int,
+    rounds_kw: dict | None,
+    outputs: str,
+):
+    """The UNJITTED cycle body shared by every cycle builder: one
+    snapshot in, CycleResult/CycleDecision out. `build_cycle_fn` wraps
+    it in a jit; `build_packed_multicycle_fn` re-invokes it K times
+    inside a device-resident loop (one trace, K iterations). Extracted
+    so the multi-cycle loop executes the EXACT op chain of a single
+    dispatch — the bit-identical equivalence contract
+    (tests/test_multicycle.py) rests on this sharing."""
     lean = outputs == "latency"
 
     def cycle(snap: ClusterSnapshot, stable=None) -> CycleResult:
@@ -595,6 +629,59 @@ def build_cycle_fn(
             rounds_used, accepted_per_round, diag_per_round,
         )
 
+    return cycle
+
+
+def build_cycle_fn(
+    framework: Framework | None = None,
+    gang_scheduling: bool = True,
+    commit_mode: str = "scan",
+    max_rounds: int = 64,
+    percentage_of_nodes_to_score: int = 0,  # 0 = adaptive (upstream default)
+    rounds_kw: dict | None = None,  # compact/passes/shortlist overrides
+    outputs: str = "full",  # "full" -> CycleResult, "latency" ->
+    # CycleDecision: only the decision carry is computed; reject
+    # attribution / per-round diagnostics / pv_claimed move off the
+    # decision path (build_diagnosis_fn is the deferred companion)
+) -> Callable[[ClusterSnapshot], CycleResult]:
+    """Compile the cycle for a framework (default: the default plugin set).
+    The returned callable is jitted; snapshots with identical padded shapes
+    reuse the compiled program.
+
+    `outputs` selects the split-phase axis: "full" returns the classic
+    CycleResult (diagnostic outputs fused into the decision program);
+    "latency" returns a CycleDecision whose compiled program contains ONLY
+    the work needed to decide placements — the parity contract (enforced
+    by tests/test_pipeline.py) is that its assignment/node_requested/
+    unschedulable/gang_dropped are bit-identical to the monolithic
+    program's in both commit modes.
+
+    `commit_mode` selects the in-cycle commitment engine:
+      - "scan": the strict sequential scan (ops/commit.py) — exact
+        one-pod-at-a-time ScheduleOne semantics, one lax.scan step per
+        pod. Best for small pending sets and for differential parity.
+      - "rounds": the round-based batched commit (ops/rounds.py) — a few
+        MXU-wide rounds instead of P sequential steps; the production
+        mode at 10k-pod scale (~1000x faster on TPU; see ops/rounds.py
+        for the documented semantics contract).
+
+    With `gang_scheduling` (the Coscheduling plugin analogue, SURVEY.md §2
+    C14), pods carrying a pod-group whose placed-member count stays below
+    the group's minMember are rolled back after the commit scan — the
+    all-or-nothing semantics upstream gets from Permit-and-wait, here a
+    single batched unwind. minMember counts pods placed THIS cycle;
+    already-running members are bound facts, not waiters."""
+    fw = framework or Framework.from_config()
+    if commit_mode not in ("scan", "rounds"):
+        raise ValueError(f"unknown commit_mode {commit_mode!r}")
+    if outputs not in ("full", "latency"):
+        raise ValueError(f"unknown outputs {outputs!r}")
+    if commit_mode == "rounds":
+        fw.check_batched_parity()
+    cycle = _make_cycle_body(
+        fw, gang_scheduling, commit_mode, max_rounds,
+        percentage_of_nodes_to_score, rounds_kw, outputs,
+    )
     return _jit(
         cycle, "cycle",
         disc=(
@@ -629,6 +716,162 @@ def build_packed_cycle_fn(spec, **kw):
         disc=(
             repr(spec.key()) + repr(sorted(scalars.items()))
             + _fw_disc(kw.get("framework"))
+        ),
+    )
+
+
+def build_packed_multicycle_fn(
+    spec,
+    framework: Framework | None = None,
+    k: int = 4,
+    gang_scheduling: bool = True,
+    commit_mode: str = "rounds",
+    max_rounds: int = 64,
+    percentage_of_nodes_to_score: int = 0,
+    rounds_kw: dict | None = None,
+):
+    """The MULTI-CYCLE serving program: up to `k` scheduling cycles per
+    dispatch inside a device-resident `lax.while_loop`, amortizing the
+    ~100 ms remote-compile tunnel round trip K-fold for small-delta
+    cycles (ROADMAP item 1 — `tunnel_rt / K` instead of `tunnel_rt`).
+
+    Inputs: `(wbufs u32 [K, W], bbufs u8 [K, B], stable, n_cycles i32)`
+    — a stacked per-cycle delta feed: row i is the packed snapshot the
+    host would have dispatched as cycle i (its own pending group, ranks,
+    cycle_index), all encoded against the PRE-batch cache state. The
+    loop threads the post-cycle carry the host fold would have produced:
+
+      - `node_requested` — inner cycle i+1 schedules against cycle i's
+        post-commit capacity, overriding the (stale) snapshot field;
+      - per-group placed counts — folded into `group_existing_count` so
+        a gang spanning inner cycles still reaches minMember.
+
+    Within the supported envelope (`multicycle_unsupported_reason` —
+    no inter-pod affinity / topology spread / volumes / host ports /
+    extenders) these two are the ONLY existing-pod-derived state the
+    cycle body reads, so the loop is bit-identical to K sequential
+    single-cycle dispatches with host bind-folding between them
+    (tests/test_multicycle.py asserts exactly that). The inner body IS
+    the single-dispatch body (`_make_cycle_body`, outputs="latency"),
+    traced once.
+
+    Early exit: the loop stops at `n_cycles` or as soon as every
+    remaining row carries zero valid pods (the pending set drained), so
+    a short batch never pays the full K iterations. `cycles_run`
+    reports how many rows are real.
+
+    There is no clock under jit, so per-inner-cycle device time cannot
+    be stamped on device; the host apportions the measured batch window
+    by per-cycle attempted-pod counts (core/scheduler.py) — the
+    `device_share` phase in core/observe.PHASES."""
+    from ..models import packing
+
+    fw = framework or Framework.from_config()
+    if commit_mode not in ("scan", "rounds"):
+        raise ValueError(f"unknown commit_mode {commit_mode!r}")
+    if k < 1:
+        raise ValueError(f"multi-cycle k must be >= 1, got {k}")
+    if commit_mode == "rounds":
+        fw.check_batched_parity()
+    body = _make_cycle_body(
+        fw, gang_scheduling, commit_mode, max_rounds,
+        percentage_of_nodes_to_score, rounds_kw, outputs="latency",
+    )
+    # pod_valid's static location in the packed bool buffer: the
+    # early-exit drain check reads the stacked validity rows directly
+    # instead of unpacking every snapshot up front
+    pv_off = pv_p = None
+    for name, shape, off in spec.bools:
+        if name == "pod_valid":
+            pv_off, pv_p = off, int(shape[0])
+    if pv_off is None:  # pragma: no cover — every spec carries pod_valid
+        raise ValueError("spec has no pod_valid field")
+
+    def multicycle(wbufs, bbufs, stable, n_cycles):
+        snap0 = packing.unpack(wbufs[0], bbufs[0], spec)
+        reason = multicycle_unsupported_reason(snap0)
+        if reason is not None:
+            # trace-time guard: the scheduler/bench gate BEFORE building
+            # this program; reaching here is a driver bug, and a traced
+            # wrong answer would be far worse than a loud build failure
+            raise ValueError(
+                f"multi-cycle loop unsupported for this snapshot: "
+                f"{reason} (carry would go stale across inner cycles)"
+            )
+        P = snap0.P
+        N, R = snap0.node_requested.shape
+        G = snap0.group_min_member.shape[0]
+        # suffix counts of valid pods per row: remaining[i] == 0 means
+        # rows i.. are all empty — the drain early-exit
+        pv = (bbufs[:, pv_off:pv_off + pv_p] != 0)  # [K, P]
+        counts = jnp.sum(pv, axis=1, dtype=jnp.int32)  # [K]
+        remaining = jnp.concatenate(
+            [jnp.cumsum(counts[::-1])[::-1], jnp.zeros((1,), jnp.int32)]
+        )  # [K+1]
+
+        def body_fn(carry):
+            (i, node_req, gplaced, a_out, u_out, d_out, act_out,
+             nr_out) = carry
+            w = jax.lax.dynamic_index_in_dim(wbufs, i, keepdims=False)
+            b = jax.lax.dynamic_index_in_dim(bbufs, i, keepdims=False)
+            snap = packing.unpack(w, b, spec)
+            snap = dataclasses.replace(
+                snap,
+                node_requested=node_req,
+                group_existing_count=snap.group_existing_count + gplaced,
+            )
+            dec = body(snap, stable)
+            placed = snap.pod_valid & (dec.assignment >= 0)
+            gid = jnp.clip(snap.pod_group, 0, G - 1)
+            in_group = snap.pod_group >= 0
+            gplaced = gplaced + jnp.zeros((G,), jnp.int32).at[gid].add(
+                jnp.where(in_group & placed, 1, 0)
+            )
+            a_out = a_out.at[i].set(
+                jnp.where(snap.pod_valid, dec.assignment, -1)
+            )
+            u_out = u_out.at[i].set(dec.unschedulable)
+            d_out = d_out.at[i].set(dec.gang_dropped)
+            act_out = act_out.at[i].set(snap.pod_valid)
+            nr_out = nr_out.at[i].set(dec.node_requested)
+            return (i + 1, dec.node_requested, gplaced, a_out, u_out,
+                    d_out, act_out, nr_out)
+
+        def cond_fn(carry):
+            i = carry[0]
+            return (i < jnp.minimum(n_cycles, k)) & (
+                remaining[jnp.clip(i, 0, k)] > 0
+            )
+
+        init = (
+            jnp.int32(0),
+            snap0.node_requested,
+            jnp.zeros((G,), jnp.int32),
+            jnp.full((k, P), -1, jnp.int32),
+            jnp.zeros((k, P), bool),
+            jnp.zeros((k, P), bool),
+            jnp.zeros((k, P), bool),
+            jnp.zeros((k, N, R), jnp.float32),
+        )
+        i, _nr, _gp, a_out, u_out, d_out, act_out, nr_out = (
+            jax.lax.while_loop(cond_fn, body_fn, init)
+        )
+        return MultiCycleResult(
+            assignment=a_out,
+            unschedulable=u_out,
+            gang_dropped=d_out,
+            attempted=act_out,
+            node_requested=nr_out,
+            cycles_run=i,
+        )
+
+    return _jit(
+        multicycle, "multicycle",
+        disc=(
+            f"k{k}|{commit_mode}|{gang_scheduling}|{max_rounds}|"
+            f"{percentage_of_nodes_to_score}|"
+            f"{sorted((rounds_kw or {}).items())!r}|"
+            + repr(spec.key()) + _fw_disc(fw)
         ),
     )
 

@@ -71,6 +71,12 @@ TRACE_LANE_FOR_PHASE = {
     "postfilter": (LANE_HOST, "postfilter"),
     "device": (LANE_DEVICE, "device cycle[seq]"),
     "diag_lag": (LANE_DIAG, "diag lag[seq]"),
+    # multi-cycle batched decomposition: an inner cycle's host-side
+    # coalescing wait renders on the host lane (it precedes the batch's
+    # encode), its apportioned device share inside the batch's device
+    # slice (the host cannot see per-inner-cycle device boundaries)
+    "batch_wait": (LANE_HOST, "batch wait"),
+    "device_share": (LANE_DEVICE, "device cycle[seq]"),
 }
 
 

@@ -76,6 +76,14 @@ PHASES = (
     "postfilter",     # preemption force between winners and losers
     "diag_lag",       # deferred FailedScheduling attribution lag
     "compile",        # packed-program (re)build on a regime flip
+    # multi-cycle batched decomposition (core/scheduler.py
+    # _schedule_profile_multi): one device dispatch runs K inner cycles,
+    # and each inner cycle's record carries its share of the batch —
+    "batch_wait",     # how long this inner cycle's delta group waited
+    # host-side for the batch to fill (bounded by multiCycleMaxWaitMs)
+    "device_share",   # this inner cycle's apportioned share of the
+    # batch's device window (no clock runs under jit, so the host
+    # splits the measured window by per-cycle attempted-pod counts)
 )
 
 ANOMALY_CLASSES = (
@@ -132,6 +140,12 @@ def phase_seconds(rec) -> dict[str, float]:
         out["diag_lag"] = ph["diag_lag_ms"] / 1e3
     if "compile_ms" in ph:
         out["compile"] = ph["compile_ms"] / 1e3
+    # multi-cycle batched decomposition: stamped only on inner-cycle
+    # records of a multi-cycle dispatch (scheduler-side apportioning)
+    if "batch_wait_ms" in ph:
+        out["batch_wait"] = ph["batch_wait_ms"] / 1e3
+    if "device_share_ms" in ph:
+        out["device_share"] = ph["device_share_ms"] / 1e3
     return out
 
 
@@ -552,13 +566,22 @@ class CycleObserver:
                 if (
                     delta > 0 and not first and not flipped
                     and not counts.get("regime_flip")
+                    and not counts.get("multi_cycle_k")
+                    and not counts.get("post_batch")
                 ):
                     # a regime flip legitimately full-encodes; only an
                     # UNexplained fall off the delta path is a fold
                     # miss. regime_flip covers dictionary-growth
                     # recompiles too — spec.key() changed while the six
                     # named pad sizes stayed identical, so `flipped`
-                    # alone cannot see them
+                    # alone cannot see them. multi_cycle_k marks a
+                    # batched dispatch, whose K per-group encodes are
+                    # full by design (the delta arena serves the
+                    # single-cycle path) — explained, not a miss.
+                    # post_batch marks the FIRST single-cycle dispatch
+                    # after a batch, whose full encode is the batch's
+                    # doing: the plain encodes left _delta_state
+                    # describing the pre-batch arena
                     raise_anomaly(
                         "fold_miss",
                         phase="encode",

@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .cycle import _jit
+from .cycle import CycleDecision, _jit
 
 
 def build_decision_slim_fn(num_nodes: int):
@@ -66,6 +66,45 @@ def build_decision_slim_fn(num_nodes: int):
         return a, flags
 
     return _jit(slim, "decision_slim", disc=f"narrow{int(narrow)}")
+
+
+def build_multicycle_slim_fn(num_nodes: int):
+    """Multi-cycle variant of the decision slimming: stacked [K, P]
+    decisions in, (assignment i16|i32 [K, P], flags u8 [K, P],
+    cycles_run i32) out. Flag bits: 0 = unschedulable, 1 = gang_dropped,
+    2 = attempted (the pod was valid in that inner cycle — the host
+    needs it to tell "not this cycle's pod" from "placed at node 0")."""
+    narrow = num_nodes < (1 << 15)
+
+    def slim(assignment, unschedulable, gang_dropped, attempted,
+             cycles_run):
+        a = assignment.astype(jnp.int16) if narrow else assignment
+        flags = (
+            unschedulable.astype(jnp.uint8)
+            | (gang_dropped.astype(jnp.uint8) << 1)
+            | (attempted.astype(jnp.uint8) << 2)
+        )
+        return a, flags, cycles_run
+
+    return _jit(slim, "multicycle_slim", disc=f"narrow{int(narrow)}")
+
+
+def _cpu_safe_buffers(wbuf, bbuf):
+    """Force a device copy of numpy packed buffers on the CPU backend.
+
+    jax's CPU backend copies a jit's numpy arguments ASYNCHRONOUSLY on
+    the dispatch thread (reproduced in PR 4's pure-jax repro), so a
+    deferred program (diagnosis/preemption) still holding the host arena
+    can race the NEXT encode's in-place rewrite and read a torn copy.
+    The rig/TPU paths device_put explicitly and are unaffected; this is
+    the explicit copy for drivers that skip device_put on CPU
+    (K8S_TPU_NO_DEVICE_PUT=1, probes). A HOST-side np.copy is taken
+    first: jax.device_put on the CPU backend may zero-copy alias an
+    aligned numpy array, which would re-create exactly the aliasing
+    this guard exists to break."""
+    if isinstance(wbuf, np.ndarray) and jax.default_backend() == "cpu":
+        return jax.device_put(wbuf.copy()), jax.device_put(bbuf.copy())
+    return wbuf, bbuf
 
 
 class CycleHandle:
@@ -231,6 +270,156 @@ class CycleHandle:
         self._wbuf = self._bbuf = self._stable = self._emask = None
 
 
+class MultiCycleHandle:
+    """One in-flight multi-cycle batch (K inner cycles dispatched as a
+    single device program — core/cycle.build_packed_multicycle_fn).
+    Mirrors CycleHandle's contract: the only blocking transfer is the
+    slimmed stacked decision fetch; the per-inner-cycle deferred
+    programs (diagnosis, preemption) dispatch lazily against the stacked
+    buffers' row i and the loop's post-cycle-i `node_requested`."""
+
+    def __init__(self, pipe, result, slim, wbufs, bbufs, stable):
+        self._pipe = pipe
+        self.result = result  # MultiCycleResult device futures
+        self._slim = slim  # (i16|i32 [K,P], u8 [K,P], i32) futures
+        self._wbufs = wbufs
+        self._bbufs = bbufs
+        self._stable = stable
+        self._decisions = None
+        self._t_decisions = None
+        self._diag: dict[int, object] = {}
+        self._pre: dict[int, object] = {}
+        # inner cycle i -> (lag_s, t_done): deferred-diagnosis
+        # availability, stamped at first force so the scheduler can put
+        # diag_lag on inner-cycle flight records (stage_report is
+        # snapshotted BEFORE the apply loop that forces these)
+        self.diag_lag: dict[int, tuple[float, float]] = {}
+        self.fetched = False
+
+    def decisions(self):
+        """(assignment i32 [K, P], unschedulable bool [K, P],
+        gang_dropped bool [K, P], attempted bool [K, P], cycles_run int)
+        as numpy — blocks on the one slimmed stacked transfer."""
+        if self._decisions is None:
+            now = self._pipe._now
+            t0 = now()
+            self._pipe.stats["t_decision_start"] = t0
+            try:
+                a, flags, cycles_run = jax.device_get(self._slim)
+            except Exception:
+                # same contract as CycleHandle.decisions: a failed fetch
+                # consumes the batch so the ordering guard releases
+                self.fetched = True
+                self.release()
+                self._pipe._note_inflight()
+                raise
+            self._t_decisions = now()
+            st = self._pipe.stats
+            st["decision_wait_ms"] = (self._t_decisions - t0) * 1e3
+            st["t_decision_end"] = self._t_decisions
+            st["fetch_bytes"] = int(a.nbytes + flags.nbytes) + 4
+            self._pipe._fetch_bytes_total += st["fetch_bytes"]
+            m = self._pipe._metrics
+            if m is not None:
+                m.cycle_duration.labels(phase="decision_fetch").observe(
+                    self._t_decisions - t0
+                )
+                m.decision_fetch_bytes.inc(st["fetch_bytes"])
+            self._decisions = (
+                np.asarray(a, dtype=np.int32),
+                (flags & 1) != 0,
+                (flags & 2) != 0,
+                (flags & 4) != 0,
+                int(cycles_run),
+            )
+            self.fetched = True
+            self._pipe._note_inflight()
+        return self._decisions
+
+    def _inner_decision(self, i: int) -> CycleDecision:
+        """Inner cycle i's decision carry as the deferred programs'
+        input: stacked row i plus the loop's POST-cycle-i state."""
+        r = self.result
+        return CycleDecision(
+            assignment=r.assignment[i],
+            node_requested=r.node_requested[i],
+            unschedulable=r.unschedulable[i],
+            gang_dropped=r.gang_dropped[i],
+        )
+
+    def dispatch_preemption(self, i: int):
+        """Dispatch inner cycle i's preemption PostFilter (non-blocking);
+        returns its device-side result or None. NOTE the documented
+        multi-cycle deviation: candidates/victims are computed against
+        the BATCH-start existing set — a pod bound by an earlier inner
+        cycle is not yet evictable (it becomes so next batch)."""
+        if i not in self._pre and self._pipe._preempt_fn is not None:
+            self._pre[i] = self._pipe._preempt_fn(
+                self._wbufs[i], self._bbufs[i],
+                self._inner_decision(i), self._stable,
+            )
+        return self._pre.get(i)
+
+    def dispatch_diagnosis(self, i: int):
+        """Dispatch inner cycle i's FailedScheduling diagnosis program
+        (non-blocking); returns the device-side [P, F] handle or None.
+        Uses `pipe.multi_diag_fn` when set — the multi-cycle decisions
+        are lean (no fused reject counts), so the scheduler installs a
+        diagnosis program even for regimes whose single-cycle path runs
+        the fused full program and needs none."""
+        fn = self._pipe.multi_diag_fn or self._pipe._diag_fn
+        if i not in self._diag and fn is not None:
+            r = self.result
+            self._diag[i] = fn(
+                self._wbufs[i], self._bbufs[i], self._stable,
+                r.assignment[i], r.node_requested[i],
+            )
+            if self._pipe.forced_sync:
+                jax.block_until_ready(self._diag[i])
+                self._stamp_diag_lag(i)
+        return self._diag.get(i)
+
+    def _stamp_diag_lag(self, i: int) -> None:
+        if self._t_decisions is None or i in self.diag_lag:
+            return
+        t_done = self._pipe._now()
+        lag_s = max(0.0, t_done - self._t_decisions)
+        self.diag_lag[i] = (lag_s, t_done)
+        m = self._pipe._metrics
+        if m is not None:
+            m.cycle_duration.labels(phase="diag_lag").observe(lag_s)
+
+    def reject_counts(self, i: int):
+        """Force inner cycle i's diagnosis output (i32 [P, F]); None
+        when the pipeline has no diagnosis program. First force stamps
+        the deferred-diagnosis lag for inner cycle i — how long after
+        the batch's decision fetch the attribution became available."""
+        d = self.dispatch_diagnosis(i)
+        if d is None:
+            return None
+        arr = np.asarray(d)
+        self._stamp_diag_lag(i)
+        return arr
+
+    def block(self):
+        """Force everything in flight (the forced_sync escape hatch)."""
+        try:
+            jax.block_until_ready((self.result, self._slim))
+        except Exception:
+            self.fetched = True
+            self.release()
+            self._pipe._note_inflight()
+            raise
+        return self
+
+    def release(self):
+        self.result = self._slim = None
+        self._wbufs = self._bbufs = self._stable = None
+        self._diag = {}
+        self._pre = {}
+        self.diag_lag = {}
+
+
 class ServingPipeline:
     """Owns the two upload slots, the in-flight handle, and the carry
     hand-off (CarryKeeper-compatible). One instance per compiled packed
@@ -248,6 +437,10 @@ class ServingPipeline:
         keeper=None,
         diag_fn=None,
         preempt_fn=None,
+        multi_fn=None,  # optional multi-cycle program
+        # (build_packed_multicycle_fn) driving dispatch_multi; the
+        # scheduler assigns it lazily (`pipe.multi_fn = ...`) when
+        # multiCycleK > 1 and the workload is in the envelope
         forced_sync: bool = False,
         require_decision_fetch: bool = True,
         donate_diagnosis: bool = False,
@@ -274,6 +467,12 @@ class ServingPipeline:
         self._now = now
         self._slots = [None] * max(2, slots)
         self._slim_fn = None
+        self.multi_fn = multi_fn
+        # multi-cycle diagnosis program (build_diagnosis_fn): the
+        # scheduler installs it next to multi_fn; falls back to
+        # _diag_fn (carry mode shares one) when None
+        self.multi_diag_fn = None
+        self._multi_slim_fn = None
         self._last = None
         self._n = 0
         self._fetch_bytes_total = 0
@@ -335,6 +534,12 @@ class ServingPipeline:
         if device_put:
             wbuf = jax.device_put(wbuf)
             bbuf = jax.device_put(bbuf)
+        else:
+            # CPU backend: numpy arena buffers must not feed async
+            # dispatch directly — the deferred diagnosis/preemption
+            # programs would race the next encode's arena rewrite
+            # (see _cpu_safe_buffers)
+            wbuf, bbuf = _cpu_safe_buffers(wbuf, bbuf)
         if self._keeper is not None:
             carry = self._keeper.state(
                 wbuf, bbuf, stable, dirty, carry_key, pin=pin
@@ -384,6 +589,86 @@ class ServingPipeline:
             # inside dispatch_ms here, so the conservative
             # encode-vs-decision-wait estimate would misread the tiny
             # post-block fetch as "encode fully hidden" — pin it to 0
+            self.stats["encode_hidden_ms"] = 0.0
+        return handle
+
+    def dispatch_multi(
+        self,
+        wbufs,
+        bbufs,
+        stable,
+        n_cycles: int,
+        *,
+        device_put: bool = True,
+    ) -> MultiCycleHandle:
+        """Upload + dispatch one MULTI-CYCLE batch (stacked [K, ...]
+        packed snapshots, one device dispatch for up to `n_cycles` inner
+        cycles — see build_packed_multicycle_fn). Shares the single-
+        dispatch ordering guard: a batch counts as the in-flight cycle,
+        so the next dispatch (single or multi) is refused until the
+        batch's decisions were fetched — binds-fold ordering holds
+        across the batch boundary exactly as it does between single
+        cycles."""
+        if self.multi_fn is None:
+            raise RuntimeError(
+                "ServingPipeline.dispatch_multi: no multi-cycle program "
+                "(assign pipe.multi_fn = build_packed_multicycle_fn(...))"
+            )
+        if (
+            self.require_decision_fetch
+            and self._last is not None
+            and not self._last.fetched
+        ):
+            raise RuntimeError(
+                "ServingPipeline: multi-cycle batch dispatched before "
+                "the previous cycle's decisions were fetched — binds "
+                "cannot have folded before this batch was encoded"
+            )
+        t0 = self._now()
+        slot = self._n % len(self._slots)
+        prev = self._slots[slot]
+        if prev is not None:
+            prev.release()
+        if device_put:
+            wbufs = jax.device_put(wbufs)
+            bbufs = jax.device_put(bbufs)
+        else:
+            wbufs, bbufs = _cpu_safe_buffers(wbufs, bbufs)
+        result = self.multi_fn(
+            wbufs, bbufs, stable, np.int32(n_cycles)
+        )
+        if self._multi_slim_fn is None:
+            self._multi_slim_fn = build_multicycle_slim_fn(
+                result.node_requested.shape[1]
+            )
+        slim = self._multi_slim_fn(
+            result.assignment, result.unschedulable,
+            result.gang_dropped, result.attempted, result.cycles_run,
+        )
+        handle = MultiCycleHandle(
+            self, result, slim, wbufs, bbufs, stable
+        )
+        self._slots[slot] = handle
+        self._last = handle
+        self._n += 1
+        t1 = self._now()
+        self.stats = {
+            "dispatch_ms": (t1 - t0) * 1e3,
+            "slot": slot,
+            "multi_cycles": n_cycles,
+            "t_dispatch_start": t0,
+            "t_dispatch_end": t1,
+        }
+        if self._pending_encode_ms is not None:
+            self.stats["encode_ms"] = self._pending_encode_ms
+            self._pending_encode_ms = None
+        if self._metrics is not None:
+            self._metrics.cycle_duration.labels(phase="dispatch").observe(
+                t1 - t0
+            )
+        self._note_inflight()
+        if self.forced_sync:
+            handle.block()
             self.stats["encode_hidden_ms"] = 0.0
         return handle
 

@@ -243,6 +243,38 @@ class Scheduler:
         # bucket changes) reuse earlier compilations
         self._packed: dict = {}
         self._dev_stable: dict = {}
+        # multi-cycle serving (ROADMAP item 1): with multiCycleK > 1,
+        # per-cycle arrival groups coalesce in _mc_groups until K groups
+        # are buffered, an idle pop signals the arrival stream paused,
+        # or the oldest group ages past multiCycleMaxWaitMs — then ONE
+        # device dispatch runs all of them as inner cycles of a device-
+        # resident loop (core/cycle.build_packed_multicycle_fn),
+        # amortizing the dispatch round trip K-fold. _mc_fns memoizes
+        # the per-regime multi-cycle + diagnosis programs; _mc_off pins
+        # the profiles whose workload left the exactness envelope (the
+        # encoder's capability flags are sticky/grow-only, so a profile
+        # that left it never re-enters for this process's lifetime).
+        self._mc_k = max(int(self.config.multi_cycle_k), 1)
+        self._mc_wait_s = (
+            max(float(self.config.multi_cycle_max_wait_ms), 0.0) / 1e3
+        )
+        self._mc_groups: dict[str, list[tuple[float, list[Pod]]]] = {
+            n: [] for n in names
+        }
+        self._mc_fns: dict = {}
+        self._mc_off: dict[str, str] = {}
+        # profiles whose packed delta arena a batch dispatch left stale
+        # (the K stacked snapshots take plain encode(), not
+        # encode_packed(), so _delta_state still describes the
+        # pre-batch arena): the NEXT single-cycle record is stamped
+        # post_batch=1 so the observer can excuse its full re-encode
+        # from the fold_miss anomaly
+        self._mc_stale_arena: set[str] = set()
+        if self.extenders:
+            # extender verdicts are consulted per HOST cycle; inner
+            # device cycles cannot re-consult a webhook, so batching is
+            # off for every profile from the start
+            self._mc_off = {n: "extender" for n in names}
         # regime-flip accounting for the observer: _packed_fns bumps the
         # build count on every memo miss and records how long the host-
         # side program (re)build took — the XLA compile itself rides the
@@ -496,16 +528,23 @@ class Scheduler:
                 )
         self.queue.flush_unschedulable_timeout()
 
-        pending_all = self.queue.pop_ready()
-        if not pending_all:
+        mc_buffered = self._mc_k > 1 and any(
+            self._mc_groups[n] for n in self._profile_order
+        )
+        # hold-pop while groups are buffered: their in-flight entries
+        # (attempts counts, delete tombstones, crash recovery) must
+        # survive until the batch flush applies their outcomes
+        pending_all = self.queue.pop_ready(hold=mc_buffered)
+        if not pending_all and not mc_buffered:
             # gauges must track deletions/moves that happen between
             # non-empty cycles, so update them on the empty path too
             self._update_gauges()
             if self.state is not None:
                 self.state.maybe_snapshot()
             return stats
-        stats.attempted = len(pending_all)
-        self.metrics.cycle_pods.observe(len(pending_all))
+        if pending_all:
+            stats.attempted = len(pending_all)
+            self.metrics.cycle_pods.observe(len(pending_all))
 
         by_prof: dict[str, list[Pod]] = {
             n: [] for n in self._profile_order
@@ -532,8 +571,71 @@ class Scheduler:
             lst.append(pod)
 
         for name in self._profile_order:
-            if by_prof[name]:
-                self._schedule_profile(name, by_prof[name], stats, t0)
+            group = by_prof[name]
+            if self._mc_k > 1 and name not in self._mc_off:
+                # multi-cycle coalescing: buffer this pop's arrival group
+                # and flush K of them as ONE device dispatch. Flush when
+                # the batch is full, the arrival stream paused (an empty
+                # pop — holding a ready group while nothing else is
+                # coming would be pure added latency), or the oldest
+                # group aged past the latency bound.
+                buf = self._mc_groups[name]
+                if group:
+                    buf.append((t0, group))
+                if not buf:
+                    continue
+                if (
+                    len(buf) >= self._mc_k
+                    or not group
+                    or (t0 - buf[0][0]) >= self._mc_wait_s
+                ):
+                    self._mc_groups[name] = []
+                    # a pod is "attempted" in the cycle whose dispatch
+                    # carries it: groups popped by EARLIER buffering
+                    # cycles count NOW (their buffering cycle
+                    # subtracted them below), so per-cycle stats keep
+                    # scheduled <= attempted and a cross-cycle
+                    # sum(scheduled)/sum(attempted) rate stays honest
+                    # (this cycle's own group is already counted via
+                    # pending_all)
+                    stats.attempted += (
+                        sum(len(g) for _t, g in buf) - len(group)
+                    )
+                    if len(buf) == 1:
+                        # a lone group gains nothing from the stacked
+                        # path — keep it on the delta/carry-optimized
+                        # single-cycle encode
+                        self._schedule_profile(
+                            name, buf[0][1], stats, t0
+                        )
+                    else:
+                        self._schedule_profile_multi(
+                            name, buf, stats, t0
+                        )
+                    # outcomes applied: drop the batch's pods from the
+                    # in-flight set. Hold pops only ACCUMULATE, and
+                    # out-of-phase profile buffers can keep every pop
+                    # holding — without this, bound pods stay
+                    # "recoverable" forever (unbounded growth + a
+                    # takeover re-binding pods bound long ago)
+                    self.queue.retire_in_flight(
+                        [p.uid for _t_enq, g in buf for p in g]
+                    )
+                else:
+                    # buffered, not dispatched: attempted at the flush
+                    stats.attempted -= len(group)
+            elif group:
+                self._schedule_profile(name, group, stats, t0)
+                if self._mc_k > 1:
+                    # this profile is pinned out of batching but other
+                    # profiles' buffers may be holding every pop — its
+                    # outcomes are applied, so retire explicitly too
+                    # (K=1 serving skips this: the non-hold pop's
+                    # wholesale replacement retires, and the journal
+                    # stream stays byte-identical to the seed's)
+                    self.queue.retire_in_flight(
+                        [p.uid for p in group]
+                    )
 
         stats.cycle_seconds = self._now() - t0
         self.metrics.cycle_duration.labels(phase="total").observe(
@@ -699,7 +801,6 @@ class Scheduler:
         assignment, _unsched, gang_dropped = handle.decisions()
         assignment = assignment[: len(pending)]
         gang_dropped = gang_dropped[: len(pending)]
-        filter_names = framework.filter_names
         # accumulate like every sibling counter: in a multi-profile
         # cycle `=` would report only the LAST profile's gang drops
         profile_gang_dropped = int(gang_dropped.sum())
@@ -741,6 +842,473 @@ class Scheduler:
         if ppreempt is not None and (assignment < 0).any():
             self.metrics.preemption_attempts.inc()
             pre_handle = handle.dispatch_preemption()
+        def force_pre():
+            if pre_handle is None:
+                return None, None
+            return (
+                np.asarray(pre_handle.nominated)[: len(pending)],
+                np.asarray(pre_handle.victims)[: len(existing)],
+            )
+
+        self._apply_phase(
+            profile, framework, pending, nodes, existing, assignment,
+            gang_dropped, extender_errors, reject_counts_of, force_pre,
+            stats, t0, rec, t_device,
+        )
+
+        # ---- flight record: assemble + commit (one list store) ----
+        if rec is not None:
+            st = pipe.stage_report()
+            # latency-attribution enrichment (core/observe.py reads
+            # these at publish): the encoder's incremental-fold share
+            # of the encode, and the program-(re)build cost when this
+            # cycle flipped regimes
+            extra_phases: dict = {}
+            extra_counts: dict = {}
+            fold_ms = encoder.delta_profile.get("fold")
+            if fold_ms:
+                extra_phases["fold_ms"] = float(fold_ms)
+            if self._packed_builds > builds_before:
+                extra_phases["compile_ms"] = self._last_build_s * 1e3
+                extra_counts["regime_flip"] = 1
+            if profile in self._mc_stale_arena:
+                # first single-cycle dispatch after a batch: a full
+                # re-encode here is the batch's fault (its plain
+                # encodes left _delta_state stale), not a fold miss —
+                # cleared now because this encode_packed reinstalled
+                # the arena, so later full encodes are unexplained
+                self._mc_stale_arena.discard(profile)
+                extra_counts["post_batch"] = 1
+            self._commit_record(
+                rec, st, spec, encoder, pending, nodes, stats,
+                _before, profile_gang_dropped,
+                fetch_bytes=int(st.get("fetch_bytes", 0)),
+                extra_phases=extra_phases, extra_counts=extra_counts,
+            )
+            if "diag_lag_ms" in st:
+                self.metrics.diag_lag.observe(st["diag_lag_ms"] / 1e3)
+
+    def _mc_programs(self, spec, profile: str):
+        """Memoized multi-cycle program pair for one packed regime:
+        (multicycle_fn, diagnosis_fn). Counted into `_packed_builds`
+        like every other program build so the observer's recompile
+        anomaly attributes the one-time compile cost of a new regime's
+        batch program."""
+        key = (spec.key(), profile)
+        hit = self._mc_fns.get(key)
+        if hit is None:
+            from .cycle import (
+                build_diagnosis_fn,
+                build_packed_multicycle_fn,
+            )
+
+            t_build = self._now()
+            fw = self.frameworks[profile]
+            mfn = build_packed_multicycle_fn(
+                spec, framework=fw, k=self._mc_k, **self._cycle_kw
+            )
+            # the multi-cycle decisions are lean (no fused reject
+            # counts), so every regime needs the separate diagnosis
+            # program — including scan-mode regimes whose single-cycle
+            # path runs the fused full program and has none
+            mdiag = build_diagnosis_fn(spec, fw)
+            hit = (mfn, mdiag)
+            self._mc_fns[key] = hit
+            self._packed_builds += 1
+            self._last_build_s = self._now() - t_build
+            while len(self._mc_fns) > 4 * len(self.frameworks):
+                self._mc_fns.pop(next(iter(self._mc_fns)))
+        return hit
+
+    def _schedule_profile_multi(
+        self,
+        profile: str,
+        groups: "list[tuple[float, list[Pod]]]",
+        stats: CycleStats,
+        t0: float,
+    ) -> None:
+        """Dispatch the buffered arrival groups as ONE multi-cycle
+        device program (core/cycle.build_packed_multicycle_fn): group i
+        becomes inner cycle i of a device-resident loop, paying one
+        dispatch round trip for up to K scheduling cycles.
+
+        Semantics contract: each inner cycle's decisions are applied
+        through `_apply_phase` in batch order — binds, journal records,
+        events, and pod timelines land per cycle exactly as K sequential
+        dispatches would, so durability does not change across the
+        batch boundary. The device loop threads the post-cycle capacity
+        + gang-count carry the host fold would have produced; workloads
+        whose snapshots leave the exactness envelope
+        (`multicycle_unsupported_reason`) fall back to sequential
+        single-cycle dispatches — sticky capability reasons (affinity /
+        topology spread / volumes, grow-only encoder flags) pin the
+        profile out of batching for the process lifetime, while
+        host_ports is per-snapshot: a later port-free batch re-enters
+        the device loop."""
+        framework = self.frameworks[profile]
+        encoder = self._encoders[profile]
+        fr = self.flight
+        log = logging.getLogger(__name__)
+        nodes = self.cache.nodes()
+        existing = self.cache.existing_pods()
+        kw = dict(
+            pod_groups=list(self._groups.values()),
+            pvcs=list(self._pvcs.values()),
+            pvs=list(self._pvs.values()),
+            storage_classes=list(self._storage_classes.values()),
+            pdbs=list(self._pdbs.values()),
+        )
+        from ..models import packing
+        from .cycle import multicycle_unsupported_reason
+
+        def fall_back(reason: str | None) -> None:
+            if reason == "host_ports":
+                # per-SNAPSHOT reason, not a sticky capability: only a
+                # PENDING pod that requests a port leaves the envelope
+                # (cycle.multicycle_unsupported_reason), so a later
+                # port-free batch is exact again — fall back for THIS
+                # batch without pinning the profile
+                log.info(
+                    "multi-cycle batch for profile %r fell back to "
+                    "sequential dispatches: pending set carries host "
+                    "ports (batching resumes on port-free batches)",
+                    profile,
+                )
+            elif reason is not None and profile not in self._mc_off:
+                # sticky encoder capability flags (affinity / topology
+                # spread / volumes / extender) are grow-only: once a
+                # profile's workload shows them, it never re-enters
+                self._mc_off[profile] = reason
+                log.warning(
+                    "multi-cycle serving disabled for profile %r: "
+                    "workload left the exactness envelope (%s); "
+                    "falling back to sequential single-cycle "
+                    "dispatches", profile, reason,
+                )
+            for _t_enq, g in groups:
+                self._schedule_profile(profile, g, stats, t0)
+
+        # one spec for every row: pad to the LARGEST group so all K
+        # packed snapshots stack into [K, W]/[K, B]
+        encoder.pad_pods = _pad(
+            max(len(g) for _, g in groups), self._pad_bucket
+        )
+        encoder.pad_nodes = _pad(len(nodes), self._pad_bucket)
+        builds_before = self._packed_builds
+        t_batch = self._now()
+        t_batch_rec = fr.now() if fr is not None else 0.0
+        # the stacked snapshots below take plain encode() — the packed
+        # delta arena is bypassed and its _delta_state goes stale, so
+        # the next single-cycle encode_packed may legitimately fall
+        # back to a full encode (set even when the envelope precheck
+        # falls back: the plain encodes have run either way)
+        self._mc_stale_arena.add(profile)
+        snaps = []
+        for _t_enq, g in groups:
+            snaps.append(encoder.encode(nodes, g, existing, **kw))
+            reason = multicycle_unsupported_reason(snaps[-1])
+            if reason is not None:
+                fall_back(reason)
+                return
+        specs = [packing.make_spec(s) for s in snaps]
+        if any(sp.key() != specs[0].key() for sp in specs[1:]):
+            # a later group grew an interning dimension: re-encode the
+            # whole batch once against the now-grown (grow-only) tables
+            # so every row shares the final spec
+            snaps = [
+                encoder.encode(nodes, g, existing, **kw)
+                for _t_enq, g in groups
+            ]
+            specs = [packing.make_spec(s) for s in snaps]
+            if any(sp.key() != specs[0].key() for sp in specs[1:]):
+                # cannot happen with grow-only tables; refuse to guess
+                fall_back(None)
+                return
+        spec = specs[0]
+        (
+            _pcycle, ppreempt, stable_fn, _keeper, _diag, _ek, pipe,
+        ) = self._packed_fns(spec, profile)
+        mfn, mdiag = self._mc_programs(spec, profile)
+        pipe.multi_fn = mfn
+        pipe.multi_diag_fn = mdiag
+
+        n = len(groups)
+        wbufs = np.zeros((self._mc_k, spec.n_words), np.uint32)
+        bbufs = np.zeros((self._mc_k, spec.n_bytes), np.uint8)
+        for i, s in enumerate(snaps):
+            w, b = packing.pack(s, spec)
+            wbufs[i] = w
+            bbufs[i] = b
+        import os as _os
+
+        if _os.environ.get("K8S_TPU_NO_DEVICE_PUT") != "1":
+            import jax as _jax
+
+            wbufs = _jax.device_put(wbufs)
+            bbufs = _jax.device_put(bbufs)
+        stable = self._stable_state(
+            spec, stable_fn, wbufs[0], bbufs[0], encoder
+        )
+        t_encode = self._now()
+        self.metrics.cycle_duration.labels(phase="encode").observe(
+            t_encode - t_batch
+        )
+        pipe.forced_sync = self.forced_sync
+        pipe.note_encode(t_encode - t_batch)
+        handle = pipe.dispatch_multi(
+            wbufs, bbufs, stable, n, device_put=False
+        )
+        assignment, _unsched, gang_dropped, attempted, cycles_run = (
+            handle.decisions()
+        )
+        t_device = self._now()
+        self.metrics.cycle_duration.labels(phase="device").observe(
+            t_device - t_encode
+        )
+        self.metrics.multicycle_batch.observe(n)
+        self.metrics.multicycle_cycles.inc(min(cycles_run, n) or 0)
+        if cycles_run < n:
+            # drain early-exit cannot fire on non-empty groups, so an
+            # unran row is a driver bug: requeue its pods loudly rather
+            # than treating "never executed" as "found no node"
+            log.error(
+                "multi-cycle dispatch ran %d of %d inner cycles; "
+                "requeueing the unran groups", cycles_run, n,
+            )
+            for _t_enq, g in groups[cycles_run:]:
+                for pod in g:
+                    # a distinct event name keeps the recovery honest:
+                    # these pods never reached a bind attempt, so a
+                    # "BindError" burst would send the operator to the
+                    # API-server bind path instead of the dispatch
+                    # driver (bind_errors still counts them — the
+                    # closest CycleStats bucket for "cycle failed
+                    # through no fault of the pod")
+                    self.queue.requeue_backoff(
+                        pod, event="MultiCycleUnran"
+                    )
+                    stats.bind_errors += 1
+
+        st = pipe.stage_report()
+        device_win_s = max(
+            st.get("t_decision_end", 0.0)
+            - st.get("t_dispatch_end", 0.0),
+            0.0,
+        )
+        total_attempted = sum(
+            len(g) for _t_enq, g in groups[:cycles_run]
+        ) or 1
+        for i in range(min(cycles_run, n)):
+            t_enq, pending = groups[i][0], groups[i][1]
+            rec = fr.start(profile) if fr is not None else None
+            _before = (
+                stats.scheduled, stats.unschedulable, stats.bind_errors,
+                stats.preemptors, stats.victims,
+            )
+            if rec is not None:
+                # the record's window opens at the batch flush, not at
+                # this inner cycle's apply: its `total` is the latency
+                # the inner cycle's pods actually experienced
+                rec.t_start = t_batch_rec
+                rec.mark("encode_start", t_batch_rec)
+            a_i = assignment[i][: len(pending)]
+            gd_i = gang_dropped[i][: len(pending)]
+            profile_gang_dropped = int(gd_i.sum())
+            stats.gang_dropped += profile_gang_dropped
+            self.metrics.decisions.inc(len(pending) * len(nodes))
+
+            if (a_i < 0).any():
+                handle.dispatch_diagnosis(i)
+            _rej_box: list = []
+
+            def reject_counts_of(
+                j: int, i=i, pending=pending, _rej_box=_rej_box
+            ):
+                if not _rej_box:
+                    _rej_box.append(
+                        handle.reject_counts(i)[: len(pending)]
+                    )
+                return _rej_box[0][j]
+
+            pre_handle = None
+            if ppreempt is not None and (a_i < 0).any():
+                self.metrics.preemption_attempts.inc()
+                pre_handle = handle.dispatch_preemption(i)
+
+            def force_pre(pre_handle=pre_handle, pending=pending):
+                if pre_handle is None:
+                    return None, None
+                return (
+                    np.asarray(pre_handle.nominated)[: len(pending)],
+                    np.asarray(pre_handle.victims)[: len(existing)],
+                )
+
+            self._apply_phase(
+                profile, framework, pending, nodes, existing, a_i,
+                gd_i, {}, reject_counts_of, force_pre,
+                stats, t0, rec, self._now(),
+            )
+
+            if rec is not None:
+                # batched decomposition (observe.PHASES): how long this
+                # group waited for the batch to fill, and its share of
+                # the batch's device window apportioned by attempted-pod
+                # counts (no clock runs under jit). multi_cycle_k marks
+                # this record as an inner cycle of an n-cycle batch —
+                # the observer reads it to excuse the full (non-delta)
+                # per-group encodes from fold_miss
+                extra_phases: dict = {
+                    "batch_wait_ms": max(t_batch - t_enq, 0.0) * 1e3,
+                    "device_share_ms": (
+                        device_win_s * len(pending)
+                        / total_attempted * 1e3
+                    ),
+                }
+                extra_marks: dict = {}
+                extra_counts: dict = {"multi_cycle_k": n}
+                # st was snapshotted BEFORE the apply loop; this inner
+                # cycle's deferred-diagnosis force (if any) stamped its
+                # lag on the handle during _apply_phase just above
+                dl = handle.diag_lag.get(i)
+                if dl is not None:
+                    lag_s, t_done = dl
+                    extra_phases["diag_lag_ms"] = lag_s * 1e3
+                    extra_marks["diag_done"] = t_done
+                    self.metrics.diag_lag.observe(lag_s)
+                if i == 0 and self._packed_builds > builds_before:
+                    extra_phases["compile_ms"] = (
+                        self._last_build_s * 1e3
+                    )
+                    extra_counts["regime_flip"] = 1
+                # batch-wide pipeline marks/phases (encode, dispatch,
+                # device window, decision fetch) land ONLY on inner
+                # record 0 — the one representing the dispatch. Copying
+                # them onto all K records would feed the streaming
+                # phase histograms K observations of ONE batch window
+                # (~K-fold inflated attribution) and let a single slow
+                # batch raise K duplicate stall anomalies; records i>0
+                # carry the apportioned decomposition instead
+                # (device_share/batch_wait), same spirit as zeroing
+                # their fetch_bytes
+                st_i = st if i == 0 else {"slot": st.get("slot", -1)}
+                self._commit_record(
+                    rec, st_i, spec, encoder, pending, nodes, stats,
+                    _before, profile_gang_dropped,
+                    fetch_bytes=(
+                        int(st.get("fetch_bytes", 0)) if i == 0 else 0
+                    ),
+                    extra_phases=extra_phases,
+                    extra_marks=extra_marks,
+                    extra_counts=extra_counts,
+                )
+
+    def _commit_record(
+        self,
+        rec,
+        st: dict,
+        spec,
+        encoder,
+        pending: "list[Pod]",
+        nodes,
+        stats: CycleStats,
+        before: tuple,
+        gang_dropped: int,
+        fetch_bytes: int,
+        extra_phases: "dict | None" = None,
+        extra_marks: "dict | None" = None,
+        extra_counts: "dict | None" = None,
+    ) -> None:
+        """Assemble + commit one cycle flight record (one list store):
+        pipeline stage marks/phases, pad-regime signature, queue
+        depths, and the per-profile outcome deltas. Shared by the
+        single-cycle path and the multi-cycle batch path so a field
+        added to one cannot silently go missing from the other; the
+        paths differ only through the extra_* parameters (fold_ms /
+        compile_ms / post_batch vs batch_wait / device_share /
+        multi_cycle_k)."""
+        from ..models import packing as _packing
+        from .cycle import RESILIENT_STRIKES
+
+        rec.slot = int(st.get("slot", -1))
+        rec.forced_sync = bool(self.forced_sync)
+        # absolute pipeline marks (same perf_counter clock as the
+        # recorder) -> trace lanes; "t_dispatch_start" -> mark
+        # "dispatch_start" etc.
+        for k, v in st.items():
+            if k.startswith("t_"):
+                rec.mark(k[2:], v)
+        rec.phases.update(
+            {
+                k: float(v)
+                for k, v in st.items()
+                if k.endswith("_ms")
+            }
+        )
+        for k, v in (extra_marks or {}).items():
+            rec.mark(k, v)
+        rec.phases.update(extra_phases or {})
+        # pad-regime signature: core/observe.py diffs consecutive
+        # cycles' sigs to attribute recompile dimensions
+        rec.sig = _packing.shape_signature(spec)
+        qc = self.queue.pending_counts()
+        sb, ub, bb, pb, vb = before
+        rec.counts.update(
+            pods=len(pending),
+            nodes=len(nodes),
+            scheduled=stats.scheduled - sb,
+            unschedulable=stats.unschedulable - ub,
+            bind_errors=stats.bind_errors - bb,
+            preemptors=stats.preemptors - pb,
+            victims=stats.victims - vb,
+            gang_dropped=gang_dropped,
+            fetch_bytes=fetch_bytes,
+            retry_strikes_total=sum(RESILIENT_STRIKES.values()),
+            # monotonic encoder counters: the observer diffs them
+            # per profile to classify fold_miss (an unexplained
+            # fall off the delta/fold encode path)
+            full_encodes=int(encoder.full_encodes),
+            delta_hits=int(encoder.delta_hits),
+            fold_hits=int(getattr(encoder, "fold_hits", 0)),
+            queue_active=qc.get("active", 0),
+            queue_backoff=qc.get("backoff", 0),
+            queue_unschedulable=qc.get("unschedulable", 0),
+            **(extra_counts or {}),
+        )
+        self.flight.commit(rec)
+
+    def _apply_phase(
+        self,
+        profile: str,
+        framework,
+        pending: "list[Pod]",
+        nodes,
+        existing,
+        assignment,
+        gang_dropped,
+        extender_errors: "dict[int, str]",
+        reject_counts_of,
+        force_pre,
+        stats: CycleStats,
+        t0: float,
+        rec,
+        t_device: float,
+    ) -> None:
+        """The host APPLY phase of one cycle: winner bind loop,
+        preemption force, loser requeue, victim eviction — everything
+        between "decisions in hand" and "flight record assembled".
+        Shared verbatim by the single-cycle path (_schedule_profile)
+        and the multi-cycle batch path (_schedule_profile_multi), which
+        invokes it once per INNER cycle in batch order, so binds,
+        journal records, events, and timelines are applied per cycle
+        exactly as sequential dispatches would — durability semantics
+        do not change across the batch boundary.
+
+        `reject_counts_of(i)` lazily forces this cycle's deferred
+        diagnosis; `force_pre()` forces its preemption program and
+        returns `(nominated[:P_real] | None, victims[:E_real] | None)`.
+        """
+        fr = self.flight
+        filter_names = framework.filter_names
         if rec is not None:
             # bind work starts here: under forced_sync the deferred
             # dispatches above BLOCKED, and the trace's bind slice must
@@ -854,10 +1422,7 @@ class Scheduler:
         t_winners = self._now()
         if rec is not None:
             rec.mark("winners_end", fr.now())
-        nominated = victims = None
-        if pre_handle is not None:
-            nominated = np.asarray(pre_handle.nominated)[: len(pending)]
-            victims = np.asarray(pre_handle.victims)[: len(existing)]
+        nominated, victims = force_pre()
         t_post = self._now()
         if rec is not None:
             rec.mark("postfilter_end", fr.now())
@@ -946,66 +1511,6 @@ class Scheduler:
             (t_winners - t_device) + (self._now() - t_post)
         )
 
-        # ---- flight record: assemble + commit (one list store) ----
-        if rec is not None:
-            from .cycle import RESILIENT_STRIKES
-
-            st = pipe.stage_report()
-            rec.slot = int(st.get("slot", -1))
-            rec.forced_sync = bool(self.forced_sync)
-            # absolute pipeline marks (same perf_counter clock as the
-            # recorder) -> trace lanes; "t_dispatch_start" -> mark
-            # "dispatch_start" etc.
-            for k, v in st.items():
-                if k.startswith("t_"):
-                    rec.mark(k[2:], v)
-            rec.phases.update(
-                {
-                    k: float(v)
-                    for k, v in st.items()
-                    if k.endswith("_ms")
-                }
-            )
-            # latency-attribution enrichment (core/observe.py reads
-            # these at publish): the pad-regime signature for recompile
-            # dimension attribution, the encoder's incremental-fold
-            # share of the encode, and the program-(re)build cost when
-            # this cycle flipped regimes
-            from ..models import packing as _packing
-
-            rec.sig = _packing.shape_signature(spec)
-            fold_ms = encoder.delta_profile.get("fold")
-            if fold_ms:
-                rec.phases["fold_ms"] = float(fold_ms)
-            if self._packed_builds > builds_before:
-                rec.phases["compile_ms"] = self._last_build_s * 1e3
-                rec.counts["regime_flip"] = 1
-            qc = self.queue.pending_counts()
-            sb, ub, bb, pb, vb = _before
-            rec.counts.update(
-                pods=len(pending),
-                nodes=len(nodes),
-                scheduled=stats.scheduled - sb,
-                unschedulable=stats.unschedulable - ub,
-                bind_errors=stats.bind_errors - bb,
-                preemptors=stats.preemptors - pb,
-                victims=stats.victims - vb,
-                gang_dropped=profile_gang_dropped,
-                fetch_bytes=int(st.get("fetch_bytes", 0)),
-                retry_strikes_total=sum(RESILIENT_STRIKES.values()),
-                # monotonic encoder counters: the observer diffs them
-                # per profile to classify fold_miss (an unexplained
-                # fall off the delta/fold encode path)
-                full_encodes=int(encoder.full_encodes),
-                delta_hits=int(encoder.delta_hits),
-                fold_hits=int(getattr(encoder, "fold_hits", 0)),
-                queue_active=qc.get("active", 0),
-                queue_backoff=qc.get("backoff", 0),
-                queue_unschedulable=qc.get("unschedulable", 0),
-            )
-            fr.commit(rec)
-            if "diag_lag_ms" in st:
-                self.metrics.diag_lag.observe(st["diag_lag_ms"] / 1e3)
 
     def _bind(self, pod: Pod, node_name: str) -> None:
         """Bind, delegating to the first bind-verb extender (upstream: an
@@ -1110,5 +1615,12 @@ class Scheduler:
         while max_cycles is None or cycles < max_cycles:
             stats = self.schedule_cycle()
             cycles += 1
-            if stats.attempted == 0:
+            if stats.attempted == 0 and not (
+                self._mc_k > 1
+                and any(self._mc_groups.values())
+            ):
+                # buffered groups are waiting on the NEXT pop to
+                # detect a paused arrival stream (the flush trigger) —
+                # sleeping here would stretch every batch by
+                # idle_sleep; a truly idle loop still backs off
                 _time.sleep(idle_sleep)

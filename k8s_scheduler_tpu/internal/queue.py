@@ -207,27 +207,67 @@ class SchedulingQueue:
 
     # ---- cycle boundary --------------------------------------------------
 
-    def pop_ready(self) -> list[Pod]:
+    def pop_ready(self, hold: bool = False) -> list[Pod]:
         """Drain the active tier — the whole next cycle's pending set.
-        Flushes expired backoff first so a ready pod is never left behind."""
+        Flushes expired backoff first so a ready pod is never left behind.
+
+        `hold=True` is the multi-cycle coalescing variant: groups popped
+        by EARLIER cycles are still buffered scheduler-side (their
+        outcomes apply at the batch flush), so this pop ACCUMULATES into
+        the in-flight set instead of replacing it, and keeps the
+        deleted-in-flight tombstones — otherwise a buffered pod would
+        lose its attempts count, its delete tombstone, and its crash
+        recovery (recover_in_flight) the moment the next group was
+        popped. The flag is journaled: replay must reproduce the exact
+        in-flight set a takeover recovers."""
         with self._lock:
             now = self._now()
             # journal only a pop that changes SOMETHING: drains pods,
             # flushes backoff, or retires a previous in-flight set — an
             # idle scheduler's empty cycles must not grow the journal
-            had_inflight = bool(self._in_flight) or bool(
-                self._deleted_in_flight
+            had_inflight = not hold and (
+                bool(self._in_flight) or bool(self._deleted_in_flight)
             )
             flushed = self._flush_backoff_locked(now, "BackoffComplete")
             ready = [e.pod for e in self._active.values()]
             for e in self._active.values():
                 e.attempts += 1
-            self._in_flight = dict(self._active)
-            self._deleted_in_flight.clear()
+            if hold:
+                self._in_flight.update(self._active)
+            else:
+                self._in_flight = dict(self._active)
+                self._deleted_in_flight.clear()
             self._active.clear()
             if ready or flushed or had_inflight:
-                self._emit("q.pop", now, {})
+                self._emit(
+                    "q.pop", now, {"hold": True} if hold else {}
+                )
             return ready
+
+    def retire_in_flight(self, uids: Sequence[str]) -> None:
+        """A multi-cycle batch flush applied these pods' outcomes: drop
+        them (and their delete tombstones) from the in-flight set.
+
+        Single-cycle serving retires implicitly — the next non-hold
+        pop replaces the whole set — but hold pops only ever
+        ACCUMULATE, and out-of-phase profile buffers can keep every
+        pop holding, so without an explicit retire a bound pod would
+        stay "recoverable" forever: unbounded in-flight growth, and a
+        leader takeover re-scheduling (re-binding) pods bound
+        arbitrarily long ago. Pods the failure paths already requeued
+        are not in the set — the membership filter skips them."""
+        with self._lock:
+            live = [
+                u for u in uids
+                if u in self._in_flight or u in self._deleted_in_flight
+            ]
+            if not live:
+                return
+            now = self._now()
+            self._emit("q.retire", now, {"uids": live})
+            for u in live:
+                self._in_flight.pop(u, None)
+                self._deleted_in_flight.discard(u)
 
     def requeue_unschedulable(
         self, pod: Pod, reasons: Sequence[str] | str = ()

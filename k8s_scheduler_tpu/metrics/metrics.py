@@ -56,8 +56,11 @@ streaming consumer of every flight record):
 - scheduler_cycle_phase_seconds{phase} — streaming per-phase latency
   attribution of every committed cycle record; phases: total, encode,
   fold, dispatch, device, decision_fetch, bind, postfilter, diag_lag,
-  compile (the inventory is core/observe.PHASES, machine-checked by
-  schedlint ID005 against the trace lane mapping and the README)
+  compile, batch_wait, device_share (the last two are the multi-cycle
+  batched decomposition: an inner cycle's host-side coalescing wait and
+  its apportioned share of the batch's device window; the inventory is
+  core/observe.PHASES, machine-checked by schedlint ID005 against the
+  trace lane mapping and the README)
 - scheduler_cycle_phase_p50_seconds{phase} /
   scheduler_cycle_phase_p99_seconds{phase} — per-phase quantiles from
   the observer's streaming histograms, evaluated at scrape time
@@ -70,6 +73,15 @@ streaming consumer of every flight record):
   the sustainable rate), 0 when no sloP99Ms objective is configured
 - scheduler_slo_budget_remaining — fraction of the slow window's
   violation budget left (1.0 = untouched, negative = overspent)
+
+Multi-cycle serving families (core/scheduler.py _schedule_profile_multi
+— K scheduling cycles per device dispatch, amortizing the dispatch
+round trip):
+
+- scheduler_multicycle_batch_cycles — inner scheduling cycles per
+  multi-cycle device dispatch (1 = a degenerate single-cycle batch)
+- scheduler_multicycle_inner_cycles_total — scheduling cycles served
+  through multi-cycle dispatches (vs one dispatch per cycle)
 
 Durable-state families (state/ package — write-ahead journal, snapshots,
 restore) and leader election:
@@ -319,6 +331,20 @@ class SchedulerMetrics:
             "scheduler_slo_budget_remaining",
             "Fraction of the slow-window SLO violation budget left "
             "(1.0 = untouched, negative = overspent).",
+            registry=r,
+        )
+        # ---- multi-cycle serving (core/scheduler.py) ----
+        self.multicycle_batch = Histogram(
+            "scheduler_multicycle_batch_cycles",
+            "Inner scheduling cycles per multi-cycle device dispatch "
+            "(multiCycleK coalescing; 1 = a degenerate batch).",
+            buckets=(1, 2, 4, 8, 16, 32),
+            registry=r,
+        )
+        self.multicycle_cycles = Counter(
+            "scheduler_multicycle_inner_cycles_total",
+            "Scheduling cycles served through multi-cycle dispatches "
+            "(each paid dispatch_rt/K instead of a full round trip).",
             registry=r,
         )
         # ---- durable state (state/: journal + snapshots + restore) ----

@@ -219,7 +219,7 @@ class DurableState:
         elif op == "q.delete":
             queue.delete(d["uid"])
         elif op == "q.pop":
-            queue.pop_ready()
+            queue.pop_ready(hold=bool(d.get("hold")))
         elif op == "q.unsched":
             queue.requeue_unschedulable(
                 pod_from_state(d["pod"]), reasons=tuple(d.get("reasons", ()))
@@ -236,6 +236,8 @@ class DurableState:
             queue.move_all_to_active_or_backoff(d["event"])
         elif op == "q.recover":
             queue.recover_in_flight()
+        elif op == "q.retire":
+            queue.retire_in_flight(d["uids"])
         elif op == "c.add_node":
             cache.add_node(node_from_state(d["node"]))
         elif op == "c.update_node":

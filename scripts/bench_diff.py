@@ -28,6 +28,10 @@ threshold flag (percent):
                    stalls that come and go between runs)
     device_ms      device compute     regression = rise  > --max-device-rise
     encode_p50_ms  host encode p50    regression = rise  > --max-encode-rise
+    tunnel_amortization  multi-cycle amortization factor
+                   regression = drop  > --max-amortization-drop
+    effective_p50_ms     multi-cycle best-K effective per-cycle p50
+                   regression = rise  > --max-effective-p50-rise
     stall_cycles   >10x-p50 cycles    regression = new > old + --allow-stalls
     anomalies      classifier total   regression = new > old + --allow-stalls
 
@@ -50,6 +54,12 @@ _METRICS = {
     "p99_ms": ("lower", "p99_ms", "p99"),
     "device_ms": ("lower", "device_ms", "dev"),
     "encode_p50_ms": ("lower", "encode_p50_ms", "enc"),
+    # multi-cycle serving (BENCH_MULTI_K sweep): the amortization factor
+    # must not DROP and the best-K effective per-cycle p50 must not
+    # RISE — both skipped (like any metric) for configs/artifacts that
+    # predate the sweep or sit outside the exactness envelope
+    "tunnel_amortization": ("higher", "tunnel_amortization", "amort"),
+    "effective_p50_ms": ("lower", "effective_cycle_p50_ms", "effp50"),
 }
 _COUNT_METRICS = ("stall_cycles", "anomalies_total")
 
@@ -239,6 +249,16 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--max-device-rise", type=float, default=35.0)
     ap.add_argument("--max-encode-rise", type=float, default=60.0)
     ap.add_argument(
+        "--max-amortization-drop", type=float, default=25.0,
+        help="multi-cycle tunnel_amortization may drop this many "
+        "percent before it counts as a regression",
+    )
+    ap.add_argument(
+        "--max-effective-p50-rise", type=float, default=25.0,
+        help="multi-cycle best-K effective per-cycle p50 may rise "
+        "this many percent before it counts as a regression",
+    )
+    ap.add_argument(
         "--allow-stalls", type=int, default=1,
         help="stall/anomaly count may grow by this many before it "
         "counts as a regression (one stall is a known rig flake — "
@@ -275,6 +295,8 @@ def main(argv: list[str] | None = None) -> int:
             "p99_ms": args.max_p99_rise,
             "device_ms": args.max_device_rise,
             "encode_p50_ms": args.max_encode_rise,
+            "tunnel_amortization": args.max_amortization_drop,
+            "effective_p50_ms": args.max_effective_p50_rise,
         },
         allow_stalls=args.allow_stalls,
         min_ms_delta=args.min_ms_delta,

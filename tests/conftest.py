@@ -60,6 +60,15 @@ _SLOW_TESTS = {
     # durable-state failover tests that spawn jax-importing subprocesses
     "test_kill9_failover_digest_matches_pre_kill",
     "test_soak_failover_smoke",
+    # multi-cycle heavyweights: the 3-seed scheduler-level equivalence
+    # drive (~40 s/seed: two full Schedulers + WAL per seed), the
+    # 15-cycle burst/lull trace, and the bench K-sweeps (wall-clock
+    # perf bounds — kept out of the functional tier so machine load
+    # can't flake it; the device-level equivalence cases stay fast)
+    "test_scheduler_multicycle_matches_sequential",
+    "test_mixed_burst_lull_traffic_no_false_fold_miss",
+    "test_bench_multicycle_sweep_amortizes_dispatch",
+    "test_bench_multicycle_sweep_respects_envelope",
 }
 _SLOW_MODULES = {"tests.test_concurrency"}
 

@@ -265,3 +265,55 @@ def test_forced_sync_produces_identical_bindings():
         assert pipes and pipes[0].fetch_bytes_total > 0
         assert pipes[0].forced_sync is sync
     assert results[False] == results[True]
+
+
+def test_cpu_backend_arena_copy_guards_deferred_programs():
+    """The CPU-backend arena race (PR 4's open note), closed: a dispatch
+    fed RAW numpy arena buffers (device_put=False — probe paths and
+    K8S_TPU_NO_DEVICE_PUT=1) must take an explicit device copy before
+    async dispatch on the CPU backend. The deferred diagnosis/preemption
+    programs are forced lazily, possibly AFTER the next encode rewrote
+    the arena in place; without the copy they would attribute against
+    the NEXT cycle's bytes (jax's CPU backend converts numpy args
+    asynchronously / by aliasing, so the rewrite tears them)."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("CPU-backend aliasing guard")
+    from k8s_scheduler_tpu.core.cycle import (
+        build_diagnosis_fn,
+        build_packed_cycle_fn,
+        build_stable_state_fn,
+    )
+
+    nodes = [
+        MakeNode(f"n{i}").capacity({"cpu": "4"}).obj() for i in range(3)
+    ]
+    pods = [MakePod(f"p{i}").req({"cpu": "1"}).obj() for i in range(3)]
+    pods.append(MakePod("huge").req({"cpu": "64"}).obj())
+    enc = SnapshotEncoder(pad_pods=8, pad_nodes=4)
+    wbuf, bbuf, spec, _snap, _dirty = enc.encode_packed(nodes, pods)
+    cyc = build_packed_cycle_fn(
+        spec, commit_mode="scan", outputs="latency"
+    )
+    pipe = ServingPipeline(cyc, diag_fn=build_diagnosis_fn(spec))
+    stable = build_stable_state_fn(spec)(wbuf.copy(), bbuf.copy())
+
+    h1 = pipe.dispatch(wbuf, bbuf, stable, device_put=False)
+    _, unsched, _ = h1.decisions()
+    assert unsched[3]  # 'huge' found no node; diagnosis has work to do
+    rc_ref = np.asarray(h1.reject_counts()).copy()
+    assert rc_ref[3].sum() > 0
+
+    h2 = pipe.dispatch(wbuf, bbuf, stable, device_put=False)
+    h2.decisions()
+    # the next encode's in-place arena rewrite, BEFORE the deferred
+    # diagnosis is forced — without the explicit copy the diagnosis
+    # would read these zeros and attribute nothing
+    wbuf[:] = 0
+    bbuf[:] = 0
+    np.testing.assert_array_equal(
+        np.asarray(h2.reject_counts()), rc_ref,
+        err_msg="deferred diagnosis read the rewritten arena "
+        "(CPU-backend copy guard regressed)",
+    )

@@ -30,14 +30,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def lint_fixture(tmp_path, files: dict[str, str], passes=None,
-                 baseline_path=None):
+                 baseline_path=None, paths=None):
     for rel, src in files.items():
         full = tmp_path / rel
         full.parent.mkdir(parents=True, exist_ok=True)
         full.write_text(textwrap.dedent(src))
     return run_lint(
         str(tmp_path),
-        paths=["."],
+        paths=paths or ["."],
         passes=passes,
         pass_args={"INVENTORY-DRIFT": {"metrics_runtime": False}},
         baseline_path=baseline_path,
@@ -480,6 +480,53 @@ def test_hygiene_unused_import_and_dead_constant(tmp_path):
     assert hy2.line == 4 and "_DEAD" in hy2.message
 
 
+def test_hygiene_script_inventory_hy003(tmp_path):
+    """HY003: a scripts/*.py outside SCRIPT_ALLOWLIST is flagged (dead
+    one-off probes accumulated 25 deep before ISSUE 6 pruned them), a
+    dangling allowlist entry is flagged against hygiene.py itself, and
+    a package-scoped scan that never saw scripts/ judges neither."""
+    result = lint_fixture(tmp_path, {
+        "scripts/_one_off_probe.py": """\
+            X = 1
+        """,
+    }, passes=["HYGIENE"])
+    hy3 = codes_at(result, "HY003")
+    assert any(
+        f.file == "scripts/_one_off_probe.py"
+        and "SCRIPT_ALLOWLIST" in f.message
+        for f in hy3
+    )
+    # every maintained entry is dangling in this fixture tree — flagged
+    # once each, against the allowlist's own file
+    assert any("no such file exists" in f.message for f in hy3)
+    # a scan that covered no scripts/ files must not judge the
+    # allowlist at all (fresh tree: tmp_path still holds the fixture
+    # above)
+    pkg_only = lint_fixture(tmp_path / "pkg_only", {
+        "mod.py": """\
+            Y = 2
+        """,
+    }, passes=["HYGIENE"])
+    assert not codes_at(pkg_only, "HY003")
+    # staleness is judged against the DISK, not the scanned set: a
+    # path-scoped scan of ONE allowlisted script (the CLI accepts file
+    # paths) must not flag the other, existing, entries
+    from k8s_scheduler_tpu.analysis.hygiene import SCRIPT_ALLOWLIST
+
+    scoped = lint_fixture(tmp_path / "scoped", {
+        rel: "X = 1\n" for rel in SCRIPT_ALLOWLIST
+    }, passes=["HYGIENE"], paths=[sorted(SCRIPT_ALLOWLIST)[0]])
+    assert not codes_at(scoped, "HY003")
+    # ...but a scan that saw the pass's own module and NO scripts/ at
+    # all (scripts/ deleted wholesale, allowlist left behind) must
+    # still flag every dangling entry — HY003 must not self-disable on
+    # exactly the drift it exists to catch
+    gone = lint_fixture(tmp_path / "gone", {
+        "k8s_scheduler_tpu/analysis/hygiene.py": "X = 1\n",
+    }, passes=["HYGIENE"])
+    assert len(codes_at(gone, "HY003")) == len(SCRIPT_ALLOWLIST)
+
+
 # ---- suppressions & baseline --------------------------------------------
 
 
@@ -572,7 +619,9 @@ def test_tree_is_clean():
         baseline_path=os.path.join(REPO, ".schedlint-baseline.json"),
     )
     assert result.findings == [], "\n".join(str(f) for f in result.findings)
-    assert result.files_scanned > 90
+    # sanity floor only (a typo'd root scanning ~nothing must fail);
+    # ISSUE 6 pruned the 25 stale one-off probe scripts, hence not ~100
+    assert result.files_scanned > 70
 
 
 def test_schedlint_cli_json_mode(tmp_path, capsys):
