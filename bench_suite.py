@@ -119,9 +119,14 @@ CONFIG_NAMES = {
     3: "interpod_affinity",
     4: "full_default_preemption",
     5: "gang_coscheduling",
+    # compile-regime churn soak (ISSUE 8 / ROADMAP item 2): the pending
+    # count oscillates across a P pad-bucket boundary through a REAL
+    # Scheduler, measuring regime flips, compile-attributed stall
+    # cycles, and the persistent executable cache's warm-vs-cold cost
+    6: "regime_churn",
 }
 CONFIG_SHAPES = {1: (100, 10), 2: (1000, 100), 3: (5000, 1000),
-                 4: (10000, 5000), 5: (8000, 2000)}
+                 4: (10000, 5000), 5: (8000, 2000), 6: (80, 16)}
 
 
 def _draw_pending(cfg: int, i: int, prev: list | None, churn: float):
@@ -207,6 +212,8 @@ def _parse_multi_k_env() -> "list[int]":
 
 
 def run_config(cfg: int, snapshots: int = 50) -> dict:
+    if cfg == 6:
+        return run_regime_churn_config(snapshots=snapshots)
     import jax
     import numpy as np
 
@@ -872,6 +879,163 @@ def run_multicycle_config(
         out["tunnel_amortization"] = round(baseline_eff / best_eff, 2)
         out["effective_cycle_p50_ms"] = round(best_eff * 1e3, 3)
     return out
+
+
+def run_regime_churn_config(snapshots: int = 36) -> dict:
+    """Config 6: the pad-bucket-crossing churn soak. A real Scheduler
+    (flight recorder + observer + persistent compile cache) serves a
+    pending stream oscillating across the P=64/128 pad-bucket boundary,
+    three times over one shared cache directory:
+
+    - **cold**: empty cache — every regime compiles; `compile_seconds`
+      is the cold cost, `regime_flips` counts the boundary crossings,
+      and `compile_stall_cycles` counts cycles that paid >50 ms of
+      program (re)build AFTER the first traversal of each regime — the
+      ISSUE 8 acceptance metric (the memo + cache must absorb every
+      later flip, so this must be 0).
+    - **hysteresis**: same trace with padHysteresisPct=20 — the larger
+      regime holds, `hysteresis_flips` counts what remains (expect 1:
+      the initial up-step).
+    - **warm**: a fresh Scheduler (fresh jit wrappers — the in-process
+      restart analogue) against the now-populated cache: zero cold
+      compiles for previously-seen regimes; `warm_compile_seconds` is
+      the total trace+load cost that replaced them and
+      `compile_cache_hit_rate` feeds bench_diff's directional gate.
+
+    The sticky E/MPN pads are pre-sized (the documented fold-mode
+    deployment pattern) so the oscillation exercises exactly ONE
+    dimension — P — and flips are deterministic."""
+    import shutil
+    import tempfile
+
+    from k8s_scheduler_tpu.config import SchedulerConfiguration
+    from k8s_scheduler_tpu.core import Scheduler
+    from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+    hi, n_nodes = CONFIG_SHAPES[6]
+    # _pad(60)=64 vs _pad(80)=128: one boundary crossed every cycle.
+    # lo sits just under the boundary (60/64 = 6% headroom) so the
+    # hysteresis phase's 20% down-step margin HOLDS the larger regime —
+    # a lo leaving more headroom than the margin would legitimately
+    # step down, which is the knob working, not a flip
+    lo = 60
+    cache_dir = os.environ.get("BENCH_COMPILE_CACHE_DIR", "")
+    ephemeral = not cache_dir
+    if ephemeral:
+        cache_dir = tempfile.mkdtemp(prefix="bench_regime_churn_cc_")
+    nodes = make_cluster(n_nodes)
+
+    def drive(hysteresis_pct: float) -> dict:
+        cfg_obj = SchedulerConfiguration(
+            compile_cache_dir=cache_dir,
+            pad_existing=4096,
+            pad_pods_per_node=1024,
+            pad_hysteresis_pct=hysteresis_pct,
+            speculative_compile=False,  # the cache is the subject here;
+            # speculation would race the oscillation nondeterministically
+        )
+        # manual clock: cold-phase compiles take real seconds, and the
+        # assumed-pod TTL expiring mid-soak would requeue bound pods
+        # into later cycles' pending sets — moving P off the scripted
+        # oscillation (the multicycle PR hit the same seed behavior)
+        clk = [0.0]
+        sched = Scheduler(
+            config=cfg_obj, binder=lambda p, n: None,
+            now=lambda: clk[0],
+        )
+        for nd in nodes:
+            sched.on_node_add(nd)
+        seq = 0
+        t0 = time.perf_counter()
+        for i in range(snapshots):
+            count = hi if i % 2 else lo
+            for p in make_pods(
+                count, seed=9000 + i, name_prefix=f"rc{seq}-"
+            ):
+                sched.on_pod_add(p)
+                seq += 1
+            sched.schedule_cycle()
+            clk[0] += 0.05
+        wall = time.perf_counter() - t0
+        recs = sched.flight.snapshot()
+        # builds = regime_flip stamps (memo misses that paid a program
+        # build); sig flips = what the WORKLOAD did (consecutive-cycle
+        # signature changes) — hysteresis shrinks the latter, the memo
+        # + persistent cache absorb the former
+        flips = [r for r in recs if r.counts.get("regime_flip")]
+        sig_flips = sum(
+            1 for a, b in zip(recs, recs[1:]) if a.sig != b.sig
+        )
+        compile_s = sum(
+            r.phases.get("compile_ms", 0.0) for r in recs
+        ) / 1e3
+        # the first traversal = the first build of each DISTINCT regime
+        # (two here); every compile-attributed cycle after it is a
+        # stall the cache/memo should have absorbed
+        seen: set = set()
+        stall_after_first = 0
+        for r in recs:
+            key = r.sig
+            fresh_regime = key not in seen
+            seen.add(key)
+            if r.phases.get("compile_ms", 0.0) > 50.0 and not fresh_regime:
+                stall_after_first += 1
+        cc = sched._compile_cache
+        return {
+            "wall_s": round(wall, 2),
+            "cycles": len(recs),
+            "regime_flips": sig_flips,
+            "regime_builds": len(flips),
+            "compile_seconds": round(compile_s, 2),
+            "compile_stall_cycles": stall_after_first,
+            "sources": sorted(
+                {r.compile_source for r in flips if r.compile_source}
+            ),
+            "cache": cc.status() if cc is not None else {},
+        }
+
+    from k8s_scheduler_tpu.core import compile_cache as _cc
+
+    try:
+        cold = drive(0.0)
+        hyst = drive(20.0)
+        # the warm phase must measure real executable DESERIALIZATION
+        # (the restart path the cache exists to prove), not the
+        # process-level loaded-executable memo the earlier drives
+        # populated — clear it, as a fresh process would start
+        _cc.clear_loaded_memo()
+        warm = drive(0.0)
+    finally:
+        if ephemeral:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+    attempts = warm["cache"]["hits"] + warm["cache"]["misses"]
+    hit_rate = warm["cache"]["hits"] / attempts if attempts else 0.0
+    return {
+        "config": 6,
+        "name": CONFIG_NAMES[6],
+        "pods": hi,
+        "nodes": n_nodes,
+        "snapshots": snapshots,
+        "regime_flips": cold["regime_flips"],
+        "hysteresis_flips": hyst["regime_flips"],
+        "compile_seconds": cold["compile_seconds"],
+        "warm_compile_seconds": warm["compile_seconds"],
+        "warm_load_p50_ms": round(
+            warm["cache"].get("load_p50_s", 0.0) * 1e3, 1
+        ),
+        "cache_hits": warm["cache"]["hits"],
+        "cache_misses": warm["cache"]["misses"],
+        "compile_cache_hit_rate": round(hit_rate, 3),
+        # acceptance metrics: zero compile-attributed stall cycles
+        # after the first traversal of each regime, in every phase
+        "stall_cycles": (
+            cold["compile_stall_cycles"]
+            + hyst["compile_stall_cycles"]
+            + warm["compile_stall_cycles"]
+        ),
+        "warm_sources": warm["sources"],
+        "detail": {"cold": cold, "hysteresis": hyst, "warm": warm},
+    }
 
 
 def run_suite(configs=(1, 2, 3, 4, 5), snapshots: int = 50) -> list[dict]:

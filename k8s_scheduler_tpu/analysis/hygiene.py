@@ -35,6 +35,7 @@ SCRIPT_ALLOWLIST = frozenset({
     "scripts/schedlint.py",       # this framework's CLI
     "scripts/soak_differential.py",  # slow-marked differential soak
     "scripts/soak_failover.py",   # slow-marked kill -9 failover soak
+    "scripts/warm_cache.py",      # compile-cache pre-warmer (ops tool)
 })
 
 

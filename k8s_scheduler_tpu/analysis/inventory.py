@@ -24,6 +24,14 @@ in BOTH directions:
          scheduler_cycle_phase_seconds, and in the README
          "## Observability" section — the recorder, the metrics, and
          the trace export cannot disagree about what a phase is
+- ID006  the compile-cache key inventory: the dimension names of
+         models/packing.SIGNATURE_DIMS must equal
+         core/compile_cache.SIG_KEY_FIELDS (a new pad dimension added
+         without a cache-key field silently ALIASES distinct programs
+         into one persistent-cache entry; a stale key field caches
+         against a dimension that no longer exists), and every field of
+         SIG_KEY_FIELDS + EXTRA_KEY_FIELDS must appear in the README
+         "## Compile-regime management" key table
 
 The metric-registry half (ID001) imports the live package; pass
 `{"metrics_runtime": False}` to skip it when linting fixture trees.
@@ -98,6 +106,9 @@ class InventoryDriftPass(PassBase):
         "ID005": "cycle-phase inventory drifted between observe.PHASES, "
                  "the trace lane mapping, the metrics docstring, and "
                  "the README",
+        "ID006": "compile-cache key inventory drifted between "
+                 "packing.SIGNATURE_DIMS, compile_cache.SIG_KEY_FIELDS, "
+                 "and the README key table",
     }
 
     def run(self, ctx: LintContext) -> list[Finding]:
@@ -119,6 +130,7 @@ class InventoryDriftPass(PassBase):
         ):
             findings += self._check_metrics(ctx)
         findings += self._check_phases(ctx)
+        findings += self._check_compile_key(ctx)
         return findings
 
     @staticmethod
@@ -378,6 +390,100 @@ class InventoryDriftPass(PassBase):
                         f"phase {p!r} (observe.PHASES) is not documented "
                         'in the README "## Observability" section',
                     ))
+        return findings
+
+    # ---- ID006: compile-cache key inventory ------------------------------
+
+    @staticmethod
+    def _tuple_of_tuples_heads(sf, name: str):
+        """First string element of each inner tuple of a module-level
+        `NAME = ((..., ...), ...)` literal — the dimension names of
+        packing.SIGNATURE_DIMS."""
+        for node in sf.tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+            ):
+                continue
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                return None, node.lineno
+            out = set()
+            for e in node.value.elts:
+                if (
+                    isinstance(e, (ast.Tuple, ast.List)) and e.elts
+                    and isinstance(e.elts[0], ast.Constant)
+                    and isinstance(e.elts[0].value, str)
+                ):
+                    out.add(e.elts[0].value)
+            return out, node.lineno
+        return None, 0
+
+    def _check_compile_key(self, ctx: LintContext) -> list[Finding]:
+        cc_sf = self._find(ctx, "core/compile_cache.py")
+        pk_sf = self._find(ctx, "models/packing.py")
+        if cc_sf is None or pk_sf is None:
+            return []
+        findings: list[Finding] = []
+        dims, pk_line = self._tuple_of_tuples_heads(
+            pk_sf, "SIGNATURE_DIMS"
+        )
+        sig_fields, cc_line = self._module_const(cc_sf, "SIG_KEY_FIELDS")
+        extra_fields, _ = self._module_const(cc_sf, "EXTRA_KEY_FIELDS")
+        if sig_fields is None:
+            return [Finding(
+                cc_sf.rel, 1, "ID006",
+                "core/compile_cache.py defines no literal "
+                "SIG_KEY_FIELDS tuple — the cache-key inventory the "
+                "pad dimensions are checked against",
+            )]
+        if dims is None:
+            return [Finding(
+                pk_sf.rel, 1, "ID006",
+                "models/packing.py defines no literal SIGNATURE_DIMS — "
+                "the pad-dimension inventory the cache key must cover",
+            )]
+        for d in sorted(dims - sig_fields):
+            findings.append(Finding(
+                cc_sf.rel, cc_line, "ID006",
+                f"pad dimension {d!r} (packing.SIGNATURE_DIMS) has no "
+                "cache-key field in SIG_KEY_FIELDS: two regimes "
+                f"differing only in {d} would alias one persistent "
+                "executable entry",
+            ))
+        for d in sorted(sig_fields - dims):
+            findings.append(Finding(
+                pk_sf.rel, pk_line, "ID006",
+                f"cache-key field {d!r} (SIG_KEY_FIELDS) names no "
+                "SIGNATURE_DIMS dimension: stale key field",
+            ))
+        path = os.path.join(ctx.root, "README.md")
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            m = re.search(
+                r"^## Compile-regime management\b(.*?)(?=^## |\Z)",
+                text, re.M | re.S,
+            )
+            if m is None:
+                findings.append(Finding(
+                    cc_sf.rel, cc_line, "ID006",
+                    'README.md has no "## Compile-regime management" '
+                    "section documenting the cache-key table",
+                ))
+            else:
+                section = m.group(1)
+                for fld in sorted(sig_fields | (extra_fields or set())):
+                    if not re.search(
+                        rf"\b{re.escape(fld)}\b", section
+                    ):
+                        findings.append(Finding(
+                            cc_sf.rel, cc_line, "ID006",
+                            f"cache-key field {fld!r} is not documented "
+                            'in the README "## Compile-regime '
+                            'management" key table',
+                        ))
         return findings
 
     # ---- ID001: metric inventory (runtime) -------------------------------

@@ -112,6 +112,30 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         "(config sloP99Ms; 0 disables, -1 = keep config)",
     )
     ap.add_argument(
+        "--pad-hysteresis-pct", type=float, default=-1.0,
+        help="regime hysteresis: a shrinking pod/node count only steps "
+        "the pad bucket DOWN when it leaves at least this many percent "
+        "of headroom inside the smaller bucket, so an oscillating "
+        "workload holds the larger (already-compiled) regime instead "
+        "of flip-flopping (config padHysteresisPct; 0 disables, "
+        "-1 = keep config)",
+    )
+    ap.add_argument(
+        "--compile-cache-dir", default="",
+        help="persistent compiled-program cache directory (config "
+        "compileCacheDir): AOT-compiled executables keyed by pad "
+        "regime + profile + program kind + jaxlib/backend fingerprint, "
+        "so a warm restart compiles zero programs for previously-seen "
+        "regimes. Empty = <stateDir>/compile_cache when --state-dir is "
+        "set, else disabled; 'off' disables even with a state dir",
+    )
+    ap.add_argument(
+        "--speculative-compile", type=int, default=-1, choices=(-1, 0, 1),
+        help="background pre-compilation of the adjacent pad regime on "
+        "a warm thread when demand drifts toward a bucket boundary "
+        "(config speculativeCompile; 1 on, 0 off, -1 = keep config)",
+    )
+    ap.add_argument(
         "--state-dir", default="",
         help="durable scheduler state: write-ahead journal + snapshots "
         "of the queue/cache live here (config stateDir). A process "
@@ -148,6 +172,12 @@ def main(argv: list[str] | None = None) -> int:
         config.multi_cycle_k = args.multi_cycle_k
     if args.multi_cycle_max_wait_ms >= 0:
         config.multi_cycle_max_wait_ms = args.multi_cycle_max_wait_ms
+    if args.pad_hysteresis_pct >= 0:
+        config.pad_hysteresis_pct = args.pad_hysteresis_pct
+    if args.compile_cache_dir:
+        config.compile_cache_dir = args.compile_cache_dir
+    if args.speculative_compile >= 0:
+        config.speculative_compile = bool(args.speculative_compile)
     if args.state_dir:
         config.state_dir = args.state_dir
     if args.snapshot_interval >= 0:

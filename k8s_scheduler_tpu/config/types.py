@@ -148,6 +148,28 @@ class SchedulerConfiguration:
     # held back longer than this many milliseconds waiting for the
     # batch to fill (an idle pop also flushes immediately)
     multi_cycle_max_wait_ms: float = 5.0
+    # compile-regime management (core/compile_cache.py):
+    # padHysteresisPct — down-step margin for the P/N pad buckets: a
+    # shrinking pending/node count only steps the pad regime DOWN when
+    # it leaves at least this many percent of headroom inside the
+    # smaller bucket, so a workload oscillating around a bucket
+    # boundary holds the larger (already-compiled) regime instead of
+    # flip-flopping. 0 disables (immediate down-step).
+    pad_hysteresis_pct: float = 0.0
+    # compileCacheDir — directory for the persistent compiled-program
+    # cache (AOT executables keyed by pad regime + profile + program
+    # kind + jaxlib/backend fingerprint). "" derives
+    # <stateDir>/compile_cache when stateDir is set, else disables;
+    # "off"/"none" disables even with a state dir (slow shared
+    # storage, poisoned-cache triage). A warm restart then compiles
+    # zero programs for previously-seen regimes (entry load ~<1 s vs
+    # the 8.8-16.8 s cold compile).
+    compile_cache_dir: str = ""
+    # speculativeCompile — background pre-compilation of the ADJACENT
+    # pad regime on a warm thread (never the bind path) when the
+    # anomaly sentinel's demand EWMA drifts toward a bucket boundary;
+    # a flip speculation won costs ~0 compile on the serve path.
+    speculative_compile: bool = True
     # durable scheduler state (state/ package): directory for the
     # write-ahead journal + snapshots. "" disables durability — a
     # takeover then rebuilds only what informer events re-deliver,
@@ -284,6 +306,9 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         slo_window_cycles=int(data.get("sloWindowCycles", 1024)),
         multi_cycle_k=int(data.get("multiCycleK", 1)),
         multi_cycle_max_wait_ms=float(data.get("multiCycleMaxWaitMs", 5.0)),
+        pad_hysteresis_pct=float(data.get("padHysteresisPct", 0.0)),
+        compile_cache_dir=str(data.get("compileCacheDir", "")),
+        speculative_compile=bool(data.get("speculativeCompile", True)),
         state_dir=str(data.get("stateDir", "")),
         snapshot_interval_seconds=_duration_seconds(
             data.get("snapshotInterval", 60.0)

@@ -283,10 +283,23 @@ class _Resilient:
 
     Every retry is recorded in RESILIENT_STRIKES and the
     scheduler_program_retry_strikes_total metric (kind =
-    executable_cache | transport). Anything else re-raises."""
+    executable_cache | transport). Anything else re-raises.
+
+    An AOT-compiled executable (core/compile_cache.py: loaded from the
+    persistent cache or compiled up front) can be installed via
+    `install_aot`; calls whose argument avals match run it directly —
+    the jit path stays as the fallback for any other call shape (e.g.
+    the preemption program fed a CycleDecision by the multi-cycle path
+    where the single-cycle path feeds a CycleResult) and as the
+    executable-cache-corruption recovery."""
 
     def __init__(self, fn):
         self._fn = fn
+        self._aot = None
+
+    def install_aot(self, compiled) -> None:
+        """Serve through an AOT executable for matching-aval calls."""
+        self._aot = compiled
 
     def __call__(self, *a, **k):
         # classify by MESSAGE, not exception type: a transport flake can
@@ -294,6 +307,17 @@ class _Resilient:
         # a non-ValueError (advisor r4) — one except block, two recoveries
         for attempt in range(3):
             try:
+                aot = self._aot
+                if aot is not None:
+                    try:
+                        return aot(*a, **k)
+                    except TypeError:
+                        # aval/convention mismatch for THIS call shape
+                        # (a second legitimate signature of the same
+                        # program): fall through to the jit path, which
+                        # traces and caches that variant. The AOT
+                        # executable stays installed for matching calls.
+                        pass
                 return self._fn(*a, **k)
             except Exception as e:
                 msg = str(e)
@@ -315,6 +339,9 @@ class _Resilient:
                     # healable clear_cache+retry recovery must win when
                     # both match (ADVICE r5)
                     _record_strike(self._fn.__name__, "executable_cache")
+                    # a corrupted executable may BE the AOT one: drop it
+                    # so the retry re-traces through the cleared jit
+                    self._aot = None
                     self._fn.clear_cache()
                 elif any(m in msg for m in _WEDGE_MARKERS):
                     # not healable in-process (see _WEDGE_MARKERS):

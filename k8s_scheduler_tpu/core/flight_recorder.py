@@ -106,6 +106,13 @@ class CycleRecord:
     # observer diffs consecutive signatures to attribute WHICH pad
     # dimension (E/MPN/MA/MC/P/N) flipped on a recompile anomaly
     sig: tuple | None = None
+    # where this cycle's (re)built programs came from, stamped only on
+    # regime-flip cycles: "cold" (full XLA compile on the serve path),
+    # "cache" (loaded from the persistent executable cache), or
+    # "speculative" (the warm thread pre-built the regime before the
+    # flip). The observer surfaces it in /debug/anomalies recompile
+    # events so operators can tell a cache miss from a win.
+    compile_source: str = ""
 
     def mark(self, name: str, t: float) -> None:
         self.marks[name] = t
@@ -128,6 +135,10 @@ class CycleRecord:
             **(
                 {"sig": {k: v for k, v in self.sig}}
                 if self.sig is not None else {}
+            ),
+            **(
+                {"compile_source": self.compile_source}
+                if self.compile_source else {}
             ),
         }
 

@@ -421,6 +421,7 @@ class CycleObserver:
             seq=rec.seq,
             t_s=rec.t_end - self.epoch,
             wall=rec.wall_start,
+            compile_source=getattr(rec, "compile_source", ""),
         )
 
     def observe_phases(
@@ -432,6 +433,7 @@ class CycleObserver:
         seq: int = -1,
         t_s: float = 0.0,
         wall: float = 0.0,
+        compile_source: str = "",
     ) -> list[dict]:
         """The sentinel core, usable without a CycleRecord (bench_suite
         feeds plain latency series through classify_latency_series)."""
@@ -442,6 +444,19 @@ class CycleObserver:
                 profile, {"sig": None, "counts": {}, "cycles": 0}
             )
             first = prof["cycles"] == 0
+            # per-profile demand drift baseline: an EWMA of the cycle's
+            # attempted-pod count. The speculative-compile warmer
+            # (core/compile_cache.py) watches it to pre-build the
+            # ADJACENT pad regime before churn crosses a bucket
+            # boundary — alpha 0.2 tracks a drifting arrival rate in a
+            # handful of cycles without chasing single-cycle spikes.
+            pods_n = counts.get("pods")
+            if pods_n is not None:
+                prev_d = prof.get("demand_ewma")
+                prof["demand_ewma"] = (
+                    float(pods_n) if prev_d is None
+                    else prev_d + 0.2 * (pods_n - prev_d)
+                )
 
             def raise_anomaly(
                 cls: str, phase: str = "", value_s: float = 0.0,
@@ -543,6 +558,12 @@ class CycleObserver:
                     # diff to show, but the rebuild cost is just as real
                     else {"dims": [], "growth": "interning"}
                 )
+                if compile_source:
+                    # cold | cache | speculative: a cache hit or a
+                    # speculation win is a regime flip that cost ~no
+                    # serve-path compile — operators triage these
+                    # differently from a cold miss
+                    detail["compile_source"] = compile_source
                 raise_anomaly(
                     "recompile",
                     phase="compile",
@@ -634,6 +655,14 @@ class CycleObserver:
     def quantile(self, phase: str, q: float) -> float:
         with self._lock:
             return self.raw[phase].quantile(q)
+
+    def demand_ewma(self, profile: str) -> float:
+        """The per-profile attempted-pod EWMA (0.0 before any cycle) —
+        the drift signal the speculative-compile warmer watches."""
+        with self._lock:
+            return float(
+                self._prof.get(profile, {}).get("demand_ewma") or 0.0
+            )
 
     # locked SloEngine reads: the scrape-time gauge closures must not
     # iterate the burn-window deques while the scheduling loop appends

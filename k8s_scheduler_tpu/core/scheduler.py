@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading as _threading
 import time as _time
 from typing import Callable
 
@@ -223,6 +224,7 @@ class Scheduler:
                 ),
                 pad_ma=self.config.pad_ma or None,
                 pad_mc=self.config.pad_mc or None,
+                pad_hysteresis_pct=self.config.pad_hysteresis_pct,
             )
             for n in names
         }
@@ -278,9 +280,48 @@ class Scheduler:
         # regime-flip accounting for the observer: _packed_fns bumps the
         # build count on every memo miss and records how long the host-
         # side program (re)build took — the XLA compile itself rides the
-        # first dispatch, which the recompile anomaly attributes
+        # first dispatch (or, with the compile cache enabled, the AOT
+        # build inside _build_packed_entry), which the recompile anomaly
+        # attributes. _last_compile_source tells the flip's cost class:
+        # cold (full XLA compile), cache (persistent-cache load), or
+        # speculative (the warm thread pre-built it).
         self._packed_builds = 0
         self._last_build_s = 0.0
+        self._last_compile_source = "cold"
+        # compile-regime management (core/compile_cache.py): persistent
+        # AOT-executable cache under compileCacheDir (or the state dir's
+        # compile_cache/ subtree), plus the speculative warm thread that
+        # pre-builds the adjacent pad regime when the sentinel's demand
+        # EWMA drifts toward a bucket boundary. _packed_lock serializes
+        # the program memos against the warm thread; the serve path pays
+        # one uncontended acquire per memo hit.
+        self._packed_lock = _threading.Lock()
+        cc_dir = self.config.compile_cache_dir
+        if cc_dir.lower() in ("off", "none"):
+            # explicit opt-out even with a state dir (slow shared
+            # storage, poisoned-cache triage): "" means derive, not off
+            cc_dir = ""
+        elif not cc_dir and state is not None:
+            cc_dir = getattr(state, "compile_cache_path", "")
+        self._compile_cache = None
+        if cc_dir:
+            from .compile_cache import CompileCache
+
+            self._compile_cache = CompileCache(
+                cc_dir, metrics=self.metrics
+            )
+            if state is not None:
+                # /debug/state shows hit/miss/entry counts next to the
+                # journal the same directory tree holds
+                state.compile_cache = self._compile_cache
+        self._warmer = None
+        if self.config.speculative_compile and self.observer is not None:
+            from .compile_cache import CompileWarmer
+
+            # lazy daemon thread: nothing starts until the first
+            # speculative submit, so recorder-less or idle schedulers
+            # never spawn it
+            self._warmer = CompileWarmer(metrics=self.metrics)
         # carry mode (rounds only; extender verdicts replace snapshot
         # fields, which the arena spec does not carry): the [P,N] static
         # base + [S,P] matched-pending persist on device and are updated
@@ -331,62 +372,262 @@ class Scheduler:
         self._preempt = build_preemption_fn(self.framework)
 
     def _packed_fns(self, spec, profile: str):
-        from .pipeline import ServingPipeline
-
-        fw = self.frameworks[profile]
         key = (spec.key(), profile)
-        hit = self._packed.get(key)
-        if hit is None:
-            t_build = self._now()
-            if self._use_carry:
-                from .cycle import (
-                    CarryKeeper,
-                    ExtenderVerdictKeeper,
-                    build_diagnosis_fn,
-                    build_packed_cycle_carry_fn,
-                )
-
-                ext = self._extender_carry
-                cyc = build_packed_cycle_carry_fn(
-                    spec, framework=fw,
-                    gang_scheduling=self._cycle_kw["gang_scheduling"],
-                    percentage_of_nodes_to_score=self._cycle_kw[
-                        "percentage_of_nodes_to_score"
-                    ],
-                    extender_args=ext,
-                )
-                keeper = CarryKeeper(spec, fw)
-                diag = build_diagnosis_fn(spec, fw, extender_args=ext)
-                ext_keeper = ExtenderVerdictKeeper(spec) if ext else None
-            else:
-                cyc = build_packed_cycle_fn(
-                    spec, framework=fw, **self._cycle_kw
-                )
-                keeper = diag = ext_keeper = None
-            preempt = build_packed_preemption_fn(spec, fw)
-            pipe = ServingPipeline(
-                cyc,
-                keeper=keeper,
-                diag_fn=diag,
-                preempt_fn=preempt,
-                forced_sync=self.forced_sync,
-                metrics=self.metrics,
-            )
-            hit = (
-                cyc,
-                preempt,
-                build_stable_state_fn(spec),
-                keeper, diag, ext_keeper, pipe,
-            )
+        with self._packed_lock:
+            entry = self._packed.get(key)
+            if entry is not None:
+                # true LRU: move-to-end on hit so the eviction below
+                # drops the COLDEST regime, never the one serving now
+                self._packed.pop(key)
+                self._packed[key] = entry
+                if entry.pop("fresh", None):
+                    # first serve-path use of a speculative warm build:
+                    # the flip speculation predicted just happened, and
+                    # it costs ~zero compile here — stamp a regime_flip
+                    # so the observer records the win
+                    self._packed_builds += 1
+                    self._last_build_s = 0.0
+                    self._last_compile_source = "speculative"
+                return entry["fns"]
+        # build OUTSIDE the lock (seconds of trace/compile; the warm
+        # thread must stay able to install other regimes meanwhile)
+        entry = self._build_packed_entry(
+            spec, profile,
+            aot=self._compile_cache is not None and not self.extenders,
+        )
+        with self._packed_lock:
+            cur = self._packed.setdefault(key, entry)
+            self._packed.pop(key)
+            self._packed[key] = cur  # newest position (LRU end)
+            cur.pop("fresh", None)  # this cycle IS the flip; stamp once
             self._packed_builds += 1
-            self._last_build_s = self._now() - t_build
-            self._packed[key] = hit
+            self._last_build_s = entry["build_s"]
+            self._last_compile_source = entry["source"]
             # bounded: grow-only interning dimensions make old regimes
             # permanently dead — keep only the recent few (pad-bucket
             # flip-flops) instead of leaking compiled executables forever
             while len(self._packed) > 4 * len(self.frameworks):
                 self._packed.pop(next(iter(self._packed)))
-        return hit
+        return cur["fns"]
+
+    def _build_packed_entry(
+        self, spec, profile: str, aot: bool
+    ) -> dict:
+        """Construct one regime's full program set (the `_packed` memo
+        entry). Pure with respect to scheduler state — safe on the
+        speculative warm thread — except for the program-build metrics
+        the AOT layer records. With `aot`, every program is
+        ahead-of-time compiled through the persistent executable cache
+        (core/compile_cache.py) and the loaded/compiled executable is
+        installed on its _Resilient wrapper, so the first dispatch pays
+        a call, not a compile."""
+        from .pipeline import ServingPipeline
+
+        fw = self.frameworks[profile]
+        # wall measurement, NOT self._now(): the injected clock is
+        # logical time (backoff/TTL) and may be frozen in tests/bench
+        # drives — build_s feeds compile_ms attribution, which must be
+        # the real seconds the (re)build cost
+        t_build = _time.perf_counter()
+        if self._use_carry:
+            from .cycle import (
+                CarryKeeper,
+                ExtenderVerdictKeeper,
+                build_diagnosis_fn,
+                build_packed_cycle_carry_fn,
+            )
+
+            ext = self._extender_carry
+            cyc = build_packed_cycle_carry_fn(
+                spec, framework=fw,
+                gang_scheduling=self._cycle_kw["gang_scheduling"],
+                percentage_of_nodes_to_score=self._cycle_kw[
+                    "percentage_of_nodes_to_score"
+                ],
+                extender_args=ext,
+            )
+            keeper = CarryKeeper(spec, fw)
+            diag = build_diagnosis_fn(spec, fw, extender_args=ext)
+            ext_keeper = ExtenderVerdictKeeper(spec) if ext else None
+        else:
+            cyc = build_packed_cycle_fn(
+                spec, framework=fw, **self._cycle_kw
+            )
+            keeper = diag = ext_keeper = None
+        preempt = build_packed_preemption_fn(spec, fw)
+        pipe = ServingPipeline(
+            cyc,
+            keeper=keeper,
+            diag_fn=diag,
+            preempt_fn=preempt,
+            forced_sync=self.forced_sync,
+            metrics=self.metrics,
+        )
+        fns = (
+            cyc,
+            preempt,
+            build_stable_state_fn(spec),
+            keeper, diag, ext_keeper, pipe,
+        )
+        source = "cold"
+        if aot:
+            src = self._aot_install(
+                spec, profile,
+                cyc=cyc, preempt=preempt, stable_fn=fns[2],
+                keeper=keeper, diag=diag,
+            )
+            if src is not None:
+                source = src
+        return {
+            "fns": fns,
+            "build_s": _time.perf_counter() - t_build,
+            "source": source,
+        }
+
+    def _aot_install(
+        self, spec, profile: str, *, cyc, preempt, stable_fn, keeper,
+        diag,
+    ) -> "str | None":
+        """AOT-compile this regime's programs through the persistent
+        executable cache and install the executables on their
+        _Resilient wrappers. Argument avals are derived from the spec
+        alone (packed buffers) plus each upstream program's out_info,
+        so no device work happens here. Returns "cache" when EVERY
+        program loaded from disk, "cold" when any compiled here, None
+        when AOT was impossible (the plain jit path remains)."""
+        import jax
+
+        from . import compile_cache as cc
+
+        w = jax.ShapeDtypeStruct((spec.n_words,), np.uint32)
+        b = jax.ShapeDtypeStruct((spec.n_bytes,), np.uint8)
+        sources: list[str] = []
+
+        def one(kind, fn, args, kwargs=None):
+            if fn is None:
+                return None
+            compiled, source, _dt, out_sds = cc.load_or_compile(
+                fn, self._compile_cache, spec, profile, kind,
+                args=args, kwargs=kwargs,
+            )
+            if compiled is None:
+                return None
+            fn.install_aot(compiled)
+            sources.append(source)
+            return out_sds
+
+        stable_sds = one("stable", stable_fn, (w, b))
+        if stable_sds is None:
+            return None
+        if keeper is not None:
+            carry_sds = one("carry_init", keeper.ci, (w, b, stable_sds))
+            if carry_sds is None:
+                return None
+            out_sds = one("cycle", cyc, (w, b, stable_sds, carry_sds))
+            idx_sds = jax.ShapeDtypeStruct((keeper.bucket,), np.int32)
+            one(
+                "carry_update", keeper._cu(keeper.bucket),
+                (w, b, stable_sds, carry_sds, idx_sds),
+            )
+        else:
+            out_sds = one("cycle", cyc, (w, b, stable_sds))
+        if out_sds is not None and preempt is not None:
+            one("preempt", preempt, (w, b, out_sds, stable_sds))
+        if out_sds is not None and diag is not None:
+            kwargs = {}
+            pv = getattr(out_sds, "pv_claimed", None)
+            if pv is not None:
+                # matches CycleHandle.dispatch_diagnosis's convention
+                kwargs["pv_claimed"] = pv
+            one(
+                "diag", diag,
+                (w, b, stable_sds, out_sds.assignment,
+                 out_sds.node_requested),
+                kwargs,
+            )
+        if not sources:
+            return None
+        return "cache" if all(s == "cache" for s in sources) else "cold"
+
+    def _maybe_speculate(self, profile: str, spec) -> None:
+        """Speculative precompilation trigger, run at the tail of a
+        profile's cycle (never the bind path — the dispatch, fetch, and
+        bind loop are all behind us): when the sentinel's demand EWMA
+        for this profile drifts within the margin of the current P pad
+        bucket's boundary, derive the ADJACENT regime's spec
+        (packing.respec — no re-encode) and hand its program build to
+        the warm thread. A wrong prediction costs one wasted background
+        build; a right one makes the flip's serve-path compile ~zero."""
+        warmer = self._warmer
+        obs = self.observer
+        if warmer is None or obs is None:
+            return
+        from ..models import packing
+
+        sig = dict(packing.shape_signature(spec))
+        P = sig.get("P", 0)
+        if P <= 0:
+            return
+        demand = obs.demand_ewma(profile)
+        if demand <= 0.0:
+            return
+        bucket = self._pad_bucket
+        targets = []
+        if demand >= 0.85 * P:
+            # drifting UP toward the boundary: the next bucket's regime
+            targets.append(_pad(P + 1, bucket))
+        down = _pad(max(int(demand), 1), bucket)
+        if down < P and demand <= down * (
+            1.0 - max(self.config.pad_hysteresis_pct, 10.0) / 100.0
+        ):
+            # drifting DOWN with enough headroom that hysteresis (or a
+            # plain re-bucket) will actually step the regime down
+            targets.append(down)
+        for tgt in targets:
+            adj = packing.respec(spec, {"P": tgt})
+            if adj is None:
+                continue
+            key = (adj.key(), profile)
+            with self._packed_lock:
+                if key in self._packed:
+                    continue
+            warmer.submit(
+                ("packed",) + key,
+                lambda adj=adj, profile=profile: self._warm_regime(
+                    adj, profile
+                ),
+            )
+
+    def _warm_regime(self, spec, profile: str) -> None:
+        """Warm-thread body: pre-build one predicted regime's programs
+        into the `_packed` (and, under multi-cycle serving, `_mc_fns`)
+        memos and the persistent executable cache. Installs with
+        setdefault — if the serve loop flipped first and built its own
+        entry, this build is discarded (the disk entries still land)."""
+        key = (spec.key(), profile)
+        with self._packed_lock:
+            if key in self._packed:
+                return
+        entry = self._build_packed_entry(spec, profile, aot=True)
+        entry["source"] = "speculative"
+        entry["fresh"] = True
+        with self._packed_lock:
+            self._packed.setdefault(key, entry)
+            while len(self._packed) > 4 * len(self.frameworks) + 1:
+                # +1: a fresh speculative entry must not evict a live
+                # regime the moment it lands, nor be evicted itself
+                self._packed.pop(next(iter(self._packed)))
+        if self._mc_k > 1 and profile not in self._mc_off:
+            with self._packed_lock:
+                if key in self._mc_fns:
+                    return
+            m_entry = self._build_mc_entry(spec, profile, aot=True)
+            m_entry["source"] = "speculative"
+            m_entry["fresh"] = True
+            with self._packed_lock:
+                self._mc_fns.setdefault(key, m_entry)
+                while len(self._mc_fns) > 4 * len(self.frameworks) + 1:
+                    self._mc_fns.pop(next(iter(self._mc_fns)))
 
 
     def _stable_state(self, spec, stable_fn, wbuf, bbuf, encoder=None):
@@ -667,9 +908,16 @@ class Scheduler:
             )
         nodes = self.cache.nodes()
         existing = self.cache.existing_pods()
-        # bucketed pod/node padding keeps jit caches warm across cycles
-        encoder.pad_pods = _pad(len(pending), self._pad_bucket)
-        encoder.pad_nodes = _pad(len(nodes), self._pad_bucket)
+        # bucketed pod/node padding keeps jit caches warm across cycles;
+        # hysteresis_pad damps the DOWN-steps (padHysteresisPct), so a
+        # count oscillating around a bucket boundary holds the larger
+        # already-compiled regime instead of flip-flopping
+        encoder.pad_pods = encoder.hysteresis_pad(
+            "P", _pad(len(pending), self._pad_bucket), len(pending)
+        )
+        encoder.pad_nodes = encoder.hysteresis_pad(
+            "N", _pad(len(nodes), self._pad_bucket), len(nodes)
+        )
         kw = dict(
             pod_groups=list(self._groups.values()),
             pvcs=list(self._pvcs.values()),
@@ -865,12 +1113,15 @@ class Scheduler:
             # cycle flipped regimes
             extra_phases: dict = {}
             extra_counts: dict = {}
+            compile_source = ""
             fold_ms = encoder.delta_profile.get("fold")
             if fold_ms:
                 extra_phases["fold_ms"] = float(fold_ms)
             if self._packed_builds > builds_before:
                 extra_phases["compile_ms"] = self._last_build_s * 1e3
                 extra_counts["regime_flip"] = 1
+                # cold | cache | speculative — how the flip was paid
+                compile_source = self._last_compile_source
             if profile in self._mc_stale_arena:
                 # first single-cycle dispatch after a batch: a full
                 # re-encode here is the batch's fault (its plain
@@ -884,41 +1135,135 @@ class Scheduler:
                 _before, profile_gang_dropped,
                 fetch_bytes=int(st.get("fetch_bytes", 0)),
                 extra_phases=extra_phases, extra_counts=extra_counts,
+                compile_source=compile_source,
             )
             if "diag_lag_ms" in st:
                 self.metrics.diag_lag.observe(st["diag_lag_ms"] / 1e3)
+        # speculative precompilation: after the cycle's work is fully
+        # committed, check whether demand is drifting toward a pad
+        # boundary and pre-build the adjacent regime off-thread
+        self._maybe_speculate(profile, spec)
 
     def _mc_programs(self, spec, profile: str):
         """Memoized multi-cycle program pair for one packed regime:
         (multicycle_fn, diagnosis_fn). Counted into `_packed_builds`
         like every other program build so the observer's recompile
         anomaly attributes the one-time compile cost of a new regime's
-        batch program."""
+        batch program. True LRU: a hit moves the entry to the end, so
+        eviction drops the coldest regime — the seed's FIFO pop could
+        evict the hottest multi-cycle regime while a cold one stayed
+        (regression-tested in tests/test_compile_cache.py)."""
         key = (spec.key(), profile)
-        hit = self._mc_fns.get(key)
-        if hit is None:
-            from .cycle import (
-                build_diagnosis_fn,
-                build_packed_multicycle_fn,
-            )
-
-            t_build = self._now()
-            fw = self.frameworks[profile]
-            mfn = build_packed_multicycle_fn(
-                spec, framework=fw, k=self._mc_k, **self._cycle_kw
-            )
-            # the multi-cycle decisions are lean (no fused reject
-            # counts), so every regime needs the separate diagnosis
-            # program — including scan-mode regimes whose single-cycle
-            # path runs the fused full program and has none
-            mdiag = build_diagnosis_fn(spec, fw)
-            hit = (mfn, mdiag)
-            self._mc_fns[key] = hit
+        with self._packed_lock:
+            entry = self._mc_fns.get(key)
+            if entry is not None:
+                self._mc_fns.pop(key)
+                self._mc_fns[key] = entry  # move-to-end on hit
+                if entry.pop("fresh", None):
+                    self._packed_builds += 1
+                    self._last_build_s = 0.0
+                    self._last_compile_source = "speculative"
+                return entry["fns"]
+        entry = self._build_mc_entry(
+            spec, profile,
+            aot=self._compile_cache is not None and not self.extenders,
+        )
+        with self._packed_lock:
+            cur = self._mc_fns.setdefault(key, entry)
+            self._mc_fns.pop(key)
+            self._mc_fns[key] = cur
+            cur.pop("fresh", None)
             self._packed_builds += 1
-            self._last_build_s = self._now() - t_build
+            self._last_build_s = entry["build_s"]
+            self._last_compile_source = entry["source"]
             while len(self._mc_fns) > 4 * len(self.frameworks):
                 self._mc_fns.pop(next(iter(self._mc_fns)))
-        return hit
+        return cur["fns"]
+
+    def _build_mc_entry(self, spec, profile: str, aot: bool) -> dict:
+        """Construct one regime's multi-cycle program pair (the
+        `_mc_fns` memo entry); warm-thread safe like
+        _build_packed_entry."""
+        from .cycle import (
+            build_diagnosis_fn,
+            build_packed_multicycle_fn,
+        )
+
+        t_build = _time.perf_counter()  # wall, like _build_packed_entry
+        fw = self.frameworks[profile]
+        mfn = build_packed_multicycle_fn(
+            spec, framework=fw, k=self._mc_k, **self._cycle_kw
+        )
+        # the multi-cycle decisions are lean (no fused reject
+        # counts), so every regime needs the separate diagnosis
+        # program — including scan-mode regimes whose single-cycle
+        # path runs the fused full program and has none
+        mdiag = build_diagnosis_fn(spec, fw)
+        source = "cold"
+        if aot:
+            src = self._aot_install_multi(
+                spec, profile, mfn=mfn, mdiag=mdiag
+            )
+            if src is not None:
+                source = src
+        return {
+            "fns": (mfn, mdiag),
+            "build_s": _time.perf_counter() - t_build,
+            "source": source,
+        }
+
+    def _aot_install_multi(
+        self, spec, profile: str, *, mfn, mdiag
+    ) -> "str | None":
+        """AOT layer for the multi-cycle programs: the stacked [K, ...]
+        batch loop (kind `multicycle-K` — K is static in the program)
+        and its per-row diagnosis companion (same key as the
+        single-cycle diag when the conventions match, so the disk entry
+        is shared)."""
+        import jax
+
+        from . import compile_cache as cc
+        from .cycle import build_stable_state_fn
+
+        w1 = jax.ShapeDtypeStruct((spec.n_words,), np.uint32)
+        b1 = jax.ShapeDtypeStruct((spec.n_bytes,), np.uint8)
+        wk = jax.ShapeDtypeStruct(
+            (self._mc_k, spec.n_words), np.uint32
+        )
+        bk = jax.ShapeDtypeStruct((self._mc_k, spec.n_bytes), np.uint8)
+        try:
+            stable_sds = jax.eval_shape(
+                build_stable_state_fn(spec), w1, b1
+            )
+        except Exception:
+            return None
+        n_sds = jax.ShapeDtypeStruct((), np.int32)
+        sources: list[str] = []
+        compiled, source, _dt, out_sds = cc.load_or_compile(
+            mfn, self._compile_cache, spec, profile,
+            f"multicycle-{self._mc_k}",
+            args=(wk, bk, stable_sds, n_sds),
+        )
+        if compiled is not None:
+            mfn.install_aot(compiled)
+            sources.append(source)
+        if out_sds is not None:
+            a_row = jax.ShapeDtypeStruct(
+                tuple(out_sds.assignment.shape[1:]), np.int32
+            )
+            nr_row = jax.ShapeDtypeStruct(
+                tuple(out_sds.node_requested.shape[1:]), np.float32
+            )
+            compiled_d, source_d, _dt, _out = cc.load_or_compile(
+                mdiag, self._compile_cache, spec, profile, "diag",
+                args=(w1, b1, stable_sds, a_row, nr_row),
+            )
+            if compiled_d is not None:
+                mdiag.install_aot(compiled_d)
+                sources.append(source_d)
+        if not sources:
+            return None
+        return "cache" if all(s == "cache" for s in sources) else "cold"
 
     def _schedule_profile_multi(
         self,
@@ -989,11 +1334,15 @@ class Scheduler:
                 self._schedule_profile(profile, g, stats, t0)
 
         # one spec for every row: pad to the LARGEST group so all K
-        # packed snapshots stack into [K, W]/[K, B]
-        encoder.pad_pods = _pad(
-            max(len(g) for _, g in groups), self._pad_bucket
+        # packed snapshots stack into [K, W]/[K, B]; down-steps damped
+        # by the same hysteresis as the single-cycle path
+        mc_pods = max(len(g) for _, g in groups)
+        encoder.pad_pods = encoder.hysteresis_pad(
+            "P", _pad(mc_pods, self._pad_bucket), mc_pods
         )
-        encoder.pad_nodes = _pad(len(nodes), self._pad_bucket)
+        encoder.pad_nodes = encoder.hysteresis_pad(
+            "N", _pad(len(nodes), self._pad_bucket), len(nodes)
+        )
         builds_before = self._packed_builds
         t_batch = self._now()
         t_batch_rec = fr.now() if fr is not None else 0.0
@@ -1175,11 +1524,13 @@ class Scheduler:
                     extra_phases["diag_lag_ms"] = lag_s * 1e3
                     extra_marks["diag_done"] = t_done
                     self.metrics.diag_lag.observe(lag_s)
+                compile_source = ""
                 if i == 0 and self._packed_builds > builds_before:
                     extra_phases["compile_ms"] = (
                         self._last_build_s * 1e3
                     )
                     extra_counts["regime_flip"] = 1
+                    compile_source = self._last_compile_source
                 # batch-wide pipeline marks/phases (encode, dispatch,
                 # device window, decision fetch) land ONLY on inner
                 # record 0 — the one representing the dispatch. Copying
@@ -1200,7 +1551,9 @@ class Scheduler:
                     extra_phases=extra_phases,
                     extra_marks=extra_marks,
                     extra_counts=extra_counts,
+                    compile_source=compile_source,
                 )
+        self._maybe_speculate(profile, spec)
 
     def _commit_record(
         self,
@@ -1217,6 +1570,7 @@ class Scheduler:
         extra_phases: "dict | None" = None,
         extra_marks: "dict | None" = None,
         extra_counts: "dict | None" = None,
+        compile_source: str = "",
     ) -> None:
         """Assemble + commit one cycle flight record (one list store):
         pipeline stage marks/phases, pad-regime signature, queue
@@ -1250,6 +1604,10 @@ class Scheduler:
         # pad-regime signature: core/observe.py diffs consecutive
         # cycles' sigs to attribute recompile dimensions
         rec.sig = _packing.shape_signature(spec)
+        if compile_source:
+            # regime-flip cycles only: how the (re)build was paid —
+            # cold compile, persistent-cache load, or a speculation win
+            rec.compile_source = compile_source
         qc = self.queue.pending_counts()
         sb, ub, bb, pb, vb = before
         rec.counts.update(
@@ -1594,8 +1952,12 @@ class Scheduler:
         nodes = self.cache.nodes()
         if not pending or not nodes:
             return {}
-        self._encoder.pad_pods = _pad(len(pending), self._pad_bucket)
-        self._encoder.pad_nodes = _pad(len(nodes), self._pad_bucket)
+        self._encoder.pad_pods = self._encoder.hysteresis_pad(
+            "P", _pad(len(pending), self._pad_bucket), len(pending)
+        )
+        self._encoder.pad_nodes = self._encoder.hysteresis_pad(
+            "N", _pad(len(nodes), self._pad_bucket), len(nodes)
+        )
         snap = self._encoder.encode(
             nodes,
             pending,

@@ -83,6 +83,20 @@ round trip):
 - scheduler_multicycle_inner_cycles_total — scheduling cycles served
   through multi-cycle dispatches (vs one dispatch per cycle)
 
+Compile-regime management families (core/compile_cache.py — persistent
+AOT-executable cache + speculative pre-compilation):
+
+- scheduler_compile_cache_hits_total — programs loaded from the
+  persistent executable cache instead of compiling cold
+- scheduler_compile_cache_misses_total — programs that compiled cold
+  with the cache enabled (entry absent, corrupt, or
+  fingerprint-mismatched; the fresh build is stored back)
+- scheduler_compile_cache_loads_seconds — time to trace + deserialize a
+  cached executable (vs the 8.8-16.8 s cold compile it replaces)
+- scheduler_compile_cache_speculative_builds_total — adjacent pad
+  regimes pre-built by the warm thread before churn crossed a bucket
+  boundary (a flip speculation won costs ~0 serve-path compile)
+
 Durable-state families (state/ package — write-ahead journal, snapshots,
 restore) and leader election:
 
@@ -345,6 +359,32 @@ class SchedulerMetrics:
             "scheduler_multicycle_inner_cycles_total",
             "Scheduling cycles served through multi-cycle dispatches "
             "(each paid dispatch_rt/K instead of a full round trip).",
+            registry=r,
+        )
+        # ---- compile-regime management (core/compile_cache.py) ----
+        self.compile_cache_hits = Counter(
+            "scheduler_compile_cache_hits_total",
+            "Programs loaded from the persistent executable cache "
+            "instead of compiling cold.",
+            registry=r,
+        )
+        self.compile_cache_misses = Counter(
+            "scheduler_compile_cache_misses_total",
+            "Programs that compiled cold with the cache enabled (entry "
+            "absent, corrupt, or fingerprint-mismatched).",
+            registry=r,
+        )
+        self.compile_cache_loads = Histogram(
+            "scheduler_compile_cache_loads_seconds",
+            "Time to trace + deserialize a cached executable (replaces "
+            "a multi-second cold compile).",
+            buckets=_DURATION_BUCKETS,
+            registry=r,
+        )
+        self.compile_cache_speculative = Counter(
+            "scheduler_compile_cache_speculative_builds_total",
+            "Adjacent pad regimes pre-built by the speculative warm "
+            "thread before churn crossed a bucket boundary.",
             registry=r,
         )
         # ---- durable state (state/: journal + snapshots + restore) ----

@@ -417,6 +417,13 @@ class SnapshotEncoder:
         # term count the workload can carry (ADVICE r5)
         pad_mc: int | None = None,  # pre-size the sticky MC pad
         # (topology-spread constraints per pod) the same way
+        pad_hysteresis_pct: float = 0.0,  # down-step margin for the
+        # P/N pad buckets (config padHysteresisPct): a shrinking real
+        # count only steps the pad DOWN when it leaves at least this
+        # many percent of headroom inside the smaller bucket, so a
+        # workload oscillating around a bucket boundary holds the
+        # larger regime instead of flip-flopping (each flip risks a
+        # full recompile). 0 disables (classic immediate down-step).
     ) -> None:
         self.strings = StringInterner()
         self.resource_names = list(resource_names)
@@ -426,6 +433,9 @@ class SnapshotEncoder:
         self.pad_pods_per_node = pad_pods_per_node
         self.pad_ma = pad_ma
         self.pad_mc = pad_mc
+        self.pad_hysteresis_pct = float(pad_hysteresis_pct)
+        # last pad actually used per hysteresis dimension ("P"/"N")
+        self._held_pads: dict[str, int] = {}
         # the profile's queueSort plugin (SURVEY §2 C11): owns the
         # pod_order rank both encode paths bake into the snapshot
         if queue_sort is None:
@@ -478,6 +488,29 @@ class SnapshotEncoder:
         self.full_encodes = 0
         # per-segment ms of the LAST delta encode (see _encode_delta)
         self.delta_profile: dict[str, float] = {}
+
+    def hysteresis_pad(self, dim: str, candidate: int, real: int) -> int:
+        """Regime hysteresis for the externally-bucketed P/N pads: the
+        pad a caller should actually use for this encode, given the
+        bucket-rounded `candidate` and the `real` count behind it.
+
+        Up-steps are immediate (the candidate no longer fits the held
+        regime). A DOWN-step is taken only when the real count leaves at
+        least `pad_hysteresis_pct` percent of headroom inside the
+        smaller bucket — a count hovering just under the boundary keeps
+        the larger (already-compiled) regime, so an oscillating
+        workload costs zero regime flips instead of one per crossing.
+        With the knob at 0 this is the identity on `candidate`."""
+        held = self._held_pads.get(dim, 0)
+        pct = self.pad_hysteresis_pct
+        if (
+            candidate >= held
+            or pct <= 0.0
+            or real <= candidate * (1.0 - pct / 100.0)
+        ):
+            self._held_pads[dim] = candidate
+            return candidate
+        return held
 
     def _stick(self, key: str, val: int) -> int:
         cur = self._sticky_dims.get(key, 0)
@@ -579,8 +612,16 @@ class SnapshotEncoder:
 
         n_real, p_real, e_real = len(nodes), len(pending), len(existing)
         self._cycle_index += 1
-        N = self.pad_nodes or _pow2_bucket(n_real)
-        P = self.pad_pods or _pow2_bucket(p_real)
+        # hysteresis applies to the DEFAULT pow2 bucketing here; callers
+        # that drive pad_pods/pad_nodes themselves (the scheduler's
+        # bucketed pads) route their candidates through hysteresis_pad
+        # before assigning, so both paths share one held-regime state
+        N = self.pad_nodes or self.hysteresis_pad(
+            "N", _pow2_bucket(n_real), n_real
+        )
+        P = self.pad_pods or self.hysteresis_pad(
+            "P", _pow2_bucket(p_real), p_real
+        )
         # E is STICKY (like MPL/MA): the incremental existing-fold appends
         # bound pods in place, and a completion batch that shrinks e_real
         # must not flip the packed regime; pad_existing pre-sizes it.

@@ -80,6 +80,66 @@ def shape_signature(spec: PackSpec) -> tuple[tuple[str, int], ...]:
     return tuple(out)
 
 
+def respec(spec: PackSpec, dims: "dict[str, int]") -> PackSpec | None:
+    """Rewrite a PackSpec's P and/or N pad dimension to an ADJACENT
+    regime size without re-encoding — the speculative-precompilation
+    path (core/compile_cache.py) predicts the regime churn is about to
+    cross a pad-bucket boundary and needs the neighbouring regime's
+    exact spec to pre-build its programs off the serve thread.
+
+    The rewrite leans on the encoder's naming contract, verified
+    empirically by tests/test_compile_cache.py against real encodes:
+    every `pod_*` array field carries P on axis 0, every `node_*` array
+    field carries N on axis 0, and no other axis of any field scales
+    with P or N — with ONE exception, the extender verdict planes
+    (`pod_extender_mask`/`pod_extender_score` are [P, N]). Those are
+    array fields only when `has_extender`, a workload speculation does
+    not cover, so their presence refuses the rewrite (returns None)
+    rather than risking a mis-shaped program. Offsets are recomputed
+    from scratch; aux is untouched (P/N are array-derived, not aux)."""
+    prefixes = {"P": "pod_", "N": "node_"}
+    if not dims or any(d not in prefixes for d in dims):
+        return None
+    names = {n for n, _dt, _sh, _off in spec.words}
+    names.update(n for n, _sh, _off in spec.bools)
+    if names & {"pod_extender_mask", "pod_extender_score"}:
+        return None  # [P, N] planes: axis-0-only rewrite would be wrong
+    old_sizes = dict(shape_signature(spec))
+
+    def rewrite(name: str, shape: tuple) -> tuple:
+        for dim, new in dims.items():
+            if name.startswith(prefixes[dim]):
+                if not shape or shape[0] != old_sizes.get(dim):
+                    return shape  # scalar/odd field: leave untouched
+                return (int(new),) + tuple(shape[1:])
+        return shape
+
+    words = []
+    bools = []
+    wo = 0
+    bo = 0
+    for name, dt, shape, _off in spec.words:
+        shape = rewrite(name, shape)
+        words.append((name, dt, shape, wo))
+        wo += int(np.prod(shape, dtype=np.int64)) if shape else 1
+    for name, shape, _off in spec.bools:
+        shape = rewrite(name, shape)
+        bools.append((name, shape, bo))
+        bo += int(np.prod(shape, dtype=np.int64)) if shape else 1
+    out = PackSpec(
+        words=tuple(words),
+        bools=tuple(bools),
+        n_words=wo,
+        n_bytes=max(bo, 1),
+        aux=spec.aux,
+    )
+    got = dict(shape_signature(out))
+    for dim, new in dims.items():
+        if got.get(dim) != int(new):
+            return None  # the naming contract did not hold; refuse
+    return out
+
+
 def make_spec(snap: ClusterSnapshot) -> PackSpec:
     words = []
     bools = []

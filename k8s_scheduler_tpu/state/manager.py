@@ -73,6 +73,15 @@ class DurableState:
     ) -> None:
         self.dir = state_dir
         os.makedirs(state_dir, exist_ok=True)
+        # compile-regime cache lifecycle rides the state dir: the
+        # persistent executable cache (core/compile_cache.py) lives in
+        # a sibling subtree so a standby that wins the lease inherits
+        # the active's compiled programs along with its queue/cache
+        # state. Path only — CompileCache.__init__ mkdirs when the
+        # Scheduler actually wires it here (compileCacheDir may point
+        # elsewhere or disable the cache, and an empty never-used
+        # directory next to the journal would mislead restart triage).
+        self.compile_cache_path = os.path.join(state_dir, "compile_cache")
         self.snapshot_interval = snapshot_interval_seconds
         self._now = now
         self._metrics = metrics
@@ -352,7 +361,7 @@ class DurableState:
 
     def status(self) -> dict:
         """The /debug/state payload."""
-        return {
+        out = {
             "state_dir": self.dir,
             "snapshot_interval_s": self.snapshot_interval,
             "journal": self.journal.status(),
@@ -360,3 +369,10 @@ class DurableState:
             "last_restore": dict(self.last_restore),
             "sealed": self._closed,
         }
+        cc = getattr(self, "compile_cache", None)
+        if cc is not None:
+            # the Scheduler pins its CompileCache here after wiring so
+            # /debug/state shows hit/miss/entry counts next to the
+            # journal the same directory holds
+            out["compile_cache"] = cc.status()
+        return out

@@ -32,6 +32,10 @@ threshold flag (percent):
                    regression = drop  > --max-amortization-drop
     effective_p50_ms     multi-cycle best-K effective per-cycle p50
                    regression = rise  > --max-effective-p50-rise
+    compile_seconds      cold compile spend
+                   regression = rise  > --max-compile-rise
+    compile_cache_hit_rate  warm-start executable-cache hit rate
+                   regression = drop  > --max-hit-rate-drop
     stall_cycles   >10x-p50 cycles    regression = new > old + --allow-stalls
     anomalies      classifier total   regression = new > old + --allow-stalls
 
@@ -60,6 +64,14 @@ _METRICS = {
     # predate the sweep or sit outside the exactness envelope
     "tunnel_amortization": ("higher", "tunnel_amortization", "amort"),
     "effective_p50_ms": ("lower", "effective_cycle_p50_ms", "effp50"),
+    # compile-regime management (ISSUE 8): cold compile spend must not
+    # RISE (a new program or a lost cache hit re-pays 8.8-16.8 s per
+    # program) and the warm-start cache hit rate must not DROP (every
+    # lost hit is a cold compile at restart/failover time). stall_cycles
+    # (higher = regressed) already gates via _COUNT_METRICS below.
+    "compile_seconds": ("lower", "compile_seconds", "comp"),
+    "compile_cache_hit_rate": ("higher", "compile_cache_hit_rate",
+                               "cchr"),
 }
 _COUNT_METRICS = ("stall_cycles", "anomalies_total")
 
@@ -259,6 +271,18 @@ def main(argv: list[str] | None = None) -> int:
         "this many percent before it counts as a regression",
     )
     ap.add_argument(
+        "--max-compile-rise", type=float, default=75.0,
+        help="per-config compile_seconds may rise this many percent "
+        "before it counts as a regression (compile time is rig-noisy; "
+        "a genuinely new program or a lost cache hit roughly doubles "
+        "it — r04->r05 moved -7%%/-42%% on the shared configs)",
+    )
+    ap.add_argument(
+        "--max-hit-rate-drop", type=float, default=10.0,
+        help="warm-start compile_cache_hit_rate may drop this many "
+        "percent before it counts as a regression",
+    )
+    ap.add_argument(
         "--allow-stalls", type=int, default=1,
         help="stall/anomaly count may grow by this many before it "
         "counts as a regression (one stall is a known rig flake — "
@@ -297,6 +321,8 @@ def main(argv: list[str] | None = None) -> int:
             "encode_p50_ms": args.max_encode_rise,
             "tunnel_amortization": args.max_amortization_drop,
             "effective_p50_ms": args.max_effective_p50_rise,
+            "compile_seconds": args.max_compile_rise,
+            "compile_cache_hit_rate": args.max_hit_rate_drop,
         },
         allow_stalls=args.allow_stalls,
         min_ms_delta=args.min_ms_delta,

@@ -69,6 +69,13 @@ _SLOW_TESTS = {
     "test_mixed_burst_lull_traffic_no_false_fold_miss",
     "test_bench_multicycle_sweep_amortizes_dispatch",
     "test_bench_multicycle_sweep_respects_envelope",
+    # compile-regime management end-to-end proofs (ISSUE 8): each
+    # drives real Schedulers through cold XLA compiles of whole
+    # program sets (warm-restart zero-cold-compile, speculation-won
+    # flip, and the three-phase regime_churn bench soak)
+    "test_warm_restart_compiles_zero_programs",
+    "test_speculative_precompile_wins_the_flip",
+    "test_regime_churn_soak_zero_compile_stalls",
 }
 _SLOW_MODULES = {"tests.test_concurrency"}
 

@@ -261,7 +261,7 @@ def test_forced_sync_produces_identical_bindings():
         st = s.schedule_cycle()
         results[sync] = (sorted(bound), st.scheduled, st.unschedulable)
         # the pipeline really ran and fetched the slimmed payload
-        pipes = [v[6] for v in s._packed.values()]
+        pipes = [v["fns"][6] for v in s._packed.values()]
         assert pipes and pipes[0].fetch_bytes_total > 0
         assert pipes[0].forced_sync is sync
     assert results[False] == results[True]

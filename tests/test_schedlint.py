@@ -457,6 +457,81 @@ def test_inventory_drift_phase_inventory_id005(tmp_path):
     )
 
 
+def test_inventory_drift_compile_key_id006(tmp_path):
+    """ID006: the compile-cache key inventory cannot drift between
+    packing.SIGNATURE_DIMS, compile_cache.SIG_KEY_FIELDS, and the
+    README key table — a new pad dim without a key field would alias
+    distinct programs into one persistent-cache entry."""
+    result = lint_fixture(tmp_path, {
+        # a NEW pad dimension "MV" joined the signature...
+        "models/packing.py": """\
+            SIGNATURE_DIMS = (
+                ("P", "pod_valid", 0),
+                ("N", "node_valid", 0),
+                ("MV", "pod_vol_mode", 1),
+            )
+        """,
+        # ...but the cache key still carries a STALE "E" and no "MV"
+        "core/compile_cache.py": """\
+            SIG_KEY_FIELDS = ("P", "N", "E")
+            EXTRA_KEY_FIELDS = ("spec", "kind")
+        """,
+        # README documents P/N/E/spec but not MV or kind
+        "README.md": """\
+            # fixture
+
+            ## Compile-regime management
+
+            key fields: P, N, E, spec
+        """,
+    }, passes=["INVENTORY-DRIFT"])
+    msgs = [f.message for f in codes_at(result, "ID006")]
+    assert any("'MV'" in m and "no cache-key field" in m for m in msgs)
+    assert any("'E'" in m and "stale key field" in m for m in msgs)
+    assert any("'kind'" in m and "README" in m for m in msgs)
+    # MV is absent from SIG_KEY_FIELDS so it is not README-checked;
+    # the three seeded drifts are exactly what fires
+    assert len(msgs) == 3
+
+    # a consistent tree lints clean
+    clean = lint_fixture(tmp_path / "clean", {
+        "models/packing.py":
+            'SIGNATURE_DIMS = (("P", "pod_valid", 0),)\n',
+        "core/compile_cache.py":
+            'SIG_KEY_FIELDS = ("P",)\n'
+            'EXTRA_KEY_FIELDS = ("spec",)\n',
+        "README.md":
+            "## Compile-regime management\n\nP and spec\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert codes_at(clean, "ID006") == []
+
+    # no SIG_KEY_FIELDS literal at all: the anchor itself is flagged
+    anchorless = lint_fixture(tmp_path / "anchorless", {
+        "models/packing.py":
+            'SIGNATURE_DIMS = (("P", "pod_valid", 0),)\n',
+        "core/compile_cache.py":
+            "SIG_KEY_FIELDS = tuple(x for x in ())\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "no literal" in f.message and "SIG_KEY_FIELDS" in f.message
+        for f in codes_at(anchorless, "ID006")
+    )
+
+    # a missing README section is flagged when both code surfaces exist
+    sectionless = lint_fixture(tmp_path / "sectionless", {
+        "models/packing.py":
+            'SIGNATURE_DIMS = (("P", "pod_valid", 0),)\n',
+        "core/compile_cache.py":
+            'SIG_KEY_FIELDS = ("P",)\n'
+            'EXTRA_KEY_FIELDS = ()\n',
+        "README.md": "# no such section\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "Compile-regime management" in f.message
+        for f in codes_at(sectionless, "ID006")
+    )
+
+
 # ---- HYGIENE -------------------------------------------------------------
 
 
