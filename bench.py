@@ -30,8 +30,9 @@ import sys
 TARGET_DECISIONS_PER_SEC = 50_000.0
 
 # distinct snapshots per config; overridable via BENCH_SNAPSHOTS
-# (config 6 = the compile-regime churn soak: cycles per drive phase)
-DEFAULT_SNAPSHOTS = {1: 50, 2: 50, 3: 50, 4: 30, 5: 30, 6: 24}
+# (config 6 = the compile-regime churn soak: cycles per drive phase;
+# config 7 = the fault-storm soak: serving cycles under the fault plan)
+DEFAULT_SNAPSHOTS = {1: 50, 2: 50, 3: 50, 4: 30, 5: 30, 6: 24, 7: 40}
 
 
 def _run_one_isolated(c: int, n: int):
@@ -259,6 +260,15 @@ def main() -> None:
                     "rflips": r["regime_flips"],
                 }
                 if "compile_cache_hit_rate" in r else {}
+            ),
+            # fault-storm soak (config 7): mean recovery time and
+            # cycles spent below the top rung — diffed by bench_diff
+            **(
+                {
+                    "mttr": r["mttr_ms"],
+                    "degc": r["degraded_cycles"],
+                }
+                if "mttr_ms" in r else {}
             ),
         }
 

@@ -124,9 +124,16 @@ CONFIG_NAMES = {
     # Scheduler, measuring regime flips, compile-attributed stall
     # cycles, and the persistent executable cache's warm-vs-cold cost
     6: "regime_churn",
+    # fault-storm soak (ISSUE 9): a scripted FaultPlan fires a hung
+    # fetch (longer than the dispatch deadline) and every device-error
+    # marker class through a REAL Scheduler, measuring MTTR (wall ms
+    # from leaving rung 0 to returning), degraded cycles, and the
+    # watchdog's bound on the hang cycle — gated by bench_diff
+    7: "fault_storm",
 }
 CONFIG_SHAPES = {1: (100, 10), 2: (1000, 100), 3: (5000, 1000),
-                 4: (10000, 5000), 5: (8000, 2000), 6: (80, 16)}
+                 4: (10000, 5000), 5: (8000, 2000), 6: (80, 16),
+                 7: (48, 16)}
 
 
 def _draw_pending(cfg: int, i: int, prev: list | None, churn: float):
@@ -214,6 +221,8 @@ def _parse_multi_k_env() -> "list[int]":
 def run_config(cfg: int, snapshots: int = 50) -> dict:
     if cfg == 6:
         return run_regime_churn_config(snapshots=snapshots)
+    if cfg == 7:
+        return run_fault_storm_config(snapshots=snapshots)
     import jax
     import numpy as np
 
@@ -1036,6 +1045,177 @@ def run_regime_churn_config(snapshots: int = 36) -> dict:
         "warm_sources": warm["sources"],
         "detail": {"cold": cold, "hysteresis": hyst, "warm": warm},
     }
+
+
+def chaos_serve_drive(
+    fault_spec: str,
+    cycles: int,
+    deadline_ms: float,
+    pods_per_cycle: int = 4,
+    n_nodes: int = 16,
+    cache_dir: str = "off",
+    promote_cycles: int = 4,
+    drain_timeout_s: float = 30.0,
+) -> dict:
+    """The shared chaos-serve harness (ISSUE 9): one real Scheduler
+    (dispatch watchdog + ladder + pre-sized pads so no regime flip
+    pollutes the timing) serves a steady arrival stream under
+    `fault_spec`, then drains until every added pod bound and the
+    ladder promoted home (or `drain_timeout_s` expires). Used by bench
+    config 7 (`run_fault_storm_config`) and scripts/soak_chaos.py's
+    serve phase, so the two can never assert different invariants of
+    the same storm.
+
+    Returns raw facts — `sched` (live handle), `added`, `binds`
+    (uid -> bind count), per-cycle `walls`, `degraded_cycles` (flight
+    records with rung > 0), `episodes_ms` (completed recovery episodes),
+    `duplicate_binds`, `lost` — and leaves the fault plan ARMED so the
+    caller can probe `faults.plan()`; the caller must
+    `faults.disarm()` when done."""
+    from k8s_scheduler_tpu.config import SchedulerConfiguration
+    from k8s_scheduler_tpu.core.scheduler import Scheduler
+    from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+    cfg_obj = SchedulerConfiguration(
+        dispatch_deadline_ms=deadline_ms,
+        degrade_promote_cycles=promote_cycles,
+        fault_spec=fault_spec,
+        # backoff short so DispatchFailed pods retry within the drive
+        pod_initial_backoff_seconds=0.05,
+        pod_max_backoff_seconds=0.2,
+        # pre-sized pads: the oscillation-free workload must not flip
+        # regimes, so the deadline assertions are compile-free
+        pad_existing=2048,
+        pad_pods_per_node=512,
+        compile_cache_dir=cache_dir,
+        speculative_compile=False,
+    )
+    binds: dict[str, int] = {}
+    added: set[str] = set()
+    sched = Scheduler(
+        config=cfg_obj,
+        binder=lambda p, n: binds.__setitem__(
+            p.uid, binds.get(p.uid, 0) + 1
+        ),
+    )
+    for nd in make_cluster(n_nodes):
+        sched.on_node_add(nd)
+    walls: dict[int, float] = {}
+    t_run = time.perf_counter()
+    for i in range(1, cycles + 1):
+        for p in make_pods(
+            pods_per_cycle, seed=5000 + i, name_prefix=f"cz{i}-"
+        ):
+            sched.on_pod_add(p)
+            added.add(p.uid)
+        t0 = time.perf_counter()
+        sched.schedule_cycle()
+        walls[i] = time.perf_counter() - t0
+    # drain tail: requeued pods bind, ladder promotes home
+    drain_deadline = time.perf_counter() + drain_timeout_s
+    while (
+        len(binds) < len(added) or sched.ladder.rung > 0
+    ) and time.perf_counter() < drain_deadline:
+        sched.schedule_cycle()
+        time.sleep(0.02)
+    recs = sched.flight.snapshot(last=4096)
+    return {
+        "sched": sched,
+        "added": added,
+        "binds": binds,
+        "walls": walls,
+        "wall_s": time.perf_counter() - t_run,
+        "degraded_cycles": sum(
+            1 for r in recs if r.counts.get("rung", 0) > 0
+        ),
+        "episodes_ms": sched.ladder.recovery_episodes_ms(),
+        "duplicate_binds": sum(1 for n in binds.values() if n > 1),
+        "lost": sorted(
+            added - set(binds)
+            - {p.uid for p in sched.queue.all_pending()}
+        ),
+    }
+
+
+def run_fault_storm_config(snapshots: int = 40) -> dict:
+    """Config 7: the fault-storm soak (ISSUE 9), on the shared
+    `chaos_serve_drive` harness. The plan fires a `fetch_hang` LONGER
+    than the 300 ms dispatch deadline (the watchdog must bound the
+    serve loop — `max_blocked_ms` reports the hang cycle's wall) and
+    one `device_error` per marker class: transport and corrupt are
+    absorbed in-cycle by `_Resilient` (no rung change), wedge fails
+    fast and steps the ladder.
+
+    Headline metrics, both gated directionally by bench_diff:
+
+    - `mttr_ms` — mean wall ms from leaving rung 0 to returning
+      (ladder transition timestamps; rise = regressed recovery);
+    - `degraded_cycles` — cycles spent below rung 0 (rise = regressed).
+
+    The run FAILS (raises) if a pod is lost, binds twice, the hang
+    cycle blocks past half the injected hang, or the ladder never
+    recovers — the bench is the acceptance test run at fleet cadence."""
+    from k8s_scheduler_tpu.core import faults
+
+    n_nodes = CONFIG_SHAPES[7][1]
+    deadline_ms, hang_ms = 300.0, 2500.0
+    cycles = max(snapshots, 28)  # the plan's last fault fires at 20
+    try:
+        d = chaos_serve_drive(
+            fault_spec=(
+                "seed=11;"
+                f"fetch_hang@cycle=8:ms={hang_ms}:n=1;"
+                "device_error@cycle=12:kind=transport:n=1;"
+                "device_error@cycle=16:kind=corrupt:n=1;"
+                "device_error@cycle=20:kind=wedge:n=1"
+            ),
+            cycles=cycles,
+            deadline_ms=deadline_ms,
+            n_nodes=n_nodes,
+        )
+        sched = d["sched"]
+        episodes = d["episodes_ms"]
+        max_blocked_ms = d["walls"][8] * 1e3
+        if d["lost"] or d["duplicate_binds"]:
+            raise AssertionError(
+                f"fault_storm invariants violated: lost={d['lost']} "
+                f"duplicate_binds={d['duplicate_binds']}"
+            )
+        if max_blocked_ms > hang_ms * 0.5:
+            raise AssertionError(
+                f"serve loop blocked {max_blocked_ms:.0f} ms against a "
+                f"{deadline_ms:.0f} ms deadline — watchdog failed"
+            )
+        if sched.ladder.rung != 0 or not episodes:
+            raise AssertionError(
+                "ladder never degraded-and-recovered "
+                f"(rung={sched.ladder.rung}, episodes={episodes})"
+            )
+        return {
+            "config": 7,
+            "name": CONFIG_NAMES[7],
+            "pods": len(d["added"]),
+            "nodes": n_nodes,
+            "snapshots": cycles,
+            "wall_s": round(d["wall_s"], 2),
+            "scheduled": len(d["binds"]),
+            "mttr_ms": round(sum(episodes) / len(episodes), 1),
+            "mttr_max_ms": round(max(episodes), 1),
+            "degraded_cycles": d["degraded_cycles"],
+            "degradations": sched.ladder.degradations,
+            "deadline_ms": deadline_ms,
+            "max_blocked_ms": round(max_blocked_ms, 1),
+            "fired_points": sorted(
+                faults.plan().fired_points()
+                if faults.plan() is not None else []
+            ),
+            "transitions": [
+                (t["from_name"], t["to_name"])
+                for t in sched.ladder.transitions
+            ],
+        }
+    finally:
+        faults.disarm()
 
 
 def run_suite(configs=(1, 2, 3, 4, 5), snapshots: int = 50) -> list[dict]:

@@ -33,6 +33,7 @@ SCRIPT_ALLOWLIST = frozenset({
     "scripts/lint_metrics.py",    # metric-inventory shim (tests)
     "scripts/probe_pipeline.py",  # CPU-runnable pipeline smoke probe
     "scripts/schedlint.py",       # this framework's CLI
+    "scripts/soak_chaos.py",      # slow-marked fault-injection chaos soak
     "scripts/soak_differential.py",  # slow-marked differential soak
     "scripts/soak_failover.py",   # slow-marked kill -9 failover soak
     "scripts/warm_cache.py",      # compile-cache pre-warmer (ops tool)

@@ -32,6 +32,12 @@ in BOTH directions:
          against a dimension that no longer exists), and every field of
          SIG_KEY_FIELDS + EXTRA_KEY_FIELDS must appear in the README
          "## Compile-regime management" key table
+- ID007  the degradation-rung inventory: every rung name in
+         core/degrade.RUNGS must appear in the README "## Failure
+         model & degradation ladder" rung table (operators act on the
+         rung names /healthz and the transition events carry; a rung
+         added or renamed without its README row leaves the runbook
+         pointing at modes that no longer exist)
 
 The metric-registry half (ID001) imports the live package; pass
 `{"metrics_runtime": False}` to skip it when linting fixture trees.
@@ -109,6 +115,8 @@ class InventoryDriftPass(PassBase):
         "ID006": "compile-cache key inventory drifted between "
                  "packing.SIGNATURE_DIMS, compile_cache.SIG_KEY_FIELDS, "
                  "and the README key table",
+        "ID007": "degradation-rung inventory drifted between "
+                 "degrade.RUNGS and the README rung table",
     }
 
     def run(self, ctx: LintContext) -> list[Finding]:
@@ -131,6 +139,7 @@ class InventoryDriftPass(PassBase):
             findings += self._check_metrics(ctx)
         findings += self._check_phases(ctx)
         findings += self._check_compile_key(ctx)
+        findings += self._check_rungs(ctx)
         return findings
 
     @staticmethod
@@ -484,6 +493,46 @@ class InventoryDriftPass(PassBase):
                             'in the README "## Compile-regime '
                             'management" key table',
                         ))
+        return findings
+
+    # ---- ID007: degradation-rung inventory -------------------------------
+
+    def _check_rungs(self, ctx: LintContext) -> list[Finding]:
+        dg_sf = self._find(ctx, "core/degrade.py")
+        if dg_sf is None:
+            return []
+        rungs, dg_line = self._module_const(dg_sf, "RUNGS")
+        if not rungs:
+            return [Finding(
+                dg_sf.rel, 1, "ID007",
+                "core/degrade.py defines no literal RUNGS tuple — the "
+                "ladder inventory the README rung table is pinned to",
+            )]
+        path = os.path.join(ctx.root, "README.md")
+        if not os.path.exists(path):
+            return []
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        m = re.search(
+            r"^## Failure model & degradation ladder\b(.*?)(?=^## |\Z)",
+            text, re.M | re.S,
+        )
+        if m is None:
+            return [Finding(
+                dg_sf.rel, dg_line, "ID007",
+                'README.md has no "## Failure model & degradation '
+                'ladder" section documenting the rung table',
+            )]
+        section = m.group(1)
+        findings: list[Finding] = []
+        for rung in sorted(rungs):
+            if not re.search(rf"\b{re.escape(rung)}\b", section):
+                findings.append(Finding(
+                    dg_sf.rel, dg_line, "ID007",
+                    f"rung {rung!r} (degrade.RUNGS) is not documented "
+                    'in the README "## Failure model & degradation '
+                    'ladder" rung table',
+                ))
         return findings
 
     # ---- ID001: metric inventory (runtime) -------------------------------

@@ -52,6 +52,7 @@ def default_registry() -> PassRegistry:
     from .inventory import InventoryDriftPass
     from .journal_emit import JournalEmitOncePass
     from .lock_discipline import LockDisciplinePass
+    from .robustness import RobustnessPass
     from .trace_safety import TraceSafetyPass
 
     r = PassRegistry()
@@ -61,6 +62,7 @@ def default_registry() -> PassRegistry:
         JournalEmitOncePass,
         InventoryDriftPass,
         HygienePass,
+        RobustnessPass,
     ):
         r.register(cls.name, lambda args, _cls=cls: _cls(args))
     return r

@@ -1,0 +1,112 @@
+"""ROBUSTNESS (RB0xx): failure handling must leave a trace.
+
+RB001: in `core/`, `state/`, and `internal/`, a broad exception handler
+(`except Exception` / bare `except:`) must LOG, COUNT A METRIC, or EMIT
+AN EVENT somewhere in its body before swallowing or re-raising — the
+silent-swallow pattern is how a consumed cycle, a dead writer thread,
+or a dropped record disappears without an on-box trace (the exact gap
+ISSUE 9's fetch-failure attribution closed). Handlers that transform
+the error into an explicit `raise NewError(...)` pass too: the message
+travels with the new exception.
+
+Deliberately silent handlers are INVENTORIED, not outlawed: each needs
+an inline `# schedlint: disable=RB001 -- why` on the `except` line, so
+new silent swallows can't accumulate without a reviewed justification.
+
+Detection is name-based and over-approximate, like the rest of the
+framework: a call whose attribute/function name is in the known
+logging / metric / event vocabularies counts as a trace. A helper with
+an unknown name that "really does log" should either be named into the
+vocabulary or carry a suppression — the cost of one pragma beats a
+silent hole.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintContext
+from .registry import PassBase
+
+# package directories the rule applies to (matched as path segments, so
+# fixture trees like pkg/core/x.py are covered the same way)
+_TARGET_SEGMENTS = {"core", "state", "internal"}
+
+# attribute names that count as leaving a trace
+_LOG_ATTRS = {
+    "exception", "warning", "error", "info", "debug", "critical", "log",
+}
+_METRIC_ATTRS = {"inc", "observe", "set", "labels", "observe_attempt"}
+_EVENT_ATTRS = {
+    "record", "system", "pod_event", "note", "failed_scheduling",
+    "assume_expired", "scheduled", "preempted", "note_fetch_failure",
+    "degrade", "raise_anomaly", "_cycle_failed", "note_unsupported",
+}
+_TRACE_ATTRS = _LOG_ATTRS | _METRIC_ATTRS | _EVENT_ATTRS
+# bare function names that count (module-local helpers)
+_TRACE_NAMES = {"_record_strike", "_pev", "print"}
+
+
+def _is_broad_handler(h: ast.ExceptHandler) -> bool:
+    """`except:` or `except Exception[ as e]:` (incl. dotted/builtin
+    spellings and tuple members)."""
+    t = h.type
+    if t is None:
+        return True
+
+    def one(n) -> bool:
+        if isinstance(n, ast.Name):
+            return n.id in ("Exception", "BaseException")
+        if isinstance(n, ast.Attribute):
+            return n.attr in ("Exception", "BaseException")
+        return False
+
+    if isinstance(t, ast.Tuple):
+        return any(one(e) for e in t.elts)
+    return one(t)
+
+
+def _leaves_trace(h: ast.ExceptHandler) -> bool:
+    for node in ast.walk(h):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _TRACE_ATTRS:
+                return True
+            if isinstance(f, ast.Name) and f.id in _TRACE_NAMES:
+                return True
+        elif isinstance(node, ast.Raise) and node.exc is not None:
+            # an explicit `raise NewError(...)` re-contextualizes the
+            # failure loudly; a bare `raise` just forwards it silently
+            return True
+    return False
+
+
+class RobustnessPass(PassBase):
+    name = "ROBUSTNESS"
+    codes = {
+        "RB001": "bare `except Exception` in core//state//internal/ "
+                 "swallows or re-raises without logging, counting a "
+                 "metric, or emitting an event",
+    }
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in ctx.files:
+            segments = sf.rel.split("/")[:-1]
+            if not _TARGET_SEGMENTS & set(segments):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not _is_broad_handler(node):
+                    continue
+                if _leaves_trace(node):
+                    continue
+                findings.append(Finding(
+                    sf.rel, node.lineno, "RB001",
+                    "broad `except Exception` handler leaves no trace "
+                    "(no log / metric / event) before swallowing or "
+                    "re-raising — attribute the failure, or inventory "
+                    "it with `# schedlint: disable=RB001 -- why`",
+                ))
+        return findings

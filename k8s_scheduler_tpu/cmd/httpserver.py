@@ -53,6 +53,7 @@ def staleness_healthz(
     recorder,
     max_age_seconds: float,
     observer=None,  # core/observe.CycleObserver | None
+    ladder=None,  # core/degrade.DegradationLadder | None
 ) -> Callable[[], tuple[bool, dict]]:
     """Health closure with flight-recorder staleness: reports
     `last_cycle_age_s` and flips to not-ok (503) once no scheduling
@@ -62,7 +63,12 @@ def staleness_healthz(
     reporting a static 200 forever. With an `observer`, the payload
     additionally carries the SLO burn status and `degraded: true` on a
     fast-window burn — still 200: budget burn is a paging signal, and
-    killing the pod does not refill an error budget."""
+    killing the pod does not refill an error budget. With a `ladder`
+    (core/degrade.py), the current degradation rung rides the payload
+    and any rung below `normal` also reports `degraded: true` (again
+    200: the ladder is actively recovering — a restart would only lose
+    its progress, and at the bottom rung the standby takeover is
+    already underway via the sealed state)."""
 
     def healthz() -> tuple[bool, dict]:
         detail = dict(base()) if base is not None else {}
@@ -79,6 +85,16 @@ def staleness_healthz(
                 )
         if observer is not None:
             detail.update(observer.healthz_detail())
+        if ladder is not None:
+            st = ladder.status()
+            detail["degradation"] = st
+            if st["rung"] > 0:
+                detail["degraded"] = True
+                detail.setdefault(
+                    "degraded_reason",
+                    f"degradation ladder at rung {st['rung']} "
+                    f"({st['name']}): {st['last_reason']}",
+                )
         return ok, detail
 
     return healthz

@@ -136,6 +136,26 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         "(config speculativeCompile; 1 on, 0 off, -1 = keep config)",
     )
     ap.add_argument(
+        "--dispatch-deadline-ms", type=float, default=-1.0,
+        help="dispatch watchdog: bound on the blocking per-cycle "
+        "decision fetch in milliseconds — on expiry the fetch is "
+        "abandoned, the cycle's pods requeue, and the degradation "
+        "ladder steps down a rung (config dispatchDeadlineMs; "
+        "0 disables, -1 = keep config)",
+    )
+    ap.add_argument(
+        "--degrade-promote-cycles", type=int, default=0,
+        help="degradation ladder: consecutive clean cycles before the "
+        "ladder steps one rung back up toward normal (config "
+        "degradePromoteCycles; 0 = keep config)",
+    )
+    ap.add_argument(
+        "--fault-spec", default="",
+        help="fault injection plan, e.g. 'fetch_hang@cycle=40:ms=5000' "
+        "(config faultSpec; env SCHED_FAULTS also read when both are "
+        "empty) — soaks/benches/tests only, never production",
+    )
+    ap.add_argument(
         "--state-dir", default="",
         help="durable scheduler state: write-ahead journal + snapshots "
         "of the queue/cache live here (config stateDir). A process "
@@ -178,6 +198,12 @@ def main(argv: list[str] | None = None) -> int:
         config.compile_cache_dir = args.compile_cache_dir
     if args.speculative_compile >= 0:
         config.speculative_compile = bool(args.speculative_compile)
+    if args.dispatch_deadline_ms >= 0:
+        config.dispatch_deadline_ms = args.dispatch_deadline_ms
+    if args.degrade_promote_cycles > 0:
+        config.degrade_promote_cycles = args.degrade_promote_cycles
+    if args.fault_spec:
+        config.fault_spec = args.fault_spec
     if args.state_dir:
         config.state_dir = args.state_dir
     if args.snapshot_interval >= 0:
@@ -290,6 +316,7 @@ def main(argv: list[str] | None = None) -> int:
         recorder,
         config.health_max_cycle_age_seconds,
         observer=observer,
+        ladder=service.scheduler.ladder,
     )
 
     http_server = None

@@ -170,6 +170,22 @@ class SchedulerConfiguration:
     # anomaly sentinel's demand EWMA drifts toward a bucket boundary;
     # a flip speculation won costs ~0 compile on the serve path.
     speculative_compile: bool = True
+    # dispatch watchdog (core/pipeline.py): bound, in milliseconds, on
+    # the ONE blocking device->host decision fetch. On expiry the fetch
+    # is abandoned (DispatchDeadlineExceeded), the cycle's pods requeue
+    # with backoff, and the degradation ladder (core/degrade.py) steps
+    # down one rung — a hung tunnel can no longer wedge the serve loop
+    # forever. 0 disables the bound (the pre-watchdog behavior).
+    dispatch_deadline_ms: float = 0.0
+    # degradation ladder promotion: after this many consecutive clean
+    # scheduling cycles (dispatches that completed without a failure)
+    # the ladder steps one rung back up toward `normal`.
+    degrade_promote_cycles: int = 8
+    # fault injection (core/faults.py): a FaultPlan spec like
+    # "fetch_hang@cycle=40:ms=5000" — scripted, seeded faults fired at
+    # named points on the real code paths (soaks/benches/tests only;
+    # env SCHED_FAULTS overrides when this is empty). "" disarms.
+    fault_spec: str = ""
     # durable scheduler state (state/ package): directory for the
     # write-ahead journal + snapshots. "" disables durability — a
     # takeover then rebuilds only what informer events re-deliver,
@@ -309,6 +325,9 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         pad_hysteresis_pct=float(data.get("padHysteresisPct", 0.0)),
         compile_cache_dir=str(data.get("compileCacheDir", "")),
         speculative_compile=bool(data.get("speculativeCompile", True)),
+        dispatch_deadline_ms=float(data.get("dispatchDeadlineMs", 0.0)),
+        degrade_promote_cycles=int(data.get("degradePromoteCycles", 8)),
+        fault_spec=str(data.get("faultSpec", "")),
         state_dir=str(data.get("stateDir", "")),
         snapshot_interval_seconds=_duration_seconds(
             data.get("snapshotInterval", 60.0)

@@ -52,6 +52,8 @@ import time as _time
 import zlib
 from typing import Any, Callable
 
+from . import faults as _faults
+
 log = logging.getLogger("k8s_scheduler_tpu.compile_cache")
 
 _MAGIC = b"KSCC"
@@ -75,7 +77,9 @@ def backend_fingerprint() -> str:
 
     try:
         kind = jax.devices()[0].device_kind
-    except Exception:
+    except Exception:  # schedlint: disable=RB001 -- benign default: an
+        # uninitializable backend still gets a usable fingerprint, and
+        # the compile that follows will raise its own (louder) error
         kind = "unknown"
     return (
         f"jax{jax.__version__}-jaxlib{jaxlib.__version__}-"
@@ -271,6 +275,21 @@ class CompileCache:
             f".{key.name}.tmp.{os.getpid()}.{threading.get_ident()}",
         )
         try:
+            if _faults.ARMED:
+                # `cache_enospc` raises here (caught by the OSError
+                # handler below — a refused store, never a crash);
+                # `cache_torn` lands a TRUNCATED entry at the FINAL
+                # path, as if a rename survived a crash its data did
+                # not — load() must refuse it and recompile
+                _faults.raise_enospc("cache_enospc")
+                if _faults.torn_store():
+                    with open(self._path(key), "wb") as f:
+                        f.write(blob[: max(len(blob) // 2, 1)])
+                    log.error(
+                        "compile cache: fault-injected torn write of "
+                        "%s", key.name,
+                    )
+                    return False
             with open(tmp, "wb") as f:
                 f.write(blob)
                 f.flush()
@@ -394,7 +413,7 @@ def _compile_natively(low):
 
     try:
         from jax._src import compilation_cache as _jcc
-    except Exception:  # pragma: no cover — jax internals moved
+    except Exception:  # pragma: no cover — jax internals moved  # schedlint: disable=RB001 -- degraded-but-correct: without the internal module the flag toggle still applies
         _jcc = None
     prev = jax.config.jax_enable_compilation_cache
     try:
@@ -402,7 +421,7 @@ def _compile_natively(low):
         if _jcc is not None:
             try:
                 _jcc.reset_cache()
-            except Exception:  # pragma: no cover
+            except Exception:  # pragma: no cover  # schedlint: disable=RB001 -- best-effort memo drop; the verification deserialize downstream catches a poison build
                 pass
         return low.compile()
     finally:
@@ -410,7 +429,7 @@ def _compile_natively(low):
         if _jcc is not None:
             try:
                 _jcc.reset_cache()
-            except Exception:  # pragma: no cover
+            except Exception:  # pragma: no cover  # schedlint: disable=RB001 -- best-effort memo drop on the restore side
                 pass
 
 

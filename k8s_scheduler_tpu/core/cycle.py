@@ -32,6 +32,7 @@ from ..models.encoding import ClusterSnapshot
 from ..ops import commit as commit_ops
 from ..ops import rounds as rounds_ops
 from ..ops import volumes as volumes_ops
+from . import faults as _faults
 
 
 @jax.tree_util.register_dataclass
@@ -242,6 +243,22 @@ def is_transport_error(e: BaseException) -> bool:
     return any(m in msg for m in _TRANSPORT_MARKERS)
 
 
+def classify_failure(e: BaseException) -> str:
+    """Failure class of a device/dispatch error, by the SAME marker
+    precedence `_Resilient` recovers with: transport (flake, cache
+    preserved) before corrupt (clear_cache heals) before wedge (process
+    restart heals). Feeds `scheduler_fetch_failures_total{class}` and
+    the degradation ladder's transition reasons."""
+    msg = str(e)
+    if is_transport_error(e):
+        return "transport"
+    if any(m in msg for m in _CORRUPT_MARKERS):
+        return "corrupt"
+    if any(m in msg for m in _WEDGE_MARKERS):
+        return "wedge"
+    return "other"
+
+
 # per-process strike log: (program name, kind) -> count. Mirrored into
 # the prometheus counter (scheduler_program_retry_strikes_total) so
 # operators can see how often serving pays a retry; kept as a plain
@@ -258,8 +275,11 @@ def _record_strike(program: str, kind: str) -> None:
         global_metrics().program_retry_strikes.labels(
             program=program, kind=kind
         ).inc()
-    except Exception:
-        pass  # metrics must never break the serving path
+    except Exception:  # schedlint: disable=RB001 -- deliberately silent:
+        # the strike itself IS the trace (RESILIENT_STRIKES + the
+        # caller's retry log); a broken metrics registry must not break
+        # the serving path it observes
+        pass
 
 
 class _Resilient:
@@ -307,6 +327,12 @@ class _Resilient:
         # a non-ValueError (advisor r4) — one except block, two recoveries
         for attempt in range(3):
             try:
+                if _faults.ARMED:
+                    # fault injection (core/faults.py `device_error`):
+                    # raises with a real marker signature INSIDE the
+                    # try, so the injected fault walks the exact
+                    # transport/corrupt/wedge recovery below
+                    _faults.raise_device_error()
                 aot = self._aot
                 if aot is not None:
                     try:

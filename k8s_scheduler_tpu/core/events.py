@@ -29,6 +29,12 @@ PREEMPTED = "Preempted"
 # batched-cycle addition: the assumed-pod TTL sweep used to drop pods
 # silently — this reason makes the expiry explainable per pod
 ASSUME_EXPIRED = "AssumeExpired"
+# robustness additions: scheduler-level (pod-less) events — a consumed
+# cycle's fetch failure and degradation-ladder rung transitions must
+# leave an on-box trace even though no single pod owns them
+FETCH_FAILED = "FetchFailed"
+DEGRADED = "Degraded"
+PROMOTED = "Promoted"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,6 +91,19 @@ class EventRecorder:
         self.record(
             "Normal", PREEMPTED, victim,
             f"Preempted by pod {preemptor_name}",
+        )
+
+    def system(self, reason: str, message: str) -> None:
+        """A scheduler-level event with no owning pod (fetch failures,
+        degradation-ladder transitions): rides the same ring/drain path
+        as pod events with an empty uid and the synthetic name
+        "scheduler", so the gRPC shim forwards it like any other."""
+        ev = Event("Warning", reason, "", "scheduler", message)
+        with self._lock:
+            self._ring.append(ev)
+        log.warning(
+            "event", extra={"event_reason": reason, "pod": "scheduler",
+                            "event_message": message}
         )
 
     def assume_expired(self, pod: Pod, node_name: str) -> None:

@@ -219,9 +219,12 @@ class FlightRecorder:
         self._wall = wall
         self._ring: list[CycleRecord | None] = [None] * self.capacity
         # COMMIT count (monotonic): the seqlock generation readers check.
-        # Distinct from _seq — a started-but-never-committed record (an
-        # aborted cycle, e.g. a failed decision fetch) consumes a seq but
-        # must not inflate the committed-cycle count.
+        # Distinct from _seq — a started-but-never-committed record
+        # consumes a seq but must not inflate the committed-cycle count.
+        # (A failed decision fetch the degradation ladder handled IS
+        # committed, stamped counts.aborted=1 + the post-failure rung —
+        # core/scheduler._cycle_failed; only failures that escape the
+        # ladder leave a consumed seq behind.)
         self._commits = 0
         self._seq = 0  # next record's sequence number
         self.epoch = now()

@@ -32,7 +32,10 @@ rebuilt TPU-natively on top of the recorder:
     into a full re-encode (without a regime flip to explain it);
   * `wedge_precursor`— `_Resilient` absorbed new retry strikes this
     cycle (core/cycle.py): the strike classes that precede the rig's
-    executable-cache wedge.
+    executable-cache wedge;
+  * `degraded`       — a degradation-ladder rung transition
+    (core/degrade.py), raised externally via `raise_anomaly` with the
+    from/to rung names and the triggering reason in the detail.
 
   Each anomaly is a structured ring event carrying the cycle `seq`, so
   `/debug/anomalies?last=N` links straight to the flight record and the
@@ -58,6 +61,7 @@ from __future__ import annotations
 import bisect
 import collections
 import threading
+import time as _time
 from typing import Any, Iterable
 
 # The canonical phase inventory. schedlint's ID005 check enforces that
@@ -92,6 +96,10 @@ ANOMALY_CLASSES = (
     "recompile",
     "fold_miss",
     "wedge_precursor",
+    # a degradation-ladder rung transition (core/degrade.py): raised
+    # externally via raise_anomaly — both directions, with the from/to
+    # rung names and the triggering reason in the detail
+    "degraded",
 )
 
 # Fixed log-ish bucket edges (seconds) for the streaming phase
@@ -649,6 +657,45 @@ class CycleObserver:
             for ev in anomalies:
                 m.anomalies.labels(ev["class"]).inc()
         return anomalies
+
+    # ---- external anomaly sources ----------------------------------------
+
+    def raise_anomaly(
+        self,
+        cls: str,
+        *,
+        seq: int = -1,
+        profile: str = "",
+        phase: str = "",
+        value_s: float = 0.0,
+        **detail: Any,
+    ) -> dict:
+        """Push one anomaly event from OUTSIDE the per-record pipeline
+        (the degradation ladder's rung transitions): same ring, counts,
+        and scheduler_anomalies_total accounting as record-driven
+        classes, so /debug/anomalies is the one place to look."""
+        if cls not in self.anomaly_counts:
+            raise ValueError(
+                f"unknown anomaly class {cls!r} (ANOMALY_CLASSES)"
+            )
+        ev = {
+            "seq": seq,
+            "profile": profile,
+            "t_s": 0.0,
+            "wall": _time.time(),
+            "class": cls,
+            "phase": phase,
+            "value_ms": round(value_s * 1e3, 3),
+            "baseline_ms": 0.0,
+            "detail": dict(detail),
+        }
+        with self._lock:
+            self.ring.append(ev)
+            self.anomaly_counts[cls] += 1
+        m = self._metrics
+        if m is not None:
+            m.anomalies.labels(cls).inc()
+        return ev
 
     # ---- readers ---------------------------------------------------------
 

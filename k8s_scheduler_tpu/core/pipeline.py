@@ -41,13 +41,84 @@ change, not a semantic one).
 
 from __future__ import annotations
 
+import threading as _threading
 import time as _time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import faults as _faults
 from .cycle import CycleDecision, _jit
+
+
+class DispatchDeadlineExceeded(RuntimeError):
+    """The blocking decision fetch exceeded `dispatchDeadlineMs`: the
+    watchdog abandoned the wedged transfer (its worker thread keeps
+    blocking harmlessly until the backend lets go) so the serve loop
+    can step down the degradation ladder and requeue the cycle's pods
+    instead of hanging forever. The cycle is CONSUMED — same contract
+    as any other failed fetch (the ordering guard releases)."""
+
+
+class _FetchWorker:
+    """Deadline-bounding for a blocking call the host cannot interrupt
+    (`jax.device_get` holds no Python-level cancellation point): the
+    fetch runs on a reusable daemon thread while the serve loop waits
+    with a timeout. On expiry the worker is considered wedged and
+    abandoned — told to exit when (if ever) the fetch returns — and the
+    next bounded fetch lazily starts a fresh worker. Cost when a fetch
+    completes in time: one queue hand-off + one Event wait (~tens of
+    microseconds), paid only when a deadline is configured."""
+
+    def __init__(self) -> None:
+        self._lock = _threading.Lock()
+        self._q = None
+        self._thread: "_threading.Thread | None" = None
+
+    def _run(self, jobs) -> None:
+        while True:
+            fn, box, done = jobs.get()
+            if fn is None:
+                return  # abandoned after a deadline expiry
+            try:
+                box["v"] = fn()
+            except BaseException as e:  # schedlint: disable=RB001 -- not swallowed: delivered whole to the waiting serve thread, which classifies + attributes it
+                box["e"] = e
+            finally:
+                done.set()
+
+    def run(self, fn, deadline_s: float):
+        import queue as _queue
+
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._q = _queue.Queue()
+                self._thread = _threading.Thread(
+                    target=self._run, args=(self._q,),
+                    name="decision-fetch", daemon=True,
+                )
+                self._thread.start()
+            q = self._q
+            box: dict = {}
+            done = _threading.Event()
+            q.put((fn, box, done))
+        if not done.wait(deadline_s):
+            with self._lock:
+                if self._q is q:
+                    # tell the wedged worker to exit once the hung
+                    # fetch finally returns; a fresh worker spawns on
+                    # the next bounded fetch
+                    q.put((None, None, None))
+                    self._thread = None
+                    self._q = None
+            raise DispatchDeadlineExceeded(
+                f"decision fetch exceeded the dispatch deadline "
+                f"({deadline_s * 1e3:.0f} ms); transfer abandoned"
+            )
+        if "e" in box:
+            raise box["e"]
+        return box["v"]
 
 
 def build_decision_slim_fn(num_nodes: int):
@@ -136,8 +207,10 @@ class CycleHandle:
             t0 = now()
             self._pipe.stats["t_decision_start"] = t0
             try:
-                a, flags = jax.device_get(self._slim)
-            except Exception:
+                a, flags = self._pipe.fetch_decisions(
+                    lambda: jax.device_get(self._slim)
+                )
+            except Exception as e:
                 # a failed fetch consumes the cycle: no bind can come of
                 # it, so the ordering guard must NOT hold the pipeline
                 # hostage — the next dispatch proceeds against a cache
@@ -145,6 +218,10 @@ class CycleHandle:
                 # exactly what it would have read. Without this, one
                 # transient device error would poison the memoized
                 # pipeline's guard forever (permanent serving outage).
+                # Attribution BEFORE the re-raise: a consumed cycle must
+                # leave an on-box trace of WHY (events-ring entry +
+                # scheduler_fetch_failures_total{class}).
+                self._pipe.note_fetch_failure(e)
                 self.fetched = True
                 self.release()
                 self._pipe._note_inflight()
@@ -251,12 +328,21 @@ class CycleHandle:
         return arr
 
     def block(self):
-        """Force everything in flight (the forced_sync escape hatch)."""
+        """Force everything in flight (the forced_sync escape hatch).
+        Routed through the same bounded-fetch path as decisions(): at
+        the ladder's forced_sync rung THIS is the serve loop's blocking
+        wait, and without the watchdog a persistently hung tunnel would
+        re-wedge the loop at exactly the rung meant to contain it (the
+        next expiry then escalates to stateless/seal-for-failover)."""
         try:
-            jax.block_until_ready((self.result, self._slim))
-        except Exception:
+            self._pipe.fetch_decisions(
+                lambda: jax.block_until_ready((self.result, self._slim))
+            )
+        except Exception as e:
             # same contract as a failed decisions() fetch: the cycle is
-            # consumed, the guard releases (see decisions)
+            # consumed, the guard releases (see decisions) — and the
+            # failure class is stamped before the re-raise
+            self._pipe.note_fetch_failure(e)
             self.fetched = True
             self.release()
             self._pipe._note_inflight()
@@ -305,10 +391,14 @@ class MultiCycleHandle:
             t0 = now()
             self._pipe.stats["t_decision_start"] = t0
             try:
-                a, flags, cycles_run = jax.device_get(self._slim)
-            except Exception:
+                a, flags, cycles_run = self._pipe.fetch_decisions(
+                    lambda: jax.device_get(self._slim)
+                )
+            except Exception as e:
                 # same contract as CycleHandle.decisions: a failed fetch
-                # consumes the batch so the ordering guard releases
+                # consumes the batch so the ordering guard releases,
+                # with the failure class stamped before the re-raise
+                self._pipe.note_fetch_failure(e)
                 self.fetched = True
                 self.release()
                 self._pipe._note_inflight()
@@ -402,10 +492,16 @@ class MultiCycleHandle:
         return arr
 
     def block(self):
-        """Force everything in flight (the forced_sync escape hatch)."""
+        """Force everything in flight (the forced_sync escape hatch);
+        watchdog-bounded like CycleHandle.block."""
         try:
-            jax.block_until_ready((self.result, self._slim))
-        except Exception:
+            self._pipe.fetch_decisions(
+                lambda: jax.block_until_ready((self.result, self._slim))
+            )
+        except Exception as e:
+            # consumed batch: guard releases, class stamped (see
+            # CycleHandle.block)
+            self._pipe.note_fetch_failure(e)
             self.fetched = True
             self.release()
             self._pipe._note_inflight()
@@ -445,6 +541,11 @@ class ServingPipeline:
         require_decision_fetch: bool = True,
         donate_diagnosis: bool = False,
         metrics=None,
+        events=None,  # core/events.EventRecorder | None: fetch-failure
+        # attribution stamps a system event on the ring before re-raise
+        dispatch_deadline_s: float = 0.0,  # bound on the blocking
+        # decision fetch (0 = unbounded); expiry raises
+        # DispatchDeadlineExceeded via the _FetchWorker watchdog
         now=_time.perf_counter,
         slots: int = 2,
     ) -> None:
@@ -464,6 +565,9 @@ class ServingPipeline:
         self.require_decision_fetch = require_decision_fetch
         self._donate_diagnosis = donate_diagnosis
         self._metrics = metrics
+        self._events = events
+        self.dispatch_deadline_s = dispatch_deadline_s
+        self._fetch_worker = _FetchWorker()  # no thread until first use
         self._now = now
         self._slots = [None] * max(2, slots)
         self._slim_fn = None
@@ -489,6 +593,57 @@ class ServingPipeline:
     @property
     def fetch_bytes_total(self) -> int:
         return self._fetch_bytes_total
+
+    def fetch_decisions(self, fn):
+        """Run the one blocking device->host decision fetch with the
+        fault hooks and (when `dispatch_deadline_s` > 0) the watchdog
+        applied. `fetch_delay` sleeps OUTSIDE the bounded call (a slow
+        tunnel: visible latency); `fetch_hang` sleeps INSIDE it (a
+        wedged tunnel: exactly what the deadline bounds)."""
+        if _faults.ARMED:
+            _faults.sleep_point("fetch_delay")
+            inner = fn
+
+            def fn():
+                _faults.sleep_point("fetch_hang")
+                return inner()
+
+        d = self.dispatch_deadline_s
+        if d and d > 0:
+            return self._fetch_worker.run(fn, d)
+        return fn()
+
+    def note_fetch_failure(self, e: BaseException) -> str:
+        """Attribute a consumed cycle's fetch failure before it
+        re-raises: `scheduler_fetch_failures_total{class}` + an
+        events-ring entry. Returns the class (transport | corrupt |
+        wedge | deadline | other). MUST NOT raise: it runs inside the
+        failure handlers BEFORE the ordering-guard release — an
+        attribution error that escaped would leave the guard held
+        forever (the permanent-outage mode the release exists to
+        prevent), so a broken metrics registry or events ring costs
+        the trace, never the pipeline."""
+        from .cycle import classify_failure
+
+        cls = (
+            "deadline" if isinstance(e, DispatchDeadlineExceeded)
+            else classify_failure(e)
+        )
+        try:
+            m = self._metrics
+            if m is not None:
+                m.fetch_failures.labels(cls).inc()
+            ev = self._events
+            if ev is not None:
+                from .events import FETCH_FAILED
+
+                ev.system(
+                    FETCH_FAILED,
+                    f"cycle decision fetch failed ({cls}): {e}"[:400],
+                )
+        except Exception:  # schedlint: disable=RB001 -- deliberately silent: the original error re-raises right after this call and carries the story; attribution must never hold the ordering guard hostage
+            pass
+        return cls
 
     def note_encode(self, seconds: float) -> None:
         """Record the host encode time of the snapshot about to be

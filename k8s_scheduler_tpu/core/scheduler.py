@@ -57,6 +57,14 @@ from .cycle import (
     build_packed_preemption_fn,
     build_preemption_fn,
     build_stable_state_fn,
+    classify_failure,
+)
+from .degrade import (
+    RUNG_FORCED_SYNC,
+    RUNG_RETRACE,
+    RUNG_SEQUENTIAL,
+    RUNG_STATELESS,
+    DegradationLadder,
 )
 from .events import EventRecorder, failed_scheduling_message
 from .flight_recorder import FlightRecorder
@@ -190,6 +198,44 @@ class Scheduler:
                     "anomaly sentinel, and /debug/anomalies are all "
                     "off", self.config.slo_p99_ms,
                 )
+        # the explicit degradation ladder (core/degrade.py): dispatch/
+        # fetch failures step it down (retrace -> sequential ->
+        # forced_sync -> stateless), clean cycles promote it back up.
+        # Process-local by design — a standby that takes over starts at
+        # the top rung on its own evidence, never inherits this one's.
+        self.ladder = DegradationLadder(
+            promote_after=self.config.degrade_promote_cycles,
+            metrics=self.metrics,
+            events=self.events,
+            observer=self.observer,
+            on_transition=self._on_rung_transition,
+        )
+        if state is not None:
+            # /debug/state shows the current rung next to the journal
+            state.degradation = self.ladder
+        # watchdog bound on the blocking decision fetch (0 = unbounded):
+        # refreshed onto each memoized pipeline at dispatch time
+        self._dispatch_deadline_s = (
+            max(float(self.config.dispatch_deadline_ms), 0.0) / 1e3
+        )
+        # fault injection (core/faults.py): armed process-globally from
+        # config faultSpec / env SCHED_FAULTS — production configs leave
+        # it empty and every hook stays a dead branch
+        self._fault_plan = None
+        self._cycle_counter = 0
+        _fault_spec = self.config.fault_spec
+        if not _fault_spec:
+            import os as _os_f
+
+            _fault_spec = _os_f.environ.get("SCHED_FAULTS", "")
+        if _fault_spec:
+            from . import faults as _faults_mod
+
+            self._fault_plan = _faults_mod.FaultPlan.parse(_fault_spec)
+            _faults_mod.arm(self._fault_plan)
+            logging.getLogger(__name__).warning(
+                "fault injection ARMED: %s", _fault_spec
+            )
         self._now = now
         self._pad_bucket = pad_bucket
         self._profile_name = self.config.profiles[0].scheduler_name  # legacy alias
@@ -462,6 +508,8 @@ class Scheduler:
             preempt_fn=preempt,
             forced_sync=self.forced_sync,
             metrics=self.metrics,
+            events=self.events,
+            dispatch_deadline_s=self._dispatch_deadline_s,
         )
         fns = (
             cyc,
@@ -752,7 +800,17 @@ class Scheduler:
         `pod.spec.scheduler_name` (upstream: multiple schedulers by
         schedulerName); profiles run in declaration order within the
         cycle, each seeing the previous profiles' assumptions."""
+        from . import faults as _faults
+
+        self._cycle_counter += 1
+        self._cycle_fault = False
         t0 = self._now()
+        if _faults.ARMED:
+            # ambient cycle index for fault-rule windows, and the
+            # clock_skew injection point (derived stats must tolerate a
+            # stepping clock read)
+            _faults.set_cycle(self._cycle_counter)
+            t0 += _faults.skew_s()
         stats = CycleStats()
         self.last_nominations = []
         self.last_evictions = []
@@ -769,22 +827,46 @@ class Scheduler:
                 )
         self.queue.flush_unschedulable_timeout()
 
-        mc_buffered = self._mc_k > 1 and any(
+        # multi-cycle batching is gated on the degradation ladder: at or
+        # below the `sequential` rung every cycle dispatches alone
+        mc_on = self._mc_k > 1 and self.ladder.rung < RUNG_SEQUENTIAL
+        if self._mc_k > 1 and not mc_on:
+            # the ladder stepped below `sequential` with groups still
+            # coalescing: drain them as single-cycle dispatches BEFORE
+            # this cycle's non-hold pop replaces the in-flight set (a
+            # stranded buffer's pods would be neither queued nor
+            # in-flight — lost)
+            for name in self._profile_order:
+                buf = self._mc_groups[name]
+                if not buf:
+                    continue
+                self._mc_groups[name] = []
+                for _t_enq, g in buf:
+                    stats.attempted += len(g)
+                    self._schedule_profile(name, g, stats, t0)
+                self.queue.retire_in_flight(
+                    [p.uid for _t_enq, g in buf for p in g]
+                )
+        mc_buffered = mc_on and any(
             self._mc_groups[n] for n in self._profile_order
         )
         # hold-pop while groups are buffered: their in-flight entries
         # (attempts counts, delete tombstones, crash recovery) must
         # survive until the batch flush applies their outcomes
         pending_all = self.queue.pop_ready(hold=mc_buffered)
-        if not pending_all and not mc_buffered:
+        if not pending_all and not mc_buffered and stats.attempted == 0:
             # gauges must track deletions/moves that happen between
             # non-empty cycles, so update them on the empty path too
+            # (attempted > 0 means the rung-gated drain above dispatched
+            # — that work must flow through the full cycle epilogue)
             self._update_gauges()
             if self.state is not None:
                 self.state.maybe_snapshot()
             return stats
         if pending_all:
-            stats.attempted = len(pending_all)
+            # += not =: the rung-gated buffer drain above may already
+            # have counted its groups into this cycle's attempted
+            stats.attempted += len(pending_all)
             self.metrics.cycle_pods.observe(len(pending_all))
 
         by_prof: dict[str, list[Pod]] = {
@@ -813,7 +895,7 @@ class Scheduler:
 
         for name in self._profile_order:
             group = by_prof[name]
-            if self._mc_k > 1 and name not in self._mc_off:
+            if mc_on and name not in self._mc_off:
                 # multi-cycle coalescing: buffer this pop's arrival group
                 # and flush K of them as ONE device dispatch. Flush when
                 # the batch is full, the arrival stream paused (an empty
@@ -882,6 +964,10 @@ class Scheduler:
         self.metrics.cycle_duration.labels(phase="total").observe(
             stats.cycle_seconds
         )
+        if stats.attempted > 0 and not self._cycle_fault:
+            # promotion bookkeeping: only cycles that actually exercised
+            # the dispatch path count as evidence the fault cleared
+            self.ladder.note_clean_cycle(seq=self._cycle_counter)
         self._update_gauges()
         if self.state is not None:
             # interval-gated journal compaction, deliberately AFTER
@@ -952,9 +1038,16 @@ class Scheduler:
                 pcycle, ppreempt, stable_fn, keeper, diag, ext_keeper,
                 pipe,
             ) = self._packed_fns(spec, profile)
-            stable = self._stable_state(
-                spec, stable_fn, wbuf, bbuf, encoder
-            )
+            try:
+                stable = self._stable_state(
+                    spec, stable_fn, wbuf, bbuf, encoder
+                )
+            except Exception as e:
+                # a device failure BEFORE any bind (stable precompute):
+                # step the ladder and requeue — no winner exists yet,
+                # so the whole pending set retries safely
+                self._cycle_failed(profile, pending, e, stats, t0, rec)
+                return
             t_encode = self._now()
             self.metrics.cycle_duration.labels(phase="encode").observe(
                 t_encode - t_start
@@ -988,19 +1081,27 @@ class Scheduler:
             # and the latency cycle program go out without blocking; the
             # only synchronous read below is the slimmed decision fetch
             enc_st = getattr(encoder, "_stable", None)
-            pipe.forced_sync = self.forced_sync
-            pipe.note_encode(t_encode - t_start)
-            handle = pipe.dispatch(
-                wbuf, bbuf, stable,
-                dirty=dirty,
-                carry_key=(
-                    spec.key(), id(enc_st),
-                    getattr(encoder, "_carry_key", None),
-                ),
-                pin=enc_st,
-                emask=ext_mask, escore=ext_score,
-                device_put=False,  # uploaded above (stable/carry share it)
+            pipe.forced_sync = (
+                self.forced_sync or self.ladder.rung >= RUNG_FORCED_SYNC
             )
+            pipe.dispatch_deadline_s = self._dispatch_deadline_s
+            pipe.note_encode(t_encode - t_start)
+            try:
+                handle = pipe.dispatch(
+                    wbuf, bbuf, stable,
+                    dirty=dirty,
+                    carry_key=(
+                        spec.key(), id(enc_st),
+                        getattr(encoder, "_carry_key", None),
+                    ),
+                    pin=enc_st,
+                    emask=ext_mask, escore=ext_score,
+                    device_put=False,  # uploaded above (stable/carry
+                    # share it)
+                )
+            except Exception as e:
+                self._cycle_failed(profile, pending, e, stats, t0, rec)
+                return
         else:
             snap = encoder.encode(nodes, pending, existing, **kw)
             if self.extenders:
@@ -1032,21 +1133,40 @@ class Scheduler:
 
                 wbuf = _jax.device_put(wbuf)
                 bbuf = _jax.device_put(bbuf)
-            stable = self._stable_state(
-                spec, stable_fn, wbuf, bbuf, encoder
-            )
+            try:
+                stable = self._stable_state(
+                    spec, stable_fn, wbuf, bbuf, encoder
+                )
+            except Exception as e:
+                self._cycle_failed(profile, pending, e, stats, t0, rec)
+                return
             t_encode = self._now()
             self.metrics.cycle_duration.labels(phase="encode").observe(
                 t_encode - t_start
             )
-            pipe.forced_sync = self.forced_sync
-            pipe.note_encode(t_encode - t_start)
-            handle = pipe.dispatch(
-                wbuf, bbuf, stable, device_put=False
+            pipe.forced_sync = (
+                self.forced_sync or self.ladder.rung >= RUNG_FORCED_SYNC
             )
+            pipe.dispatch_deadline_s = self._dispatch_deadline_s
+            pipe.note_encode(t_encode - t_start)
+            try:
+                handle = pipe.dispatch(
+                    wbuf, bbuf, stable, device_put=False
+                )
+            except Exception as e:
+                self._cycle_failed(profile, pending, e, stats, t0, rec)
+                return
         # the ONLY blocking transfer on the bind path: the slimmed
-        # decision payload (i16 assignment + u8 flags per pod)
-        assignment, _unsched, gang_dropped = handle.decisions()
+        # decision payload (i16 assignment + u8 flags per pod). A
+        # failure here — deadline expiry, transport flake past the
+        # retries, corrupt/wedged executable — consumes the cycle (the
+        # pipeline guard released) and walks the degradation ladder;
+        # every pod requeues with backoff, none was bound.
+        try:
+            assignment, _unsched, gang_dropped = handle.decisions()
+        except Exception as e:
+            self._cycle_failed(profile, pending, e, stats, t0, rec)
+            return
         assignment = assignment[: len(pending)]
         gang_dropped = gang_dropped[: len(pending)]
         # accumulate like every sibling counter: in a multi-profile
@@ -1235,7 +1355,11 @@ class Scheduler:
             stable_sds = jax.eval_shape(
                 build_stable_state_fn(spec), w1, b1
             )
-        except Exception:
+        except Exception as e:
+            logging.getLogger(__name__).warning(
+                "multi-cycle AOT install skipped: stable-state avals "
+                "unavailable (%s); the jit path remains", e,
+            )
             return None
         n_sds = jax.ShapeDtypeStruct((), np.int32)
         sources: list[str] = []
@@ -1395,21 +1519,37 @@ class Scheduler:
 
             wbufs = _jax.device_put(wbufs)
             bbufs = _jax.device_put(bbufs)
-        stable = self._stable_state(
-            spec, stable_fn, wbufs[0], bbufs[0], encoder
-        )
+        batch_pods = [p for _t_enq, g in groups for p in g]
+        try:
+            stable = self._stable_state(
+                spec, stable_fn, wbufs[0], bbufs[0], encoder
+            )
+        except Exception as e:
+            self._cycle_failed(profile, batch_pods, e, stats, t0, None)
+            return
         t_encode = self._now()
         self.metrics.cycle_duration.labels(phase="encode").observe(
             t_encode - t_batch
         )
-        pipe.forced_sync = self.forced_sync
+        pipe.forced_sync = (
+            self.forced_sync or self.ladder.rung >= RUNG_FORCED_SYNC
+        )
+        pipe.dispatch_deadline_s = self._dispatch_deadline_s
         pipe.note_encode(t_encode - t_batch)
-        handle = pipe.dispatch_multi(
-            wbufs, bbufs, stable, n, device_put=False
-        )
-        assignment, _unsched, gang_dropped, attempted, cycles_run = (
-            handle.decisions()
-        )
+        # a failed batch dispatch/fetch consumes the WHOLE batch before
+        # any bind: every group's pods requeue (the caller's
+        # retire_in_flight after this return drops only pods the
+        # requeue did not re-track)
+        try:
+            handle = pipe.dispatch_multi(
+                wbufs, bbufs, stable, n, device_put=False
+            )
+            assignment, _unsched, gang_dropped, attempted, cycles_run = (
+                handle.decisions()
+            )
+        except Exception as e:
+            self._cycle_failed(profile, batch_pods, e, stats, t0, None)
+            return
         t_device = self._now()
         self.metrics.cycle_duration.labels(phase="device").observe(
             t_device - t_encode
@@ -1630,9 +1770,112 @@ class Scheduler:
             queue_active=qc.get("active", 0),
             queue_backoff=qc.get("backoff", 0),
             queue_unschedulable=qc.get("unschedulable", 0),
+            # current degradation rung (0 = normal): bench config 7 and
+            # soak_chaos count records with rung > 0 as degraded cycles
+            rung=self.ladder.rung,
             **(extra_counts or {}),
         )
         self.flight.commit(rec)
+
+    def _cycle_failed(
+        self,
+        profile: str,
+        pending: "list[Pod]",
+        e: BaseException,
+        stats: CycleStats,
+        t0: float,
+        rec,
+    ) -> None:
+        """A dispatch/fetch failure consumed the cycle BEFORE any bind:
+        classify it, step the degradation ladder, requeue every pod with
+        backoff, and commit an aborted flight record — the serve loop
+        then continues at the new rung instead of dying (or, for a hung
+        tunnel without the watchdog, hanging forever)."""
+        from .pipeline import DispatchDeadlineExceeded
+
+        cls = (
+            "deadline" if isinstance(e, DispatchDeadlineExceeded)
+            else classify_failure(e)
+        )
+        self._cycle_fault = True
+        seq = rec.seq if rec is not None else -1
+        logging.getLogger(__name__).error(
+            "cycle dispatch failed for profile %r (%s: %s); stepping "
+            "the degradation ladder and requeueing %d pods",
+            profile, cls, e, len(pending),
+        )
+        new_rung = self.ladder.degrade(
+            f"{cls}: {str(e)[:200]}", seq=seq
+        )
+        per_pod_s = (self._now() - t0) / max(len(pending), 1)
+        for pod in pending:
+            self.queue.requeue_backoff(pod, event="DispatchFailed")
+            stats.bind_errors += 1
+            if self.flight is not None:
+                self.flight.pod_event(
+                    pod.uid, pod.name, "DispatchFailed", cycle=seq,
+                    failure=cls,
+                )
+            self.metrics.observe_attempt("error", per_pod_s, profile)
+        if rec is not None:
+            # an aborted record: total is real wall time (the SLO engine
+            # must charge a blown deadline), device phases absent (no
+            # decision landed, so the stall baselines stay clean)
+            rec.counts.update(
+                pods=len(pending),
+                aborted=1,
+                bind_errors=len(pending),
+                rung=new_rung,
+            )
+            self.flight.commit(rec)
+
+    def _on_rung_transition(
+        self, old: int, new: int, reason: str
+    ) -> None:
+        """Apply a rung's side effects (runs outside the ladder lock).
+        Rungs `sequential` and `forced_sync` are read at dispatch time;
+        only `retrace` (clear+rebuild) and `stateless` (seal for
+        failover) act here."""
+        if new > old and new >= RUNG_RETRACE:
+            # the regime-wide clear_cache+retrace recovery: drop every
+            # memoized program set (with its jit caches and installed
+            # AOT executables) so the next cycle re-traces from scratch.
+            # Re-applied on every further down-step — if the fault
+            # persisted, a stale executable must not survive into the
+            # next rung's retry.
+            with self._packed_lock:
+                self._packed.clear()
+                self._mc_fns.clear()
+            self._dev_stable.clear()
+        if (
+            new >= RUNG_STATELESS
+            and old < RUNG_STATELESS
+            and self.state is not None
+        ):
+            # seal-for-failover: a final snapshot + journal close means
+            # the standby restores a CLEAN boundary instead of replaying
+            # a tail written by a process this degraded; then detach so
+            # this process's further mutations stop journaling (it is
+            # stateless from here on — the documented journal-death
+            # degrade, entered deliberately)
+            try:
+                self.state.seal()
+                self.state.detach()
+                logging.getLogger(__name__).warning(
+                    "durable state sealed + detached for failover "
+                    "(degradation rung 'stateless'): %s", reason,
+                )
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "seal-for-failover failed; continuing stateless "
+                    "(journal tail on disk is the fallback)"
+                )
+            # durability is gone for this process either way (seal
+            # succeeded and detached, or the journal died trying):
+            # pin the promotion floor so the ladder never reports
+            # "normal" while mutations go unjournaled — the standby
+            # takeover is the recovery that clears this
+            self.ladder.floor = RUNG_STATELESS
 
     def _apply_phase(
         self,
@@ -1913,6 +2156,7 @@ class Scheduler:
         # seq, which joins back to /debug/flightrecorder records
         attempt_kinds = {
             "Bound", "Unschedulable", "BindError", "Rejected", "Expired",
+            "DispatchFailed",
         }
         out["attempts"] = [
             {

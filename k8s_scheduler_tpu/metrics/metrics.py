@@ -66,8 +66,8 @@ streaming consumer of every flight record):
   the observer's streaming histograms, evaluated at scrape time
 - scheduler_anomalies_total{class} — typed anomaly detections
   (tunnel_stall | fetch_stall | recompile | fold_miss |
-  wedge_precursor); each increment has a matching structured event in
-  /debug/anomalies carrying the cycle seq
+  wedge_precursor | degraded); each increment has a matching structured
+  event in /debug/anomalies carrying the cycle seq
 - scheduler_slo_burn_rate{window} — latency-SLO burn rate over the
   fast/slow cycle windows (1.0 = burning the error budget exactly at
   the sustainable rate), 0 when no sloP99Ms objective is configured
@@ -96,6 +96,22 @@ AOT-executable cache + speculative pre-compilation):
 - scheduler_compile_cache_speculative_builds_total — adjacent pad
   regimes pre-built by the warm thread before churn crossed a bucket
   boundary (a flip speculation won costs ~0 serve-path compile)
+
+Robustness / degradation families (core/degrade.py ladder +
+core/pipeline.py dispatch watchdog + fetch-failure attribution):
+
+- scheduler_degradation_rung — current degradation-ladder rung
+  (0 = normal, 1 = retrace, 2 = sequential, 3 = forced_sync,
+  4 = stateless); stepped down on dispatch failures, promoted back up
+  after degradePromoteCycles clean cycles
+- scheduler_degradation_transitions_total{from,to} — ladder rung
+  transitions by from/to rung name (both directions; each has a
+  matching events-ring entry and a `degraded` anomaly in
+  /debug/anomalies)
+- scheduler_fetch_failures_total{class} — consumed cycles whose
+  blocking decision fetch raised, by failure class (transport |
+  corrupt | wedge | deadline | other — the `_Resilient` marker
+  classifiers plus the watchdog's deadline)
 
 Durable-state families (state/ package — write-ahead journal, snapshots,
 restore) and leader election:
@@ -329,8 +345,8 @@ class SchedulerMetrics:
             "scheduler_anomalies_total",
             "Typed anomaly detections from the cycle observer "
             "(tunnel_stall | fetch_stall | recompile | fold_miss | "
-            "wedge_precursor); each has a structured /debug/anomalies "
-            "event carrying the cycle seq.",
+            "wedge_precursor | degraded); each has a structured "
+            "/debug/anomalies event carrying the cycle seq.",
             ["class"],
             registry=r,
         )
@@ -385,6 +401,29 @@ class SchedulerMetrics:
             "scheduler_compile_cache_speculative_builds_total",
             "Adjacent pad regimes pre-built by the speculative warm "
             "thread before churn crossed a bucket boundary.",
+            registry=r,
+        )
+        # ---- robustness / degradation (core/degrade.py) ----
+        self.degradation_rung = Gauge(
+            "scheduler_degradation_rung",
+            "Current degradation-ladder rung (0 = normal, 1 = retrace, "
+            "2 = sequential, 3 = forced_sync, 4 = stateless).",
+            registry=r,
+        )
+        self.degradation_transitions = Counter(
+            "scheduler_degradation_transitions_total",
+            "Degradation-ladder rung transitions by from/to rung name "
+            "(both directions; each has an events-ring entry and a "
+            "'degraded' anomaly).",
+            ["from", "to"],
+            registry=r,
+        )
+        self.fetch_failures = Counter(
+            "scheduler_fetch_failures_total",
+            "Consumed cycles whose blocking decision fetch raised, by "
+            "failure class (transport | corrupt | wedge | deadline | "
+            "other).",
+            ["class"],
             registry=r,
         )
         # ---- durable state (state/: journal + snapshots + restore) ----

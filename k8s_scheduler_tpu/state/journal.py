@@ -45,11 +45,23 @@ import logging
 import os
 import re
 import struct
+import sys
 import threading
 import time as _time
 import zlib
 
 log = logging.getLogger("k8s_scheduler_tpu.state")
+
+
+def _fault_hook(point: str) -> None:
+    """Fault-injection bridge (core/faults.py) without importing the
+    core package: resolved through sys.modules, so a restore-only
+    Journal (standby, tooling, tests) never drags jax in — arming
+    requires the faults module to be imported already, and unarmed cost
+    is one dict lookup per writer batch (never the append path)."""
+    mod = sys.modules.get("k8s_scheduler_tpu.core.faults")
+    if mod is not None and mod.ARMED:
+        mod.raise_enospc(point)
 
 SEGMENT_MAGIC = b"TPUSWAL\x00"
 FORMAT_VERSION = 1
@@ -412,6 +424,10 @@ class Journal:
                 return
 
     def _write_batch(self, batch: list[tuple[int, str, float, dict]]) -> None:
+        # `journal_enospc` injection point: raises ENOSPC exactly where
+        # a full disk would, driving the real writer-death path (_run's
+        # handler -> failed flag -> DurableState degrades to stateless)
+        _fault_hook("journal_enospc")
         wrote = 0
         for idx, op, t, data in batch:
             rec = encode_record(op, t, data)

@@ -341,6 +341,19 @@ class DurableState:
             m.snapshot_bytes.set(nbytes)
         return path
 
+    def detach(self) -> None:
+        """Stop journaling: drop the queue/cache emitters (plain
+        attribute stores — see _emit for the lock-order argument) and
+        mark this state closed. Used by the degradation ladder's
+        `stateless` rung after seal(): the process keeps serving with
+        no durability, and the sealed snapshot is what a standby
+        restores."""
+        if self._queue is not None:
+            self._queue._journal = None
+        if self._cache is not None:
+            self._cache._journal = None
+        self._closed = True
+
     def seal(self) -> None:
         """Clean shutdown: final snapshot (so the next start replays
         nothing), flush, close. Safe to call twice."""
@@ -375,4 +388,9 @@ class DurableState:
             # /debug/state shows hit/miss/entry counts next to the
             # journal the same directory holds
             out["compile_cache"] = cc.status()
+        deg = getattr(self, "degradation", None)
+        if deg is not None:
+            # the Scheduler pins its DegradationLadder here: the current
+            # rung belongs next to the durability it can seal away
+            out["degradation"] = deg.status()
         return out

@@ -36,8 +36,12 @@ threshold flag (percent):
                    regression = rise  > --max-compile-rise
     compile_cache_hit_rate  warm-start executable-cache hit rate
                    regression = drop  > --max-hit-rate-drop
+    mttr_ms        fault-storm mean recovery time
+                   regression = rise  > --max-mttr-rise
     stall_cycles   >10x-p50 cycles    regression = new > old + --allow-stalls
     anomalies      classifier total   regression = new > old + --allow-stalls
+    degraded_cycles  cycles below the top ladder rung
+                   regression = new > old + --allow-stalls
 
 Millisecond metrics additionally ignore absolute deltas below
 --min-ms-delta (CPU smoke configs sit at sub-ms device times where a
@@ -72,8 +76,13 @@ _METRICS = {
     "compile_seconds": ("lower", "compile_seconds", "comp"),
     "compile_cache_hit_rate": ("higher", "compile_cache_hit_rate",
                                "cchr"),
+    # fault-storm soak (ISSUE 9): mean recovery time after a fault
+    # must not RISE (a slower ladder is a regression even when every
+    # invariant still holds); degraded_cycles (higher = regressed)
+    # gates via _COUNT_METRICS below.
+    "mttr_ms": ("lower", "mttr_ms", "mttr"),
 }
-_COUNT_METRICS = ("stall_cycles", "anomalies_total")
+_COUNT_METRICS = ("stall_cycles", "anomalies_total", "degraded_cycles")
 
 
 def _scan_tail(text: str) -> list[dict]:
@@ -115,6 +124,9 @@ def _normalize(row: dict) -> dict | None:
     stall = row.get("stall_cycles", row.get("stall"))
     if stall is not None:
         out["stall_cycles"] = int(stall)
+    degc = row.get("degraded_cycles", row.get("degc"))
+    if degc is not None:
+        out["degraded_cycles"] = int(degc)
     anom = row.get("anomalies", row.get("anom"))
     if anom is not None:
         out["anomalies"] = dict(anom)
@@ -283,6 +295,12 @@ def main(argv: list[str] | None = None) -> int:
         "percent before it counts as a regression",
     )
     ap.add_argument(
+        "--max-mttr-rise", type=float, default=50.0,
+        help="fault-storm mean-time-to-recovery may rise this many "
+        "percent before it counts as a regression (recovery time is "
+        "promotion-cycle-quantized, so small shifts are noise)",
+    )
+    ap.add_argument(
         "--allow-stalls", type=int, default=1,
         help="stall/anomaly count may grow by this many before it "
         "counts as a regression (one stall is a known rig flake — "
@@ -323,6 +341,7 @@ def main(argv: list[str] | None = None) -> int:
             "effective_p50_ms": args.max_effective_p50_rise,
             "compile_seconds": args.max_compile_rise,
             "compile_cache_hit_rate": args.max_hit_rate_drop,
+            "mttr_ms": args.max_mttr_rise,
         },
         allow_stalls=args.allow_stalls,
         min_ms_delta=args.min_ms_delta,

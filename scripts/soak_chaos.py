@@ -1,0 +1,453 @@
+#!/usr/bin/env python
+"""Chaos soak: replay a workload while every fault class fires, assert
+the degradation ladder's invariants hold and measure MTTR.
+
+Three phases (each selectable; default = all):
+
+- **serve** — one in-process Scheduler (flight recorder + observer +
+  compile cache + dispatch watchdog) serves a steady arrival stream
+  while a scripted `FaultPlan` fires every injection point that does
+  not kill durability: `fetch_delay`, `fetch_hang` (longer than
+  `dispatchDeadlineMs` — the watchdog must bound it), `device_error`
+  in all three marker classes, `clock_skew`, `cache_torn`, and
+  `cache_enospc`. Invariants asserted:
+    * the serve loop is NEVER blocked past the deadline (the hang
+      cycle's wall time stays far below the injected hang);
+    * zero lost accepted pods (every added pod ends bound or still
+      tracked in a queue tier);
+    * zero duplicate binds (each uid binds at most once);
+    * the ladder recovered to rung 0 by the end (MTTR reported);
+    * a warm restart against the same compile-cache dir neither
+      crashes on the torn entry nor misses every entry.
+- **enospc** — a Scheduler with durable state takes a
+  `journal_enospc` hit: the writer dies, DurableState degrades to
+  stateless (the documented path), and serving CONTINUES — pods still
+  bind after durability is gone.
+- **crash** — soak_failover-style kill -9 while the child is BELOW the
+  top rung (a fetch_hang degraded it): the parent restores into fresh
+  queue/cache and asserts the restored digest matches an op boundary
+  the child logged (nothing lost, duplicated, or half-applied) AND
+  that degradation state did not leak into the restore — a fresh
+  Scheduler starts at rung 0.
+
+Standalone:
+
+    JAX_PLATFORMS=cpu python scripts/soak_chaos.py --smoke
+
+A smoke subset runs as tests/test_faults.py::test_soak_chaos_smoke
+(marked slow).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _mean(xs):
+    return sum(xs) / len(xs) if xs else 0.0
+
+
+# ---------------------------------------------------------------------------
+# phase 1: chaos serve
+# ---------------------------------------------------------------------------
+
+# every non-durability fault class, scripted against warm cycles (the
+# first cycles compile; faults land after the programs are warm so the
+# deadline assertion measures the fetch, not a compile)
+SERVE_PLAN = (
+    "seed=7;"
+    "cache_enospc@cycle=1:n=1;"
+    "cache_torn@cycle=1:n=1;"
+    "fetch_delay@cycle=6:ms=120:n=1;"
+    "fetch_hang@cycle=8:ms={hang_ms}:n=1;"
+    "device_error@cycle=12:kind=transport:n=1;"
+    "device_error@cycle=16:kind=corrupt:n=1;"
+    "device_error@cycle=20:kind=wedge:n=1;"
+    "clock_skew@cycle=24:ms=250:n=1"
+)
+
+
+def run_serve_phase(
+    cycles: int = 48,
+    deadline_ms: float = 300.0,
+    hang_ms: float = 4000.0,
+    pods_per_cycle: int = 4,
+    cache_dir: str = "",
+    verbose: bool = True,
+) -> dict:
+    # the drive itself is bench_suite.chaos_serve_drive — shared with
+    # bench config 7 (fault_storm), so the soak and the bench can never
+    # assert different invariants of the same storm; this phase adds
+    # the wider fault plan (cache/clock classes) and the warm-restart
+    # check over the chaos-written compile cache
+    import bench_suite
+
+    from k8s_scheduler_tpu.core import faults
+
+    try:
+        d = bench_suite.chaos_serve_drive(
+            fault_spec=SERVE_PLAN.format(hang_ms=hang_ms),
+            cycles=cycles,
+            deadline_ms=deadline_ms,
+            pods_per_cycle=pods_per_cycle,
+            cache_dir=cache_dir or "off",
+        )
+        sched = d["sched"]
+        plan = faults.plan()
+        mttr = d["episodes_ms"]
+        result = {
+            "phase": "serve",
+            "cycles": cycles,
+            "added": len(d["added"]),
+            "bound": len(d["binds"]),
+            "duplicate_binds": d["duplicate_binds"],
+            "lost": d["lost"],
+            "hang_cycle_wall_ms": round(d["walls"][8] * 1e3, 1),
+            "deadline_ms": deadline_ms,
+            "hang_ms": hang_ms,
+            "fired_points": sorted(
+                plan.fired_points()
+            ) if plan else [],
+            "degradations": sched.ladder.degradations,
+            "degraded_cycles": d["degraded_cycles"],
+            "final_rung": sched.ladder.rung,
+            "mttr_ms": round(_mean(mttr), 1),
+            "mttr_max_ms": round(max(mttr), 1) if mttr else 0.0,
+            "fetch_failure_events": sum(
+                1 for e in sched.events.events()
+                if e.reason == "FetchFailed"
+            ),
+        }
+    finally:
+        faults.disarm()
+
+    # invariants
+    assert not result["lost"], f"lost accepted pods: {result['lost']}"
+    assert result["duplicate_binds"] == 0, "duplicate binds"
+    assert result["bound"] == result["added"], (
+        f"only {result['bound']}/{result['added']} pods bound"
+    )
+    assert result["hang_cycle_wall_ms"] < hang_ms * 0.5, (
+        f"serve loop blocked {result['hang_cycle_wall_ms']}ms against a "
+        f"{deadline_ms}ms deadline — watchdog failed"
+    )
+    assert result["final_rung"] == 0, "ladder never recovered to normal"
+    assert result["degradations"] >= 2, "plan fired but nothing degraded"
+    expect = {
+        "cache_enospc", "cache_torn", "fetch_delay", "fetch_hang",
+        "device_error", "clock_skew",
+    }
+    missing = expect - set(result["fired_points"])
+    assert not missing, f"fault classes never fired: {missing}"
+
+    if cache_dir:
+        # warm restart against the chaos-written cache: the torn entry
+        # must be refused (recompile), never a crash
+        from k8s_scheduler_tpu.config import SchedulerConfiguration
+        from k8s_scheduler_tpu.core import compile_cache as _cc
+        from k8s_scheduler_tpu.core.scheduler import Scheduler
+        from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+        _cc.clear_loaded_memo()
+        sched2 = Scheduler(
+            config=SchedulerConfiguration(
+                pad_existing=2048, pad_pods_per_node=512,
+                compile_cache_dir=cache_dir,
+                speculative_compile=False,
+            ),
+            binder=lambda p, n: None,
+        )
+        for nd in make_cluster(16):
+            sched2.on_node_add(nd)
+        for p in make_pods(pods_per_cycle, seed=99, name_prefix="wz-"):
+            sched2.on_pod_add(p)
+        sched2.schedule_cycle()
+        cc = sched2._compile_cache
+        result["warm_cache"] = cc.status() if cc is not None else {}
+        assert cc is not None and cc.hits + cc.misses > 0
+    if verbose:
+        print(json.dumps(result), flush=True)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# phase 2: journal ENOSPC -> stateless degrade, serving continues
+# ---------------------------------------------------------------------------
+
+
+def run_enospc_phase(state_dir: str, verbose: bool = True) -> dict:
+    from k8s_scheduler_tpu.config import SchedulerConfiguration
+    from k8s_scheduler_tpu.core import faults
+    from k8s_scheduler_tpu.core.scheduler import Scheduler
+    from k8s_scheduler_tpu.state import DurableState
+    from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+    st = DurableState(state_dir, snapshot_interval_seconds=0)
+    cfg = SchedulerConfiguration(
+        fault_spec="journal_enospc@cycle=3:n=1",
+        pad_existing=512, pad_pods_per_node=256,
+        pod_initial_backoff_seconds=0.05,
+    )
+    binds: list[str] = []
+    sched = Scheduler(
+        config=cfg, binder=lambda p, n: binds.append(p.uid), state=st
+    )
+    try:
+        for nd in make_cluster(8):
+            sched.on_node_add(nd)
+        for i in range(1, 9):
+            for p in make_pods(3, seed=7000 + i, name_prefix=f"en{i}-"):
+                sched.on_pod_add(p)
+            sched.schedule_cycle()
+            if i == 3:
+                # give the poll-cadence writer time to hit the injected
+                # ENOSPC and die before asserting the degrade
+                try:
+                    st.journal.flush(timeout=5.0)
+                except Exception:
+                    pass  # a dead writer raises StateError — expected
+        binds_after = len(binds)
+    finally:
+        faults.disarm()
+    result = {
+        "phase": "enospc",
+        "journal_failed": st.journal.failed,
+        "emitters_detached": sched.queue._journal is None,
+        "bound": binds_after,
+    }
+    assert st.journal.failed is not None, "journal writer survived ENOSPC"
+    assert result["emitters_detached"], "queue still journaling"
+    assert binds_after > 9, "serving stopped after durability loss"
+    if verbose:
+        print(json.dumps(result), flush=True)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# phase 3: kill -9 while degraded -> digest-verified restore at rung 0
+# ---------------------------------------------------------------------------
+
+
+def run_crash_child(state_dir: str, digest_log: str) -> int:
+    """Child: a real Scheduler with durable state and a fetch_hang plan
+    that degrades it, logging the queue/cache digest after EVERY public
+    mutation (soak_failover's discipline: journal drains only at the
+    per-cycle flush barrier, so every durable boundary is logged)."""
+    from k8s_scheduler_tpu.config import SchedulerConfiguration
+    from k8s_scheduler_tpu.core.scheduler import Scheduler
+    from k8s_scheduler_tpu.state import DurableState, state_digest
+    from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+    st = DurableState(state_dir, snapshot_interval_seconds=0)
+    st.journal._poll_s = 60.0  # drain only at flush barriers
+    cfg = SchedulerConfiguration(
+        dispatch_deadline_ms=200.0,
+        fault_spec="fetch_hang@cycle=3:ms=60000:n=1",
+        pad_existing=512, pad_pods_per_node=256,
+        pod_initial_backoff_seconds=0.05,
+    )
+    sched = Scheduler(config=cfg, binder=lambda p, n: None, state=st)
+    q, c = sched.queue, sched.cache
+    f = open(digest_log, "a")
+    counter = {"i": 0}
+
+    def log_line(kind: str) -> None:
+        f.write(f"{kind} {counter['i']} {state_digest(q, c)}\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+    def _wrap(obj, name):
+        orig = getattr(obj, name)
+
+        def wrapped(*a, **k):
+            r = orig(*a, **k)
+            counter["i"] += 1
+            log_line("op")
+            return r
+
+        setattr(obj, name, wrapped)
+
+    for name in (
+        "add", "update", "delete", "pop_ready", "requeue_unschedulable",
+        "requeue_backoff", "flush_backoff", "flush_unschedulable_timeout",
+        "move_all_to_active_or_backoff", "recover_in_flight",
+        "retire_in_flight",
+    ):
+        _wrap(q, name)
+    for name in (
+        "add_node", "update_node", "remove_node", "add_pod",
+        "remove_pod", "assume", "finish_binding", "confirm", "forget",
+        "cleanup_expired",
+    ):
+        _wrap(c, name)
+
+    for nd in make_cluster(8):
+        sched.on_node_add(nd)
+    log_line("start")
+    for i in range(1, 200):
+        for p in make_pods(3, seed=8000 + i, name_prefix=f"cr{i}-"):
+            sched.on_pod_add(p)
+        sched.schedule_cycle()
+        st.journal.flush()
+        log_line("flushed")
+        if sched.ladder.rung > 0:
+            # below the top rung: tell the parent we are degraded (it
+            # kills us mid-degradation from here on)
+            log_line("degraded")
+        time.sleep(0.01)
+    return 0
+
+
+def run_crash_phase(state_dir: str, verbose: bool = True) -> dict:
+    """Parent: spawn the child, SIGKILL it once it reports a degraded
+    rung, then restore and check the failover invariants."""
+    digest_log = os.path.join(state_dir, "digests.txt")
+    if os.path.exists(digest_log):
+        os.unlink(digest_log)
+    child = subprocess.Popen(
+        [
+            sys.executable, os.path.abspath(__file__),
+            "--crash-child", "--state-dir", state_dir,
+            "--digest-log", digest_log,
+        ],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.monotonic() + 300
+    degraded_seen = False
+    try:
+        while time.monotonic() < deadline:
+            if child.poll() is not None:
+                raise RuntimeError(
+                    f"crash child exited early rc={child.returncode}"
+                )
+            if os.path.exists(digest_log):
+                with open(digest_log) as f:
+                    if any(
+                        line.startswith("degraded") for line in f
+                    ):
+                        degraded_seen = True
+                        break
+            time.sleep(0.05)
+    finally:
+        child.send_signal(signal.SIGKILL)
+        child.wait()
+    assert degraded_seen, "child never reported a degraded rung"
+
+    # standby restore into a BARE queue/cache pair (digest comparable
+    # to the child's op-boundary log: the Scheduler ctor's journaled
+    # recover_in_flight would move the state past the logged boundary)
+    from k8s_scheduler_tpu.internal.cache import SchedulerCache
+    from k8s_scheduler_tpu.internal.queue import SchedulingQueue
+    from k8s_scheduler_tpu.state import DurableState, state_digest
+
+    q = SchedulingQueue(
+        initial_backoff_seconds=0.05, max_backoff_seconds=0.2,
+    )
+    c = SchedulerCache()
+    st = DurableState(state_dir, snapshot_interval_seconds=0)
+    st.restore_into(q, c)
+    dig = state_digest(q, c)
+    digests: set[str] = set()
+    with open(digest_log) as f:
+        for line in f:
+            parts = line.strip().split()
+            if len(parts) == 3 and len(parts[2]) == 64:
+                digests.add(parts[2])
+    st.journal.close()
+    # real standby takeover: a Scheduler attached to the same state dir
+    # restores the dead (degraded) active's queue/cache — and its
+    # ladder starts at the TOP rung, because degradation state is
+    # process-local and never journaled as authoritative
+    from k8s_scheduler_tpu.config import SchedulerConfiguration
+    from k8s_scheduler_tpu.core.scheduler import Scheduler
+
+    st2 = DurableState(state_dir, snapshot_interval_seconds=0)
+    standby = Scheduler(
+        config=SchedulerConfiguration(
+            pad_existing=512, pad_pods_per_node=256,
+        ),
+        binder=lambda p, n: None,
+        state=st2,
+    )
+    result = {
+        "phase": "crash",
+        "boundaries": len(digests),
+        "digest_matched": dig in digests,
+        "restored_rung": standby.ladder.rung,
+        "restored_pending": dict(standby.queue.pending_counts()),
+        "replayed": st2.last_restore.get("records_replayed"),
+    }
+    st2.journal.close()
+    assert dig in digests, (
+        "restored digest matches no op boundary the degraded child "
+        "recorded — state lost, duplicated, or half-applied"
+    )
+    assert result["restored_rung"] == 0, (
+        "degradation state leaked into the takeover: a standby must "
+        "start at the top rung"
+    )
+    if verbose:
+        print(json.dumps(result), flush=True)
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--phases", default="serve,enospc,crash",
+        help="comma list: serve, enospc, crash",
+    )
+    ap.add_argument("--cycles", type=int, default=48)
+    ap.add_argument("--deadline-ms", type=float, default=300.0)
+    ap.add_argument("--hang-ms", type=float, default=4000.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="short plan: every fault class fires once")
+    ap.add_argument("--state-dir", default="")
+    ap.add_argument("--digest-log", default="")
+    ap.add_argument("--crash-child", action="store_true", help="internal")
+    args = ap.parse_args()
+    if args.crash_child:
+        return run_crash_child(
+            args.state_dir,
+            args.digest_log
+            or os.path.join(args.state_dir, "digests.txt"),
+        )
+    import tempfile
+
+    base = args.state_dir or tempfile.mkdtemp(prefix="soak-chaos-")
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()]
+    cycles = 30 if args.smoke else args.cycles
+    results = []
+    if "serve" in phases:
+        results.append(run_serve_phase(
+            cycles=cycles,
+            deadline_ms=args.deadline_ms,
+            hang_ms=args.hang_ms,
+            cache_dir=os.path.join(base, "compile_cache"),
+        ))
+    if "enospc" in phases:
+        results.append(run_enospc_phase(os.path.join(base, "enospc")))
+    if "crash" in phases:
+        results.append(run_crash_phase(os.path.join(base, "crash")))
+    print(json.dumps({
+        "soak_chaos": "ok",
+        "phases": [r["phase"] for r in results],
+        "mttr_ms": next(
+            (r["mttr_ms"] for r in results if "mttr_ms" in r), 0.0
+        ),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

@@ -532,6 +532,180 @@ def test_inventory_drift_compile_key_id006(tmp_path):
     )
 
 
+def test_inventory_drift_rung_table_id007(tmp_path):
+    """ID007: the degradation rung table cannot drift — every rung name
+    in degrade.RUNGS must appear in the README "## Failure model &
+    degradation ladder" section (operators act on rung names /healthz
+    and the transition events carry)."""
+    # a rung was renamed in code but not in the README table
+    result = lint_fixture(tmp_path, {
+        "core/degrade.py": """\
+            RUNGS = (
+                "normal",
+                "retrace",
+                "half_speed",
+            )
+        """,
+        "README.md": """\
+            # fixture
+
+            ## Failure model & degradation ladder
+
+            | 0 | normal | fine |
+            | 1 | retrace | clear + rebuild |
+        """,
+    }, passes=["INVENTORY-DRIFT"])
+    msgs = [f.message for f in codes_at(result, "ID007")]
+    assert len(msgs) == 1 and "'half_speed'" in msgs[0]
+
+    # consistent tree lints clean
+    clean = lint_fixture(tmp_path / "clean", {
+        "core/degrade.py": 'RUNGS = ("normal", "retrace")\n',
+        "README.md": (
+            "## Failure model & degradation ladder\n\n"
+            "normal then retrace\n"
+        ),
+    }, passes=["INVENTORY-DRIFT"])
+    assert codes_at(clean, "ID007") == []
+
+    # the section itself missing is flagged
+    sectionless = lint_fixture(tmp_path / "sectionless", {
+        "core/degrade.py": 'RUNGS = ("normal",)\n',
+        "README.md": "# no ladder section\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "Failure model & degradation ladder" in f.message
+        for f in codes_at(sectionless, "ID007")
+    )
+
+    # no literal RUNGS tuple: the anchor itself is flagged
+    anchorless = lint_fixture(tmp_path / "anchorless", {
+        "core/degrade.py": "RUNGS = tuple(n for n in ())\n",
+        "README.md": (
+            "## Failure model & degradation ladder\n\nwords\n"
+        ),
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "no literal RUNGS" in f.message
+        for f in codes_at(anchorless, "ID007")
+    )
+
+
+# ---- ROBUSTNESS ----------------------------------------------------------
+
+
+def test_robustness_rb001_flags_silent_swallow_and_reraise(tmp_path):
+    """RB001: a broad handler in core//state//internal/ that neither
+    logs, counts, nor emits before swallowing (or bare-re-raising) is
+    flagged; the same shape OUTSIDE the target dirs is not."""
+    result = lint_fixture(tmp_path, {
+        "pkg/core/a.py": """\
+            def swallow():
+                try:
+                    work()
+                except Exception:
+                    pass
+
+
+            def forward():
+                try:
+                    work()
+                except Exception:
+                    raise
+        """,
+        # same shapes outside core//state//internal/: not this pass's
+        # business
+        "pkg/tools/b.py": """\
+            def swallow():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """,
+    }, passes=["ROBUSTNESS"])
+    findings = codes_at(result, "RB001")
+    assert len(findings) == 2
+    assert all(f.file == "pkg/core/a.py" for f in findings)
+    assert findings[0].line == 4 and findings[1].line == 11
+
+
+def test_robustness_rb001_accepts_log_metric_event_or_new_raise(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "pkg/state/ok.py": """\
+            import logging
+
+            log = logging.getLogger(__name__)
+
+
+            def logs():
+                try:
+                    work()
+                except Exception:
+                    log.exception("died")
+
+
+            def counts(metrics):
+                try:
+                    work()
+                except Exception:
+                    metrics.journal_failures.labels("io").inc()
+                    raise
+
+
+            def emits(events):
+                try:
+                    work()
+                except Exception as e:
+                    events.system("Failed", str(e))
+
+
+            def transforms():
+                try:
+                    work()
+                except Exception as e:
+                    raise RuntimeError(f"wrapped: {e}")
+        """,
+    }, passes=["ROBUSTNESS"])
+    assert codes_at(result, "RB001") == []
+
+
+def test_robustness_rb001_suppression_inventories_intentional(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "pkg/internal/quiet.py": """\
+            def deliberate():
+                try:
+                    work()
+                except Exception:  # schedlint: disable=RB001 -- ok
+                    pass
+        """,
+    }, passes=["ROBUSTNESS"])
+    assert codes_at(result, "RB001") == []
+    assert len(result.suppressed) == 1
+
+
+def test_robustness_rb001_narrow_handlers_exempt(tmp_path):
+    """Typed handlers (except OSError) are the caller's business —
+    only the broad Exception/BaseException/bare shapes are audited."""
+    result = lint_fixture(tmp_path, {
+        "pkg/core/narrow.py": """\
+            def narrow():
+                try:
+                    work()
+                except OSError:
+                    pass
+
+
+            def bare():
+                try:
+                    work()
+                except:
+                    pass
+        """,
+    }, passes=["ROBUSTNESS"])
+    findings = codes_at(result, "RB001")
+    assert len(findings) == 1 and findings[0].line == 11
+
+
 # ---- HYGIENE -------------------------------------------------------------
 
 
@@ -670,7 +844,7 @@ def test_registry_mirrors_framework_semantics():
     reg = default_registry()
     assert reg.names() == sorted([
         "TRACE-SAFETY", "LOCK-DISCIPLINE", "JOURNAL-EMIT-ONCE",
-        "INVENTORY-DRIFT", "HYGIENE",
+        "INVENTORY-DRIFT", "HYGIENE", "ROBUSTNESS",
     ])
     with pytest.raises(KeyError):
         reg.make("NOPE")
