@@ -31,8 +31,11 @@ TARGET_DECISIONS_PER_SEC = 50_000.0
 
 # distinct snapshots per config; overridable via BENCH_SNAPSHOTS
 # (config 6 = the compile-regime churn soak: cycles per drive phase;
-# config 7 = the fault-storm soak: serving cycles under the fault plan)
-DEFAULT_SNAPSHOTS = {1: 50, 2: 50, 3: 50, 4: 30, 5: 30, 6: 24, 7: 40}
+# config 7 = the fault-storm soak: serving cycles under the fault plan;
+# config 8 = the sharded scale sweep: timed cycles per grid point x
+# device count)
+DEFAULT_SNAPSHOTS = {1: 50, 2: 50, 3: 50, 4: 30, 5: 30, 6: 24, 7: 40,
+                     8: 4}
 
 
 def _run_one_isolated(c: int, n: int):
@@ -269,6 +272,18 @@ def main() -> None:
                     "degc": r["degraded_cycles"],
                 }
                 if "mttr_ms" in r else {}
+            ),
+            # sharded scale sweep (config 8): scaling efficiency at the
+            # largest grid point's max device count, the compiled
+            # collective payload per cycle, and per-device ms — seff
+            # and cpmb diffed directionally by bench_diff
+            **(
+                {
+                    "seff": r["scaling_efficiency"],
+                    "cpmb": r["collective_payload_mb"],
+                    "pdms": r["per_device_ms"],
+                }
+                if "scaling_efficiency" in r else {}
             ),
         }
 

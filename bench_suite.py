@@ -119,6 +119,13 @@ CONFIG_NAMES = {
     3: "interpod_affinity",
     4: "full_default_preemption",
     5: "gang_coscheduling",
+    # sharded multi-chip scale sweep (ISSUE 10 / ROADMAP item 3): the
+    # carry cycle over device counts {1,2,4,8} at grid points up to
+    # 100k pods x 50k nodes, reporting per-device ms, compiled
+    # collective payload MB/cycle, and scaling efficiency — config 8
+    # below (CONFIG_SHAPES holds the target headline geometry; points
+    # the host cannot hold are skipped LOUDLY, never silently)
+    8: "sharded_scale",
     # compile-regime churn soak (ISSUE 8 / ROADMAP item 2): the pending
     # count oscillates across a P pad-bucket boundary through a REAL
     # Scheduler, measuring regime flips, compile-attributed stall
@@ -133,7 +140,7 @@ CONFIG_NAMES = {
 }
 CONFIG_SHAPES = {1: (100, 10), 2: (1000, 100), 3: (5000, 1000),
                  4: (10000, 5000), 5: (8000, 2000), 6: (80, 16),
-                 7: (48, 16)}
+                 7: (48, 16), 8: (100000, 50000)}
 
 
 def _draw_pending(cfg: int, i: int, prev: list | None, churn: float):
@@ -223,6 +230,8 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
         return run_regime_churn_config(snapshots=snapshots)
     if cfg == 7:
         return run_fault_storm_config(snapshots=snapshots)
+    if cfg == 8:
+        return run_sharded_scale_config(snapshots=snapshots)
     import jax
     import numpy as np
 
@@ -1216,6 +1225,241 @@ def run_fault_storm_config(snapshots: int = 40) -> dict:
         }
     finally:
         faults.disarm()
+
+
+def _sharded_grid_env() -> "list[tuple[int, int]]":
+    """Parse BENCH_SHARDED_GRID ("PxN,PxN,..."; default = the audit
+    shape plus the 100k x 50k headline target). Parsed BEFORE any
+    device work so a typo exits with the variable named."""
+    default = "10000x5000,100000x50000"
+    raw = os.environ.get("BENCH_SHARDED_GRID", default)
+    out = []
+    try:
+        for part in raw.split(","):
+            if not part.strip():
+                continue
+            p, n = part.lower().split("x")
+            out.append((_pad(int(p)), _pad(int(n))))
+    except ValueError as e:
+        raise SystemExit(
+            f"BENCH_SHARDED_GRID={raw!r} is not a comma list of PxN "
+            f"pairs: {e}"
+        ) from None
+    if not out:
+        raise SystemExit("BENCH_SHARDED_GRID parsed to an empty grid")
+    return out
+
+
+def run_sharded_scale_config(snapshots: int = 4) -> dict:
+    """Config 8 (`sharded_scale`, ISSUE 10 / ROADMAP item 3): the carry
+    cycle swept over device counts x a (pods, nodes) grid up to the
+    100k x 50k headline geometry, sharded over a 1-D ('pods',) mesh.
+
+    Per (grid point, device count): forced-sync per-cycle ms on
+    device-resident buffers and the compiled program's collective
+    payload (parallel/audit.py — the same parser the audit gate and the
+    serving probe use). Headline keys, both gated directionally by
+    scripts/bench_diff.py:
+
+    - `scaling_efficiency` — t(1 device) / (t(D devices) * D) at the
+      largest grid point that ran (drop = regressed);
+    - `collective_payload_mb` — compiled payload per cycle at that
+      point's max device count (rise = regressed).
+
+    Grid points whose working set cannot fit the host's memory budget
+    (BENCH_SHARDED_MEM_GB; default 60% of physical RAM — virtual CPU
+    devices share one host) are skipped LOUDLY into `skipped[]`, never
+    silently: on a single-chip rig the 100k x 50k row documents exactly
+    why it needs the multi-chip deployment. Device counts come from
+    BENCH_SHARDED_DEVICES (default "1,2,4,8") intersected with what the
+    backend exposes; on a CPU backend the virtual-device flag is forced
+    up front so the full sweep runs."""
+    grid = _sharded_grid_env()
+    try:
+        dev_counts = sorted({
+            max(int(x), 1)
+            for x in os.environ.get(
+                "BENCH_SHARDED_DEVICES", "1,2,4,8"
+            ).split(",") if x.strip()
+        })
+    except ValueError as e:
+        raise SystemExit(
+            f"BENCH_SHARDED_DEVICES is not a comma list of ints: {e}"
+        ) from None
+    want = max(dev_counts)
+    # CPU backend: force the virtual device count BEFORE first backend
+    # use (same trick as __graft_entry__._force_virtual_cpu_mesh; on a
+    # real accelerator the flag is inert and the sweep clips to the
+    # chips that exist)
+    if (
+        os.environ.get("BENCH_FORCE_CPU") == "1"
+        or os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    ):
+        flag = f"--xla_force_host_platform_device_count={want}"
+        if flag not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + flag
+            ).strip()
+    import jax
+
+    from k8s_scheduler_tpu.utils.compilation_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    import numpy as np
+
+    from k8s_scheduler_tpu.core import (
+        build_packed_cycle_carry_fn,
+        build_stable_state_fn,
+    )
+    from k8s_scheduler_tpu.core.cycle import CarryKeeper
+    from k8s_scheduler_tpu.models import SnapshotEncoder
+    from k8s_scheduler_tpu.parallel import audit
+    from k8s_scheduler_tpu.parallel.mesh import make_mesh
+    from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+    avail = len(jax.devices())
+    dev_counts = [d for d in dev_counts if d <= avail and 128 % d == 0]
+    if not dev_counts:
+        dev_counts = [1]
+    try:
+        page = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError):
+        page = 16 << 30
+    mem_budget = float(
+        os.environ.get("BENCH_SHARDED_MEM_GB", page * 0.6 / (1 << 30))
+    ) * (1 << 30)
+
+    rows: list[dict] = []
+    skipped: list[dict] = []
+    mb = 1024.0 * 1024.0
+    for P, N in grid:
+        # working-set model: the [P, N] f32 static base plus the round
+        # engine's live [B, N]/[P, N] planes — ~16 bytes per (pod,
+        # node) cell has held within 2x on the audit shape. Virtual CPU
+        # devices share host RAM, so the budget is per HOST here; a
+        # real multi-chip mesh divides by device count.
+        est = P * N * 16
+        if est > mem_budget:
+            reason = (
+                f"needs ~{est / (1 << 30):.1f} GiB working set vs "
+                f"{mem_budget / (1 << 30):.1f} GiB budget "
+                "(BENCH_SHARDED_MEM_GB) — run on a mesh whose devices "
+                "hold it"
+            )
+            print(
+                f"bench sharded_scale: SKIP {P}x{N}: {reason}",
+                file=sys.stderr, flush=True,
+            )
+            skipped.append({"pods": P, "nodes": N, "reason": reason})
+            continue
+        n_real = min(N, max(N // 2, 1))
+        pods_real = min(P, max(P // 2, 1))
+        nodes = make_cluster(
+            n_real, taint_fraction=0.1, cpu_choices=(4, 8, 16)
+        )
+        pending = make_pods(
+            pods_real, seed=0, selector_fraction=0.3,
+            toleration_fraction=0.1, priorities=(0, 0, 10, 100),
+            num_apps=500,
+        )
+        enc = SnapshotEncoder(pad_pods=P, pad_nodes=N)
+        t0 = time.perf_counter()
+        wbuf, bbuf, spec, _vs, _dirty = enc.encode_packed(nodes, pending)
+        encode_s = time.perf_counter() - t0
+        point = {
+            "pods": P, "nodes": N, "encode_s": round(encode_s, 2),
+            "devices": {},
+        }
+        base_assign = None
+        for d in dev_counts:
+            mesh = make_mesh(jax.devices()[:d]) if d > 1 else None
+            cyc = build_packed_cycle_carry_fn(
+                spec, mesh=mesh,
+                rounds_kw=(
+                    {"compact_gather": "onehot"} if mesh is not None
+                    else None
+                ),
+            )
+            keeper = CarryKeeper(spec, mesh=mesh)
+            stable = build_stable_state_fn(spec)(wbuf, bbuf)
+            w = jax.device_put(wbuf)
+            b = jax.device_put(bbuf)
+            t0 = time.perf_counter()
+            carry = keeper.ci(w, b, stable)
+            out = cyc(w, b, stable, carry)
+            a = np.asarray(out.assignment)
+            compile_s = time.perf_counter() - t0
+            if base_assign is None:
+                base_assign = a
+            elif not (a == base_assign).all():
+                raise AssertionError(
+                    f"sharded_scale {P}x{N}: {d}-device placements "
+                    "diverged from the 1-device run — the shard-"
+                    "invariance contract is broken"
+                )
+            times = []
+            for _ in range(max(snapshots, 2)):
+                t0 = time.perf_counter()
+                out = cyc(w, b, stable, carry)
+                np.asarray(out.assignment)
+                times.append(time.perf_counter() - t0)
+            payload = 0
+            try:
+                payload = audit.collective_payload_bytes(
+                    cyc.lower(w, b, stable, carry).compile().as_text()
+                )
+            except Exception as e:  # accounting only, never the sweep
+                print(
+                    f"bench sharded_scale: payload probe failed at "
+                    f"{P}x{N}/d{d}: {e}", file=sys.stderr, flush=True,
+                )
+            point["devices"][str(d)] = {
+                "per_device_ms": round(_percentile(times, 50) * 1e3, 2),
+                "compile_s": round(compile_s, 2),
+                "collective_payload_mb": round(payload / mb, 3),
+            }
+        ds = point["devices"]
+        if "1" in ds and len(ds) > 1:
+            dmax = str(max(int(k) for k in ds))
+            t1 = ds["1"]["per_device_ms"]
+            td = ds[dmax]["per_device_ms"]
+            point["scaling_efficiency"] = round(
+                t1 / max(td * int(dmax), 1e-9), 3
+            )
+            point["speedup"] = round(t1 / max(td, 1e-9), 2)
+        rows.append(point)
+
+    if not rows:
+        raise SystemExit(
+            "sharded_scale: every grid point was skipped — lower "
+            "BENCH_SHARDED_GRID or raise BENCH_SHARDED_MEM_GB"
+        )
+    head = rows[-1]  # largest grid point that ran
+    dmax = str(max(int(k) for k in head["devices"]))
+    return {
+        "config": 8,
+        "name": CONFIG_NAMES[8],
+        "pods": head["pods"],
+        "nodes": head["nodes"],
+        "snapshots": snapshots,
+        "device_counts": dev_counts,
+        "grid": rows,
+        "skipped": skipped,
+        "per_device_ms": head["devices"][dmax]["per_device_ms"],
+        "collective_payload_mb": (
+            head["devices"][dmax]["collective_payload_mb"]
+        ),
+        **(
+            {"scaling_efficiency": head["scaling_efficiency"]}
+            if "scaling_efficiency" in head else
+            # a single-chip host cannot measure scaling; 1.0 keeps the
+            # key present (and bench_diff comparable) without
+            # fabricating a speedup
+            {"scaling_efficiency": 1.0}
+        ),
+    }
 
 
 def run_suite(configs=(1, 2, 3, 4, 5), snapshots: int = 50) -> list[dict]:

@@ -29,6 +29,7 @@ from .registry import PassBase
 # HERE in the same commit (HY003 fails otherwise), which is the review
 # hook that keeps dead one-off probes from accumulating silently again.
 SCRIPT_ALLOWLIST = frozenset({
+    "scripts/audit_sharded.py",   # compile-only collective-budget gate
     "scripts/bench_diff.py",      # BENCH artifact CI tripwire
     "scripts/lint_metrics.py",    # metric-inventory shim (tests)
     "scripts/probe_pipeline.py",  # CPU-runnable pipeline smoke probe

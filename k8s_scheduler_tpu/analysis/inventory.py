@@ -38,6 +38,13 @@ in BOTH directions:
          rung names /healthz and the transition events carry; a rung
          added or renamed without its README row leaves the runbook
          pointing at modes that no longer exist)
+- ID008  the sharded-collective budget inventory: every budget class
+         in parallel/audit.COLLECTIVE_BUDGETS (the committed allowlist
+         scripts/audit_sharded.py gates on) and every mesh-axis name
+         in parallel/mesh.MESH_AXES must appear in the README
+         "## Multi-chip and multi-host" budget table — a class or axis
+         renamed without its doc row silently un-classifies the very
+         collectives the payload diet bounds
 
 The metric-registry half (ID001) imports the live package; pass
 `{"metrics_runtime": False}` to skip it when linting fixture trees.
@@ -117,6 +124,9 @@ class InventoryDriftPass(PassBase):
                  "and the README key table",
         "ID007": "degradation-rung inventory drifted between "
                  "degrade.RUNGS and the README rung table",
+        "ID008": "sharded-collective budget inventory drifted between "
+                 "audit.COLLECTIVE_BUDGETS, mesh.MESH_AXES, and the "
+                 "README budget table",
     }
 
     def run(self, ctx: LintContext) -> list[Finding]:
@@ -140,6 +150,7 @@ class InventoryDriftPass(PassBase):
         findings += self._check_phases(ctx)
         findings += self._check_compile_key(ctx)
         findings += self._check_rungs(ctx)
+        findings += self._check_collective_budgets(ctx)
         return findings
 
     @staticmethod
@@ -532,6 +543,69 @@ class InventoryDriftPass(PassBase):
                     f"rung {rung!r} (degrade.RUNGS) is not documented "
                     'in the README "## Failure model & degradation '
                     'ladder" rung table',
+                ))
+        return findings
+
+    # ---- ID008: sharded-collective budget inventory ----------------------
+
+    def _check_collective_budgets(self, ctx: LintContext) -> list[Finding]:
+        au_sf = self._find(ctx, "parallel/audit.py")
+        if au_sf is None:
+            return []
+        budgets, au_line = self._module_const(
+            au_sf, "COLLECTIVE_BUDGETS"
+        )
+        if not budgets:
+            return [Finding(
+                au_sf.rel, 1, "ID008",
+                "parallel/audit.py defines no literal "
+                "COLLECTIVE_BUDGETS dict — the committed allowlist "
+                "scripts/audit_sharded.py gates the payload diet on",
+            )]
+        findings: list[Finding] = []
+        mesh_sf = self._find(ctx, "parallel/mesh.py")
+        axes: "set[str] | None" = None
+        if mesh_sf is not None:
+            axes, mesh_line = self._module_const(mesh_sf, "MESH_AXES")
+            if axes is None:
+                findings.append(Finding(
+                    mesh_sf.rel, 1, "ID008",
+                    "parallel/mesh.py defines no literal MESH_AXES "
+                    "tuple — the axis-name inventory the budget table "
+                    "and the sharding constraints are pinned to",
+                ))
+        path = os.path.join(ctx.root, "README.md")
+        if not os.path.exists(path):
+            return findings
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        m = re.search(
+            r"^## Multi-chip and multi-host\b(.*?)(?=^## |\Z)",
+            text, re.M | re.S,
+        )
+        if m is None:
+            findings.append(Finding(
+                au_sf.rel, au_line, "ID008",
+                'README.md has no "## Multi-chip and multi-host" '
+                "section documenting the collective budget table",
+            ))
+            return findings
+        section = m.group(1)
+        for cls in sorted(budgets):
+            if not re.search(rf"\b{re.escape(cls)}\b", section):
+                findings.append(Finding(
+                    au_sf.rel, au_line, "ID008",
+                    f"budget class {cls!r} (audit.COLLECTIVE_BUDGETS) "
+                    'is not documented in the README "## Multi-chip '
+                    'and multi-host" budget table',
+                ))
+        for axis in sorted(axes or ()):
+            if not re.search(rf"\b{re.escape(axis)}\b", section):
+                findings.append(Finding(
+                    mesh_sf.rel, mesh_line, "ID008",
+                    f"mesh axis {axis!r} (mesh.MESH_AXES) is not "
+                    'documented in the README "## Multi-chip and '
+                    'multi-host" section',
                 ))
         return findings
 

@@ -130,6 +130,13 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         "set, else disabled; 'off' disables even with a state dir",
     )
     ap.add_argument(
+        "--shard-devices", type=int, default=-1,
+        help="shard the device-resident carry over a 1-D pods mesh of "
+        "this many local devices (config shardDevices); placements "
+        "stay bit-identical to the single-device run (shard-invariant "
+        "tie-breaking). 0/1 = single device, -1 = keep config",
+    )
+    ap.add_argument(
         "--speculative-compile", type=int, default=-1, choices=(-1, 0, 1),
         help="background pre-compilation of the adjacent pad regime on "
         "a warm thread when demand drifts toward a bucket boundary "
@@ -196,6 +203,8 @@ def main(argv: list[str] | None = None) -> int:
         config.pad_hysteresis_pct = args.pad_hysteresis_pct
     if args.compile_cache_dir:
         config.compile_cache_dir = args.compile_cache_dir
+    if args.shard_devices >= 0:
+        config.shard_devices = args.shard_devices
     if args.speculative_compile >= 0:
         config.speculative_compile = bool(args.speculative_compile)
     if args.dispatch_deadline_ms >= 0:

@@ -165,6 +165,14 @@ class SchedulerConfiguration:
     # zero programs for previously-seen regimes (entry load ~<1 s vs
     # the 8.8-16.8 s cold compile).
     compile_cache_dir: str = ""
+    # shardDevices — shard the serving path's device-resident carry
+    # (the [P, N] static base and [S, P] matched-pending tables) over a
+    # 1-D ('pods',) jax.sharding.Mesh of this many local devices; the
+    # claim path's shard-invariant tie-breaking (ops/argsel.py) keeps
+    # placements bit-identical to the single-device run at any count.
+    # 0/1 disables (everything stays on one device). Must divide the
+    # pod pad bucket (64) and not exceed jax.devices().
+    shard_devices: int = 0
     # speculativeCompile — background pre-compilation of the ADJACENT
     # pad regime on a warm thread (never the bind path) when the
     # anomaly sentinel's demand EWMA drifts toward a bucket boundary;
@@ -324,6 +332,7 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         multi_cycle_max_wait_ms=float(data.get("multiCycleMaxWaitMs", 5.0)),
         pad_hysteresis_pct=float(data.get("padHysteresisPct", 0.0)),
         compile_cache_dir=str(data.get("compileCacheDir", "")),
+        shard_devices=int(data.get("shardDevices", 0)),
         speculative_compile=bool(data.get("speculativeCompile", True)),
         dispatch_deadline_ms=float(data.get("dispatchDeadlineMs", 0.0)),
         degrade_promote_cycles=int(data.get("degradePromoteCycles", 8)),

@@ -65,7 +65,9 @@ _VERSION = 1
 # one entry), and every field of both tuples must appear in the README
 # "## Compile-regime management" key table.
 SIG_KEY_FIELDS = ("P", "N", "E", "MPN", "MA", "MC")
-EXTRA_KEY_FIELDS = ("spec", "profile", "kind", "program", "fingerprint")
+EXTRA_KEY_FIELDS = (
+    "spec", "profile", "kind", "program", "mesh", "fingerprint",
+)
 
 
 def backend_fingerprint() -> str:
@@ -113,10 +115,20 @@ class CacheKey:
 def cache_key(
     spec, profile: str, kind: str, program: str,
     fingerprint: str | None = None,
+    mesh: str = "none",
 ) -> CacheKey:
     """Build the key for one (regime, profile, program kind) triple.
     Iterates the literal key-field inventories above so the key string
-    and the documented key table cannot structurally diverge."""
+    and the documented key table cannot structurally diverge.
+
+    `mesh` is the sharding descriptor of the call's argument layout
+    (see `_args_mesh_desc`): an executable compiled against sharded
+    buffers partitions its kernels and is NOT interchangeable with the
+    single-device build of the same regime — without this field the
+    two would alias one entry and a sharded load could serve the
+    unsharded program (or vice versa). Mesh-closure programs (the
+    carry cycle built with `mesh=`) additionally carry the mesh in
+    their deterministic program NAME, so both routes stay distinct."""
     from ..models.packing import shape_signature
 
     sig = dict(shape_signature(spec))
@@ -128,6 +140,7 @@ def cache_key(
         "profile": profile,
         "kind": kind,
         "program": program,
+        "mesh": mesh,
         "fingerprint": fingerprint or backend_fingerprint(),
     }
     parts += [f"{f}={extra[f]}" for f in EXTRA_KEY_FIELDS]
@@ -395,6 +408,38 @@ def _avals_digest(args: tuple, kwargs: dict) -> str:
     return hashlib.sha256(sig.encode()).hexdigest()[:12]
 
 
+def _args_mesh_desc(args: tuple, kwargs: dict) -> str:
+    """Sharding descriptor of a call's argument layout: "none" when
+    every leaf is unsharded/single-device, else a short digest over the
+    sorted set of (mesh shape, partition spec) pairs. Feeds the cache
+    key's `mesh` field so sharded and unsharded builds of one program
+    never alias a persistent entry."""
+    import jax
+
+    leaves, _treedef = jax.tree_util.tree_flatten((args, kwargs))
+    parts: set[str] = set()
+    for v in leaves:
+        sh = getattr(v, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is None:
+            continue
+        try:
+            shape = tuple(mesh.shape.items())
+        except Exception:  # schedlint: disable=RB001 -- accounting
+            # only: an exotic sharding without a dict-shaped mesh just
+            # stays out of the descriptor (the program name still
+            # disambiguates mesh-closure builds)
+            continue
+        if all(s == 1 for _a, s in shape):
+            continue  # a 1-device mesh is the unsharded layout
+        parts.add(f"{shape}|{getattr(sh, 'spec', None)!r}")
+    if not parts:
+        return "none"
+    return hashlib.sha256(
+        "||".join(sorted(parts)).encode()
+    ).hexdigest()[:10]
+
+
 def _compile_natively(low):
     """Compile a Lowered with JAX's persistent compilation cache truly
     OUT of the loop. Toggling `jax_enable_compilation_cache` alone is
@@ -461,6 +506,7 @@ def load_or_compile(
     key = cache_key(
         spec, profile, kind,
         f"{program_name(fn)}+{_avals_digest(args, kwargs)}",
+        mesh=_args_mesh_desc(args, kwargs),
     )
     t0 = _time.perf_counter()
     try:

@@ -31,6 +31,7 @@ from ..framework.interfaces import CycleContext
 from ..framework.runtime import Framework
 from ..metrics import SchedulerMetrics
 from ..models.encoding import ClusterSnapshot
+from ..ops import argsel
 
 
 def _time_call(fn, snap, repeats: int = 3) -> tuple[float, Any]:
@@ -199,7 +200,9 @@ def _dyn_probe(plugin, snap: ClusterSnapshot, as_score: bool):
                 score = plugin.dyn_score(ctx, p, node_req, ext, mask)
             else:
                 mask = mask & plugin.dyn_mask(ctx, p, node_req, ext)
-            best = jnp.argmax(jnp.where(mask, score, -1e9)).astype(jnp.int32)
+            best = argsel.argmax_first(
+                jnp.where(mask, score, -1e9), axis=0
+            )
             ok = mask[best] & snap.pod_valid[p]
             node_req = node_req.at[best].add(
                 jnp.where(ok, snap.pod_requested[p], 0.0)

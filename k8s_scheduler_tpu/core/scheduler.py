@@ -368,6 +368,54 @@ class Scheduler:
             # speculative submit, so recorder-less or idle schedulers
             # never spawn it
             self._warmer = CompileWarmer(metrics=self.metrics)
+        # multi-chip serving (shardDevices, ROADMAP item 3): the device-
+        # resident carry shards over a 1-D ('pods',) mesh and the rounds
+        # engine pins its compacted views onto it (the collective-
+        # payload diet in ops/rounds.py). Placements are bit-identical
+        # to the single-device run at any device count — the shard-
+        # invariant tie-breaking contract (ops/argsel.py), promoted to
+        # tier-1 by tests/test_shard_invariance.py.
+        self._mesh = None
+        d = int(self.config.shard_devices)
+        if d > 1:
+            import jax as _jax
+
+            from ..parallel.mesh import make_mesh
+
+            avail = len(_jax.devices())
+            if d > avail:
+                raise ValueError(
+                    f"shardDevices={d} but only {avail} device(s) are "
+                    "visible to this process"
+                )
+            if pad_bucket % d != 0:
+                # every pod-axis pad is a multiple of the bucket, so a
+                # divisor of the bucket always divides P
+                raise ValueError(
+                    f"shardDevices={d} must divide the pod pad bucket "
+                    f"({pad_bucket}) so sharded arrays split evenly"
+                )
+            self._mesh = make_mesh(_jax.devices()[:d])
+        self.n_devices = d if d > 1 else 1
+        self.metrics.shard_devices.set(self.n_devices)
+        # per-profile collective payload (bytes/cycle) of the current
+        # regime's CYCLE program, probed from the compiled executable's
+        # HLO at AOT-install time (parallel/audit.py — the same parser
+        # scripts/audit_sharded.py gates on). 0 until a program has
+        # been AOT-compiled (plain-jit builds are not probed: lowering
+        # a second time just for accounting would double compile cost).
+        self._collective_payload: dict[str, int] = {}
+        self._shard_status = {
+            "n_devices": self.n_devices,
+            "mesh": (
+                dict(self._mesh.shape) if self._mesh is not None else None
+            ),
+            "collective_payload_bytes": self._collective_payload,
+        }
+        if state is not None:
+            # /debug/state shows the sharding layout + payload probe
+            # next to the compile cache (same pin pattern)
+            state.sharding = self._shard_status
         # carry mode (rounds only; extender verdicts replace snapshot
         # fields, which the arena spec does not carry): the [P,N] static
         # base + [S,P] matched-pending persist on device and are updated
@@ -491,8 +539,16 @@ class Scheduler:
                     "percentage_of_nodes_to_score"
                 ],
                 extender_args=ext,
+                mesh=self._mesh,
+                # sharded builds fetch compacted rows via the one-hot
+                # contraction (its psum stays mesh-local under the
+                # shard_view pin); single-device keeps the row-gather
+                rounds_kw=(
+                    {"compact_gather": "onehot"}
+                    if self._mesh is not None else None
+                ),
             )
-            keeper = CarryKeeper(spec, fw)
+            keeper = CarryKeeper(spec, fw, mesh=self._mesh)
             diag = build_diagnosis_fn(spec, fw, extender_args=ext)
             ext_keeper = ExtenderVerdictKeeper(spec) if ext else None
         else:
@@ -562,6 +618,8 @@ class Scheduler:
                 return None
             fn.install_aot(compiled)
             sources.append(source)
+            if kind == "cycle":
+                self._probe_payload(profile, compiled)
             return out_sds
 
         stable_sds = one("stable", stable_fn, (w, b))
@@ -596,6 +654,30 @@ class Scheduler:
         if not sources:
             return None
         return "cache" if all(s == "cache" for s in sources) else "cold"
+
+    def _probe_payload(self, profile: str, compiled) -> None:
+        """Stamp this regime's per-cycle collective payload (bytes) off
+        the compiled CYCLE executable's HLO — the same parser the audit
+        gate uses (parallel/audit.py), so serving telemetry
+        (`scheduler_collective_payload_bytes`, flight-record counts,
+        /debug/state) can never disagree with scripts/audit_sharded.py
+        about what a byte of collective is. Runs once per regime build,
+        off the bind path (the AOT install already took seconds)."""
+        try:
+            from ..parallel.audit import collective_payload_bytes
+
+            nbytes = int(collective_payload_bytes(compiled.as_text()))
+        except Exception as e:
+            # accounting only — a backend whose executables cannot
+            # render HLO text must not lose its AOT install
+            logging.getLogger(__name__).debug(
+                "collective payload probe failed for %r: %s", profile, e
+            )
+            return
+        self._collective_payload[profile] = nbytes
+        self.metrics.collective_payload.labels(profile=profile).set(
+            nbytes
+        )
 
     def _maybe_speculate(self, profile: str, spec) -> None:
         """Speculative precompilation trigger, run at the tail of a
@@ -1773,6 +1855,13 @@ class Scheduler:
             # current degradation rung (0 = normal): bench config 7 and
             # soak_chaos count records with rung > 0 as degraded cycles
             rung=self.ladder.rung,
+            # multi-chip serving: mesh width this cycle dispatched over
+            # and the regime's probed per-cycle collective payload
+            # (0 = single device / no AOT probe yet)
+            n_devices=self.n_devices,
+            collective_payload_bytes=self._collective_payload.get(
+                rec.profile, 0
+            ),
             **(extra_counts or {}),
         )
         self.flight.commit(rec)

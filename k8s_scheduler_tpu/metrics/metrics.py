@@ -83,6 +83,16 @@ round trip):
 - scheduler_multicycle_inner_cycles_total — scheduling cycles served
   through multi-cycle dispatches (vs one dispatch per cycle)
 
+Multi-chip serving families (shardDevices + parallel/audit.py — the
+sharded carry path with shard-invariant tie-breaking):
+
+- scheduler_shard_devices — devices the serving mesh shards the
+  device-resident carry over (1 = single-device serving)
+- scheduler_collective_payload_bytes{profile} — per-cycle cross-device
+  collective payload of the profile's compiled cycle program, probed
+  from its HLO at AOT-install time (the audit-gate parser; also
+  stamped on every flight record and shown in /debug/state)
+
 Compile-regime management families (core/compile_cache.py — persistent
 AOT-executable cache + speculative pre-compilation):
 
@@ -375,6 +385,22 @@ class SchedulerMetrics:
             "scheduler_multicycle_inner_cycles_total",
             "Scheduling cycles served through multi-cycle dispatches "
             "(each paid dispatch_rt/K instead of a full round trip).",
+            registry=r,
+        )
+        # ---- multi-chip serving (ops/argsel.py + parallel/) ----
+        self.shard_devices = Gauge(
+            "scheduler_shard_devices",
+            "Devices the serving mesh shards the device-resident carry "
+            "over (1 = single-device; placements are bit-identical at "
+            "any count — the shard-invariant tie-break contract).",
+            registry=r,
+        )
+        self.collective_payload = Gauge(
+            "scheduler_collective_payload_bytes",
+            "Per-cycle cross-device collective payload of the current "
+            "regime's compiled cycle program, probed from its HLO at "
+            "AOT-install time (parallel/audit.py; 0 = no AOT probe).",
+            ["profile"],
             registry=r,
         )
         # ---- compile-regime management (core/compile_cache.py) ----

@@ -1,0 +1,77 @@
+"""Shard-invariant selection primitives (ISSUE 10 / ROADMAP item 3).
+
+`jnp.argmax`/`jax.lax.top_k` break score ties by LOWEST INDEX on a
+single device, but under GSPMD a reduce over a *sharded* axis lowers to
+a per-shard partial reduce plus a cross-shard (value, index) combiner
+whose tie order is an implementation detail of the chosen partitioning
+strategy — equal-valued entries can merge in shard-local order, so the
+same program picks DIFFERENT (equally good) nodes at different device
+counts (`test_dryrun_multichip_8`'s historical divergence: every
+divergent pod landed on an equal-score node).
+
+The fix is structural, not a tweak to the combiner: never present a tie
+to a partitioned reduce. Each helper here decomposes the selection into
+reductions that are order-invariant by algebra (max, min over distinct
+integers) or into a comparator that is already a total order (a 2-key
+sort whose second key is the index), so the result is bit-identical at
+ANY device count — and identical to the single-device numpy semantics
+("first occurrence of the max"), which is why swapping these in changes
+nothing on the replicated path.
+
+Every partitioned claim-path reduce in ops/rounds.py, ops/commit.py and
+ops/preemption.py routes through this module; a new argmax/top_k over a
+potentially-sharded axis should too.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def argmax_first(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Index of the FIRST maximum along `axis` (i32), shard-invariant.
+
+    max() is order-invariant (no rounding, associative+commutative), and
+    the follow-up min() runs over distinct integer indices — so neither
+    reduce can merge ties shard-locally. Bit-identical to jnp.argmax on
+    one device (numpy's first-occurrence rule) and at every shard count.
+    Two cheap reduces replace one (value, index) tuple-reduce; under a
+    sharded axis the cross-shard payload is a scalar-per-row f32 + s32
+    instead of the tuple combiner's pairs.
+    """
+    ax = axis if axis >= 0 else x.ndim + axis
+    n = x.shape[ax]
+    m = jnp.max(x, axis=ax, keepdims=True)
+    idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, ax)
+    return jnp.min(jnp.where(x == m, idx, jnp.int32(n)), axis=ax)
+
+
+def top_k_first(x: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """`jax.lax.top_k` over the last axis with shard-invariant tie
+    order: (values [., k], indices [., k]), ties resolved lowest-index
+    first — exactly top_k's documented single-device order.
+
+    Implemented as a 2-key `lax.sort` (descending value, ascending
+    index): the comparator is a TOTAL order, so the sorted sequence is
+    unique regardless of how XLA partitions the sort. Costs a full sort
+    of the axis instead of a selection — acceptable for the shortlist
+    path, whose per-round top_k was already the dominant term at the
+    geometry where it is enabled (see ops/rounds.py `shortlist`). The
+    index operand rides the sort at the minimal width the axis extent
+    allows (the collective-payload diet: a partitioned sort all-gathers
+    its operands); the returned indices are widened back to i32.
+    """
+    n = x.shape[-1]
+    iota = jax.lax.broadcasted_iota(index_dtype(n), x.shape, x.ndim - 1)
+    neg, idx = jax.lax.sort((-x, iota), dimension=x.ndim - 1, num_keys=2)
+    take = (slice(None),) * (x.ndim - 1) + (slice(0, k),)
+    return -neg[take], idx[take].astype(jnp.int32)
+
+
+def index_dtype(n: int):
+    """Minimal sortable index dtype addressing `n` values — the
+    collective-payload diet's "claim-sort index width": a sorted-iota
+    permutation operand rides every partitioned sort's all-gather, and
+    half-width indices halve that payload where the extent allows."""
+    return jnp.int16 if n <= 2**15 - 1 else jnp.int32

@@ -31,6 +31,8 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from . import argsel
+
 NEG_INF = -1e9
 
 # dyn_fn(pod_idx, node_requested [N,R], extra, static_row [N] bool)
@@ -90,7 +92,11 @@ def greedy_commit(
         # node first and keeps it if it passes filters.
         nom = jnp.clip(pod_nominated[p], 0, N - 1)
         nom_ok = (pod_nominated[p] >= 0) & feasible[nom]
-        best = jnp.where(nom_ok, nom, jnp.argmax(score)).astype(jnp.int32)
+        # lowest-index tie-break that survives a sharded nodes axis
+        # (ops/argsel.py) — identical to argmax on a single device
+        best = jnp.where(
+            nom_ok, nom, argsel.argmax_first(score, axis=0)
+        ).astype(jnp.int32)
         ok = feasible[best] & pod_valid[p]
         node = jnp.where(ok, best, jnp.int32(-1))
         node_req = node_req.at[best].add(

@@ -86,6 +86,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models import encoding as enc
+from . import argsel
 from . import interpod as interpod_ops
 
 # Production per-cycle latency budgets (the DefaultPreemption plugin's
@@ -505,7 +506,9 @@ def run_preemption(
         # (evict younger pods); minimize the negated start time
         hi_start = pick1(vict_start, last)
         best = lexmin(best, -hi_start, big=jnp.float32(jnp.inf))
-        b = jnp.argmax(best).astype(jnp.int32)  # lowest node index among ties
+        # lowest node index among ties — shard-invariant over a sharded
+        # nodes axis (ops/argsel.py; plain argmax merges shard-locally)
+        b = argsel.argmax_first(best, axis=0)
 
         do = live2[rank] & jnp.any(candidate)
         nominated_p = jnp.where(do, b, jnp.int32(-1))

@@ -78,8 +78,12 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec
 
 from ..models import encoding as enc
+from ..parallel.mesh import MESH_AXES, mesh_pin
+from . import argsel
 from . import interpod as interpod_ops
 
 NEG_INF = -1e9
@@ -251,6 +255,14 @@ def rounds_commit(
     pv_choice_fn: Callable | None = None,  # (vsnap, node_of, live, ext)
     # -> i32 [B, MVol] chosen static PV per claimant/slot (-1 none): the
     # guard arbitrates same-round claimants of one PV by rank
+    mesh=None,  # jax.sharding.Mesh | None — the collective-payload
+    # diet's sharding hint: with a mesh, the compacted per-round [B, N]
+    # views carry an explicit with_sharding_constraint over the mesh
+    # axes (parallel/mesh.MESH_AXES), so the one-hot compaction's psum
+    # lowers to a reduce-scatter of the PARTITIONED view instead of
+    # all-reducing a replicated [B, N] (the single largest collective in
+    # AUDIT_SHARDED_r05: 23.6 MB of 43.2 MB total). None (the default,
+    # and every single-device build) changes nothing.
 ) -> RoundsResult:
     P, N = (sbase if sbase is not None else static_mask).shape
     S = m_pending.shape[0]
@@ -287,6 +299,36 @@ def rounds_commit(
     GK_INVALID = GK_PV + V + 1
     has_pv_guards = bool(snap.has_volumes and pv_choice_fn is not None)
 
+    def shard_view(arr):
+        """Constrain a compacted [B, ...] view onto the mesh axes
+        (row dim on 'pods', a second dim on 'nodes' when present and
+        divisible — parallel/mesh.mesh_pin owns the rule). Identity
+        without a mesh."""
+        if mesh is None:
+            return arr
+        return mesh_pin(arr, mesh, MESH_AXES)
+
+    def local_update_fn(fn):
+        """Force the per-round plugin-state update to run device-LOCAL
+        on a mesh (identity without one). The update contracts [B, S]/
+        [B, D] one-hots over the claims axis; left to GSPMD those dots
+        get contraction-sharded — each device computes a partial and
+        all-reduces the FULL [S, N]/[S, D] count tables, 58 MB/cycle at
+        the audit shape even with every input pinned replicated (the
+        partitioner trades our per-cycle payload for FLOP spread).
+        shard_map admits no such choice: inputs arrive replicated
+        (kilobyte-scale [B, ...] vectors — shard_map inserts the tiny
+        gathers itself), every device computes the identical full
+        update, zero collectives inside."""
+        if mesh is None:
+            return fn
+        return shard_map(
+            fn, mesh=mesh,
+            in_specs=(PartitionSpec(),) * 5,
+            out_specs=PartitionSpec(),
+            check_rep=False,
+        )
+
     slack = _REL_EPS * snap.node_allocatable + _REL_EPS  # [N, R]
     # static mask+score pre-combined; scores clamp to +-1e6 (far above any
     # plugin-weight scale, far below |NEG_INF|/2) so an extreme extender
@@ -307,15 +349,13 @@ def rounds_commit(
         if state is None and not has_port_guards and not has_pv_guards:
             return jnp.ones((B,), bool)
         nsafe = jnp.clip(choice, 0, N - 1)
-        pid = jnp.arange(B, dtype=jnp.int32)
 
-        keys, roles, caps = [], [], []
+        keys, role_ids, caps = [], [], []
 
         def emit(key, valid, role, cap=None):
             keys.append(jnp.where(valid & live, key, GK_INVALID))
-            roles.append(jnp.full((B,), role, jnp.int32))
-            caps.append(cap if cap is not None
-                        else jnp.full((B,), 2**30, jnp.int32))
+            role_ids.append(role)
+            caps.append(cap)
 
         if state is not None:
             # each capability pays only for its own machinery: affinity-
@@ -380,21 +420,46 @@ def rounds_commit(
                 ids = pvc[:, j]
                 emit(GK_PV + jnp.clip(ids, 0, V - 1), ids >= 0, _RB_PV)
 
-        keys_c = jnp.concatenate(keys)
-        roles_c = jnp.concatenate(roles)
-        caps_c = jnp.concatenate(caps)
+        # stack+reshape, NOT jnp.concatenate: on a multi-axis mesh this
+        # jaxlib's SPMD partitioner miscompiles an axis-0 concatenate of
+        # 1-D pods-sharded integer vectors — the partially-replicated
+        # operands are summed over the free ('nodes') axis, so every
+        # value comes back multiplied by that axis size (minimal repro:
+        # tests/test_shard_invariance.py::test_sharded_concat_workaround
+        # — THIS, not reduce tie order, was the real source of the 2-D
+        # mesh guard divergence behind the old dryrun_multichip_8 xfail;
+        # stack+reshape takes the safe partitioner path and is the same
+        # piece-major layout)
+        keys_c = jnp.stack(keys, axis=0).reshape(-1)
         n_emit = len(keys)
-        pods_c = jnp.tile(pid, n_emit)
         ranks_c = jnp.tile(vrank, n_emit)
-        alive = keys_c != GK_INVALID
-        roles_c = jnp.where(alive, roles_c, 0)
+        # Collective-payload diet: the claimant id, role, and cap of
+        # table entry j are all FUNCTIONS of position (claimant j % B of
+        # emit slot j // B; roles are per-slot trace constants), so the
+        # sweep gathers NONE of them through the sort — the permutation
+        # alone reconstructs pods/roles, and the caps column is gathered
+        # only when a spread emit actually produced one. (The old
+        # stacked [L, 3] payload gather was the audit's s32[283136,3]
+        # all-reduce — 3.4 MB at the P=10112 shape — for data the sort
+        # result already encodes.)
+        role_tab = jnp.asarray(role_ids, jnp.int32)  # [n_emit] constant
+        needs_caps = any(c is not None for c in caps)
+        if needs_caps:
+            caps_c = jnp.stack([  # stack, not concatenate (see keys_c)
+                c if c is not None else jnp.full((B,), 2**30, jnp.int32)
+                for c in caps
+            ], axis=0).reshape(-1)
 
         # The participant-table sort dominates the sweep. When (key, rank)
         # fits one u32 word, sort a SINGLE packed operand plus an iota
-        # permutation and fetch the payload columns with one stacked row-
-        # gather — a 5-operand multi-key sort costs ~2x the packed one at
+        # permutation — a multi-key sort costs ~2x the packed one at
         # L≈290k, and per-column 1-D gathers are ~2ms each on this backend.
         rank_space = 1 << int(P - 1).bit_length()  # active ranks are < P
+        # minimal index width for the sort's permutation operand (the
+        # compacted table fits i16; round 0's P-scale table takes i32)
+        iota = jnp.arange(
+            keys_c.shape[0], dtype=argsel.index_dtype(keys_c.shape[0])
+        )
         if (GK_INVALID + 1) * rank_space <= 2**32:
             # padded/inactive rows carry rank INT32_MAX (pod_order pad);
             # clamp so they cannot wrap the key bits (their key is
@@ -403,17 +468,16 @@ def rounds_commit(
                 keys_c.astype(jnp.uint32) * jnp.uint32(rank_space)
                 + jnp.minimum(ranks_c, rank_space - 1).astype(jnp.uint32)
             )
-            iota = jnp.arange(packed.shape[0], dtype=jnp.int32)
             packed_s, perm = jax.lax.sort((packed, iota), num_keys=1)
             keys_s = (packed_s // jnp.uint32(rank_space)).astype(jnp.int32)
-            payload = jnp.stack([pods_c, roles_c, caps_c], axis=1)[perm]
-            pods_s = payload[:, 0]
-            role_s = payload[:, 1]
-            cap_s = payload[:, 2]
         else:
-            keys_s, _ranks_s, pods_s, role_s, cap_s = jax.lax.sort(
-                (keys_c, ranks_c, pods_c, roles_c, caps_c), num_keys=2
+            keys_s, _ranks_s, perm = jax.lax.sort(
+                (keys_c, ranks_c, iota), num_keys=2
             )
+        slot = perm // B
+        pods_s = perm - slot * B
+        role_s = role_tab[slot]
+        cap_s = caps_c[perm] if needs_caps else None
         before = _seg_scan_tables(
             keys_s, pods_s,
             {
@@ -435,9 +499,12 @@ def rounds_commit(
             (before["boot"] == 0) & (before["gmatch"] == 0),
             True,
         )
-        ok_e &= jnp.where(
-            role_s == _RB_SPREAD, before["arrive"] < cap_s, True
-        )
+        if needs_caps:
+            # only spread emits carry a cap, and they exist iff a cap
+            # column was built — without one no row has _RB_SPREAD
+            ok_e &= jnp.where(
+                role_s == _RB_SPREAD, before["arrive"] < cap_s, True
+            )
         ok_e &= jnp.where(role_s == _RB_PORT, before["port"] == 0, True)
         ok_e &= jnp.where(role_s == _RB_PV, before["pv"] == 0, True)
         ok_e |= keys_s == GK_INVALID
@@ -493,6 +560,13 @@ def rounds_commit(
                 vsbase = jnp.matmul(
                     oh, sbase, precision=jax.lax.Precision.HIGHEST
                 )
+                # with a mesh, pin the compacted view SHARDED: the
+                # contraction over the pods axis then lowers to a
+                # reduce-scatter of the partitioned [B, N] view instead
+                # of all-reducing a replicated one — at the audit shape
+                # that single collective was 23.6 MB of the 43.2 MB
+                # per-cycle total (AUDIT_SHARDED_r05)
+                vsbase = shard_view(vsbase)
             else:
                 vsbase = sbase[gid]
             vrank = rank_g[gid]
@@ -522,14 +596,39 @@ def rounds_commit(
             ONLY; the guard sweep runs once at round end over all
             capacity-accepted claims and revokes violators — guards are
             ~5% of rejections but the table sort is the dominant
-            per-pass cost, so it must not run per pass."""
-            sort_key = jnp.where(live, best * P + vrank, _BIG)
-            order = jnp.argsort(sort_key)
-            s_node = jnp.where(live, best, N)[order]
-            s_req = jnp.where(live[:, None], vsnap.pod_requested, 0.0)[
-                order
-            ]
-            s_live = live[order]
+            per-pass cost, so it must not run per pass.
+
+            The (node, rank) sort key is PACKED into one u32 when it
+            fits (N+1 node values x a pow2 rank space) — the sorted key
+            then carries s_node/s_live for free, so the sort's
+            partitioned all-gather moves (key, iota) instead of the old
+            (key, iota) + two post-sort [B] row-gathers. Beyond u32
+            range (the 100k-pod x 50k-node bench grid: the old
+            `best * P + vrank` i32 key silently WRAPPED there) a 2-key
+            sort keeps exact lexicographic order at any scale."""
+            rank_space = 1 << int(P - 1).bit_length()  # ranks are < P
+            nkey = jnp.where(live, best, N).astype(jnp.uint32)
+            rkey = jnp.minimum(vrank, rank_space - 1).astype(jnp.uint32)
+            # minimal index width: the permutation operand rides the
+            # sort's partitioned all-gather (i16 halves it when B fits)
+            bidx = jnp.arange(B, dtype=argsel.index_dtype(B))
+            if (N + 1) * rank_space <= 2**32:
+                packed = nkey * jnp.uint32(rank_space) + rkey
+                packed_s, order = jax.lax.sort(
+                    (packed, bidx), num_keys=1
+                )
+                s_node = (packed_s // jnp.uint32(rank_space)).astype(
+                    jnp.int32
+                )
+            else:
+                s_nkey, _s_rkey, order = jax.lax.sort(
+                    (nkey, rkey, bidx), num_keys=2
+                )
+                s_node = s_nkey.astype(jnp.int32)
+            s_live = s_node < N  # live claims carry a real node id
+            s_req = jnp.where(
+                s_live[:, None], vsnap.pod_requested[order], 0.0
+            )
             cum = jnp.cumsum(s_req, axis=0)
             before = cum - s_req
             seg_start = jnp.concatenate(
@@ -590,7 +689,10 @@ def rounds_commit(
         if use_sl:
             k = shortlist
             scored0 = jnp.where(mask, jnp.round(base) + tie, NEG_INF)
-            vals, sl = jax.lax.top_k(scored0, k)  # [B, k]
+            # shard-invariant top_k (ops/argsel.py): equal-score entries
+            # keep the lowest-index-first order at ANY device count —
+            # lax.top_k's partitioned form merges ties shard-locally
+            vals, sl = argsel.top_k_first(scored0, k)  # [B, k]
             # the nominated node (post-preemption) must be claimable even
             # when outside the top-k: force it into the last column (and
             # NEG_INF any earlier duplicate so a dead node is not offered
@@ -626,7 +728,7 @@ def rounds_commit(
                     eff = jnp.where(avail, vals + dsl, NEG_INF)
                 else:
                     eff = jnp.where(avail, vals, NEG_INF)
-                bj = jnp.argmax(eff, axis=1).astype(jnp.int32)
+                bj = argsel.argmax_first(eff, axis=1)
                 nom_ok = has_nom & avail[:, k - 1]
                 bj = jnp.where(nom_ok, k - 1, bj)
                 best = jnp.take_along_axis(sl, bj[:, None], 1)[:, 0]
@@ -675,7 +777,7 @@ def rounds_commit(
                     scored = jnp.round(base) + tie
                 avail = mask & ~acc[:, None]
                 eff = jnp.where(avail, scored, NEG_INF)
-                best = jnp.argmax(eff, axis=1).astype(jnp.int32)
+                best = argsel.argmax_first(eff, axis=1)
                 r_nom_ok = has_nom & avail[pid, nom]
                 best = jnp.where(r_nom_ok, nom, best)
                 has = avail[pid, best] & exhausted
@@ -711,8 +813,12 @@ def rounds_commit(
                     scored = jnp.round(base) + tie
                 eff_t = jnp.where(avail, scored, NEG_INF)
                 nom_ok = has_nom & avail[pid, nom]
+                # argmax_first (ops/argsel.py): lowest-index tie-break
+                # survives a sharded nodes axis — the shard-exactness
+                # contract (sharded == replicated placements bit-
+                # identically, test_dryrun_multichip_8)
                 best = jnp.where(
-                    nom_ok, nom, jnp.argmax(eff_t, axis=1)
+                    nom_ok, nom, argsel.argmax_first(eff_t, axis=1)
                 ).astype(jnp.int32)
                 has = avail[pid, best] & act_v & vsnap.pod_valid & ~acc
                 normal = has & ~vovf
@@ -754,7 +860,7 @@ def rounds_commit(
             jnp.sum(revoked, dtype=jnp.int32),
         ])
 
-        ext = update_batched_view_fn(
+        ext = local_update_fn(update_batched_view_fn)(
             vsnap, vmp, ext, acc, jnp.where(acc, acc_node, 0)
         )
         return acc, acc_node, node_req, ext, diag

@@ -1,1 +1,6 @@
-from .mesh import make_mesh, shard_snapshot  # noqa: F401
+from .audit import (  # noqa: F401
+    COLLECTIVE_BUDGETS,
+    collective_payload_bytes,
+    parse_collectives,
+)
+from .mesh import MESH_AXES, make_mesh, shard_snapshot  # noqa: F401

@@ -16,6 +16,45 @@ import dataclasses
 
 import numpy as np
 
+# module-level on purpose: mesh_pin runs INSIDE jitted programs, where
+# a lazy first import is a trace-safety violation (schedlint TS001);
+# this environment's sitecustomize imports jax at interpreter start
+# anyway, so nothing is deferred in practice
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+# The mesh-axis name inventory, pinned by schedlint ID008 against the
+# collective budget allowlist (parallel/audit.COLLECTIVE_BUDGETS) and
+# the README "## Multi-chip and multi-host" budget table: the pods axis
+# is the data-parallel batch dimension every [P, ...] array shards on;
+# the trailing nodes axis (2-D meshes) stays intra-host (JAX orders
+# devices host-major) because the claim path's per-node collectives are
+# the latency-critical ones. Renaming an axis without updating the
+# budget allowlist would silently un-classify its collectives.
+MESH_AXES = ("pods", "nodes")
+
+
+def mesh_pin(arr, mesh, axes):
+    """`with_sharding_constraint` an array onto named mesh axes, one
+    per leading dim (None entries and dims beyond `axes` stay
+    unconstrained). An axis applies only when the mesh carries it with
+    size > 1 AND it divides that dim — otherwise the dim is pinned
+    replicated, matching shard_snapshot's fallback. The ONE place the
+    "which PartitionSpec does this array get" rule lives: the rounds
+    engine's compacted views (ops/rounds.py shard_view) and the carry
+    tables (core/cycle.py _constrain_carry) both delegate here, so the
+    sharding rule cannot drift between the two layers."""
+    spec = [None] * arr.ndim
+    for d, axis in enumerate(axes[: arr.ndim]):
+        if not axis:
+            continue
+        size = mesh.shape.get(axis, 1)
+        if size > 1 and arr.shape[d] % size == 0:
+            spec[d] = axis
+    return jax.lax.with_sharding_constraint(
+        arr, NamedSharding(mesh, PartitionSpec(*spec))
+    )
+
 
 def initialize_distributed(
     coordinator_address: str | None = None,
@@ -62,8 +101,8 @@ def make_mesh(devices=None, nodes_axis: int = 1):
     if nodes_axis > 1:
         assert n % nodes_axis == 0
         arr = np.array(devices).reshape(n // nodes_axis, nodes_axis)
-        return Mesh(arr, ("pods", "nodes"))
-    return Mesh(np.array(devices), ("pods",))
+        return Mesh(arr, MESH_AXES)
+    return Mesh(np.array(devices), MESH_AXES[:1])
 
 
 def shard_snapshot(snap, mesh):

@@ -393,4 +393,13 @@ class DurableState:
             # the Scheduler pins its DegradationLadder here: the current
             # rung belongs next to the durability it can seal away
             out["degradation"] = deg.status()
+        shard = getattr(self, "sharding", None)
+        if shard is not None:
+            # the Scheduler pins its mesh layout + per-profile
+            # collective-payload probe here (same pattern): operators
+            # triaging cross-device traffic read it off /debug/state
+            out["sharding"] = {
+                k: (dict(v) if isinstance(v, dict) else v)
+                for k, v in shard.items()
+            }
         return out

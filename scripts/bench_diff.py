@@ -38,6 +38,10 @@ threshold flag (percent):
                    regression = drop  > --max-hit-rate-drop
     mttr_ms        fault-storm mean recovery time
                    regression = rise  > --max-mttr-rise
+    scaling_efficiency   config-8 sharded scaling efficiency
+                   regression = drop  > --max-scaling-efficiency-drop
+    collective_payload_mb  config-8 compiled collective payload/cycle
+                   regression = rise  > --max-payload-rise
     stall_cycles   >10x-p50 cycles    regression = new > old + --allow-stalls
     anomalies      classifier total   regression = new > old + --allow-stalls
     degraded_cycles  cycles below the top ladder rung
@@ -81,6 +85,15 @@ _METRICS = {
     # invariant still holds); degraded_cycles (higher = regressed)
     # gates via _COUNT_METRICS below.
     "mttr_ms": ("lower", "mttr_ms", "mttr"),
+    # sharded multi-chip serving (ISSUE 10, config 8 sharded_scale):
+    # scaling efficiency must not DROP (sharding that stops paying for
+    # itself is the headline regressing) and the compiled collective
+    # payload per cycle must not RISE (the payload diet is what makes
+    # the scale grid reachable — AUDIT_SHARDED r05 43.2 MB -> r06
+    # 3.7 MB). Both skipped for artifacts predating config 8.
+    "scaling_efficiency": ("higher", "scaling_efficiency", "seff"),
+    "collective_payload_mb": ("lower", "collective_payload_mb",
+                              "cpmb"),
 }
 _COUNT_METRICS = ("stall_cycles", "anomalies_total", "degraded_cycles")
 
@@ -301,6 +314,19 @@ def main(argv: list[str] | None = None) -> int:
         "promotion-cycle-quantized, so small shifts are noise)",
     )
     ap.add_argument(
+        "--max-scaling-efficiency-drop", type=float, default=25.0,
+        help="config-8 scaling_efficiency may drop this many percent "
+        "before it counts as a regression (virtual-CPU sweeps are "
+        "noisy; a real fall-off-the-cliff is far larger)",
+    )
+    ap.add_argument(
+        "--max-payload-rise", type=float, default=25.0,
+        help="config-8 collective_payload_mb may rise this many "
+        "percent before it counts as a regression (the compile-only "
+        "audit gate asserts the hard per-class budgets; this catches "
+        "drift between rounds)",
+    )
+    ap.add_argument(
         "--allow-stalls", type=int, default=1,
         help="stall/anomaly count may grow by this many before it "
         "counts as a regression (one stall is a known rig flake — "
@@ -342,6 +368,8 @@ def main(argv: list[str] | None = None) -> int:
             "compile_seconds": args.max_compile_rise,
             "compile_cache_hit_rate": args.max_hit_rate_drop,
             "mttr_ms": args.max_mttr_rise,
+            "scaling_efficiency": args.max_scaling_efficiency_drop,
+            "collective_payload_mb": args.max_payload_rise,
         },
         allow_stalls=args.allow_stalls,
         min_ms_delta=args.min_ms_delta,

@@ -18,24 +18,17 @@ def test_entry_compiles_and_runs():
     assert (a[:8] >= 0).all()  # tiny cluster has room for all 8 pods
 
 
-@pytest.mark.xfail(
-    strict=False,
-    reason="pre-existing on the untouched seed (CHANGES.md, PR 1): the "
-    "8-device dryrun uses a 2-D (pods=4, nodes=2) mesh, and the ROUNDS "
-    "engine's sharded==replicated equality check diverges at contention "
-    "scale (~49/300 pods) ONLY when the NODES axis is sharded — "
-    "triaged 2026-08: static masks/scores are bit-identical "
-    "(max abs diff 0.0) and every divergent pod lands on an "
-    "equal-score node, i.e. the partitioned lax.top_k/argmax over the "
-    "sharded nodes axis merges equal-valued entries in shard-local "
-    "order instead of the replicated global lowest-index order, so "
-    "score TIES resolve to different (equally good) nodes. scan mode "
-    "and 1-D pods-axis meshes (dryrun_multichip_2, nodes replicated) "
-    "stay exact. Root cause is XLA partitioned-reduction tie ordering, "
-    "not a scheduler bug — needs a shard-invariant tie key in the "
-    "rounds claim path (ops/rounds.py _tie_break) to fix properly.",
-)
 def test_dryrun_multichip_8():
+    """Was xfail from the seed through PR 9: the 2-D (pods=4, nodes=2)
+    mesh diverged at contention scale. ISSUE 10 root-caused it — not
+    reduce tie ordering alone, but an SPMD partitioner miscompilation
+    of axis-0 concatenate over the sharded axis on multi-axis meshes
+    (values multiplied by the free-axis size inside the guard sweep;
+    minimal repro in tests/test_shard_invariance.py) — and fixed both:
+    stack+reshape table builds plus shard-invariant argmax/top_k
+    (ops/argsel.py). Sharded == replicated now holds bit-identically in
+    both commit modes; this run also audits the compiled carry cycle
+    for [P,N]-scale collectives."""
     ge.dryrun_multichip(8)
 
 

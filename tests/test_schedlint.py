@@ -591,6 +591,86 @@ def test_inventory_drift_rung_table_id007(tmp_path):
     )
 
 
+def test_inventory_drift_collective_budgets_id008(tmp_path):
+    """ID008: the sharded-collective budget inventory cannot drift —
+    every COLLECTIVE_BUDGETS class and every MESH_AXES axis name must
+    appear in the README "## Multi-chip and multi-host" budget table
+    (the audit gate asserts against the budgets; a class renamed
+    without its doc row silently un-classifies the collectives it
+    bounds)."""
+    result = lint_fixture(tmp_path, {
+        "parallel/audit.py": """\
+            COLLECTIVE_BUDGETS = {
+                "static_base": 2.0,
+                "claim_sort": 4.0,
+                "shiny_new_class": 1.0,
+            }
+        """,
+        "parallel/mesh.py": 'MESH_AXES = ("pods", "racks")\n',
+        "README.md": """\
+            # fixture
+
+            ## Multi-chip and multi-host
+
+            | static_base | ... | | claim_sort | ... |
+            the pods axis shards the batch
+        """,
+    }, passes=["INVENTORY-DRIFT"])
+    msgs = [f.message for f in codes_at(result, "ID008")]
+    assert any(
+        "'shiny_new_class'" in m and "budget table" in m for m in msgs
+    )
+    assert any("'racks'" in m and "MESH_AXES" in m for m in msgs)
+    assert len(msgs) == 2  # documented class/axis names do not fire
+
+    # consistent tree lints clean
+    clean = lint_fixture(tmp_path / "clean", {
+        "parallel/audit.py": 'COLLECTIVE_BUDGETS = {"claim_sort": 1.0}\n',
+        "parallel/mesh.py": 'MESH_AXES = ("pods",)\n',
+        "README.md": (
+            "## Multi-chip and multi-host\n\n"
+            "claim_sort rides the pods axis\n"
+        ),
+    }, passes=["INVENTORY-DRIFT"])
+    assert codes_at(clean, "ID008") == []
+
+    # the README section itself missing is flagged
+    sectionless = lint_fixture(tmp_path / "sectionless", {
+        "parallel/audit.py": 'COLLECTIVE_BUDGETS = {"claim_sort": 1.0}\n',
+        "parallel/mesh.py": 'MESH_AXES = ("pods",)\n',
+        "README.md": "# no such section\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "Multi-chip and multi-host" in f.message
+        for f in codes_at(sectionless, "ID008")
+    )
+
+    # no literal COLLECTIVE_BUDGETS: the allowlist anchor is flagged
+    anchorless = lint_fixture(tmp_path / "anchorless", {
+        "parallel/audit.py":
+            "COLLECTIVE_BUDGETS = dict((k, 1.0) for k in ())\n",
+        "parallel/mesh.py": 'MESH_AXES = ("pods",)\n',
+        "README.md": "## Multi-chip and multi-host\n\npods\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "no literal" in f.message and "COLLECTIVE_BUDGETS" in f.message
+        for f in codes_at(anchorless, "ID008")
+    )
+
+    # a non-literal MESH_AXES is flagged even with budgets intact
+    axeless = lint_fixture(tmp_path / "axeless", {
+        "parallel/audit.py": 'COLLECTIVE_BUDGETS = {"claim_sort": 1.0}\n',
+        "parallel/mesh.py": "MESH_AXES = tuple(a for a in ())\n",
+        "README.md": (
+            "## Multi-chip and multi-host\n\nclaim_sort\n"
+        ),
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "no literal MESH_AXES" in f.message
+        for f in codes_at(axeless, "ID008")
+    )
+
+
 # ---- ROBUSTNESS ----------------------------------------------------------
 
 

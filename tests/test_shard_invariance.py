@@ -1,0 +1,407 @@
+"""Shard-exactness (ISSUE 10 / ROADMAP item 3): the same trace must
+produce identical decisions at ANY device count.
+
+Three layers:
+
+- primitive: ops/argsel.py's argmax_first/top_k_first match numpy's
+  single-device tie semantics exactly (lowest index first), plus the
+  minimal reproduction of the SPMD concatenate miscompilation that was
+  the true root cause of the old `dryrun_multichip_8` xfail (an axis-0
+  concat of pods-sharded i32 vectors on a 2-D mesh comes back
+  multiplied by the free-axis size — guarded by the stack+reshape
+  workaround in ops/rounds.py's guard sweep);
+- program: the mesh-built carry cycle (shard_view + local_update_fn +
+  onehot compaction) places a contended guard-heavy trace bit-
+  identically at devices ∈ {1, 2, 4, 8};
+- serving: two Schedulers — shardDevices=0 and shardDevices=4 —
+  driven through the same multi-cycle trace produce identical bind
+  streams and state digests, and the sharded one stamps
+  n_devices/collective metadata on flight records, the
+  scheduler_shard_devices gauge, and /debug/state.
+
+The conftest forces an 8-device virtual CPU platform, so everything
+here is fast-tier except where marked.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from k8s_scheduler_tpu.ops import argsel
+from k8s_scheduler_tpu.parallel.mesh import MESH_AXES, make_mesh
+
+
+# ---- primitives ----------------------------------------------------------
+
+
+def test_argmax_first_matches_numpy_first_occurrence():
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 4, size=(64, 33)).astype(np.float32)  # many ties
+    got = np.asarray(jax.jit(lambda v: argsel.argmax_first(v, axis=1))(x))
+    assert (got == x.argmax(axis=1)).all()
+    # all-equal rows (every node NEG_INF) pick index 0, like argmax
+    flat = np.full((3, 7), -1e9, np.float32)
+    assert (np.asarray(argsel.argmax_first(jnp.asarray(flat), 1)) == 0).all()
+    # 1-D form (the scan engine's per-step shape)
+    v = np.array([2.0, 5.0, 5.0, 1.0], np.float32)
+    assert int(argsel.argmax_first(jnp.asarray(v), 0)) == 1
+
+
+def test_top_k_first_matches_lax_top_k_tie_order():
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 5, size=(32, 40)).astype(np.float32)
+    vals, idx = jax.jit(lambda v: argsel.top_k_first(v, 6))(x)
+    ref_v, ref_i = jax.lax.top_k(jnp.asarray(x), 6)
+    assert (np.asarray(vals) == np.asarray(ref_v)).all()
+    assert (np.asarray(idx) == np.asarray(ref_i)).all()
+
+
+def test_argmax_first_shard_invariant_on_2d_mesh():
+    mesh = make_mesh(jax.devices()[:8], nodes_axis=2)
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, 3, size=(64, 32)).astype(np.float32)
+    f = jax.jit(lambda v: argsel.argmax_first(v, axis=1))
+    rep = np.asarray(f(x))
+    sh = np.asarray(f(jax.device_put(
+        x, NamedSharding(mesh, PartitionSpec(*MESH_AXES))
+    )))
+    assert (rep == sh).all()
+
+
+def test_sharded_concat_workaround():
+    """The minimal reproduction behind the old dryrun_multichip_8
+    xfail: on a multi-axis mesh, axis-0 jnp.concatenate of 1-D
+    pods-sharded integer vectors is miscompiled by this jaxlib's SPMD
+    partitioner (partially-replicated operands get summed over the free
+    'nodes' axis — every value comes back doubled on a 2-axis mesh).
+    stack+reshape produces the same piece-major layout through a safe
+    partitioner path; ops/rounds.py's guard sweep builds its
+    participant tables with it. If this test ever FAILS on the concat
+    side after a jaxlib upgrade, the workaround can be retired."""
+    mesh = make_mesh(jax.devices()[:8], nodes_axis=2)
+    x = np.arange(320, dtype=np.int32)
+    xs = jax.device_put(x, NamedSharding(mesh, PartitionSpec("pods")))
+
+    stacked = jax.jit(lambda v: jnp.stack([v, v], 0).reshape(-1))
+    assert (np.asarray(stacked(xs)) == np.asarray(stacked(x))).all()
+    # document the live miscompilation (non-fatal if fixed upstream:
+    # the workaround is then merely redundant)
+    cat = jax.jit(lambda v: jnp.concatenate([v, v]))
+    broken = not (np.asarray(cat(xs)) == np.asarray(cat(x))).all()
+    if not broken:
+        pytest.skip(
+            "jaxlib's partitioned concatenate is fixed on this "
+            "version — the stack+reshape workaround is now optional"
+        )
+
+
+# ---- program layer: mesh-built carry cycle -------------------------------
+
+
+def _contended_workload():
+    from k8s_scheduler_tpu.models import SnapshotEncoder
+    from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+    nodes = make_cluster(24, taint_fraction=0.2, cpu_choices=(2, 4))
+    pods = make_pods(
+        300, seed=42, affinity_fraction=0.25, anti_affinity_fraction=0.2,
+        spread_fraction=0.2, selector_fraction=0.3,
+        toleration_fraction=0.3, priorities=(0, 10), num_apps=8,
+    )
+    enc = SnapshotEncoder(pad_pods=320, pad_nodes=32)
+    return enc.encode_packed(nodes, pods)
+
+
+def test_carry_cycle_shard_count_invariant():
+    """devices ∈ {1, 2, 4, 8} → bit-identical assignment AND
+    node_requested from the mesh-built carry cycle (shard_view pinning,
+    shard_map state update, onehot compaction) over a contended trace
+    with every guard capability active."""
+    from k8s_scheduler_tpu.core import (
+        build_packed_cycle_carry_fn,
+        build_stable_state_fn,
+    )
+    from k8s_scheduler_tpu.core.cycle import CarryKeeper
+
+    wbuf, bbuf, spec, _vs, _dirty = _contended_workload()
+    stable = build_stable_state_fn(spec)(wbuf, bbuf)
+    ref = None
+    for d in (1, 2, 4, 8):
+        mesh = make_mesh(jax.devices()[:d]) if d > 1 else None
+        cyc = build_packed_cycle_carry_fn(
+            spec, mesh=mesh,
+            rounds_kw=(
+                {"compact_gather": "onehot"} if mesh is not None
+                else None
+            ),
+        )
+        keeper = CarryKeeper(spec, mesh=mesh)
+        carry = keeper.ci(wbuf, bbuf, stable)
+        out = cyc(wbuf, bbuf, stable, carry)
+        a = np.asarray(out.assignment)
+        nr = np.asarray(out.node_requested)
+        if ref is None:
+            ref = (a, nr)
+            assert (a >= 0).sum() > 30, "trace places a real workload"
+        else:
+            assert (a == ref[0]).all(), (
+                f"{d}-device placements diverged at "
+                f"{np.flatnonzero(a != ref[0])[:8]}"
+            )
+            assert (nr == ref[1]).all(), (
+                f"{d}-device node_requested not bit-identical"
+            )
+
+
+# ---- serving layer: bind streams + state digests + stamping --------------
+
+
+def _drive(shard_devices: int, metrics=None):
+    from k8s_scheduler_tpu.config import SchedulerConfiguration
+    from k8s_scheduler_tpu.core.scheduler import Scheduler
+    from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+    binds = []
+    # deterministic LOGICAL clock: backoff expiries / attempt stamps
+    # land in the state digest, so both drives must see identical time
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.001
+        return t[0]
+
+    sched = Scheduler(
+        config=SchedulerConfiguration(shard_devices=shard_devices),
+        binder=lambda p, n: binds.append((p.name, n)),
+        metrics=metrics,
+        now=clock,
+    )
+    for n in make_cluster(12, taint_fraction=0.2):
+        sched.on_node_add(n)
+    for i in range(3):
+        for p in make_pods(
+            60, seed=10 + i, name_prefix=f"c{i}-",
+            selector_fraction=0.3, toleration_fraction=0.3,
+            anti_affinity_fraction=0.2,
+        ):
+            sched.on_pod_add(p)
+        sched.schedule_cycle()
+    return binds, sched
+
+
+def _digest(sched) -> str:
+    from k8s_scheduler_tpu.state.codec import state_digest
+
+    return state_digest(sched.queue, sched.cache)
+
+
+def test_scheduler_shard_devices_bind_stream_and_digest_invariant(
+    tmp_path,
+):
+    from k8s_scheduler_tpu.metrics import SchedulerMetrics
+
+    m = SchedulerMetrics()
+    b0, s0 = _drive(0)
+    b4, s4 = _drive(4, metrics=m)
+    assert len(b0) > 100  # the trace binds a real workload
+    assert b0 == b4, "sharded bind stream diverged from single-device"
+    assert _digest(s0) == _digest(s4)
+    assert s0.n_devices == 1 and s4.n_devices == 4
+    # flight records carry the mesh width; single-device stamps 1
+    for sched, want in ((s0, 1), (s4, 4)):
+        recs = sched.flight.to_dicts(last=1)
+        assert recs[-1]["counts"]["n_devices"] == want
+        assert "collective_payload_bytes" in recs[-1]["counts"]
+    # metric families on the sharded scheduler's registry
+    text = m.expose().decode()
+    assert "scheduler_shard_devices 4.0" in text
+    # the payload gauge family exists even before an AOT probe runs
+    assert "scheduler_collective_payload_bytes" in text
+    # /debug/state surfacing rides the DurableState pin
+    from k8s_scheduler_tpu.state import DurableState
+
+    st = DurableState(str(tmp_path / "state"))
+    st.sharding = s4._shard_status
+    status = st.status()
+    assert status["sharding"]["n_devices"] == 4
+    assert status["sharding"]["mesh"] == {"pods": 4}
+    st.seal()
+
+
+def test_shard_devices_validation():
+    from k8s_scheduler_tpu.config import SchedulerConfiguration
+    from k8s_scheduler_tpu.core.scheduler import Scheduler
+
+    with pytest.raises(ValueError, match="only .* device"):
+        Scheduler(config=SchedulerConfiguration(shard_devices=512))
+    with pytest.raises(ValueError, match="divide the pod pad bucket"):
+        Scheduler(config=SchedulerConfiguration(shard_devices=3))
+
+
+def test_compile_cache_key_distinguishes_sharded_builds():
+    """Satellite 6: the persistent-cache key must never alias a sharded
+    build with the single-device build of the same regime — the mesh
+    field (derived from argument shardings) and the mesh-descriptor
+    program names both separate them."""
+    from k8s_scheduler_tpu.core import compile_cache as cc
+    from k8s_scheduler_tpu.core.cycle import _mesh_desc
+
+    k_plain = cc.cache_key(_FakeSpec(), "default", "cycle", "prog")
+    k_mesh = cc.cache_key(
+        _FakeSpec(), "default", "cycle", "prog", mesh="pods4"
+    )
+    assert k_plain.name != k_mesh.name
+    assert "mesh=pods4" in k_mesh.text and "mesh=none" in k_plain.text
+
+    # _args_mesh_desc: sharded argument layouts digest differently
+    mesh = make_mesh(jax.devices()[:4])
+    x = np.arange(64, dtype=np.int32)
+    xs = jax.device_put(x, NamedSharding(mesh, PartitionSpec("pods")))
+    assert cc._args_mesh_desc((jnp.asarray(x),), {}) == "none"
+    d4 = cc._args_mesh_desc((xs,), {})
+    assert d4 != "none"
+    mesh8 = make_mesh(jax.devices()[:8])
+    x8 = jax.device_put(x, NamedSharding(mesh8, PartitionSpec("pods")))
+    assert cc._args_mesh_desc((x8,), {}) != d4
+
+    # the mesh-closure route: program names differ by mesh descriptor
+    assert _mesh_desc(None) == "none"
+    assert _mesh_desc(mesh) == "pods4"
+    assert _mesh_desc(make_mesh(jax.devices()[:8], nodes_axis=2)) == (
+        "pods4,nodes2"
+    )
+
+
+class _FakeSpec:
+    """Just enough PackSpec surface for cache_key."""
+
+    words = (("pod_valid", "int32", (64,), 0),)
+    bools = ()
+    aux = ()
+
+    def key(self):
+        return ("fake",)
+
+
+def test_flight_record_payload_digest_stable():
+    """The serving payload probe and the audit gate share one parser:
+    a synthetic HLO module must round-trip through both identically."""
+    from k8s_scheduler_tpu.parallel import audit
+
+    hlo = "\n".join([
+        "  %ar = f32[100,10]{1,0} all-reduce(f32[100,10]{1,0} %x)",
+        "  %ag = s32[64]{0} all-gather(s32[8]{0} %y)",
+        "  %cp = u8[32]{0} collective-permute(u8[32]{0} %z)",
+        "  %ars = (f32[4]{0}, pred[8]{0}) all-reduce-start(...)",
+        "  %unrelated = f32[9]{0} add(f32[9]{0} %a, f32[9]{0} %b)",
+    ])
+    colls = audit.parse_collectives(hlo)
+    assert [c.base_op for c in colls] == [
+        "all-reduce", "all-gather", "collective-permute", "all-reduce",
+    ]
+    assert colls[0].bytes == 100 * 10 * 4
+    assert colls[2].bytes == 32  # u8 counts 1 byte under real widths
+    assert colls[2].flat4 == 32 * 4  # r05-comparable flat model
+    assert colls[3].elems == 12  # tuple result, async -start form
+    total = audit.collective_payload_bytes(hlo)
+    assert total == sum(c.bytes for c in colls)
+    digest = hashlib.sha256(str(total).encode()).hexdigest()
+    assert len(digest) == 64  # parser output is deterministic
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_diff_gates_sharded_metrics(tmp_path):
+    """bench_diff gates config 8 directionally: scaling_efficiency may
+    not drop, collective_payload_mb may not rise; artifacts predating
+    config 8 (r05) still diff clean against new ones."""
+    base = {
+        "config": 8, "name": "sharded_scale",
+        "scaling_efficiency": 0.8, "collective_payload_mb": 3.7,
+        "per_device_ms": 50.0, "p50_ms": 0.0,
+    }
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(base))
+    worse = dict(base)
+    worse["scaling_efficiency"] = 0.4  # -50% efficiency
+    worse["collective_payload_mb"] = 40.0  # the diet regressed
+    new.write_text(json.dumps(worse))
+    diff = os.path.join(_REPO, "scripts", "bench_diff.py")
+    same = subprocess.run(
+        [sys.executable, diff, str(old), str(old)],
+        capture_output=True, text=True,
+    )
+    assert same.returncode == 0, same.stdout + same.stderr
+    reg = subprocess.run(
+        [sys.executable, diff, "--json", str(old), str(new)],
+        capture_output=True, text=True,
+    )
+    assert reg.returncode == 1, reg.stdout + reg.stderr
+    regressed = {
+        c["metric"] for c in json.loads(reg.stdout)["regressions"]
+    }
+    assert {"scaling_efficiency", "collective_payload_mb"} <= regressed
+    # backward compatibility: an r05 artifact (no config 8 rows) diffs
+    # clean against a new artifact that has them
+    r05 = os.path.join(_REPO, "BENCH_r05.json")
+    back = subprocess.run(
+        [sys.executable, diff, r05, str(new)],
+        capture_output=True, text=True,
+    )
+    assert back.returncode == 0, back.stdout + back.stderr
+
+
+@pytest.mark.slow
+def test_bench_sharded_scale_smoke(monkeypatch):
+    """Bench config 8 end-to-end at a smoke grid: sweeps the virtual
+    devices, asserts the invariance contract internally, and reports
+    the headline keys bench_diff gates."""
+    import bench_suite
+
+    monkeypatch.setenv("BENCH_SHARDED_GRID", "512x128")
+    monkeypatch.setenv("BENCH_SHARDED_DEVICES", "1,2")
+    r = bench_suite.run_sharded_scale_config(snapshots=2)
+    assert r["config"] == 8 and r["name"] == "sharded_scale"
+    assert "scaling_efficiency" in r and r["scaling_efficiency"] > 0
+    assert r["collective_payload_mb"] >= 0
+    assert r["grid"][0]["devices"]["2"]["per_device_ms"] > 0
+    # the 100k x 50k target grid stays documented in CONFIG_SHAPES
+    assert bench_suite.CONFIG_SHAPES[8] == (100000, 50000)
+
+
+def test_budget_checker_flags_unknown_class_and_overrun():
+    from k8s_scheduler_tpu.parallel import audit
+
+    mb = 1024 * 1024
+    clean = {k: 0 for k in audit.COLLECTIVE_BUDGETS}
+    assert audit.check_budgets(clean) == []
+    over = dict(clean)
+    over["claim_sort"] = int(
+        (audit.COLLECTIVE_BUDGETS["claim_sort"] + 1) * mb
+    )
+    assert any("claim_sort" in p for p in audit.check_budgets(over))
+    rogue = dict(clean)
+    rogue["brand_new"] = 1
+    assert any(
+        "not in" in p and "brand_new" in p
+        for p in audit.check_budgets(rogue)
+    )
+    total_buster = {k: 0 for k in audit.COLLECTIVE_BUDGETS}
+    total_buster["static_base"] = int(
+        (audit.TOTAL_BUDGET_MB + 1) * mb
+    )
+    assert any(
+        "total collective payload" in p
+        for p in audit.check_budgets(total_buster)
+    )
