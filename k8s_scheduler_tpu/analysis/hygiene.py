@@ -31,6 +31,7 @@ from .registry import PassBase
 SCRIPT_ALLOWLIST = frozenset({
     "scripts/audit_sharded.py",   # compile-only collective-budget gate
     "scripts/bench_diff.py",      # BENCH artifact CI tripwire
+    "scripts/fuzz_scheduler.py",  # scenario-fuzzer differential soak
     "scripts/lint_metrics.py",    # metric-inventory shim (tests)
     "scripts/probe_pipeline.py",  # CPU-runnable pipeline smoke probe
     "scripts/schedlint.py",       # this framework's CLI

@@ -38,12 +38,22 @@ the full pipeline without operator action.
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time as _time
 from typing import Callable
 
 log = logging.getLogger("k8s_scheduler_tpu.degrade")
+
+# Bounded transition-log depth: a long-lived process under a persistent
+# fault degrades every cycle, and the ISSUE-8 list grew one dict per
+# degrade forever. The ring keeps the recent window the soaks and bench
+# config 7 read for MTTR; the exact lifetime counts live in the
+# `degradations` / `transitions_total` counters and the
+# `scheduler_degradation_transitions_total` metric, which never lose
+# precision to the cap.
+TRANSITIONS_CAP = 512
 
 # The ladder, top first. Index IS the rung number; schedlint ID007 pins
 # the README rung table to this literal tuple.
@@ -95,10 +105,15 @@ class DegradationLadder:
         self._events = events
         self._observer = observer
         self._on_transition = on_transition
-        # transition log (bounded implicitly by soak length; soaks and
-        # bench config 7 read it for MTTR): each entry carries both
-        # clocks so recovery time is measurable in wall seconds
-        self.transitions: list[dict] = []
+        # transition log (soaks and bench config 7 read it for MTTR):
+        # each entry carries both clocks so recovery time is measurable
+        # in wall seconds. A bounded ring (ISSUE 11 satellite): a
+        # process degrading every cycle for weeks must not grow one
+        # dict per fault — `transitions_total` keeps the exact count.
+        self.transitions: "collections.deque[dict]" = collections.deque(
+            maxlen=TRANSITIONS_CAP
+        )
+        self.transitions_total = 0
         self.degradations = 0
         if metrics is not None:
             metrics.degradation_rung.set(0)
@@ -108,7 +123,11 @@ class DegradationLadder:
     def degrade(self, reason: str, seq: int = -1) -> int:
         """Step one rung DOWN (toward stateless); returns the new rung.
         At the bottom rung further failures re-emit the event/anomaly
-        (the operator must see continued failures) without moving."""
+        (the operator must see continued failures) without moving — and
+        RE-FIRE `on_transition` (ISSUE 11 satellite): the rung's side
+        effects (the retrace memo clear) must be re-applied under
+        continued failure, or a stale executable installed after the
+        last clear survives into every subsequent retry."""
         with self._lock:
             old = self.rung
             new = min(old + 1, len(RUNGS) - 1)
@@ -155,7 +174,9 @@ class DegradationLadder:
             "t": _time.perf_counter(),
             "wall": _time.time(),
         }
-        self.transitions.append(entry)
+        with self._lock:
+            self.transitions.append(entry)
+            self.transitions_total += 1
         # direction comes from the CALLER's intent, not old/new order:
         # a degrade() at the sticky bottom rung keeps old == new, and
         # inferring direction from the comparison would report those
@@ -192,7 +213,12 @@ class DegradationLadder:
                 reason=reason[:300],
             )
         cb = self._on_transition
-        if cb is not None and new != old:
+        # the hook fires on every rung CHANGE and on every sticky-bottom
+        # degrade repeat (old == new, down): continued failure must
+        # re-apply the rung's actions (retrace re-clears the program
+        # memos), not only re-emit telemetry. Promotions always change
+        # the rung, so `down` can't double-fire them.
+        if cb is not None and (new != old or down):
             try:
                 cb(old, new, reason)
             except Exception:
@@ -217,7 +243,9 @@ class DegradationLadder:
                 "promote_after": self.promote_after,
                 "degradations": self.degradations,
                 "last_reason": self.last_reason,
-                "transitions": len(self.transitions),
+                # exact lifetime count — the ring below may have evicted
+                "transitions": self.transitions_total,
+                "transitions_buffered": len(self.transitions),
             }
 
     def recovery_episodes_ms(self) -> list[float]:
@@ -226,7 +254,12 @@ class DegradationLadder:
         and soak_chaos report."""
         out: list[float] = []
         down_t: "float | None" = None
-        for e in self.transitions:
+        # snapshot under the lock: iterating the live deque while the
+        # scheduling loop appends raises (a list raced benignly here;
+        # a deque does not)
+        with self._lock:
+            transitions = list(self.transitions)
+        for e in transitions:
             if e["from"] == RUNG_NORMAL and e["to"] > RUNG_NORMAL:
                 if down_t is None:
                     down_t = e["t"]

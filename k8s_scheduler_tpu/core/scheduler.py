@@ -1924,8 +1924,11 @@ class Scheduler:
         """Apply a rung's side effects (runs outside the ladder lock).
         Rungs `sequential` and `forced_sync` are read at dispatch time;
         only `retrace` (clear+rebuild) and `stateless` (seal for
-        failover) act here."""
-        if new > old and new >= RUNG_RETRACE:
+        failover) act here. A sticky-bottom repeat arrives as
+        old == new (the ladder re-fires the hook under continued
+        failure): the retrace clear runs again so no executable
+        installed since the last clear survives into the next retry."""
+        if new >= old and new >= RUNG_RETRACE:
             # the regime-wide clear_cache+retrace recovery: drop every
             # memoized program set (with its jit caches and installed
             # AOT executables) so the next cycle re-traces from scratch.

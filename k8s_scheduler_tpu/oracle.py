@@ -19,6 +19,7 @@ import dataclasses
 import math
 from typing import Sequence
 
+from .config.types import _DEFAULT_FILTERS as _FILTER_ORDER
 from .models import api
 from .models.api import (
     Affinity,
@@ -520,6 +521,88 @@ DEFAULT_FILTERS = (
     filter_inter_pod_affinity,
     filter_topology_spread,
 )
+
+# Plugin names aligned 1:1 with DEFAULT_FILTERS, imported from the ONE
+# inventory of record (config/types._DEFAULT_FILTERS — the framework's
+# Filter execution order and therefore the column order of the kernels'
+# reject-count tables). The trace-level differential (fuzz/) compares
+# unschedulable REASONS tuples, so this alignment is load-bearing: a
+# second hand-written copy here would drift the moment the plugin list
+# changes and read as a phantom engine divergence.
+FILTER_PLUGIN_NAMES = tuple(_FILTER_ORDER)
+
+# name lookup for REASONS labeling: keyed by the filter FUNCTION so a
+# caller passing a custom `filters` subset gets each filter's own name
+# (zip against the full inventory would silently shift labels), and an
+# unknown custom filter fails loudly with a KeyError
+_FILTER_NAME_OF = dict(zip(DEFAULT_FILTERS, FILTER_PLUGIN_NAMES))
+assert len(_FILTER_NAME_OF) == len(FILTER_PLUGIN_NAMES) == len(
+    DEFAULT_FILTERS
+), "oracle filters and config/types._DEFAULT_FILTERS drifted"
+
+
+# Filters whose kernel plugin implements a STATIC mask
+# (framework/plugins.py): the node-only predicates, NodePorts (existing
+# pods' ports are stable-side), and VolumeBinding (pre-cycle
+# availability). NodeResourcesFit, InterPodAffinity and
+# PodTopologySpread define ONLY dyn_mask — their whole constraint
+# (existing pods included) evaluates in the dynamic phase, so the
+# attribution mirror must not let them first-reject a node statically.
+_STATIC_PART_FILTERS = frozenset({
+    filter_node_unschedulable,
+    filter_node_name,
+    filter_taint_toleration,
+    filter_node_affinity,
+    filter_node_ports,
+    filter_volume_binding,
+})
+
+
+def attribute_rejects(
+    pod: Pod,
+    pre_state: OracleState,
+    dyn_state: OracleState,
+    filters=DEFAULT_FILTERS,
+) -> list[int]:
+    """First-rejector counts per filter, mirroring the kernels'
+    attribution structure (framework.runtime.Framework.static + dyn):
+    TWO phases per node, matching each plugin's static/dynamic split
+    in framework/plugins.py:
+
+    1. first filter WITH A STATIC PART (`_STATIC_PART_FILTERS`) whose
+       check fails against `pre_state` — the static-table attribution;
+       wholly-dynamic plugins (resources fit, inter-pod affinity,
+       topology spread) are skipped here even when the pre-state alone
+       would reject, because the kernel evaluates their entire
+       constraint as a dynamic mask;
+    2. for statically-feasible nodes only, first filter in full order
+       whose check fails against `dyn_state` — the state the engine's
+       dynamic masks actually saw: the pod's OWN scan step for the
+       fused scan program (greedy_commit evaluates dyn_fn at the pod's
+       turn, with earlier placements INCLUDING later-gang-unwound
+       ones), the final post-cycle state for the rounds/diagnosis
+       programs. Static-only predicates can never newly fail here, and
+       a ports/volume conflict with EXISTING pods was already taken in
+       phase 1, so running the full combined checks reproduces the
+       kernel's per-plugin dyn increments.
+    """
+    counts = [0] * len(filters)
+    for i in range(len(pre_state.nodes)):
+        statically_rejected = False
+        for fi, f in enumerate(filters):
+            if f not in _STATIC_PART_FILTERS:
+                continue
+            if not f(pod, pre_state, i):
+                counts[fi] += 1
+                statically_rejected = True
+                break
+        if statically_rejected:
+            continue
+        for fi, f in enumerate(filters):
+            if not f(pod, dyn_state, i):
+                counts[fi] += 1
+                break
+    return counts
 
 
 # --------------------------------------------------------------------------
@@ -1026,6 +1109,9 @@ def schedule_with_gangs(
     pod_groups: Sequence[api.PodGroup] = (),
     weights: "OracleWeights | None" = None,
     filters=None,
+    pvcs: Sequence = (),
+    pvs: Sequence = (),
+    storage_classes: Sequence = (),
 ) -> tuple[list[OracleDecision], list[int]]:
     """schedule() then the all-or-nothing gang unwind (Coscheduling
     analogue, core/cycle.py gang_scheduling): groups whose placed-member
@@ -1033,7 +1119,25 @@ def schedule_with_gangs(
     (decisions, dropped pod indices)."""
     weights = weights or OracleWeights()
     filters = filters or DEFAULT_FILTERS
-    decisions = schedule(nodes, pending, existing, weights, filters)
+    decisions = schedule(
+        nodes, pending, existing, weights, filters, pvcs, pvs,
+        storage_classes,
+    )
+    return gang_unwind(decisions, existing, pod_groups)
+
+
+def gang_unwind(
+    decisions: "list[OracleDecision]",
+    existing: Sequence[tuple[Pod, str]],
+    pod_groups: Sequence[api.PodGroup],
+) -> tuple[list[OracleDecision], list[int]]:
+    """The all-or-nothing rollback on its own: groups whose placed
+    count (plus already-running members) stays below minMember have
+    every placement unwound. Factored out of schedule_with_gangs so
+    trace replay can keep the PRE-unwind decisions (the scan's turn
+    states saw unwound pods as placed). Returns a NEW decisions list
+    plus the dropped indices; the input list is not mutated."""
+    decisions = list(decisions)
     min_member = {g.name: g.min_member for g in pod_groups}
     placed_count: dict[str, int] = {}
     for p, _node in existing:  # running members count toward minMember
@@ -1104,6 +1208,7 @@ def preempt(
     storage_classes: Sequence = (),
     budget: int | None = None,
     scan_budget: int | None = None,
+    excluded: Sequence[int] = (),
 ) -> list[OraclePreemption]:
     """Sequential preemption over the unschedulable pods in queue order,
     mirroring ops/preemption.py's semantics: per node, victims are a prefix
@@ -1143,8 +1248,14 @@ def preempt(
     nominated_ports: list[set] = [set() for _ in nodes]
     out: list[OraclePreemption] = []
 
+    # `excluded` mirrors the kernel's run_preemption(excluded=...) mask:
+    # gang-dropped members fit without eviction — their group is what
+    # failed — so they never preempt (upstream never runs PostFilter for
+    # Permit rejections)
+    excluded_set = set(excluded)
     unsched = [pi for pi in queue_order(pending)
                if decisions[pi].node_index < 0
+               and pi not in excluded_set
                and pending[pi].spec.preemption_policy != "Never"]
     # ---- per-cycle latency budgets (ops/preemption.py mirror) ----
     # `budget`: only the lowest-rank `budget` candidates are considered
@@ -1331,3 +1442,104 @@ def schedule(
         if best >= 0:
             state.add(best, pod)
     return [OracleDecision(pending[i], decisions[i]) for i in range(len(pending))]
+
+
+# --------------------------------------------------------------------------
+# Trace semantics (the fuzz/ differential's per-cycle ground truth)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class OracleCycleOutcome:
+    """Everything ONE scheduling cycle decides, oracle-side — the unit
+    the trace-level differential (fuzz/replay.py) compares against the
+    live Scheduler's apply phase:
+
+    - `decisions`: per pending index, the chosen node (-1 = unplaced),
+      gang rollbacks applied;
+    - `dropped`: pending indices unwound by the all-or-nothing gang
+      check (their reasons are ("Coscheduling",));
+    - `reasons`: unplaced index -> rejecting plugin names, first-
+      rejector attribution against the FINAL post-cycle state (the
+      diagnosis-program mirror) — these drive the queueing hints, so
+      they must match the engine's bit-exactly for the two queues to
+      evolve identically;
+    - `preemptions`: nominations + victims for the unplaced pods,
+      gang-dropped excluded, under the kernel's production budgets.
+    """
+
+    decisions: "list[OracleDecision]"
+    dropped: "list[int]"
+    reasons: "dict[int, tuple[str, ...]]"
+    preemptions: "list[OraclePreemption]"
+
+
+def schedule_cycle_trace(
+    nodes: Sequence[Node],
+    pending: Sequence[Pod],
+    existing: Sequence[tuple[Pod, str]] = (),
+    *,
+    pod_groups: Sequence[api.PodGroup] = (),
+    pvcs: Sequence = (),
+    pvs: Sequence = (),
+    storage_classes: Sequence = (),
+    pdbs: Sequence = (),
+    gang_scheduling: bool = True,
+    weights: "OracleWeights | None" = None,
+    filters=None,
+    budget: "int | None" = None,
+    scan_budget: "int | None" = None,
+) -> OracleCycleOutcome:
+    """One full scheduling cycle under trace semantics: sequential
+    greedy scheduling, gang unwind, FailedScheduling attribution, and
+    the preemption pass — the oracle half of the fuzz differential.
+    Callers that replay multi-cycle traces own the queue/cache state
+    between cycles (fuzz/replay.py drives the SAME SchedulingQueue /
+    SchedulerCache classes the live Scheduler uses, so the differential
+    isolates the decision engine, not the host bookkeeping)."""
+    weights = weights or OracleWeights()
+    filters = filters or DEFAULT_FILTERS
+    raw = schedule(
+        nodes, pending, existing, weights, filters, pvcs, pvs,
+        storage_classes,
+    )
+    if gang_scheduling:
+        decisions, dropped = gang_unwind(raw, existing, pod_groups)
+    else:
+        decisions, dropped = list(raw), []
+    # FailedScheduling attribution replays the scan: phase B of
+    # attribute_rejects must see the state AT THE POD'S TURN — earlier
+    # placements only, gang-unwound pods still placed (the fused scan
+    # program computes dyn rejects per scan step, before the unwind).
+    # `pre` is the pre-cycle (existing-only) state the STATIC half
+    # sees; `turn` walks the scan in queue order using the PRE-unwind
+    # decisions, claims folding rank-ordered (the shared binder-choice
+    # rule).
+    pre = OracleState.build(nodes, existing, pvcs, pvs, storage_classes)
+    turn = OracleState.build(nodes, existing, pvcs, pvs, storage_classes)
+    dropped_set = set(dropped)
+    reasons: dict[int, tuple[str, ...]] = {}
+    for pi in queue_order(pending):
+        if raw[pi].node_index >= 0:
+            if pi in dropped_set:
+                reasons[pi] = ("Coscheduling",)
+            turn.add(raw[pi].node_index, pending[pi])
+            continue
+        counts = attribute_rejects(pending[pi], pre, turn, filters)
+        reasons[pi] = tuple(
+            _FILTER_NAME_OF[f]
+            for f, c in zip(filters, counts)
+            if c > 0
+        )
+    # the preemption pass consumes the POST-unwind state (the kernel's
+    # node_requested is rolled back by _gang_unwind before run_preemption)
+    post = OracleState.build(nodes, existing, pvcs, pvs, storage_classes)
+    for pi in queue_order(pending):
+        if decisions[pi].node_index >= 0:
+            post.add(decisions[pi].node_index, pending[pi])
+    preemptions = preempt(
+        nodes, pending, existing, decisions, post, pdbs=pdbs,
+        pvcs=pvcs, pvs=pvs, storage_classes=storage_classes,
+        budget=budget, scan_budget=scan_budget, excluded=dropped,
+    )
+    return OracleCycleOutcome(decisions, dropped, reasons, preemptions)

@@ -76,6 +76,18 @@ _SLOW_TESTS = {
     "test_warm_restart_compiles_zero_programs",
     "test_speculative_precompile_wins_the_flip",
     "test_regime_churn_soak_zero_compile_stalls",
+    # scenario-fuzzer live differential smoke (ISSUE 11): each case is
+    # a full trace replay through a fresh Scheduler (engine compile) —
+    # and for the differential cases a second, oracle-side replay. The
+    # corpus replays and shrinker units stay fast-tier: minimal-repro
+    # traces compile tiny programs the persistent cache keeps warm.
+    "test_fuzz_differential_plain_seed",
+    "test_fuzz_differential_multicycle_seed",
+    "test_fuzz_differential_sharded_seed",
+    "test_fuzz_chaos_seed",
+    "test_fuzz_catches_seeded_tiebreak_bug",
+    "test_corpus_repro_still_catches_its_bug",
+    "test_fuzz_soak_smoke",
 }
 _SLOW_MODULES = {"tests.test_concurrency"}
 

@@ -189,6 +189,74 @@ def test_ladder_floor_pins_promotion():
     assert lad.rung == len(RUNGS) - 2
 
 
+def test_ladder_sticky_bottom_reapplies_rung_actions():
+    """PR 8 ladder finding 1 (ISSUE 11 satellite): a degrade() at the
+    sticky bottom rung kept old == new and skipped on_transition, so
+    the retrace action was never re-applied under continued failure.
+    The hook must fire on every DOWN call, sticky repeats included —
+    and promotions must still fire only on a real rung change."""
+    calls: list[tuple[int, int]] = []
+    lad = DegradationLadder(
+        promote_after=1, on_transition=lambda o, n, r: calls.append((o, n))
+    )
+    bottom = len(RUNGS) - 1
+    for _ in range(bottom):
+        lad.degrade("cascade")
+    assert calls == [(i, i + 1) for i in range(bottom)]
+    calls.clear()
+    lad.degrade("still failing")  # sticky repeat AT the bottom
+    assert calls == [(bottom, bottom)], (
+        "sticky-bottom degrade must re-fire on_transition"
+    )
+    calls.clear()
+    lad.note_clean_cycle()  # promotion: exactly one hook call, changed rung
+    assert calls == [(bottom, bottom - 1)]
+
+
+def test_scheduler_sticky_retrace_reclears_program_memos():
+    """The scheduler-side half of finding 1: the retrace action (clear
+    every program memo) runs again on a sticky-bottom repeat, so an
+    executable installed after the last clear cannot survive into the
+    next retry."""
+    from k8s_scheduler_tpu.core.scheduler import Scheduler
+
+    sched = Scheduler(binder=lambda p, n: None)
+    bottom = len(RUNGS) - 1
+    sched._packed[("stale-regime", "default-scheduler")] = {"fns": ()}
+    sched._mc_fns[("stale-regime", "default-scheduler")] = {"fns": ()}
+    sched._dev_stable[("stale", 0, 0)] = (None, None)
+    sched._on_rung_transition(bottom, bottom, "still failing")
+    assert not sched._packed and not sched._mc_fns
+    assert not sched._dev_stable
+    # ...and a promotion (new < old) must NOT clear a live regime
+    sched._packed[("live-regime", "default-scheduler")] = {"fns": ()}
+    sched._on_rung_transition(bottom, bottom - 1, "promoted")
+    assert sched._packed
+
+
+def test_ladder_transitions_are_a_bounded_ring():
+    """PR 8 ladder finding 2 (ISSUE 11 satellite): `transitions` grew
+    one dict per degrade forever in a long-lived process. It is now a
+    bounded ring; the exact lifetime counts ride the counters."""
+    from k8s_scheduler_tpu.core.degrade import TRANSITIONS_CAP
+
+    lad = DegradationLadder(promote_after=1)
+    n = TRANSITIONS_CAP + 100
+    for _ in range(n):
+        lad.degrade("storm")
+        lad.note_clean_cycle()
+    # every degrade and every promotion transitioned; the ring holds
+    # only the recent window, the counters stay exact
+    assert len(lad.transitions) == TRANSITIONS_CAP
+    assert lad.transitions_total > TRANSITIONS_CAP
+    assert lad.degradations == n
+    st = lad.status()
+    assert st["transitions"] == lad.transitions_total
+    assert st["transitions_buffered"] == TRANSITIONS_CAP
+    # MTTR episodes stay measurable over the buffered window
+    assert lad.recovery_episodes_ms()
+
+
 def test_observer_raise_anomaly_refuses_unknown_class():
     obs = CycleObserver()
     with pytest.raises(ValueError):
