@@ -24,6 +24,16 @@ Passes (see each module's docstring for codes):
   documentation drift (absorbs scripts/lint_metrics.py)
 - HYGIENE        (hygiene.py)          HY0xx — unused module-level
   imports
+- ROBUSTNESS     (robustness.py)       RB0xx — broad exception handlers
+  must leave a trace (or carry an inventoried justification)
+- THREADS        (threads.py)          TR003 — thread-role inference +
+  spawned threads need a join/drain story
+- RACES          (races.py)            TR001/2/4 — cross-role unlocked
+  writes, whole-tree lock-order cycles, serve-loop blocking under
+  contended locks
+- SHARD-SAFETY   (shard_safety.py)     SH0xx — the PR 9 shard-exactness
+  rules: argsel reduces, no axis-0 concat of sharded vectors, specs
+  only via mesh_pin
 """
 
 from .core import (

@@ -223,7 +223,7 @@ class CodeIndex:
         module; otherwise it is `symbol` inside `module`. Only aliases
         that resolve into the indexed file set are kept."""
         out: dict[str, tuple[str, str | None]] = {}
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if isinstance(node, ast.Import):
                 for a in node.names:
                     if a.name in self._modules:
@@ -405,6 +405,31 @@ class CodeIndex:
         result = frozenset(out - {f.id})
         self._resolved[f.id] = result
         return result
+
+    def resolve_callback(self, f: FuncInfo, target) -> set[str]:
+        """Resolve a callback EXPRESSION (a jit first-arg, a
+        Thread(target=...), an observer/scrape registration) to function
+        ids: bare names through the scope chain, lambdas by position,
+        dotted chains through the precision ladder, functools.partial by
+        unwrapping its first argument. One ladder shared by every
+        root-discovery consumer so their resolution cannot drift."""
+        if target is None:
+            return set()
+        if isinstance(target, ast.Name):
+            return self.resolve_name(f, target.id)
+        if isinstance(target, ast.Lambda):
+            info = self.func_at(f.file.rel, target)
+            return {info.id} if info is not None else set()
+        if isinstance(target, ast.Attribute):
+            chain = attribute_chain(target)
+            if chain is not None:
+                return self.resolve_chain(f, chain)
+            return set()
+        if isinstance(target, ast.Call):
+            fchain = attribute_chain(target.func)
+            if fchain and fchain[-1] == "partial" and target.args:
+                return self.resolve_callback(f, target.args[0])
+        return set()
 
     def reachable(self, roots: Iterable[str]) -> set[str]:
         seen: set[str] = set()

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -47,25 +48,52 @@ class Finding:
         """Baseline identity: line-independent (see module docstring)."""
         return (self.file, self.code, self.message)
 
+    def fingerprint(self) -> str:
+        """Stable finding id for cross-PR diffing: a short digest of the
+        line-independent baseline identity (file-relative, so a repo
+        checked out anywhere produces the same fingerprint). Two
+        identical findings share a fingerprint — diff tools count
+        occurrences, exactly like apply_baseline does."""
+        return hashlib.sha256(
+            "|".join(self.key()).encode()
+        ).hexdigest()[:12]
+
     def to_dict(self) -> dict[str, Any]:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint()
+        return d
 
     def __str__(self) -> str:
         return f"{self.file}:{self.line}: {self.code} {self.message}"
 
 
 class SourceFile:
-    """One parsed module: path, text, AST, and its suppression table."""
+    """One parsed module: path, text, AST, and its suppression table.
+
+    Parsed exactly once per distinct content per process — load_tree
+    serves repeats from a content-verified module-level cache, so a
+    multi-invocation session (the `--changed` pre-commit loop, the
+    fixture-heavy test suite) never re-parses an unchanged file.
+    `walk()` is the shared whole-tree node list every pass iterates
+    instead of re-running `ast.walk` per pass."""
 
     def __init__(self, path: str, rel: str, text: str) -> None:
         self.path = path
         self.rel = rel.replace(os.sep, "/")
         self.text = text
         self.tree = ast.parse(text, filename=rel)
+        self._nodes: list[ast.AST] | None = None
         # line -> set of codes (or {"all"}); "file" key = whole-file codes
         self.line_suppressions: dict[int, set[str]] = {}
         self.file_suppressions: set[str] = set()
         self._parse_suppressions()
+
+    def walk(self) -> list[ast.AST]:
+        """Every AST node of the module, in `ast.walk` order, computed
+        once and shared by all passes (read-only by contract)."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
 
     @property
     def module(self) -> str:
@@ -105,6 +133,11 @@ class SourceFile:
         return bool(codes and ({code, "all"} & codes))
 
 
+# the default lint roots — ALSO consumed by scripts/schedlint.py's
+# --changed filter, so the two surfaces cannot drift
+DEFAULT_PATHS = ("k8s_scheduler_tpu", "scripts")
+
+
 def load_tree(
     root: str, paths: Iterable[str] | None = None
 ) -> list[SourceFile]:
@@ -112,7 +145,7 @@ def load_tree(
     `root`; default: the k8s_scheduler_tpu package + scripts/)."""
     root = os.path.abspath(root)
     if paths is None:
-        paths = ["k8s_scheduler_tpu", "scripts"]
+        paths = DEFAULT_PATHS
     out: list[SourceFile] = []
     for p in paths:
         full = p if os.path.isabs(p) else os.path.join(root, p)
@@ -136,11 +169,34 @@ def load_tree(
     return out
 
 
+# (abs path, rel) -> SourceFile: the process-wide parse cache. The hit
+# test compares the just-read TEXT against the cached one — stat-only
+# identity (mtime_ns, size) can serve a stale AST when a same-length
+# rewrite lands within one filesystem timestamp tick, and the read is
+# cheap next to the parse + suppression scan it saves. Bounded LRU
+# (refresh-on-hit): fixture-heavy test runs lint hundreds of throwaway
+# tmp-dir trees whose keys never hit again — without the cap they (and
+# their walk()-materialized node lists) would pin memory for the whole
+# process, and without the refresh they would evict the live repo.
+_PARSE_CACHE: dict[tuple[str, str], SourceFile] = {}
+_PARSE_CACHE_CAP = 1024
+
+
 def _load_one(root: str, full: str) -> SourceFile:
+    rel = os.path.relpath(full, root)
     with open(full, encoding="utf-8") as f:
         text = f.read()
-    rel = os.path.relpath(full, root)
-    return SourceFile(full, rel, text)
+    key = (os.path.abspath(full), rel)
+    hit = _PARSE_CACHE.get(key)
+    if hit is not None and hit.text == text:
+        del _PARSE_CACHE[key]
+        _PARSE_CACHE[key] = hit
+        return hit
+    sf = SourceFile(full, rel, text)
+    _PARSE_CACHE[key] = sf
+    while len(_PARSE_CACHE) > _PARSE_CACHE_CAP:
+        _PARSE_CACHE.pop(next(iter(_PARSE_CACHE)))
+    return sf
 
 
 class LintContext:

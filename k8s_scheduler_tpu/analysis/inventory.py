@@ -45,6 +45,18 @@ in BOTH directions:
          "## Multi-chip and multi-host" budget table — a class or axis
          renamed without its doc row silently un-classifies the very
          collectives the payload diet bounds
+- ID009  the finding-code inventory: every code registered by every
+         pass (registry.all_codes) must appear in the README
+         "## Static analysis" pass/code table, and every code-shaped
+         token in that table must name a registered code — the table
+         is where operators look up what a CI failure means, so a pass
+         added without its row (or a row for a deleted code) rots the
+         one documentation surface the lint itself points at. Range
+         notation (`TS001`-`TS004`) covers the codes between its
+         endpoints. Checked against the DEFAULT registry (out-of-tree
+         registries document themselves); gated like HY003 — fixture
+         trees without the section are only judged when they carry the
+         real registry module
 
 The metric-registry half (ID001) imports the live package; pass
 `{"metrics_runtime": False}` to skip it when linting fixture trees.
@@ -127,6 +139,8 @@ class InventoryDriftPass(PassBase):
         "ID008": "sharded-collective budget inventory drifted between "
                  "audit.COLLECTIVE_BUDGETS, mesh.MESH_AXES, and the "
                  "README budget table",
+        "ID009": "finding-code inventory drifted between the pass "
+                 "registry and the README Static-analysis table",
     }
 
     def run(self, ctx: LintContext) -> list[Finding]:
@@ -151,6 +165,7 @@ class InventoryDriftPass(PassBase):
         findings += self._check_compile_key(ctx)
         findings += self._check_rungs(ctx)
         findings += self._check_collective_budgets(ctx)
+        findings += self._check_code_table(ctx)
         return findings
 
     @staticmethod
@@ -181,7 +196,7 @@ class InventoryDriftPass(PassBase):
     def _yaml_keys(sf) -> dict[str, int]:
         """Top-level `data.get("...")` keys in load_config -> lineno."""
         out: dict[str, int] = {}
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not (
                 isinstance(node, ast.FunctionDef)
                 and node.name == "load_config"
@@ -233,7 +248,7 @@ class InventoryDriftPass(PassBase):
     def _cli_flags(sf) -> dict[str, int]:
         """'--flag-name' -> lineno for every add_argument call."""
         out: dict[str, int] = {}
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
@@ -251,7 +266,7 @@ class InventoryDriftPass(PassBase):
         dests = {
             flag[2:].replace("-", "_") for flag in flags
         }
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not isinstance(node, ast.Attribute):
                 continue
             if (
@@ -607,6 +622,68 @@ class InventoryDriftPass(PassBase):
                     'documented in the README "## Multi-chip and '
                     'multi-host" section',
                 ))
+        return findings
+
+    # ---- ID009: finding-code inventory -----------------------------------
+
+    _REGISTRY_ANCHOR = "k8s_scheduler_tpu/analysis/registry.py"
+    # the historical family prefixes: the phantom-row check only treats
+    # tokens with one of these prefixes as finding codes, so prose like
+    # "SHA256" in the section can never read as a stale row — while a
+    # wholesale-deleted family's leftover rows are still caught
+    _CODE_FAMILIES = ("TS", "LD", "JE", "ID", "HY", "RB", "TR", "SH")
+    _CODE_RANGE_RE = re.compile(
+        r"\b([A-Z]{2,3})(\d{3})`?\s*[-–]\s*`?\1(\d{3})\b"
+    )
+
+    def _check_code_table(self, ctx: LintContext) -> list[Finding]:
+        from .registry import all_codes
+
+        path = os.path.join(ctx.root, "README.md")
+        if not os.path.exists(path):
+            return []
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        m = re.search(
+            r"^## Static analysis\b(.*?)(?=^## |\Z)", text, re.M | re.S
+        )
+        if m is None:
+            # gated like HY003: only the real tree (which carries the
+            # registry module) owes the README a Static-analysis table
+            if ctx.file(self._REGISTRY_ANCHOR) is not None:
+                return [Finding(
+                    self._REGISTRY_ANCHOR, 1, "ID009",
+                    'README.md has no "## Static analysis" section '
+                    "documenting the pass/code table",
+                )]
+            return []
+        section = m.group(1)
+        registered = set(all_codes())
+        prefixes = sorted(
+            set(self._CODE_FAMILIES)
+            | {re.match(r"[A-Z]+", c).group() for c in registered}
+        )
+        token_re = re.compile(
+            rf"\b(?:{'|'.join(prefixes)})\d{{3}}\b"
+        )
+        documented = set(token_re.findall(section))
+        # expand `TS001`-`TS004`-style ranges to the codes between
+        for prefix, lo, hi in self._CODE_RANGE_RE.findall(section):
+            for n in range(int(lo), int(hi) + 1):
+                documented.add(f"{prefix}{n:03d}")
+        findings: list[Finding] = []
+        for code in sorted(registered - documented):
+            findings.append(Finding(
+                self._REGISTRY_ANCHOR, 1, "ID009",
+                f"finding code {code!r} is registered but missing from "
+                'the README "## Static analysis" pass/code table',
+            ))
+        for code in sorted(documented - registered):
+            findings.append(Finding(
+                self._REGISTRY_ANCHOR, 1, "ID009",
+                f'the README "## Static analysis" table documents '
+                f"{code!r}, which no registered pass defines: stale row",
+            ))
         return findings
 
     # ---- ID001: metric inventory (runtime) -------------------------------

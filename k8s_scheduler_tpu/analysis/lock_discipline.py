@@ -56,6 +56,29 @@ _BLOCKING_CHAINS = {
 }
 
 
+def blocking_effect(
+    chain: tuple[str, ...], aliases: dict
+) -> tuple[str, tuple[str, ...] | None] | None:
+    """(description, waits-on lock CHAIN or None) when `chain` names a
+    known blocking primitive, else None. The ONE classification ladder
+    shared by LOCK-DISCIPLINE and RACES — a new blocking primitive (or
+    an aliasing fix like the time.sleep handling) lands in both passes
+    at once. Callers turn the waits-on chain into their own lock
+    identity (ranked here, class-qualified in races.py)."""
+    if chain == ("open",):
+        return "open()", None
+    if chain in _BLOCKING_CHAINS:
+        return _BLOCKING_CHAINS[chain], None
+    if (
+        len(chain) == 2 and aliases.get(chain[0]) == "time"
+        and chain[1] == "sleep"
+    ) or (len(chain) == 1 and aliases.get(chain[0]) == "time.sleep"):
+        return "time.sleep", None
+    if len(chain) >= 2 and chain[-1] == "wait":
+        return f"{'.'.join(chain)} wait", chain[:-1]
+    return None
+
+
 def lock_identity(
     chain: tuple[str, ...], rel: str
 ) -> str | None:
@@ -236,33 +259,14 @@ class LockDisciplinePass(PassBase):
         chain = attribute_chain(node.func)
         if chain is None:
             return
-        # direct blocking primitives
-        if chain == ("open",):
-            self._note_blocking(
-                f, "open()", None, node.lineno, held, findings, summary
-            )
-            return
-        if chain in _BLOCKING_CHAINS:
-            self._note_blocking(
-                f, _BLOCKING_CHAINS[chain], None, node.lineno, held,
-                findings, summary,
-            )
-            return
+        # direct blocking primitives (ladder shared with RACES)
         aliases = self._time_aliases.get(f.file.rel, {})
-        if (
-            len(chain) == 2 and aliases.get(chain[0]) == "time"
-            and chain[1] == "sleep"
-        ) or (len(chain) == 1 and aliases.get(chain[0]) == "time.sleep"):
+        eff = blocking_effect(chain, aliases)
+        if eff is not None:
+            desc, wchain = eff
+            lock = lock_identity(wchain, f.file.rel) if wchain else None
             self._note_blocking(
-                f, "time.sleep", None, node.lineno, held, findings,
-                summary,
-            )
-            return
-        if len(chain) >= 2 and chain[-1] == "wait":
-            lock = lock_identity(chain[:-1], f.file.rel)
-            self._note_blocking(
-                f, f"{'.'.join(chain)} wait", lock, node.lineno, held,
-                findings, summary,
+                f, desc, lock, node.lineno, held, findings, summary
             )
             return
         # callee resolution within the scoped file set

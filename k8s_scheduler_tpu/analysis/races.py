@@ -1,0 +1,376 @@
+"""RACES (TR0xx, concurrency half): cross-role writes, lock-order
+cycles, serve-loop blocking under contended locks.
+
+The Python analogue of running the reference kube-scheduler's CI under
+`go test -race`: the thread-role model from analysis/threads.py (which
+thread executes which function, propagated over the shared call graph)
+is intersected with lock_discipline.py's STRUCTURAL lock identities —
+extended from the state//internal/ dirs to the whole tree (core/,
+service/, cmd/, parallel/, scripts/) — to flag the three shapes every
+PR since 3 has had to review by hand:
+
+- TR001  a shared `self.<attr>` written under >= 2 roles with no lock
+         identity common to every write site. Writes in `__init__` are
+         construction (the threads do not exist yet) and exempt.
+         Single-writer seqlock publications (FlightRecorder) and
+         join-ordered handoffs (Journal.close after the writer join)
+         are INVENTORIED with `# schedlint: disable=TR001 -- why`, the
+         RB001 vocabulary — new unlocked cross-role writes cannot land
+         without a reviewed justification.
+- TR002  a lock-order inversion ANYWHERE in the tree: lock A taken
+         while B is held somewhere and B taken while A is held
+         somewhere else (the generalization of LD001 beyond the ranked
+         queue -> cache -> journal order; ranked-pair inversions stay
+         LD001's jurisdiction so one bug does not fire twice).
+- TR004  a blocking call (fsync / sleep / file I/O / cond-wait /
+         device fetch) on the SERVE-LOOP role while holding a lock a
+         non-serve role also acquires — the shape that turns a slow
+         disk or a wedged tunnel into a stalled serve loop AND a
+         stalled background thread at once.
+
+Lock identity is lock_discipline.lock_identity, qualified by the
+enclosing class for unranked `self._lock`-style chains (two classes in
+one file each with their own `_lock` are different locks; the ranked
+queue/cache/journal identities still unify across spellings like
+`self._lock` in queue.py vs `self._queue._lock` in manager.py).
+
+Effects are propagated interprocedurally: a callee's transitive lock
+acquisitions and blocking calls are charged to each call site with the
+caller's held-lock set, exactly like lock_discipline — but resolved
+through the precise call graph (lexical scope, import aliases,
+self/cls methods) instead of the scoped by-name table.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .callgraph import CodeIndex, FuncInfo, attribute_chain
+from .core import Finding, LintContext
+from .lock_discipline import _RANK, blocking_effect, lock_identity
+from .registry import PassBase
+from .threads import thread_roles
+from .trace_safety import _module_aliases
+
+# device->host fetches: the serve loop's one sanctioned blocking wait —
+# blocking, but only TR004-relevant when a lock is held around them
+_FETCH_CHAINS = {
+    ("jax", "device_get"): "jax.device_get",
+    ("jax", "block_until_ready"): "jax.block_until_ready",
+}
+
+
+def _qualified_lock(
+    chain: tuple[str, ...], f: FuncInfo
+) -> str | None:
+    lock = lock_identity(chain, f.file.rel)
+    if lock is None:
+        return None
+    if lock not in _RANK and chain and chain[0] in ("self", "cls") \
+            and f.cls is not None:
+        # class-qualify unranked instance locks so CompileWarmer._lock
+        # and PodTimelines._lock (one file each) never alias
+        return f"{lock}@{f.cls}"
+    return lock
+
+
+@dataclasses.dataclass
+class _Effects:
+    """One function's transitive lock/blocking/write effects."""
+
+    acquires: set[str] = dataclasses.field(default_factory=set)
+    # (description, waits_on_or_None)
+    blocking: set[tuple[str, str | None]] = dataclasses.field(
+        default_factory=set
+    )
+
+
+class _TreeWalker:
+    """Whole-tree lock-aware walker: per function, records attribute
+    writes, acquisition-order edges, and blocking sites, each with the
+    held-lock set at that point."""
+
+    def __init__(self, ctx: LintContext) -> None:
+        self.ctx = ctx
+        self.index: CodeIndex = ctx.index
+        # (class_or_file, attr) -> [(fid, line, frozenset(held))]
+        self.writes: dict[tuple[str, str], list] = {}
+        # (outer_lock, inner_lock) -> first (file, line, qualname)
+        self.order_edges: dict[tuple[str, str], tuple] = {}
+        # fid -> [(desc, line, frozenset(held), waits_on)]
+        self.blocking_sites: dict[str, list] = {}
+        # lock -> set of fids that (transitively) acquire it
+        self.acquired_by: dict[str, set[str]] = {}
+        self._effects: dict[str, _Effects] = {}
+        self._in_progress: set[str] = set()
+        self._aliases = {
+            sf.rel: _module_aliases(sf, {"time": "time"})
+            for sf in ctx.files
+        }
+
+    def run(self) -> None:
+        for fid in sorted(self.index.funcs):
+            self._effects_of(self.index.funcs[fid])
+
+    # ---- per-function ----------------------------------------------------
+
+    def _effects_of(self, f: FuncInfo) -> _Effects:
+        hit = self._effects.get(f.id)
+        if hit is not None:
+            return hit
+        if f.id in self._in_progress:  # recursion: break the cycle
+            return _Effects()
+        self._in_progress.add(f.id)
+        eff = _Effects()
+        body = [f.node.body] if isinstance(f.node, ast.Lambda) \
+            else list(f.node.body)
+        self._walk(f, body, [], eff)
+        self._in_progress.discard(f.id)
+        self._effects[f.id] = eff
+        for lock in eff.acquires:
+            self.acquired_by.setdefault(lock, set()).add(f.id)
+        return eff
+
+    def _walk(
+        self, f: FuncInfo, nodes: list, held: list[str], eff: _Effects
+    ) -> None:
+        for node in nodes:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                       ast.ClassDef)
+            ):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                cur = list(held)
+                for item in node.items:
+                    self._walk(f, [item.context_expr], cur, eff)
+                    chain = attribute_chain(item.context_expr)
+                    lock = _qualified_lock(chain, f) if chain else None
+                    if lock is not None:
+                        self._note_acquire(f, lock, node.lineno, cur, eff)
+                        cur = cur + [lock]
+                self._walk(f, list(node.body), cur, eff)
+                continue
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in ("self", "cls")
+                    ):
+                        owner = f.cls or f.file.rel
+                        self.writes.setdefault(
+                            (owner, t.attr), []
+                        ).append((f.id, t.lineno, frozenset(held)))
+            if isinstance(node, ast.Call):
+                self._classify_call(f, node, held, eff)
+            self._walk(f, list(ast.iter_child_nodes(node)), held, eff)
+
+    def _note_acquire(
+        self, f: FuncInfo, lock: str, line: int, held: list[str],
+        eff: _Effects,
+    ) -> None:
+        eff.acquires.add(lock)
+        if lock in held:
+            return  # re-entrant (RLocks)
+        for h in held:
+            if h != lock:
+                self.order_edges.setdefault(
+                    (h, lock), (f.file.rel, line, f.qualname)
+                )
+
+    def _note_blocking(
+        self, f: FuncInfo, desc: str, waits_on: str | None, line: int,
+        held: list[str], eff: _Effects,
+    ) -> None:
+        eff.blocking.add((desc, waits_on))
+        self.blocking_sites.setdefault(f.id, []).append(
+            (desc, line, frozenset(held), waits_on)
+        )
+
+    def _classify_call(
+        self, f: FuncInfo, node: ast.Call, held: list[str], eff: _Effects
+    ) -> None:
+        chain = attribute_chain(node.func)
+        if chain is None:
+            return
+        aliases = self._aliases.get(f.file.rel, {})
+        # the ladder shared with LOCK-DISCIPLINE, with the waits-on
+        # chain qualified through THIS pass's class-aware identity
+        shared = blocking_effect(chain, aliases)
+        if shared is not None:
+            desc, wchain = shared
+            lock = _qualified_lock(wchain, f) if wchain else None
+            self._note_blocking(f, desc, lock, node.lineno, held, eff)
+            return
+        if chain in _FETCH_CHAINS:
+            self._note_blocking(
+                f, _FETCH_CHAINS[chain], None, node.lineno, held, eff
+            )
+            return
+        if (
+            len(chain) >= 2 and chain[-1] == "join"
+            and chain[-2] not in ("path", "sep", "linesep")
+            and chain[:2] != ("os", "path")
+        ):
+            # thread-join blocking; the excluded bases are the string
+            # joins (os.path.join and separator variables) that would
+            # otherwise poison every path-building serve function
+            self._note_blocking(
+                f, f"{'.'.join(chain)} join", None, node.lineno, held, eff
+            )
+            return
+        # interprocedural: charge callee effects to this call site
+        targets = self.index.resolve_chain(f, chain)
+        for tid in sorted(targets):
+            target = self.index.funcs.get(tid)
+            if target is None or target.id == f.id:
+                continue
+            teff = self._effects_of(target)
+            for lock in sorted(teff.acquires):
+                self._note_acquire(f, lock, node.lineno, held, eff)
+            for desc, waits_on in sorted(
+                teff.blocking, key=lambda x: (x[0], x[1] or "")
+            ):
+                # the callee's own sites already recorded it for the
+                # callee; here it matters only if WE hold locks
+                if held:
+                    self._note_blocking(
+                        f, f"{desc} (via {target.qualname})", waits_on,
+                        node.lineno, held, eff,
+                    )
+                else:
+                    eff.blocking.add((desc, waits_on))
+
+
+class RacesPass(PassBase):
+    name = "RACES"
+    codes = {
+        "TR001": "shared attribute written under >= 2 thread roles "
+                 "with no common lock held",
+        "TR002": "lock-order inversion (A->B and B->A observed, "
+                 "beyond the LD001 ranked order)",
+        "TR004": "serve-loop blocking call while holding a lock "
+                 "another thread role contends",
+    }
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        _sites, role_of = thread_roles(ctx)
+        walker = _TreeWalker(ctx)
+        walker.run()
+        findings: list[Finding] = []
+        findings += self._tr001(ctx, walker, role_of)
+        findings += self._tr002(walker)
+        findings += self._tr004(ctx, walker, role_of)
+        return findings
+
+    # ---- TR001 -----------------------------------------------------------
+
+    def _tr001(self, ctx, walker, role_of) -> list[Finding]:
+        index = ctx.index
+        findings: list[Finding] = []
+        for (owner, attr), sites in sorted(walker.writes.items()):
+            by_fn: dict[str, list] = {}
+            roles: set[str] = set()
+            for fid, line, held in sites:
+                f = index.funcs[fid]
+                if f.name == "__init__":
+                    continue  # construction precedes every spawn
+                rs = role_of.get(fid)
+                if not rs:
+                    continue
+                roles |= rs
+                by_fn.setdefault(fid, []).append((line, held))
+            if len(roles) < 2:
+                continue
+            common = None
+            for fid, recs in by_fn.items():
+                for _line, held in recs:
+                    common = set(held) if common is None \
+                        else common & held
+            if common:
+                continue  # every write site holds a shared lock
+            for fid in sorted(by_fn):
+                f = index.funcs[fid]
+                line = min(l for l, _h in by_fn[fid])
+                findings.append(Finding(
+                    f.file.rel, line, "TR001",
+                    f"{f.qualname} writes {owner}.{attr}, which is "
+                    f"written under roles {{{', '.join(sorted(roles))}}} "
+                    "with no lock identity common to every write site: "
+                    "a cross-thread write-write race unless ordering is "
+                    "guaranteed elsewhere (then inventory it: "
+                    "# schedlint: disable=TR001 -- why)",
+                ))
+        return findings
+
+    # ---- TR002 -----------------------------------------------------------
+
+    def _tr002(self, walker) -> list[Finding]:
+        findings: list[Finding] = []
+        for (a, b), (file, line, qual) in sorted(
+            walker.order_edges.items()
+        ):
+            if (b, a) not in walker.order_edges:
+                continue
+            if a in _RANK and b in _RANK:
+                continue  # the ranked order is LD001's jurisdiction
+            ofile, _oline, oqual = walker.order_edges[(b, a)]
+            # the opposite site is named by file+qualname only: a line
+            # number here would break the line-independent baseline/
+            # fingerprint identity on every unrelated edit above it
+            findings.append(Finding(
+                file, line, "TR002",
+                f"{qual} acquires {b} while holding {a}, but "
+                f"{oqual} ({ofile}) acquires {a} while "
+                f"holding {b}: an ABBA deadlock the moment the two "
+                "paths run on different threads",
+            ))
+        return findings
+
+    # ---- TR004 -----------------------------------------------------------
+
+    def _tr004(self, ctx, walker, role_of) -> list[Finding]:
+        index = ctx.index
+        # lock -> roles that (transitively) acquire it
+        lock_roles: dict[str, set[str]] = {}
+        for lock, fids in walker.acquired_by.items():
+            for fid in fids:
+                lock_roles.setdefault(lock, set()).update(
+                    role_of.get(fid, ())
+                )
+        findings: list[Finding] = []
+        emitted: set[tuple] = set()
+        for fid, sites in sorted(walker.blocking_sites.items()):
+            if "serve" not in role_of.get(fid, ()):
+                continue
+            f = index.funcs[fid]
+            for desc, line, held, waits_on in sites:
+                contended = sorted(
+                    h for h in held
+                    if h != waits_on
+                    and (lock_roles.get(h, set()) - {"serve"})
+                )
+                if not contended:
+                    continue
+                key = (f.file.rel, line, desc)
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                others = sorted(set().union(*(
+                    lock_roles.get(h, set()) for h in contended
+                )) - {"serve"})
+                findings.append(Finding(
+                    f.file.rel, line, "TR004",
+                    f"{f.qualname} (serve-loop role) makes a blocking "
+                    f"call ({desc}) while holding "
+                    f"{' + '.join(contended)}, which "
+                    f"{{{', '.join(others)}}} also acquire"
+                    f"{'s' if len(others) == 1 else ''}: a slow call "
+                    "here stalls the serve loop AND every thread "
+                    "waiting on that lock",
+                ))
+        return findings

@@ -52,7 +52,10 @@ def default_registry() -> PassRegistry:
     from .inventory import InventoryDriftPass
     from .journal_emit import JournalEmitOncePass
     from .lock_discipline import LockDisciplinePass
+    from .races import RacesPass
     from .robustness import RobustnessPass
+    from .shard_safety import ShardSafetyPass
+    from .threads import ThreadsPass
     from .trace_safety import TraceSafetyPass
 
     r = PassRegistry()
@@ -63,6 +66,9 @@ def default_registry() -> PassRegistry:
         InventoryDriftPass,
         HygienePass,
         RobustnessPass,
+        ThreadsPass,
+        RacesPass,
+        ShardSafetyPass,
     ):
         r.register(cls.name, lambda args, _cls=cls: _cls(args))
     return r

@@ -95,7 +95,7 @@ class RobustnessPass(PassBase):
             segments = sf.rel.split("/")[:-1]
             if not _TARGET_SEGMENTS & set(segments):
                 continue
-            for node in ast.walk(sf.tree):
+            for node in sf.walk():
                 if not isinstance(node, ast.ExceptHandler):
                     continue
                 if not _is_broad_handler(node):

@@ -1,0 +1,314 @@
+"""THREADS (TR0xx, lifecycle half): thread-role inference + TR003.
+
+Since PR 3 the scheduler is a multi-threaded host program: the journal
+writer, the decision-fetch watchdog worker, the compile warmer, the
+lease renewer, and the HTTP metrics/debug server all run concurrently
+with the serve loop, plus observer hooks and scrape-time gauge closures
+that execute on whichever thread publishes or scrapes. Their safety
+rests on convention; this module turns the conventions into a machine-
+checked role model shared by the RACES pass (analysis/races.py).
+
+Role inference (`thread_roles`), all structural — no imports of the
+analyzed code:
+
+- every `threading.Thread(target=f, name="...")` creation site seeds a
+  role (named by the thread-name literal when given, else the target's
+  name) rooted at the resolved target — `Thread(target=...)` first-args
+  count as called (analysis/callgraph.py), and the role set rides the
+  same resolution;
+- methods of `BaseHTTPRequestHandler` subclasses seed the `httpserver`
+  role (the stdlib server invokes them on its own threads, so the
+  Thread-target walk alone cannot reach them);
+- callables registered via `<x>.observers.append(f)` seed `observer`
+  (FlightRecorder publish-time hooks);
+- callables registered via `.set_function(f)` seed `scrape` (gauges
+  evaluated on the scraping thread, i.e. under the HTTP server);
+- functions named `schedule_cycle`, or a method named `Cycle`, seed
+  `serve` — the serve-loop entry points (the gRPC Cycle RPC drives
+  Scheduler.schedule_cycle).
+
+Roles propagate interprocedurally over the shared call graph; a
+function reachable from two roles carries both (that is the point —
+it is the code two threads can execute concurrently).
+
+TR003 (this pass): a spawned thread must have a join / drain-exit /
+lazy-respawn story — the CompileWarmer leak class, caught by hand in
+PR 7 review. A `threading.Thread(...)` whose object is (a) dropped on
+the floor, or (b) stored but never `.join()`ed anywhere in its module
+and never cleared (`<attr> = None` — the drain-exit/abandon pattern of
+CompileWarmer._run and _FetchWorker.run) is flagged at the creation
+site. `daemon=True` alone is NOT a story: a daemon HTTP thread still
+holds its socket until process exit (the cmd/httpserver.py instance
+this rule was written against).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .callgraph import CodeIndex, FuncInfo, attribute_chain, own_body_nodes
+from .core import Finding, LintContext
+from .registry import PassBase
+
+# serve-loop entry points (see module docstring): the gRPC Cycle RPC
+# and the Scheduler cycle driver it serializes
+SERVE_ENTRY_FUNCTIONS = frozenset({"schedule_cycle"})
+SERVE_ENTRY_METHODS = frozenset({"Cycle"})
+
+
+@dataclasses.dataclass
+class ThreadSite:
+    """One `threading.Thread(...)` creation site."""
+
+    file: str  # repo-relative
+    lineno: int
+    role: str  # thread-name literal or target name
+    target_ids: frozenset[str]  # resolved target function ids
+    daemon: bool
+    # where the Thread object went: ("attr", name) for self.X = Thread,
+    # ("name", name) for x = Thread, or None when dropped
+    stored: tuple[str, str] | None
+    creator: str  # qualname of the creating function ("<module>" at top)
+
+
+def _thread_call(node: ast.AST) -> ast.Call | None:
+    """The Call node when `node` constructs a threading.Thread."""
+    if not isinstance(node, ast.Call):
+        return None
+    chain = attribute_chain(node.func)
+    if chain and chain[-1] == "Thread":
+        return node
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _module_shim(sf) -> FuncInfo:
+    return FuncInfo(
+        id=f"{sf.rel}::<module>", file=sf, node=sf.tree,
+        name="<module>", qualname="<module>", cls=None,
+        parent=None, lineno=1,
+    )
+
+
+def _frames(index: CodeIndex):
+    """Every (FuncInfo, own-body nodes) frame, functions then modules."""
+    for f in index.funcs.values():
+        yield f, own_body_nodes(f.node)
+    for sf in index.files:
+        yield _module_shim(sf), own_body_nodes(sf.tree)
+
+
+def find_thread_sites(ctx: LintContext) -> list[ThreadSite]:
+    index = ctx.index
+
+    def _storage(t: ast.AST) -> tuple[str, str] | None:
+        if isinstance(t, ast.Attribute):
+            return ("attr", t.attr)
+        if isinstance(t, ast.Name):
+            return ("name", t.id)
+        return None
+
+    sites: list[ThreadSite] = []
+    seen_calls: set[tuple[str, int, int]] = set()
+    stored_at: dict[tuple[str, int, int], tuple[str, str]] = {}
+    for f, nodes in _frames(index):
+        for node in nodes:
+            # storage shapes: <target> [= <target2>] = Thread(...), and
+            # the elementwise  a, b = Thread(...), Thread(...)  unpack
+            if isinstance(node, ast.Assign):
+                calls_and_targets: list = []
+                call = _thread_call(node.value)
+                if call is not None:
+                    for t in node.targets:
+                        calls_and_targets.append((call, t))
+                elif (
+                    isinstance(node.value, ast.Tuple)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Tuple)
+                    and len(node.targets[0].elts)
+                    == len(node.value.elts)
+                ):
+                    calls_and_targets = [
+                        (_thread_call(v), t)
+                        for v, t in zip(
+                            node.value.elts, node.targets[0].elts
+                        )
+                    ]
+                for call, t in calls_and_targets:
+                    if call is None:
+                        continue
+                    key = (f.file.rel, call.lineno, call.col_offset)
+                    st = _storage(t)
+                    if st is not None and key not in stored_at:
+                        stored_at[key] = st
+            call = _thread_call(node)
+            if call is None:
+                continue
+            key = (f.file.rel, call.lineno, call.col_offset)
+            if key in seen_calls:
+                continue
+            seen_calls.add(key)
+            stored = stored_at.get(key)
+            target = _kwarg(call, "target")
+            name_v = _kwarg(call, "name")
+            daemon_v = _kwarg(call, "daemon")
+            targets = index.resolve_callback(f, target)
+            role = None
+            if isinstance(name_v, ast.Constant) and isinstance(
+                name_v.value, str
+            ):
+                role = name_v.value
+            elif target is not None:
+                tchain = attribute_chain(target)
+                if tchain:
+                    role = tchain[-1]
+            if role is None:
+                role = f"thread@{f.file.rel}:{call.lineno}"
+            daemon = bool(
+                isinstance(daemon_v, ast.Constant) and daemon_v.value
+            )
+            sites.append(ThreadSite(
+                file=f.file.rel, lineno=call.lineno, role=role,
+                target_ids=frozenset(targets), daemon=daemon,
+                stored=stored, creator=f.qualname,
+            ))
+    sites.sort(key=lambda s: (s.file, s.lineno))
+    return sites
+
+
+def _registration_roots(ctx: LintContext) -> dict[str, set[str]]:
+    """observer / scrape / httpserver / serve role roots."""
+    index = ctx.index
+    roots: dict[str, set[str]] = {
+        "observer": set(), "scrape": set(),
+        "httpserver": set(), "serve": set(),
+    }
+    for f, nodes in _frames(index):
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if (
+                fn.attr == "append"
+                and isinstance(fn.value, ast.Attribute)
+                and fn.value.attr == "observers"
+                and node.args
+            ):
+                roots["observer"] |= index.resolve_callback(
+                    f, node.args[0]
+                )
+            elif fn.attr == "set_function" and node.args:
+                roots["scrape"] |= index.resolve_callback(
+                    f, node.args[0]
+                )
+    for ci in index.subclasses_of("BaseHTTPRequestHandler"):
+        roots["httpserver"].update(ci.methods.values())
+    for fid, f in index.funcs.items():
+        if f.name in SERVE_ENTRY_FUNCTIONS:
+            roots["serve"].add(fid)
+        elif f.cls is not None and f.name in SERVE_ENTRY_METHODS:
+            roots["serve"].add(fid)
+    return roots
+
+
+def thread_roles(
+    ctx: LintContext,
+) -> tuple[list[ThreadSite], dict[str, frozenset[str]]]:
+    """(thread creation sites, function id -> role set), memoized on the
+    context so THREADS and RACES share one computation."""
+    cached = getattr(ctx, "_thread_roles", None)
+    if cached is not None:
+        return cached
+    index = ctx.index
+    sites = find_thread_sites(ctx)
+    roots: dict[str, set[str]] = {}
+    for s in sites:
+        if s.target_ids:
+            roots.setdefault(s.role, set()).update(s.target_ids)
+    for role, ids in _registration_roots(ctx).items():
+        if ids:
+            roots.setdefault(role, set()).update(ids)
+    role_of: dict[str, set[str]] = {}
+    for role, ids in roots.items():
+        for fid in index.reachable(ids):
+            role_of.setdefault(fid, set()).add(role)
+    frozen = {fid: frozenset(rs) for fid, rs in role_of.items()}
+    ctx._thread_roles = (sites, frozen)
+    return ctx._thread_roles
+
+
+class ThreadsPass(PassBase):
+    name = "THREADS"
+    codes = {
+        "TR003": "spawned thread has no join / drain-exit / respawn "
+                 "story (the CompileWarmer leak class)",
+    }
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        sites, _roles = thread_roles(ctx)
+        findings: list[Finding] = []
+        for s in sites:
+            sf = ctx.file(s.file)
+            if sf is None:
+                continue
+            if s.stored is None:
+                findings.append(Finding(
+                    s.file, s.lineno, "TR003",
+                    f"{s.creator} spawns thread {s.role!r} and drops "
+                    "the Thread object: nothing can ever join or drain "
+                    "it — store it and join on shutdown (see "
+                    "CompileWarmer's drain-exit for the lazy-respawn "
+                    "alternative)",
+                ))
+                continue
+            kind, name = s.stored
+            if self._has_lifecycle(sf, kind, name, s):
+                continue
+            findings.append(Finding(
+                s.file, s.lineno, "TR003",
+                f"{s.creator} spawns thread {s.role!r} into "
+                f"{'.' + name if kind == 'attr' else name} but the "
+                "module never joins it and never clears the reference "
+                "(the drain-exit/abandon pattern): the thread leaks "
+                "past shutdown"
+                + (" — daemon=True only hides the leak until process "
+                   "exit" if s.daemon else ""),
+            ))
+        return findings
+
+    @staticmethod
+    def _has_lifecycle(sf, kind: str, name: str, site: ThreadSite) -> bool:
+        """A join (`<...>.name.join(...)` / `name.join(...)`) or a
+        reference clear (`<...>.name = None` / `name = None`) anywhere
+        in the module counts as the lifecycle story. Module-scoped on
+        purpose: shutdown joins usually live in a different method than
+        the spawn (Journal.close vs Journal.append)."""
+        for node in sf.walk():
+            if isinstance(node, ast.Call):
+                chain = attribute_chain(node.func)
+                if chain and len(chain) >= 2 and chain[-1] == "join" \
+                        and chain[-2] == name:
+                    return True
+            elif isinstance(node, ast.Assign):
+                if not (
+                    isinstance(node.value, ast.Constant)
+                    and node.value.value is None
+                ):
+                    continue
+                for t in node.targets:
+                    if kind == "attr" and isinstance(t, ast.Attribute) \
+                            and t.attr == name:
+                        return True
+                    if kind == "name" and isinstance(t, ast.Name) \
+                            and t.id == name:
+                        return True
+        return False

@@ -48,7 +48,7 @@ def _module_aliases(sf: SourceFile, targets: dict[str, str]) -> dict:
     """alias -> canonical target for stdlib-ish modules we care about
     (`targets` maps real module name -> canonical tag)."""
     out: dict[str, str] = {}
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name in targets:
@@ -175,25 +175,10 @@ class TraceSafetyPass(PassBase):
         chain = attribute_chain(node.func)
         if not chain or chain[-1] not in _JIT_NAMES or not node.args:
             return set()
-        return self._resolve_target(index, f, node.args[0])
-
-    def _resolve_target(self, index: CodeIndex, f, target) -> set[str]:
-        if isinstance(target, ast.Name):
-            return index.resolve_name(f, target.id)
-        if isinstance(target, ast.Lambda):
-            info = index.func_at(f.file.rel, target)
-            return {info.id} if info is not None else set()
-        if isinstance(target, ast.Attribute):
-            tchain = attribute_chain(target)
-            if tchain is not None:
-                return index.resolve_chain(f, tchain)
-            return set()
-        if isinstance(target, ast.Call):
-            # jax.jit(functools.partial(fn, ...)): trace through partial
-            fchain = attribute_chain(target.func)
-            if fchain and fchain[-1] == "partial" and target.args:
-                return self._resolve_target(index, f, target.args[0])
-        return set()
+        # jax.jit(fn) / jax.jit(partial(fn, ...)) / jax.jit(lambda ...):
+        # the one shared callback-resolution ladder (callgraph.py) —
+        # Thread targets and observer registrations resolve identically
+        return index.resolve_callback(f, node.args[0])
 
     # ---- per-function checks ---------------------------------------------
 

@@ -269,8 +269,32 @@ def start_http_server(
             pass
 
     server = ThreadingHTTPServer((host, port), Handler)
-    thread = threading.Thread(
+    # the serve thread is pinned on the server object so shutdown can
+    # JOIN it (stop_http_server): daemon=True alone is not a lifecycle
+    # story — the thread would hold the listening socket until process
+    # exit (schedlint TR003, the CompileWarmer leak class)
+    server._serve_thread = threading.Thread(
         target=server.serve_forever, name="http-metrics", daemon=True
     )
-    thread.start()
+    server._serve_thread.start()
     return server
+
+
+def stop_http_server(server: ThreadingHTTPServer, timeout: float = 5.0) -> bool:
+    """Shut the serve loop down, join its thread, close the listening
+    socket. Returns False when the thread failed to exit within
+    `timeout` (it is daemon, so the process can still exit; the socket
+    is closed either way). Idempotent — the second call is a no-op."""
+    thread = getattr(server, "_serve_thread", None)
+    server.shutdown()
+    if thread is not None:
+        # join the CAPTURED reference: a concurrent second stop may
+        # have already cleared the attribute (both reads raced past the
+        # None check) and joining through it again would be a crash
+        thread.join(timeout)
+        alive = thread.is_alive()
+        server._serve_thread = None
+    else:
+        alive = False
+    server.server_close()
+    return not alive

@@ -17,10 +17,13 @@ from __future__ import annotations
 
 import fcntl
 import json
+import logging
 import os
 import threading
 import time as _time
 from typing import Callable
+
+log = logging.getLogger("k8s_scheduler_tpu.cmd")
 
 
 class FileLease:
@@ -160,7 +163,19 @@ class FileLease:
     def release(self) -> None:
         self._stop.set()
         if self._renewer is not None:
+            # shutdown join (the CompileWarmer drain-exit discipline,
+            # schedlint TR003): the renewer wakes from its stop-Event
+            # wait immediately, so 5s only ever elapses when a
+            # heartbeat write is wedged on dead shared storage — then
+            # say so instead of silently dropping the thread
             self._renewer.join(timeout=5)
+            if self._renewer.is_alive():
+                log.warning(
+                    "lease renewer failed to exit within 5s of "
+                    "release() (heartbeat write wedged?); abandoning "
+                    "the daemon thread — the kernel lock below is "
+                    "still released"
+                )
             self._renewer = None
         with FileLease._held_lock:
             if self._fd is not None:

@@ -16,7 +16,7 @@ import signal
 import threading
 
 from ..config import SchedulerConfiguration, load_config
-from .httpserver import start_http_server
+from .httpserver import start_http_server, stop_http_server
 from .leaderelection import FileLease
 
 
@@ -358,7 +358,10 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         server.stop(grace=2.0)
         if http_server is not None:
-            http_server.shutdown()
+            # shutdown + JOIN + close, not a bare shutdown(): the serve
+            # thread must be drained before the lease release below
+            # hands the socket's port story to a successor
+            stop_http_server(http_server)
         if state is not None:
             # seal the journal: a final clean-shutdown snapshot (same
             # pattern as the --trace-dir dump below) so the next start

@@ -663,12 +663,17 @@ class CompileWarmer:
                 continue
             try:
                 thunk()
-                self.built += 1
+                # counted under the submit lock: the worker respawns, so
+                # a successor thread (or a reader polling built/failed
+                # between respawns) must see each increment whole
+                with self._lock:
+                    self.built += 1
                 m = self._metrics
                 if m is not None:
                     m.compile_cache_speculative.inc()
             except Exception:
-                self.failed += 1
+                with self._lock:
+                    self.failed += 1
                 log.exception(
                     "compile warmer: speculative build %r failed "
                     "(prediction discarded)", key,

@@ -745,7 +745,7 @@ class CycleObserver:
                     for p in PHASES
                     if self.raw[p].n
                 },
-                "slo": self.slo.status(),
+                "slo": self.slo.status(),  # schedlint: disable=TR004 -- by-name fallback: the callee is SloEngine.status (pure dict reads), not the listdir-ing Journal/CompileCache status the resolver also matches
             }
 
     def healthz_detail(self) -> dict[str, Any]:

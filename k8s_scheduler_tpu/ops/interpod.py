@@ -324,7 +324,7 @@ def counts_by_node(snap, state: AffinityState) -> jnp.ndarray:
         nd = snap.node_domains[:, k]  # [N]
         g = state.counts[:, jnp.clip(nd, 0, D - 1)]  # [S, N]
         rows.append(jnp.where((nd >= 0)[None, :], g, -1.0))
-    return jnp.concatenate(rows, axis=0)  # [K*S, N]
+    return jnp.concatenate(rows, axis=0)  # [K*S, N]  # schedlint: disable=SH002 -- 2-D selector-table rows stacked on the K*S axis, which is never mesh-sharded (the PR 9 miscompile needs sharded 1-D operands)
 
 
 def _row_onehot(snap, sel, k) -> jnp.ndarray:  # f32 [P, K*S]
@@ -446,7 +446,7 @@ def spread_minc(snap, state: AffinityState) -> jnp.ndarray:  # f32 [K*S]
             jnp.where(eligible[None, :], state.counts, jnp.inf), axis=1
         )  # [S]
         outs.append(jnp.where(jnp.isfinite(m), m, 0.0))
-    return jnp.concatenate(outs, axis=0)
+    return jnp.concatenate(outs, axis=0)  # schedlint: disable=SH002 -- per-key [S] minima on the replicated selector axis; never pods-sharded
 
 
 def spread_mask_batched(snap, state: AffinityState, cbn,
@@ -564,7 +564,7 @@ def spread_min2(snap, counts):
     for k in range(K):
         eligible = (snap.domain_key == k) & (snap.domain_node_count > 0)
         vals = jnp.where(eligible[None, :], counts, jnp.inf)  # [S, D]
-        a1 = jnp.argmin(vals, axis=1).astype(jnp.int32)  # [S]
+        a1 = jnp.argmin(vals, axis=1).astype(jnp.int32)  # [S]  # schedlint: disable=SH001 -- reduce over the domain axis D, which is never mesh-sharded (MESH_AXES is pods/nodes); counts ties are broken identically on every replica
         m1 = jnp.min(vals, axis=1)
         vals2 = jnp.where(d_ids == a1[:, None], jnp.inf, vals)
         m2 = jnp.min(vals2, axis=1)
@@ -572,7 +572,7 @@ def spread_min2(snap, counts):
         aas.append(a1)
         m2s.append(jnp.where(jnp.isfinite(m2), m2, 1e9))
     return (
-        jnp.concatenate(m1s), jnp.concatenate(aas), jnp.concatenate(m2s)
+        jnp.concatenate(m1s), jnp.concatenate(aas), jnp.concatenate(m2s)  # schedlint: disable=SH002 -- [S] per-key vectors on the replicated selector axis; never pods-sharded
     )
 
 

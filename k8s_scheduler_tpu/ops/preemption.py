@@ -465,7 +465,7 @@ def run_preemption(
             & (ks <= elig[:, None])
         )
         exists = jnp.any(allowed, axis=1)
-        k_min = jnp.argmax(allowed, axis=1).astype(jnp.int32)  # first True
+        k_min = jnp.argmax(allowed, axis=1).astype(jnp.int32)  # first True  # schedlint: disable=SH001 -- reduce over the MPN+1 victim-prefix axis, an inner pad dimension no mesh axis ever shards; first-True over bool is deterministic per row
         # preemption must actually help: new victims >= 1 (a node feasible
         # with zero evictions would have been chosen by the main cycle)
         candidate = (

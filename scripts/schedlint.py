@@ -3,6 +3,7 @@
 
     JAX_PLATFORMS=cpu python scripts/schedlint.py            # lint the tree
     python scripts/schedlint.py --json                       # machine output
+    python scripts/schedlint.py --changed                    # diff-scoped
     python scripts/schedlint.py --passes TRACE-SAFETY        # one pass
     python scripts/schedlint.py --list-codes                 # code inventory
     python scripts/schedlint.py --write-baseline             # regrandfather
@@ -10,6 +11,10 @@
 Exit status: 0 = no unsuppressed, non-baselined findings; 1 = findings;
 2 = usage error. The committed baseline is .schedlint-baseline.json at
 the repo root (line-independent entries; shrink it, don't grow it).
+`--changed` scopes the scan to the .py files git reports modified or
+untracked under the default lint roots — the fast pre-commit loop (the
+parse cache makes repeats near-free); the full-tree run stays the
+tier-1/CI gate, since cross-file inventories can only be judged whole.
 See README "Static analysis" for pass/code docs and the
 `# schedlint: disable=CODE` suppression syntax.
 """
@@ -19,6 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -26,12 +32,42 @@ sys.path.insert(0, REPO)
 
 DEFAULT_BASELINE = os.path.join(REPO, ".schedlint-baseline.json")
 
+def changed_paths(repo: str) -> list[str] | None:
+    """Repo-relative .py files under the lint roots that git reports
+    modified (vs HEAD) or untracked. None when git is unavailable or
+    this is not a work tree (the caller turns that into a usage error —
+    silently linting nothing would be a permanent green). NUL-separated
+    output (-z) so octal-quoted non-ASCII names cannot be dropped."""
+    from k8s_scheduler_tpu.analysis.core import DEFAULT_PATHS
+
+    roots = tuple(p.rstrip("/") + "/" for p in DEFAULT_PATHS)
+    rels: set[str] = set()
+    try:
+        for args in (
+            ["diff", "--name-only", "-z", "HEAD", "--"],
+            ["ls-files", "--others", "--exclude-standard", "-z", "--"],
+        ):
+            out = subprocess.run(
+                ["git", "-C", repo, *args],
+                capture_output=True, text=True, check=True,
+            ).stdout
+            rels.update(r for r in out.split("\0") if r)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return sorted(
+        r for r in rels
+        if r.endswith(".py")
+        and r.startswith(roots)
+        and os.path.exists(os.path.join(repo, r))
+    )
+
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="schedlint",
         description="repo-native static analysis (trace safety, lock "
-        "discipline, journal emit-once, inventory drift, hygiene)",
+        "discipline, journal emit-once, inventory drift, hygiene, "
+        "robustness, thread lifecycle/races, shard safety)",
     )
     ap.add_argument(
         "paths", nargs="*",
@@ -44,7 +80,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--json", action="store_true",
         help="emit one JSON object (findings + suppressed + "
-        "grandfathered counts) so drivers can diff across PRs",
+        "grandfathered counts; each finding carries a stable "
+        "line-independent fingerprint) so drivers can diff across PRs",
+    )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="lint only the .py files git reports modified/untracked "
+        "under the default roots (fast pre-commit loop; the full-tree "
+        "run stays the CI gate)",
     )
     ap.add_argument(
         "--baseline", default=DEFAULT_BASELINE,
@@ -75,6 +118,34 @@ def main(argv: list[str] | None = None) -> int:
             for code, desc in sorted(p.codes.items()):
                 print(f"  {code}  {desc}")
         return 0
+
+    if args.changed:
+        if args.paths:
+            print(
+                "schedlint: --changed and explicit paths are mutually "
+                "exclusive", file=sys.stderr,
+            )
+            return 2
+        if args.write_baseline:
+            # a baseline written from a subset scan would silently
+            # DELETE every grandfathered entry for unscanned files —
+            # the next full-tree run then fails on all of them
+            print(
+                "schedlint: --write-baseline needs the full-tree scan, "
+                "not --changed", file=sys.stderr,
+            )
+            return 2
+        changed = changed_paths(REPO)
+        if changed is None:
+            print(
+                "schedlint: --changed needs a git work tree",
+                file=sys.stderr,
+            )
+            return 2
+        if not changed:
+            print("schedlint: ok — no changed files under the lint roots")
+            return 0
+        args.paths = changed
 
     passes = None
     if args.passes:

@@ -7,6 +7,8 @@ import os
 import time
 import urllib.request
 
+import pytest
+
 from k8s_scheduler_tpu.cmd import new_scheduler_command
 from k8s_scheduler_tpu.cmd.httpserver import start_http_server
 from k8s_scheduler_tpu.cmd.leaderelection import FileLease
@@ -328,3 +330,54 @@ def test_pad_presizing_flows_from_yaml_to_encoder():
     assert snap.exist_valid.shape[0] == 512  # pow2 bucket of 300
     assert snap.node_pods.shape[1] == 32  # bucket-of-8 ABOVE the pad: a
     # depth within the operator's sizing must never outgrow the regime
+
+
+# ---- thread-lifecycle regressions (schedlint TR003, ISSUE 12) -----------
+
+
+def test_stop_http_server_joins_the_serve_thread():
+    """The HTTP serve thread must have a shutdown JOIN story, not just
+    daemon=True: stop_http_server drains it, closes the socket, and is
+    idempotent (the CompileWarmer-leak class, machine-checked by TR003)."""
+    import urllib.error
+    import urllib.request
+
+    from k8s_scheduler_tpu.cmd.httpserver import stop_http_server
+    from k8s_scheduler_tpu.metrics import SchedulerMetrics
+
+    server = start_http_server(SchedulerMetrics(), port=0)
+    thread = server._serve_thread
+    assert thread is not None and thread.is_alive()
+    port = server.server_address[1]
+    assert stop_http_server(server) is True
+    assert not thread.is_alive()
+    assert server._serve_thread is None
+    # the listening socket is really gone, not merely unaccepted
+    with pytest.raises((urllib.error.URLError, OSError)):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=0.5
+        )
+    # idempotent: a second stop is a no-op, not a crash
+    assert stop_http_server(server) is True
+
+
+def test_lease_release_joins_the_renewer(tmp_path):
+    """FileLease.release must drain the renewer thread (the shutdown
+    join mirroring CompileWarmer's drain-exit), so a released lease
+    leaves no heartbeat writer behind to resurrect the file."""
+    path = str(tmp_path / "lease")
+    lease = FileLease(path, identity="joiner", renew_seconds=0.05)
+    assert lease.try_acquire()
+    lease.start_renewing()
+    renewer = lease._renewer
+    assert renewer is not None and renewer.is_alive()
+    lease.release()
+    assert not renewer.is_alive()
+    assert lease._renewer is None
+    # no post-release heartbeat: the file stops changing once released
+    import os
+    import time as _t
+
+    before = os.stat(path).st_mtime_ns
+    _t.sleep(0.15)
+    assert os.stat(path).st_mtime_ns == before

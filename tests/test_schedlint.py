@@ -925,6 +925,7 @@ def test_registry_mirrors_framework_semantics():
     assert reg.names() == sorted([
         "TRACE-SAFETY", "LOCK-DISCIPLINE", "JOURNAL-EMIT-ONCE",
         "INVENTORY-DRIFT", "HYGIENE", "ROBUSTNESS",
+        "THREADS", "RACES", "SHARD-SAFETY",
     ])
     with pytest.raises(KeyError):
         reg.make("NOPE")
@@ -934,6 +935,9 @@ def test_registry_mirrors_framework_semantics():
         dup.register("X", lambda args: None)
     codes = all_codes(reg)
     assert codes["TS001"].startswith("import executed")
+    # the mesh-era families are registered with their full code span
+    assert {"TR001", "TR002", "TR003", "TR004",
+            "SH001", "SH002", "SH003", "ID009"} <= set(codes)
 
 
 # ---- the tier-1 gate: the real tree lints clean --------------------------
@@ -951,6 +955,11 @@ def test_tree_is_clean():
     # sanity floor only (a typo'd root scanning ~nothing must fail);
     # ISSUE 6 pruned the 25 stale one-off probe scripts, hence not ~100
     assert result.files_scanned > 70
+    # the mesh-era pass families must actually be registered and run —
+    # a green lint that silently dropped THREADS/RACES/SHARD-SAFETY
+    # would be the exact drift this gate exists to catch
+    assert {"THREADS", "RACES", "SHARD-SAFETY", "INVENTORY-DRIFT"} <= \
+        set(result.passes_run)
 
 
 def test_schedlint_cli_json_mode(tmp_path, capsys):
@@ -972,3 +981,614 @@ def test_schedlint_cli_json_mode(tmp_path, capsys):
     # over zero files
     assert mod.main(["k8s_scheduler_tpuu"]) == 2
     capsys.readouterr()
+
+
+# ---- THREADS / RACES (ISSUE 12) ------------------------------------------
+
+
+def test_threads_tr003_lifecycle_stories(tmp_path):
+    """TR003: a spawned thread needs a join, a drain-exit (reference
+    cleared), or it is the CompileWarmer leak class — at the creation
+    line. daemon=True alone is not a story; a dropped Thread object
+    always fires."""
+    result = lint_fixture(tmp_path, {
+        "pkg/workers.py": """\
+            import threading
+
+
+            class Leaky:
+                def spawn(self):
+                    t = threading.Thread(target=self._run, daemon=True)
+                    t.start()
+
+                def _run(self):
+                    pass
+
+
+            class Dropper:
+                def spawn(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    pass
+
+
+            class Joined:
+                def start_worker(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def close(self):
+                    self._t.join()
+
+                def _run(self):
+                    pass
+
+
+            class Drainer:
+                def submit(self):
+                    self._w = threading.Thread(target=self._drain)
+                    self._w.start()
+
+                def _drain(self):
+                    self._w = None
+        """,
+    }, passes=["THREADS"])
+    tr3 = codes_at(result, "TR003")
+    assert [(f.line) for f in tr3] == [6, 15]
+    assert "daemon=True only hides the leak" in tr3[0].message
+    assert "drops the Thread object" in tr3[1].message
+    # Joined (module-level join) and Drainer (drain-exit clear) are clean
+    assert all(f.line not in (23, 35) for f in tr3)
+
+
+def test_threads_tr003_suppression_round_trip(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "pkg/w.py": """\
+            import threading
+
+
+            def fire_and_forget(fn):
+                threading.Thread(target=fn, daemon=True).start()  # schedlint: disable=TR003 -- process-lifetime metrics pump, exits with the interpreter
+        """,
+    }, passes=["THREADS"])
+    assert codes_at(result, "TR003") == []
+    assert len(result.suppressed) == 1
+
+
+_RACE_FIXTURE = """\
+    import threading
+
+
+    class Journal:
+        def emit(self, rec):
+            with self._cond:
+                self._writer = threading.Thread(
+                    target=self._run, name="journal-writer"
+                )
+                self._writer.start()
+            self.tally = 1
+
+        def close(self):
+            self._writer.join()
+
+        def _run(self):
+            self.tally = 2
+
+
+    def schedule_cycle(j):
+        j.emit(1)
+"""
+
+
+def test_races_tr001_cross_role_unlocked_write(tmp_path):
+    """TR001: `tally` is written by the serve role (emit, reached from
+    schedule_cycle) and the journal-writer role (_run, the Thread
+    target) with no common lock — one finding per writing function, at
+    the write line. The role set must name both roles."""
+    result = lint_fixture(
+        tmp_path, {"state/j.py": _RACE_FIXTURE}, passes=["RACES"]
+    )
+    tr1 = codes_at(result, "TR001")
+    assert [f.line for f in tr1] == [11, 17]
+    assert all("journal-writer" in f.message and "serve" in f.message
+               for f in tr1)
+    # the locked variant is clean: both writes under the same cond
+    locked = _RACE_FIXTURE.replace(
+        "            self.tally = 1",
+        "            with self._cond:\n"
+        "                self.tally = 1",
+    ).replace(
+        "            self.tally = 2",
+        "            with self._cond:\n"
+        "                self.tally = 2",
+    )
+    clean = lint_fixture(
+        tmp_path / "locked", {"state/j.py": locked}, passes=["RACES"]
+    )
+    assert codes_at(clean, "TR001") == []
+
+
+def test_races_tr001_init_writes_exempt(tmp_path):
+    """Construction precedes every spawn: __init__ writing the same
+    attribute a thread role writes must NOT count as a second role."""
+    result = lint_fixture(tmp_path, {
+        "core/w.py": """\
+            import threading
+
+
+            class W:
+                def __init__(self):
+                    self.count = 0
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def close(self):
+                    self._t.join()
+
+                def _run(self):
+                    self.count = 1
+
+
+            def schedule_cycle(w):
+                w.close()
+        """,
+    }, passes=["RACES"])
+    assert codes_at(result, "TR001") == []
+
+
+def test_races_tr001_suppression_inventories(tmp_path):
+    suppressed = _RACE_FIXTURE.replace(
+        "            self.tally = 1",
+        "            self.tally = 1  # schedlint: disable=TR001 -- "
+        "seqlock publication: the writer is joined first",
+    ).replace(
+        "            self.tally = 2",
+        "            self.tally = 2  # schedlint: disable=TR001 -- "
+        "single writer in practice",
+    )
+    result = lint_fixture(
+        tmp_path, {"state/j.py": suppressed}, passes=["RACES"]
+    )
+    assert codes_at(result, "TR001") == []
+    assert len(result.suppressed) == 2
+
+
+def test_races_tr002_lock_order_inversion_anywhere(tmp_path):
+    """TR002: A->B in one function and B->A in another is flagged at
+    BOTH inner acquisition sites — in any directory (the LD001
+    generalization); the ranked queue/cache pairs stay LD001's."""
+    result = lint_fixture(tmp_path, {
+        "service/locks.py": """\
+            class A:
+                def one(self):
+                    with self.alpha_lock:
+                        with self.beta_lock:
+                            pass
+
+                def two(self):
+                    with self.beta_lock:
+                        with self.alpha_lock:
+                            pass
+
+                def consistent(self):
+                    with self.alpha_lock:
+                        with self.gamma_lock:
+                            pass
+        """,
+    }, passes=["RACES"])
+    tr2 = codes_at(result, "TR002")
+    assert sorted(f.line for f in tr2) == [4, 9]
+    assert all("ABBA" in f.message for f in tr2)
+    # both-ranked pairs are LD001's jurisdiction, not TR002's
+    ranked = lint_fixture(tmp_path / "ranked", {
+        "service/m.py": """\
+            class M:
+                def good(self):
+                    with self._queue._lock:
+                        with self._cache._lock:
+                            pass
+
+                def bad(self):
+                    with self._cache._lock:
+                        with self._queue._lock:
+                            pass
+        """,
+    }, passes=["RACES"])
+    assert codes_at(ranked, "TR002") == []
+
+
+def test_races_tr004_serve_blocking_under_contended_lock(tmp_path):
+    """TR004: the serve role fsyncs while holding a lock a background
+    role also acquires; the same blocking under an uncontended lock is
+    that function's own business."""
+    result = lint_fixture(tmp_path, {
+        "core/srv.py": """\
+            import os
+            import threading
+
+
+            class S:
+                def worker(self):
+                    with self._lock:
+                        pass
+
+                def start_worker(self):
+                    self._t = threading.Thread(
+                        target=self.worker, name="bg"
+                    )
+                    self._t.start()
+
+                def close(self):
+                    self._t.join()
+
+                def Cycle(self, fh):
+                    with self._lock:
+                        os.fsync(fh)
+                    with self._private_lock:
+                        os.fsync(fh)
+        """,
+    }, passes=["RACES"])
+    tr4 = codes_at(result, "TR004")
+    assert [f.line for f in tr4] == [21]
+    assert "os.fsync" in tr4[0].message and "bg" in tr4[0].message
+    # line 23 (uncontended _private_lock) must not fire
+
+
+def test_thread_roles_ride_the_callgraph(tmp_path):
+    """The shared-callgraph contract under the new consumers: roles
+    propagate through lax.scan/cond callbacks and Thread(target=...)
+    first-args (both count as called), and a helper reachable from two
+    roles only transitively carries both."""
+    from k8s_scheduler_tpu.analysis.core import LintContext, load_tree
+    from k8s_scheduler_tpu.analysis.threads import thread_roles
+
+    (tmp_path / "prog.py").write_text(textwrap.dedent("""\
+        import threading
+
+        import jax
+
+
+        def shared_helper(x):
+            return x
+
+
+        def scan_body(c, x):
+            return c, shared_helper(x)
+
+
+        def cond_branch(x):
+            return shared_helper(x)
+
+
+        def schedule_cycle(snap, flag):
+            jax.lax.cond(flag, cond_branch, cond_branch, snap)
+            return jax.lax.scan(scan_body, 0, snap)
+
+
+        def writer_loop():
+            shared_helper(1)
+
+
+        def start():
+            t = threading.Thread(target=writer_loop, name="writer")
+            t.start()
+            t.join()
+    """))
+    files = load_tree(str(tmp_path), ["."])
+    ctx = LintContext(str(tmp_path), files)
+    sites, role_of = thread_roles(ctx)
+    (site,) = sites
+    assert site.role == "writer" and site.target_ids
+    # Thread target first-arg: the writer role rides into the target...
+    assert "writer" in role_of["prog.py::writer_loop"]
+    # ...and the scan/cond callbacks carry the serve role
+    assert "serve" in role_of["prog.py::scan_body"]
+    assert "serve" in role_of["prog.py::cond_branch"]
+    # the transitive helper is reachable from BOTH roles
+    assert {"serve", "writer"} <= role_of["prog.py::shared_helper"]
+
+
+def test_races_tr001_seeded_mutation_in_real_journal(tmp_path):
+    """The acceptance-criterion mutation: delete the lock acquisition
+    around state/journal.py's cut() (a cross-role attribute write —
+    the writer's size rotation also bumps _cur_index) and TR001 must
+    fire; the unmutated file stays clean."""
+    src = open(
+        os.path.join(REPO, "k8s_scheduler_tpu/state/journal.py"),
+        encoding="utf-8",
+    ).read()
+    locked = (
+        "        with self._cond:\n"
+        "            if self._cur_count:\n"
+        "                self._cur_index += 1\n"
+        "                self._cur_count = 0\n"
+        "            return self._cur_index\n"
+    )
+    assert locked in src, "journal.cut() changed; update this mutation"
+    unlocked = (
+        "        if self._cur_count:\n"
+        "            self._cur_index += 1\n"
+        "            self._cur_count = 0\n"
+        "        return self._cur_index\n"
+    )
+    mutated = src.replace(locked, unlocked)
+    # a serve-side driver so cut() carries the serve role (in the real
+    # tree that role arrives via DurableState.snapshot)
+    driver = "def schedule_cycle(j):\n    j.cut()\n"
+    bad = lint_fixture(tmp_path, {
+        "state/journal.py": mutated, "state/driver.py": driver,
+    }, passes=["RACES"])
+    line = mutated.splitlines().index(
+        "            self._cur_index += 1"
+    ) + 1
+    tr1 = codes_at(bad, "TR001")
+    assert any(
+        f.line == line and "_cur_index" in f.message for f in tr1
+    ), [str(f) for f in tr1]
+    clean = lint_fixture(tmp_path / "clean", {
+        "state/journal.py": src, "state/driver.py": driver,
+    }, passes=["RACES"])
+    assert not any(
+        "_cur_index" in f.message for f in codes_at(clean, "TR001")
+    )
+
+
+# ---- SHARD-SAFETY --------------------------------------------------------
+
+
+def test_shard_safety_sh001_sh002_mesh_reachable_only(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "pkg/engine.py": """\
+            import jax
+            import jax.numpy as jnp
+
+
+            def rounds_commit(scores, parts):
+                best = jnp.argmax(scores, axis=1)
+                vals, idx = jax.lax.top_k(scores, 4)
+                joined = jnp.concatenate(parts)
+                safe = jnp.concatenate(parts, axis=1)
+                return best, vals, idx, joined, safe
+
+
+            def host_helper(scores):
+                return jnp.argmax(scores)
+        """,
+    }, passes=["SHARD-SAFETY"])
+    sh1 = codes_at(result, "SH001")
+    assert [f.line for f in sh1] == [6, 7]
+    assert "argsel.argmax_first" in sh1[0].message
+    assert "top_k_first" in sh1[1].message
+    (sh2,) = codes_at(result, "SH002")
+    assert sh2.line == 8  # axis=1 on line 9 is exempt
+    # host_helper is NOT reachable from a mesh root: silent
+    assert all(f.line != 14 for f in result.findings)
+
+
+def test_shard_safety_sh001_clean_with_argsel(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "pkg/engine.py": """\
+            from . import argsel
+
+
+            def rounds_commit(scores):
+                return argsel.argmax_first(scores, axis=1)
+        """,
+        "pkg/argsel.py": """\
+            def argmax_first(x, axis=-1):
+                return x
+        """,
+    }, passes=["SHARD-SAFETY"])
+    assert result.findings == []
+
+
+def test_shard_safety_sh003_spec_outside_mesh_module(tmp_path):
+    result = lint_fixture(tmp_path, {
+        "pkg/parallel/mesh.py": """\
+            from jax.sharding import NamedSharding, PartitionSpec
+
+
+            def mesh_pin(arr, mesh, axes):
+                return NamedSharding(mesh, PartitionSpec(*axes))
+        """,
+        "pkg/rogue.py": """\
+            from jax.sharding import PartitionSpec
+
+
+            def layout():
+                return PartitionSpec("pods")
+        """,
+    }, passes=["SHARD-SAFETY"])
+    sh3 = codes_at(result, "SH003")
+    assert [(f.file, f.line) for f in sh3] == [("pkg/rogue.py", 5)]
+    assert "mesh_pin" in sh3[0].message
+
+
+def test_shard_safety_seeded_mutation_in_real_rounds(tmp_path):
+    """The acceptance-criterion mutation: swap ops/rounds.py's
+    shard-invariant shortlist top_k back to raw lax.top_k and SH001
+    must fire at that line; the committed file (with its inventoried
+    suppressions) lints clean."""
+    src = open(
+        os.path.join(REPO, "k8s_scheduler_tpu/ops/rounds.py"),
+        encoding="utf-8",
+    ).read()
+    good = "vals, sl = argsel.top_k_first(scored0, k)  # [B, k]"
+    assert good in src, "rounds.py shortlist changed; update this test"
+    mutated = src.replace(
+        good, "vals, sl = jax.lax.top_k(scored0, k)  # [B, k]"
+    )
+    bad = lint_fixture(
+        tmp_path, {"ops/rounds.py": mutated}, passes=["SHARD-SAFETY"]
+    )
+    line = mutated.splitlines().index(
+        "            vals, sl = jax.lax.top_k(scored0, k)  # [B, k]"
+    ) + 1
+    sh1 = codes_at(bad, "SH001")
+    assert [f.line for f in sh1] == [line]
+    clean = lint_fixture(
+        tmp_path / "clean", {"ops/rounds.py": src},
+        passes=["SHARD-SAFETY"],
+    )
+    assert clean.findings == [], [str(f) for f in clean.findings]
+    assert clean.suppressed  # the inventoried SH002/SH003 sites
+
+
+# ---- ID009: the pass/code table pin --------------------------------------
+
+
+def test_inventory_drift_code_table_id009(tmp_path):
+    from k8s_scheduler_tpu.analysis.registry import all_codes
+
+    codes = sorted(all_codes())
+    # complete table (range notation for TS, singles for the rest)
+    singles = " ".join(c for c in codes if not c.startswith("TS"))
+    clean = lint_fixture(tmp_path / "clean", {
+        "README.md": (
+            "# fixture\n\n## Static analysis\n\n"
+            f"| TRACE-SAFETY | `TS001`–`TS004` | ... |\n{singles}\n"
+            "fingerprints are SHA256-based digests\n"  # prose tokens
+            # outside the code families must never read as stale rows
+        ),
+    }, passes=["INVENTORY-DRIFT"])
+    assert codes_at(clean, "ID009") == []
+
+    # a registered code missing from the table + a stale row both fire
+    partial = " ".join(c for c in codes if c != "SH003")
+    drift = lint_fixture(tmp_path / "drift", {
+        "README.md": (
+            "## Static analysis\n\n" + partial + " TS999\n"
+        ),
+    }, passes=["INVENTORY-DRIFT"])
+    msgs = [f.message for f in codes_at(drift, "ID009")]
+    assert any("'SH003'" in m and "missing" in m for m in msgs)
+    assert any("'TS999'" in m and "stale row" in m for m in msgs)
+    assert len(msgs) == 2
+
+    # no Static-analysis section at all: silent in fixture trees (no
+    # registry module), flagged when the real registry rides along
+    sectionless = lint_fixture(tmp_path / "sectionless", {
+        "README.md": "# no such section\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert codes_at(sectionless, "ID009") == []
+    anchored = lint_fixture(tmp_path / "anchored", {
+        "README.md": "# no such section\n",
+        "k8s_scheduler_tpu/analysis/registry.py": "X = 1\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "Static analysis" in f.message
+        for f in codes_at(anchored, "ID009")
+    )
+
+
+# ---- wall-clock satellites: parse cache, fingerprints, --changed ---------
+
+
+def test_parse_cache_reuses_unchanged_files(tmp_path):
+    from k8s_scheduler_tpu.analysis.core import load_tree
+
+    f = tmp_path / "m.py"
+    f.write_text("X = 1\n")
+    (a,) = load_tree(str(tmp_path), ["."])
+    (b,) = load_tree(str(tmp_path), ["."])
+    assert a is b  # same parse served from the cache
+    assert a.walk() is a.walk()  # the node list is computed once
+    import time as _t
+
+    _t.sleep(0.01)
+    f.write_text("X = 2\n")  # same size — mtime must invalidate
+    (c,) = load_tree(str(tmp_path), ["."])
+    assert c is not a and "X = 2" in c.text
+
+
+def test_finding_fingerprint_stable_and_line_independent():
+    from k8s_scheduler_tpu.analysis.core import Finding
+
+    a = Finding("x.py", 10, "TS001", "msg")
+    b = Finding("x.py", 99, "TS001", "msg")
+    assert a.fingerprint() == b.fingerprint()  # lines churn, id doesn't
+    assert a.to_dict()["fingerprint"] == a.fingerprint()
+    assert a.fingerprint() != Finding("x.py", 10, "TS002", "msg").fingerprint()
+    assert a.fingerprint() != Finding("y.py", 10, "TS001", "msg").fingerprint()
+
+
+def test_schedlint_changed_paths(tmp_path):
+    import importlib.util
+    import subprocess
+
+    path = os.path.join(REPO, "scripts", "schedlint.py")
+    spec = importlib.util.spec_from_file_location("schedlint_cli2", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    repo = tmp_path / "r"
+    (repo / "k8s_scheduler_tpu").mkdir(parents=True)
+    (repo / "scripts").mkdir()
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-C", str(repo), "-c", "user.name=t",
+             "-c", "user.email=t@t", *args],
+            check=True, capture_output=True,
+        )
+
+    git("init", "-q")
+    (repo / "k8s_scheduler_tpu" / "mod.py").write_text("A = 1\n")
+    (repo / "outside.py").write_text("B = 1\n")
+    git("add", "-A")
+    git("commit", "-q", "-m", "seed")
+    assert mod.changed_paths(str(repo)) == []  # clean work tree
+    (repo / "k8s_scheduler_tpu" / "mod.py").write_text("A = 2\n")
+    (repo / "scripts" / "probe.py").write_text("C = 1\n")  # untracked
+    (repo / "outside.py").write_text("B = 2\n")  # outside lint roots
+    assert mod.changed_paths(str(repo)) == [
+        "k8s_scheduler_tpu/mod.py", "scripts/probe.py",
+    ]
+
+
+def test_threads_tr003_multi_target_and_tuple_assigns(tmp_path):
+    """Review regression: chained (`a = b = Thread()`) and elementwise
+    tuple (`t1, t2 = Thread(), Thread()`) assignments are STORED, not
+    'dropped' — each is judged by its own lifecycle story."""
+    result = lint_fixture(tmp_path, {
+        "pkg/multi.py": """\
+            import threading
+
+
+            class M:
+                def spawn(self):
+                    self._a = self._b = threading.Thread(target=self._run)
+                    self._a.start()
+
+                def close(self):
+                    self._a.join()
+
+                def _run(self):
+                    pass
+
+
+            def pair(fn):
+                t1, t2 = threading.Thread(target=fn), threading.Thread(target=fn)
+                t1.start()
+                t2.start()
+                t1.join()
+                # t2 is never joined nor cleared: the real leak
+        """,
+    }, passes=["THREADS"])
+    tr3 = codes_at(result, "TR003")
+    assert len(tr3) == 1 and tr3[0].line == 17
+    assert "t2" in tr3[0].message and "drops" not in tr3[0].message
+
+
+def test_schedlint_changed_rejects_write_baseline(tmp_path, capsys):
+    """Review regression: a baseline written from a --changed subset
+    scan would delete every grandfathered entry for unscanned files."""
+    import importlib.util
+
+    path = os.path.join(REPO, "scripts", "schedlint.py")
+    spec = importlib.util.spec_from_file_location("schedlint_cli3", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--changed", "--write-baseline"]) == 2
+    assert "full-tree" in capsys.readouterr().err
