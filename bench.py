@@ -253,6 +253,16 @@ def main() -> None:
                 }
                 if "tunnel_amortization" in r else {}
             ),
+            # device-saturated streaming (ISSUE 13): depth-2 first-bind
+            # p50 and the speculation hit rate — diffed directionally
+            # by bench_diff (fbp50 rise / shr drop = regression)
+            **(
+                {
+                    "fbp50": r["first_bind_p50_ms"],
+                    "shr": r["speculation_hit_rate"],
+                }
+                if "first_bind_p50_ms" in r else {}
+            ),
             # compile-regime churn soak (config 6): cold compile spend,
             # warm-restart hit rate, and compile-attributed stall
             # cycles after first traversal — diffed by bench_diff

@@ -143,6 +143,16 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         "(config speculativeCompile; 1 on, 0 off, -1 = keep config)",
     )
     ap.add_argument(
+        "--speculative-dispatch", type=int, default=-1, choices=(-1, 0, 1),
+        help="depth-2 speculative dispatch pipelining: while multi-cycle "
+        "batch k is on device, dispatch batch k+1 against the predicted "
+        "post-k carry; adopted on a predicate match, abandoned and "
+        "re-dispatched on a mismatch — results are bit-identical either "
+        "way. Forced off under --forced-sync and at/below the ladder's "
+        "sequential rung (config speculativeDispatch; 1 on, 0 off, "
+        "-1 = keep config)",
+    )
+    ap.add_argument(
         "--dispatch-deadline-ms", type=float, default=-1.0,
         help="dispatch watchdog: bound on the blocking per-cycle "
         "decision fetch in milliseconds — on expiry the fetch is "
@@ -207,6 +217,8 @@ def main(argv: list[str] | None = None) -> int:
         config.shard_devices = args.shard_devices
     if args.speculative_compile >= 0:
         config.speculative_compile = bool(args.speculative_compile)
+    if args.speculative_dispatch >= 0:
+        config.speculative_dispatch = bool(args.speculative_dispatch)
     if args.dispatch_deadline_ms >= 0:
         config.dispatch_deadline_ms = args.dispatch_deadline_ms
     if args.degrade_promote_cycles > 0:

@@ -178,6 +178,17 @@ class SchedulerConfiguration:
     # anomaly sentinel's demand EWMA drifts toward a bucket boundary;
     # a flip speculation won costs ~0 compile on the serve path.
     speculative_compile: bool = True
+    # speculativeDispatch — depth-2 speculative dispatch pipelining
+    # (core/pipeline.py + core/scheduler.py): while multi-cycle batch k
+    # is on device, speculatively dispatch batch k+1 against the
+    # predicted post-k carry (device-resident continuation chaining).
+    # When batch k's host fold lands, the speculation is adopted on a
+    # predicate-digest match (zero added latency) or abandoned and
+    # re-dispatched against the true carry — bit-identical results
+    # either way, only latency is speculative. Effective on the
+    # multi-cycle path (multiCycleK > 1); forced off under forcedSync
+    # and at/below the degradation ladder's `sequential` rung.
+    speculative_dispatch: bool = True
     # dispatch watchdog (core/pipeline.py): bound, in milliseconds, on
     # the ONE blocking device->host decision fetch. On expiry the fetch
     # is abandoned (DispatchDeadlineExceeded), the cycle's pods requeue
@@ -334,6 +345,7 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         compile_cache_dir=str(data.get("compileCacheDir", "")),
         shard_devices=int(data.get("shardDevices", 0)),
         speculative_compile=bool(data.get("speculativeCompile", True)),
+        speculative_dispatch=bool(data.get("speculativeDispatch", True)),
         dispatch_deadline_ms=float(data.get("dispatchDeadlineMs", 0.0)),
         degrade_promote_cycles=int(data.get("degradePromoteCycles", 8)),
         fault_spec=str(data.get("faultSpec", "")),

@@ -98,6 +98,12 @@ class MultiCycleResult:
     # row i feeds that inner cycle's deferred diagnosis/preemption
     # programs (device-resident; never part of the slimmed fetch)
     cycles_run: jnp.ndarray  # i32 [] inner cycles actually executed
+    # the loop's FINAL carry, exposed so a depth-2 speculative batch can
+    # chain device-to-device (ServingPipeline.dispatch_multi carry0=…):
+    # the continuation program consumes these without a host round trip
+    carry_node_requested: jnp.ndarray  # f32 [N, R] post-batch capacity
+    carry_gplaced: jnp.ndarray  # i32 [G] per-group members placed by
+    # this batch (continuation batches add it to their own carry)
 
 
 def multicycle_unsupported_reason(snap: ClusterSnapshot) -> str | None:
@@ -812,6 +818,7 @@ def build_packed_multicycle_fn(
     max_rounds: int = 64,
     percentage_of_nodes_to_score: int = 0,
     rounds_kw: dict | None = None,
+    carry_in: bool = False,
 ):
     """The MULTI-CYCLE serving program: up to `k` scheduling cycles per
     dispatch inside a device-resident `lax.while_loop`, amortizing the
@@ -846,7 +853,18 @@ def build_packed_multicycle_fn(
     There is no clock under jit, so per-inner-cycle device time cannot
     be stamped on device; the host apportions the measured batch window
     by per-cycle attempted-pod counts (core/scheduler.py) — the
-    `device_share` phase in core/observe.PHASES."""
+    `device_share` phase in core/observe.PHASES.
+
+    `carry_in=True` builds the CONTINUATION variant (depth-2
+    speculative dispatch, ServingPipeline.dispatch_multi carry0=…):
+    the callable takes two extra arguments `(node_req0 f32 [N, R],
+    gplaced0 i32 [G])` — a predecessor batch's `carry_node_requested` /
+    `carry_gplaced` outputs, still device-resident — and seeds the loop
+    carry from them instead of the stale snapshot fields. Chaining
+    batch B onto batch A this way is bit-identical to one combined
+    [A;B] batch (and therefore, inside the envelope, to sequential
+    dispatches with host folding), which is exactly what makes
+    adoption of a speculative batch correctness-free."""
     from ..models import packing
 
     fw = framework or Framework.from_config()
@@ -870,7 +888,7 @@ def build_packed_multicycle_fn(
     if pv_off is None:  # pragma: no cover — every spec carries pod_valid
         raise ValueError("spec has no pod_valid field")
 
-    def multicycle(wbufs, bbufs, stable, n_cycles):
+    def multicycle(wbufs, bbufs, stable, n_cycles, *carry0):
         snap0 = packing.unpack(wbufs[0], bbufs[0], spec)
         reason = multicycle_unsupported_reason(snap0)
         if reason is not None:
@@ -926,17 +944,29 @@ def build_packed_multicycle_fn(
                 remaining[jnp.clip(i, 0, k)] > 0
             )
 
+        if carry_in:
+            # continuation batch: seed the carry from the predecessor
+            # batch's device-resident final carry instead of the (stale)
+            # snapshot fields — the rows were encoded against the SAME
+            # pre-predecessor cache state, so this is the identical
+            # dataflow a combined [A;B] batch would thread internally
+            node_req0, gplaced0 = carry0
+            node_req0 = node_req0.astype(jnp.float32)
+            gplaced0 = gplaced0.astype(jnp.int32)
+        else:
+            node_req0 = snap0.node_requested
+            gplaced0 = jnp.zeros((G,), jnp.int32)
         init = (
             jnp.int32(0),
-            snap0.node_requested,
-            jnp.zeros((G,), jnp.int32),
+            node_req0,
+            gplaced0,
             jnp.full((k, P), -1, jnp.int32),
             jnp.zeros((k, P), bool),
             jnp.zeros((k, P), bool),
             jnp.zeros((k, P), bool),
             jnp.zeros((k, N, R), jnp.float32),
         )
-        i, _nr, _gp, a_out, u_out, d_out, act_out, nr_out = (
+        i, nr_fin, gp_fin, a_out, u_out, d_out, act_out, nr_out = (
             jax.lax.while_loop(cond_fn, body_fn, init)
         )
         return MultiCycleResult(
@@ -946,6 +976,11 @@ def build_packed_multicycle_fn(
             attempted=act_out,
             node_requested=nr_out,
             cycles_run=i,
+            carry_node_requested=nr_fin,
+            # a continuation's gplaced carry already contains the
+            # predecessor's counts; report only THIS batch's delta so
+            # chains of any depth add deltas, never double-count
+            carry_gplaced=gp_fin - gplaced0,
         )
 
     return _jit(
@@ -953,7 +988,7 @@ def build_packed_multicycle_fn(
         disc=(
             f"k{k}|{commit_mode}|{gang_scheduling}|{max_rounds}|"
             f"{percentage_of_nodes_to_score}|"
-            f"{sorted((rounds_kw or {}).items())!r}|"
+            f"{sorted((rounds_kw or {}).items())!r}|carry{int(carry_in)}|"
             + repr(spec.key()) + _fw_disc(fw)
         ),
     )

@@ -77,6 +77,10 @@ TRACE_LANE_FOR_PHASE = {
     # slice (the host cannot see per-inner-cycle device boundaries)
     "batch_wait": (LANE_HOST, "batch wait"),
     "device_share": (LANE_DEVICE, "device cycle[seq]"),
+    # streamed decision fetch: batch flush -> first inner cycle's
+    # decision row landed; renders inside the batch's device slice
+    # (the window ends where row 0's transfer completes)
+    "first_bind": (LANE_DEVICE, "device cycle[seq]"),
 }
 
 
@@ -113,6 +117,12 @@ class CycleRecord:
     # flip). The observer surfaces it in /debug/anomalies recompile
     # events so operators can tell a cache miss from a win.
     compile_source: str = ""
+    # depth-2 speculative dispatch outcome, stamped on the record of
+    # the batch a speculation rode (one sample per speculation):
+    # "adopted" | "abandoned" | "none" (speculation considered but not
+    # dispatched — e.g. spec mismatch), "" = no speculation involved.
+    # Feeds the observer's speculation_thrash abandon-rate EWMA.
+    speculation: str = ""
 
     def mark(self, name: str, t: float) -> None:
         self.marks[name] = t
@@ -139,6 +149,10 @@ class CycleRecord:
             **(
                 {"compile_source": self.compile_source}
                 if self.compile_source else {}
+            ),
+            **(
+                {"speculation": self.speculation}
+                if self.speculation else {}
             ),
         }
 

@@ -88,6 +88,10 @@ PHASES = (
     "device_share",   # this inner cycle's apportioned share of the
     # batch's device window (no clock runs under jit, so the host
     # splits the measured window by per-cycle attempted-pod counts)
+    "first_bind",     # streamed decision fetch: batch flush -> the
+    # FIRST inner cycle's decision row landed (the latency a row-0 pod
+    # actually waits before its bind; ~1 inner cycle under depth-2
+    # speculative dispatch instead of the whole K-cycle batch)
 )
 
 ANOMALY_CLASSES = (
@@ -100,6 +104,13 @@ ANOMALY_CLASSES = (
     # externally via raise_anomaly — both directions, with the from/to
     # rung names and the triggering reason in the detail
     "degraded",
+    # depth-2 speculative dispatch is net-negative: the per-profile
+    # abandon-rate EWMA crossed spec_thrash_threshold — every abandoned
+    # speculation re-dispatches, so a thrashing workload pays the
+    # speculative encode+dispatch for nothing. Raising this also holds
+    # speculation off for the profile for `spec_hold_cycles` cycles
+    # (the scheduler consults speculation_ok before speculating).
+    "speculation_thrash",
 )
 
 # Fixed log-ish bucket edges (seconds) for the streaming phase
@@ -154,6 +165,8 @@ def phase_seconds(rec) -> dict[str, float]:
         out["batch_wait"] = ph["batch_wait_ms"] / 1e3
     if "device_share_ms" in ph:
         out["device_share"] = ph["device_share_ms"] / 1e3
+    if "first_bind_ms" in ph:
+        out["first_bind"] = ph["first_bind_ms"] / 1e3
     return out
 
 
@@ -345,12 +358,25 @@ class CycleObserver:
         stall_k_dev: float = 6.0,
         stall_floor_s: float = 0.25,
         fast_burn_degraded: float = 6.0,
+        spec_thrash_threshold: float = 0.5,
+        spec_hold_cycles: int = 8,
+        spec_warmup: int = 4,
     ) -> None:
         self._lock = threading.Lock()
         self.warmup_cycles = warmup_cycles
         self.stall_mult = stall_mult
         self.stall_k_dev = stall_k_dev
         self.stall_floor_s = stall_floor_s
+        # speculative-dispatch thrash sentinel: per-profile EWMA of the
+        # abandon rate over speculated batches. Above the threshold
+        # (after spec_warmup samples) speculation is net-negative —
+        # every abandon re-dispatches — so a speculation_thrash anomaly
+        # fires and speculation_ok() holds the profile's speculation
+        # off for the next spec_hold_cycles opportunities (the
+        # scheduler wires degradePromoteCycles in here).
+        self.spec_thrash_threshold = spec_thrash_threshold
+        self.spec_hold_cycles = spec_hold_cycles
+        self.spec_warmup = spec_warmup
         self.baselines = {p: PhaseBaseline() for p in PHASES}
         # unwinsorized per-phase histograms: the exported p50/p99
         # gauges and status() read THESE — the baselines' winsorized
@@ -430,6 +456,7 @@ class CycleObserver:
             t_s=rec.t_end - self.epoch,
             wall=rec.wall_start,
             compile_source=getattr(rec, "compile_source", ""),
+            speculation=getattr(rec, "speculation", ""),
         )
 
     def observe_phases(
@@ -442,6 +469,7 @@ class CycleObserver:
         t_s: float = 0.0,
         wall: float = 0.0,
         compile_source: str = "",
+        speculation: str = "",
     ) -> list[dict]:
         """The sentinel core, usable without a CycleRecord (bench_suite
         feeds plain latency series through classify_latency_series)."""
@@ -629,6 +657,35 @@ class CycleObserver:
                 if delta > 0:
                     raise_anomaly("wedge_precursor", strikes=delta)
 
+            # -- speculation thrash: EWMA of the abandon rate over
+            # speculated batches (one sample per speculation — the
+            # scheduler stamps the outcome only on the record of the
+            # batch the speculation rode). Above the threshold the
+            # speculative encode+dispatch is being paid for nothing
+            # (every abandon re-dispatches), so raise the anomaly and
+            # hold speculation off for spec_hold_cycles opportunities;
+            # the EWMA resets so post-hold evidence is judged fresh.
+            if speculation in ("adopted", "abandoned"):
+                x = 1.0 if speculation == "abandoned" else 0.0
+                prev_e = prof.get("spec_ewma")
+                prof["spec_ewma"] = (
+                    x if prev_e is None else prev_e + 0.3 * (x - prev_e)
+                )
+                prof["spec_n"] = prof.get("spec_n", 0) + 1
+                if (
+                    prof["spec_n"] >= self.spec_warmup
+                    and prof["spec_ewma"] > self.spec_thrash_threshold
+                ):
+                    raise_anomaly(
+                        "speculation_thrash",
+                        abandon_rate_ewma=round(prof["spec_ewma"], 4),
+                        threshold=self.spec_thrash_threshold,
+                        hold_cycles=self.spec_hold_cycles,
+                    )
+                    prof["spec_hold"] = self.spec_hold_cycles
+                    prof["spec_ewma"] = 0.0
+                    prof["spec_n"] = 0
+
             # -- feed histograms/baselines (winsorized for flagged
             # stall phases) and the SLO accounting
             for phase, v in phases.items():
@@ -710,6 +767,22 @@ class CycleObserver:
             return float(
                 self._prof.get(profile, {}).get("demand_ewma") or 0.0
             )
+
+    def speculation_ok(self, profile: str) -> bool:
+        """Consulted by the scheduler before each speculative dispatch
+        opportunity (batch flush). False while a speculation_thrash
+        hold is active; each consult during the hold spends one of its
+        spec_hold_cycles, so speculation auto-re-enables after
+        degradePromoteCycles opportunities of sequential serving."""
+        with self._lock:
+            prof = self._prof.get(profile)
+            if prof is None:
+                return True
+            hold = prof.get("spec_hold", 0)
+            if hold <= 0:
+                return True
+            prof["spec_hold"] = hold - 1
+            return False
 
     # locked SloEngine reads: the scrape-time gauge closures must not
     # iterate the burn-window deques while the scheduling loop appends

@@ -27,11 +27,28 @@ outright — the last consumer — trading the _Resilient retry of that one
 program for immediate arena reuse.
 
 Ordering contract: cycle k's binds MUST fold into the cache before cycle
-k+1's encode reads it. The pipeline enforces the observable half — by
-default `dispatch()` refuses to start cycle k+1 until cycle k's decisions
-were fetched (without them no bind can have been issued, so an encode
-that already ran read a stale cache). Drivers that fold nothing (pure
-throughput loops, probes) opt out with `require_decision_fetch=False`.
+k+1's *adopted* encode reads it. The pipeline enforces the observable
+half — by default `dispatch()` refuses to start cycle k+1 until cycle
+k's decisions were fetched (without them no bind can have been issued,
+so an encode that already ran read a stale cache). Drivers that fold
+nothing (pure throughput loops, probes) opt out with
+`require_decision_fetch=False`.
+
+Depth-2 speculative dispatch (`dispatch_multi(..., speculative=True)`)
+is the one sanctioned relaxation: batch k+1 may be dispatched while
+batch k is still in flight, encoded against the PREDICTED post-k state
+(device-side carry chaining — cycle.build_packed_multicycle_fn
+`carry_in`). The guard is then "binds fold before the next ADOPTED
+encode": the speculative handle only becomes the current batch through
+`adopt_speculative()` — called after batch k's host fold landed and
+matched the speculation's predicate digest — and is otherwise abandoned
+(`abandon_speculative()`) and re-dispatched against the true carry.
+Correctness is never speculative, only latency is. Depth 2 needs a
+THIRD arena slot (`slots=3`): the two double-buffered slots assume one
+batch in flight, and with two in flight the slot-reuse release would
+otherwise overwrite a batch whose decisions were never fetched —
+`dispatch`/`dispatch_multi` refuse that loudly instead of corrupting
+an in-flight upload.
 
 `forced_sync=True` is the escape hatch for tests and latency measurement:
 every dispatch blocks to completion before returning, restoring strict
@@ -158,6 +175,35 @@ def build_multicycle_slim_fn(num_nodes: int):
         return a, flags, cycles_run
 
     return _jit(slim, "multicycle_slim", disc=f"narrow{int(narrow)}")
+
+
+def build_multicycle_slim_rows_fn(num_nodes: int, k: int):
+    """STREAMED variant of the multi-cycle decision slimming: the same
+    i16|u8 diet, but split K ways so each inner cycle's row is its own
+    fetchable device buffer — `(((a_0, flags_0), …, (a_{K-1},
+    flags_{K-1})), cycles_run)` instead of one stacked [K, P] pair.
+    MultiCycleHandle.decisions_row(i) then blocks on row i's transfer
+    alone, so the apply loop can bind inner cycle i's winners while
+    rows i+1…K-1 are still in flight (and, under depth-2 speculative
+    dispatch, while the NEXT batch is still running on device). Flag
+    bits match build_multicycle_slim_fn: 0 = unschedulable, 1 =
+    gang_dropped, 2 = attempted."""
+    narrow = num_nodes < (1 << 15)
+
+    def slim(assignment, unschedulable, gang_dropped, attempted,
+             cycles_run):
+        a = assignment.astype(jnp.int16) if narrow else assignment
+        flags = (
+            unschedulable.astype(jnp.uint8)
+            | (gang_dropped.astype(jnp.uint8) << 1)
+            | (attempted.astype(jnp.uint8) << 2)
+        )
+        rows = tuple((a[i], flags[i]) for i in range(k))
+        return rows, cycles_run
+
+    return _jit(
+        slim, "multicycle_slim_rows", disc=f"narrow{int(narrow)}|k{k}"
+    )
 
 
 def _cpu_safe_buffers(wbuf, bbuf):
@@ -359,18 +405,33 @@ class CycleHandle:
 class MultiCycleHandle:
     """One in-flight multi-cycle batch (K inner cycles dispatched as a
     single device program — core/cycle.build_packed_multicycle_fn).
-    Mirrors CycleHandle's contract: the only blocking transfer is the
-    slimmed stacked decision fetch; the per-inner-cycle deferred
-    programs (diagnosis, preemption) dispatch lazily against the stacked
-    buffers' row i and the loop's post-cycle-i `node_requested`."""
+    Mirrors CycleHandle's contract, streamed: the slimmed decision
+    payload is split into per-inner-cycle fetchable rows
+    (build_multicycle_slim_rows_fn), so `decisions_row(i)` blocks on
+    row i's transfer alone and the apply loop binds cycle i's winners
+    while later rows (and, under depth-2 speculation, the next batch)
+    are still in flight. The handle counts as fetched — releasing the
+    binds-fold ordering guard — once every LIVE row (`n_live`, the
+    dispatched `n_cycles`) was fetched. The per-inner-cycle deferred
+    programs (diagnosis, preemption) dispatch lazily against the
+    stacked buffers' row i and the loop's post-cycle-i
+    `node_requested`."""
 
-    def __init__(self, pipe, result, slim, wbufs, bbufs, stable):
+    def __init__(
+        self, pipe, result, slim, wbufs, bbufs, stable,
+        n_live: int, speculative: bool = False,
+    ):
         self._pipe = pipe
         self.result = result  # MultiCycleResult device futures
-        self._slim = slim  # (i16|i32 [K,P], u8 [K,P], i32) futures
+        # (((i16|i32 [P], u8 [P]) x K), i32) futures — per-row slimmed
+        self._slim = slim
         self._wbufs = wbufs
         self._bbufs = bbufs
         self._stable = stable
+        self.n_live = n_live
+        self.speculative = speculative
+        self._rows: dict[int, tuple] = {}
+        self._cycles_run: "int | None" = None
         self._decisions = None
         self._t_decisions = None
         self._diag: dict[int, object] = {}
@@ -382,45 +443,124 @@ class MultiCycleHandle:
         self.diag_lag: dict[int, tuple[float, float]] = {}
         self.fetched = False
 
+    def _consumed(self, e: BaseException) -> None:
+        """A failed fetch consumes the batch: same contract as
+        CycleHandle.decisions — the ordering guard releases, the
+        failure class is stamped before the re-raise."""
+        self._pipe.note_fetch_failure(e)
+        self.fetched = True
+        self.release()
+        self._pipe._note_inflight()
+
+    def decisions_row(self, i: int):
+        """Inner cycle i's decisions as numpy — `(assignment i32 [P],
+        unschedulable bool [P], gang_dropped bool [P], attempted bool
+        [P])` — blocking on row i's slimmed transfer only. The first
+        row fetched stamps `t_first_decision` (the scheduler's
+        `first_bind` phase anchor); fetching every live row marks the
+        handle consumed (ordering-guard release)."""
+        hit = self._rows.get(i)
+        if hit is not None:
+            return hit
+        now = self._pipe._now
+        t0 = now()
+        st = self._pipe.stats
+        st.setdefault("t_decision_start", t0)
+        try:
+            a, flags = self._pipe.fetch_decisions(
+                lambda: jax.device_get(self._slim[0][i])
+            )
+        except Exception as e:  # schedlint: disable=RB001 -- not swallowed: _consumed stamps the failure class (metric + events ring) before the re-raise — the consumed-cycle contract
+            self._consumed(e)
+            raise
+        t1 = now()
+        self._t_decisions = t1
+        st["decision_wait_ms"] = (
+            st.get("decision_wait_ms", 0.0) + (t1 - t0) * 1e3
+        )
+        st["t_decision_end"] = t1
+        st.setdefault("t_first_decision", t1)
+        nbytes = int(a.nbytes + flags.nbytes)
+        st["fetch_bytes"] = st.get("fetch_bytes", 0) + nbytes
+        self._pipe._fetch_bytes_total += nbytes
+        m = self._pipe._metrics
+        if m is not None:
+            m.cycle_duration.labels(phase="decision_fetch").observe(
+                t1 - t0
+            )
+            m.decision_fetch_bytes.inc(nbytes)
+        row = (
+            np.asarray(a, dtype=np.int32),
+            (flags & 1) != 0,
+            (flags & 2) != 0,
+            (flags & 4) != 0,
+        )
+        self._rows[i] = row
+        if len(self._rows) >= self.n_live and not self.fetched:
+            self.fetched = True
+            self._pipe._note_inflight()
+        return row
+
+    def cycles_run(self) -> int:
+        """Inner cycles the device loop actually executed (blocks on
+        the scalar transfer; ~free once the rows landed)."""
+        if self._cycles_run is None:
+            try:
+                cr = self._pipe.fetch_decisions(
+                    lambda: jax.device_get(self._slim[1])
+                )
+            except Exception as e:  # schedlint: disable=RB001 -- not swallowed: _consumed stamps the failure class (metric + events ring) before the re-raise
+                self._consumed(e)
+                raise
+            self._cycles_run = int(cr)
+        return self._cycles_run
+
     def decisions(self):
         """(assignment i32 [K, P], unschedulable bool [K, P],
         gang_dropped bool [K, P], attempted bool [K, P], cycles_run int)
-        as numpy — blocks on the one slimmed stacked transfer."""
+        as numpy — the whole-batch fetch (every row + the scalar in one
+        transfer). Kept for drivers that want the stacked shape; the
+        streaming apply path uses decisions_row."""
         if self._decisions is None:
             now = self._pipe._now
             t0 = now()
-            self._pipe.stats["t_decision_start"] = t0
+            st = self._pipe.stats
+            st.setdefault("t_decision_start", t0)
             try:
-                a, flags, cycles_run = self._pipe.fetch_decisions(
+                rows, cycles_run = self._pipe.fetch_decisions(
                     lambda: jax.device_get(self._slim)
                 )
-            except Exception as e:
-                # same contract as CycleHandle.decisions: a failed fetch
-                # consumes the batch so the ordering guard releases,
-                # with the failure class stamped before the re-raise
-                self._pipe.note_fetch_failure(e)
-                self.fetched = True
-                self.release()
-                self._pipe._note_inflight()
+            except Exception as e:  # schedlint: disable=RB001 -- not swallowed: _consumed stamps the failure class (metric + events ring) before the re-raise
+                self._consumed(e)
                 raise
             self._t_decisions = now()
-            st = self._pipe.stats
-            st["decision_wait_ms"] = (self._t_decisions - t0) * 1e3
+            st["decision_wait_ms"] = (
+                st.get("decision_wait_ms", 0.0)
+                + (self._t_decisions - t0) * 1e3
+            )
             st["t_decision_end"] = self._t_decisions
-            st["fetch_bytes"] = int(a.nbytes + flags.nbytes) + 4
-            self._pipe._fetch_bytes_total += st["fetch_bytes"]
+            st.setdefault("t_first_decision", self._t_decisions)
+            nbytes = sum(
+                int(r[0].nbytes + r[1].nbytes) for r in rows
+            ) + 4
+            a = np.stack([np.asarray(r[0], dtype=np.int32)
+                          for r in rows])
+            flags = np.stack([np.asarray(r[1]) for r in rows])
+            st["fetch_bytes"] = st.get("fetch_bytes", 0) + nbytes
+            self._pipe._fetch_bytes_total += nbytes
             m = self._pipe._metrics
             if m is not None:
                 m.cycle_duration.labels(phase="decision_fetch").observe(
                     self._t_decisions - t0
                 )
-                m.decision_fetch_bytes.inc(st["fetch_bytes"])
+                m.decision_fetch_bytes.inc(nbytes)
+            self._cycles_run = int(cycles_run)
             self._decisions = (
-                np.asarray(a, dtype=np.int32),
+                a,
                 (flags & 1) != 0,
                 (flags & 2) != 0,
                 (flags & 4) != 0,
-                int(cycles_run),
+                self._cycles_run,
             )
             self.fetched = True
             self._pipe._note_inflight()
@@ -576,8 +716,20 @@ class ServingPipeline:
         # scheduler installs it next to multi_fn; falls back to
         # _diag_fn (carry mode shares one) when None
         self.multi_diag_fn = None
+        # continuation variant (build_packed_multicycle_fn carry_in):
+        # consumes a predecessor batch's device-resident carry — the
+        # program depth-2 speculative dispatches run on
+        self.multi_cont_fn = None
         self._multi_slim_fn = None
         self._last = None
+        # the one in-flight SPECULATIVE batch (depth 2: at most one),
+        # pending adopt_speculative/abandon_speculative resolution
+        self._spec: "MultiCycleHandle | None" = None
+        # speculation ledger: outcomes of every speculative dispatch
+        # (mirrored into scheduler_speculation_total{outcome})
+        self.speculation = {
+            "adopted": 0, "abandoned": 0, "redispatched": 0,
+        }
         self._n = 0
         self._fetch_bytes_total = 0
         self._pending_encode_ms: float | None = None
@@ -650,6 +802,82 @@ class ServingPipeline:
         dispatched — feeds the overlap accounting in stage_report."""
         self._pending_encode_ms = seconds * 1e3
 
+    def _claim_slot(self) -> int:
+        """Claim the next upload slot, releasing its previous occupant's
+        device references for arena reuse. Refuses to overwrite a slot
+        whose batch was never fetched: under depth-2 speculation two
+        batches are legitimately in flight, and silently releasing an
+        unfetched handle would corrupt an in-flight upload — the
+        slot-accounting invariant is that `slots >= in-flight + 1`
+        (three slots for depth 2), enforced here loudly."""
+        slot = self._n % len(self._slots)
+        prev = self._slots[slot]
+        if prev is not None:
+            if not prev.fetched and self.require_decision_fetch:
+                # fold-free drivers (require_decision_fetch=False) opted
+                # out of the ordering guard and may legitimately leave
+                # handles unfetched — they keep the silent release
+                raise RuntimeError(
+                    f"ServingPipeline: upload slot {slot} still holds "
+                    "an unfetched in-flight batch — dispatch depth "
+                    f"exceeds the {len(self._slots)}-slot arena "
+                    "(speculative depth-2 needs slots=3)"
+                )
+            # release the old occupant's device references BEFORE
+            # uploading so the allocator hands back the same-sized
+            # blocks (buffered arena reuse instead of per-cycle growth)
+            prev.release()
+        return slot
+
+    def _speculation_outcome(self, outcome: str) -> None:
+        self.speculation[outcome] += 1
+        m = self._metrics
+        counter = getattr(m, "speculation", None) if m else None
+        if counter is not None:
+            counter.labels(outcome=outcome).inc()
+
+    def adopt_speculative(self) -> "MultiCycleHandle":
+        """The host fold of the predecessor batch matched the
+        speculation's predicate: the in-flight speculative batch
+        becomes the current one (zero added latency — it has been on
+        device the whole time) and the ordering guard resumes guarding
+        it like any adopted dispatch."""
+        h = self._spec
+        if h is None:
+            raise RuntimeError("adopt_speculative: no speculation in flight")
+        self._spec = None
+        self._last = h
+        # the adopted batch's dispatch marks become the current stage
+        # report (its rows' fetch stats land on top as they stream in)
+        self.stats = dict(getattr(h, "_stats_seed", {}))
+        self._speculation_outcome("adopted")
+        return h
+
+    def abandon_speculative(self) -> None:
+        """The host fold diverged from the speculation's predicate (or
+        the predecessor batch failed outright): drop the in-flight
+        speculative batch — its results are never observed — and free
+        its arena slot. The caller re-dispatches against the true
+        carry (note_redispatch) or requeues. Idempotent/no-op when no
+        speculation is in flight, so failure paths can call it
+        unconditionally without leaking a slot."""
+        h = self._spec
+        if h is None:
+            return
+        self._spec = None
+        h.fetched = True  # consumed-without-observation: guard releases
+        h.release()
+        for i, s in enumerate(self._slots):
+            if s is h:
+                self._slots[i] = None
+        self._speculation_outcome("abandoned")
+        self._note_inflight()
+
+    def note_redispatch(self) -> None:
+        """Ledger mark: an abandoned speculation's groups were
+        re-dispatched against the true carry."""
+        self._speculation_outcome("redispatched")
+
     def dispatch(
         self,
         wbuf,
@@ -667,6 +895,12 @@ class ServingPipeline:
         CycleHandle (unless forced_sync). Raises if the previous cycle's
         decisions were never fetched while require_decision_fetch — the
         strict-ordering guard (see module docstring)."""
+        if self._spec is not None:
+            raise RuntimeError(
+                "ServingPipeline: dispatch with an unresolved "
+                "speculative batch in flight — adopt_speculative() or "
+                "abandon_speculative() first"
+            )
         if (
             self.require_decision_fetch
             and self._last is not None
@@ -679,13 +913,7 @@ class ServingPipeline:
                 "require_decision_fetch=False for fold-free loops)"
             )
         t0 = self._now()
-        slot = self._n % len(self._slots)
-        prev = self._slots[slot]
-        if prev is not None:
-            # release slot k-2's device references BEFORE uploading so
-            # the allocator hands back the same-sized blocks (double-
-            # buffered arena reuse instead of per-cycle growth)
-            prev.release()
+        slot = self._claim_slot()
         if device_put:
             wbuf = jax.device_put(wbuf)
             bbuf = jax.device_put(bbuf)
@@ -755,6 +983,8 @@ class ServingPipeline:
         n_cycles: int,
         *,
         device_put: bool = True,
+        carry0=None,
+        speculative: bool = False,
     ) -> MultiCycleHandle:
         """Upload + dispatch one MULTI-CYCLE batch (stacked [K, ...]
         packed snapshots, one device dispatch for up to `n_cycles` inner
@@ -763,51 +993,83 @@ class ServingPipeline:
         so the next dispatch (single or multi) is refused until the
         batch's decisions were fetched — binds-fold ordering holds
         across the batch boundary exactly as it does between single
-        cycles."""
-        if self.multi_fn is None:
+        cycles.
+
+        `speculative=True` is the depth-2 relaxation: the batch may be
+        dispatched while its predecessor is still unfetched (the guard
+        becomes "binds fold before the next ADOPTED encode" — module
+        docstring). The handle is held aside until the caller resolves
+        it via adopt_speculative()/abandon_speculative(); at most one
+        speculation is in flight. `carry0 = (carry_node_requested,
+        carry_gplaced)` chains the predecessor's device-resident final
+        carry into this batch through `multi_cont_fn` (the carry_in
+        continuation program) — no host round trip."""
+        fn = self.multi_fn
+        if carry0 is not None:
+            fn = self.multi_cont_fn
+            if fn is None:
+                raise RuntimeError(
+                    "ServingPipeline.dispatch_multi: carry0 given but "
+                    "no continuation program (assign pipe.multi_cont_fn"
+                    " = build_packed_multicycle_fn(..., carry_in=True))"
+                )
+        if fn is None:
             raise RuntimeError(
                 "ServingPipeline.dispatch_multi: no multi-cycle program "
                 "(assign pipe.multi_fn = build_packed_multicycle_fn(...))"
             )
+        if self._spec is not None:
+            raise RuntimeError(
+                "ServingPipeline: dispatch_multi with an unresolved "
+                "speculative batch in flight — adopt_speculative() or "
+                "abandon_speculative() first"
+            )
         if (
-            self.require_decision_fetch
+            not speculative
+            and self.require_decision_fetch
             and self._last is not None
             and not self._last.fetched
         ):
             raise RuntimeError(
                 "ServingPipeline: multi-cycle batch dispatched before "
                 "the previous cycle's decisions were fetched — binds "
-                "cannot have folded before this batch was encoded"
+                "cannot have folded before this batch was encoded "
+                "(speculative=True is the sanctioned depth-2 path)"
             )
         t0 = self._now()
-        slot = self._n % len(self._slots)
-        prev = self._slots[slot]
-        if prev is not None:
-            prev.release()
+        slot = self._claim_slot()
         if device_put:
             wbufs = jax.device_put(wbufs)
             bbufs = jax.device_put(bbufs)
         else:
             wbufs, bbufs = _cpu_safe_buffers(wbufs, bbufs)
-        result = self.multi_fn(
-            wbufs, bbufs, stable, np.int32(n_cycles)
-        )
+        if carry0 is not None:
+            result = fn(
+                wbufs, bbufs, stable, np.int32(n_cycles), *carry0
+            )
+        else:
+            result = fn(wbufs, bbufs, stable, np.int32(n_cycles))
         if self._multi_slim_fn is None:
-            self._multi_slim_fn = build_multicycle_slim_fn(
-                result.node_requested.shape[1]
+            self._multi_slim_fn = build_multicycle_slim_rows_fn(
+                result.node_requested.shape[1],
+                result.assignment.shape[0],
             )
         slim = self._multi_slim_fn(
             result.assignment, result.unschedulable,
             result.gang_dropped, result.attempted, result.cycles_run,
         )
         handle = MultiCycleHandle(
-            self, result, slim, wbufs, bbufs, stable
+            self, result, slim, wbufs, bbufs, stable,
+            n_live=n_cycles, speculative=speculative,
         )
         self._slots[slot] = handle
-        self._last = handle
+        if speculative:
+            self._spec = handle
+        else:
+            self._last = handle
         self._n += 1
         t1 = self._now()
-        self.stats = {
+        stats = {
             "dispatch_ms": (t1 - t0) * 1e3,
             "slot": slot,
             "multi_cycles": n_cycles,
@@ -815,21 +1077,32 @@ class ServingPipeline:
             "t_dispatch_end": t1,
         }
         if self._pending_encode_ms is not None:
-            self.stats["encode_ms"] = self._pending_encode_ms
+            stats["encode_ms"] = self._pending_encode_ms
             self._pending_encode_ms = None
+        if speculative:
+            # a speculative dispatch must not clobber the in-flight
+            # batch's stage report: its marks are held on the handle
+            # and installed by adopt_speculative — the predecessor's
+            # stats only note that a speculation was dispatched in its
+            # shadow
+            handle._stats_seed = stats
+            self.stats["spec_dispatch_ms"] = stats["dispatch_ms"]
+        else:
+            self.stats = stats
         if self._metrics is not None:
             self._metrics.cycle_duration.labels(phase="dispatch").observe(
                 t1 - t0
             )
         self._note_inflight()
-        if self.forced_sync:
+        if self.forced_sync and not speculative:
             handle.block()
             self.stats["encode_hidden_ms"] = 0.0
         return handle
 
     def inflight(self) -> int:
         """Dispatched cycles whose decisions were not fetched yet (0 or
-        1 under the strict-ordering guard)."""
+        1 under the strict-ordering guard; up to 2 while a depth-2
+        speculative batch is in flight)."""
         return sum(
             1 for h in self._slots if h is not None and not h.fetched
         )

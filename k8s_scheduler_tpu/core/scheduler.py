@@ -185,6 +185,10 @@ class Scheduler:
                 metrics=self.metrics,
                 slo_p99_ms=self.config.slo_p99_ms,
                 slo_window_cycles=self.config.slo_window_cycles,
+                # speculation_thrash auto-disable horizon: the same
+                # clean-evidence window the degradation ladder promotes
+                # on (satisfies "re-enable after degradePromoteCycles")
+                spec_hold_cycles=self.config.degrade_promote_cycles,
             )
             self.observer.epoch = self.flight.epoch
             self.flight.observers.append(self.observer.observe)
@@ -566,6 +570,17 @@ class Scheduler:
             metrics=self.metrics,
             events=self.events,
             dispatch_deadline_s=self._dispatch_deadline_s,
+            # depth-2 speculation keeps TWO batches in flight: the
+            # third arena slot lets the next upload proceed without
+            # overwriting either (the 2-slot default assumes one).
+            # Only the multi-cycle path speculates, so single-cycle
+            # serving keeps the tighter double-buffered arena
+            slots=(
+                3
+                if self.config.speculative_dispatch
+                and self.config.multi_cycle_k > 1
+                else 2
+            ),
         )
         fns = (
             cyc,
@@ -1401,27 +1416,38 @@ class Scheduler:
         # program — including scan-mode regimes whose single-cycle
         # path runs the fused full program and has none
         mdiag = build_diagnosis_fn(spec, fw)
+        # depth-2 speculation chains batch k+1 onto batch k's
+        # device-resident carry through the carry_in continuation
+        # variant; only built when the config can ever dispatch one
+        mcont = None
+        if self.config.speculative_dispatch:
+            mcont = build_packed_multicycle_fn(
+                spec, framework=fw, k=self._mc_k, carry_in=True,
+                **self._cycle_kw,
+            )
         source = "cold"
         if aot:
             src = self._aot_install_multi(
-                spec, profile, mfn=mfn, mdiag=mdiag
+                spec, profile, mfn=mfn, mdiag=mdiag, mcont=mcont
             )
             if src is not None:
                 source = src
         return {
-            "fns": (mfn, mdiag),
+            "fns": (mfn, mdiag, mcont),
             "build_s": _time.perf_counter() - t_build,
             "source": source,
         }
 
     def _aot_install_multi(
-        self, spec, profile: str, *, mfn, mdiag
+        self, spec, profile: str, *, mfn, mdiag, mcont=None
     ) -> "str | None":
         """AOT layer for the multi-cycle programs: the stacked [K, ...]
-        batch loop (kind `multicycle-K` — K is static in the program)
-        and its per-row diagnosis companion (same key as the
-        single-cycle diag when the conventions match, so the disk entry
-        is shared)."""
+        batch loop (kind `multicycle-K` — K is static in the program),
+        its per-row diagnosis companion (same key as the single-cycle
+        diag when the conventions match, so the disk entry is shared),
+        and — under speculativeDispatch — the carry-in continuation
+        variant (kind `multicycle-cont-K`; two extra carry arguments,
+        so it can never alias the plain entry)."""
         import jax
 
         from . import compile_cache as cc
@@ -1453,6 +1479,23 @@ class Scheduler:
         if compiled is not None:
             mfn.install_aot(compiled)
             sources.append(source)
+        if mcont is not None and out_sds is not None:
+            # continuation avals: the same stacked inputs plus the
+            # predecessor's final carry (shapes straight off out_sds)
+            nr0 = jax.ShapeDtypeStruct(
+                tuple(out_sds.carry_node_requested.shape), np.float32
+            )
+            gp0 = jax.ShapeDtypeStruct(
+                tuple(out_sds.carry_gplaced.shape), np.int32
+            )
+            compiled_c, source_c, _dt, _out_c = cc.load_or_compile(
+                mcont, self._compile_cache, spec, profile,
+                f"multicycle-cont-{self._mc_k}",
+                args=(wk, bk, stable_sds, n_sds, nr0, gp0),
+            )
+            if compiled_c is not None:
+                mcont.install_aot(compiled_c)
+                sources.append(source_c)
         if out_sds is not None:
             a_row = jax.ShapeDtypeStruct(
                 tuple(out_sds.assignment.shape[1:]), np.int32
@@ -1478,10 +1521,15 @@ class Scheduler:
         stats: CycleStats,
         t0: float,
     ) -> None:
-        """Dispatch the buffered arrival groups as ONE multi-cycle
-        device program (core/cycle.build_packed_multicycle_fn): group i
+        """Dispatch the buffered arrival groups as a multi-cycle
+        device batch (core/cycle.build_packed_multicycle_fn): group i
         becomes inner cycle i of a device-resident loop, paying one
-        dispatch round trip for up to K scheduling cycles.
+        dispatch round trip for up to K scheduling cycles. Under
+        `speculativeDispatch` the flush splits depth-2 — row 0
+        dispatches alone and the rest ride its dispatch shadow as a
+        speculative continuation batch (_schedule_profile_multi_spec);
+        either way the decision rows stream back per inner cycle
+        (_apply_mc_rows) instead of blocking on the stacked fetch.
 
         Semantics contract: each inner cycle's decisions are applied
         through `_apply_phase` in batch order — binds, journal records,
@@ -1496,10 +1544,7 @@ class Scheduler:
         profile out of batching for the process lifetime, while
         host_ports is per-snapshot: a later port-free batch re-enters
         the device loop."""
-        framework = self.frameworks[profile]
-        encoder = self._encoders[profile]
         fr = self.flight
-        log = logging.getLogger(__name__)
         nodes = self.cache.nodes()
         existing = self.cache.existing_pods()
         kw = dict(
@@ -1512,37 +1557,11 @@ class Scheduler:
         from ..models import packing
         from .cycle import multicycle_unsupported_reason
 
-        def fall_back(reason: str | None) -> None:
-            if reason == "host_ports":
-                # per-SNAPSHOT reason, not a sticky capability: only a
-                # PENDING pod that requests a port leaves the envelope
-                # (cycle.multicycle_unsupported_reason), so a later
-                # port-free batch is exact again — fall back for THIS
-                # batch without pinning the profile
-                log.info(
-                    "multi-cycle batch for profile %r fell back to "
-                    "sequential dispatches: pending set carries host "
-                    "ports (batching resumes on port-free batches)",
-                    profile,
-                )
-            elif reason is not None and profile not in self._mc_off:
-                # sticky encoder capability flags (affinity / topology
-                # spread / volumes / extender) are grow-only: once a
-                # profile's workload shows them, it never re-enters
-                self._mc_off[profile] = reason
-                log.warning(
-                    "multi-cycle serving disabled for profile %r: "
-                    "workload left the exactness envelope (%s); "
-                    "falling back to sequential single-cycle "
-                    "dispatches", profile, reason,
-                )
-            for _t_enq, g in groups:
-                self._schedule_profile(profile, g, stats, t0)
-
         # one spec for every row: pad to the LARGEST group so all K
         # packed snapshots stack into [K, W]/[K, B]; down-steps damped
         # by the same hysteresis as the single-cycle path
         mc_pods = max(len(g) for _, g in groups)
+        encoder = self._encoders[profile]
         encoder.pad_pods = encoder.hysteresis_pad(
             "P", _pad(mc_pods, self._pad_bucket), mc_pods
         )
@@ -1558,12 +1577,35 @@ class Scheduler:
         # back to a full encode (set even when the envelope precheck
         # falls back: the plain encodes have run either way)
         self._mc_stale_arena.add(profile)
+
+        # depth-2 speculative dispatch pipelining (speculativeDispatch):
+        # row 0 dispatches alone and the remaining rows ride its
+        # dispatch shadow as a speculative continuation batch — first
+        # bind lands after ~1 inner cycle instead of K. Forced off
+        # under forcedSync, at/below the ladder's `sequential` rung,
+        # and while the sentinel's speculation_thrash hold is active.
+        if (
+            self.config.speculative_dispatch
+            and len(groups) >= 2
+            and not self.forced_sync
+            and self.ladder.rung < RUNG_SEQUENTIAL
+            and (
+                self.observer is None
+                or self.observer.speculation_ok(profile)
+            )
+        ):
+            self._schedule_profile_multi_spec(
+                profile, groups, stats, t0, t_batch, t_batch_rec,
+                builds_before, nodes, existing, kw,
+            )
+            return
+
         snaps = []
         for _t_enq, g in groups:
             snaps.append(encoder.encode(nodes, g, existing, **kw))
             reason = multicycle_unsupported_reason(snaps[-1])
             if reason is not None:
-                fall_back(reason)
+                self._mc_fall_back(profile, groups, stats, t0, reason)
                 return
         specs = [packing.make_spec(s) for s in snaps]
         if any(sp.key() != specs[0].key() for sp in specs[1:]):
@@ -1577,30 +1619,19 @@ class Scheduler:
             specs = [packing.make_spec(s) for s in snaps]
             if any(sp.key() != specs[0].key() for sp in specs[1:]):
                 # cannot happen with grow-only tables; refuse to guess
-                fall_back(None)
+                self._mc_fall_back(profile, groups, stats, t0, None)
                 return
         spec = specs[0]
         (
             _pcycle, ppreempt, stable_fn, _keeper, _diag, _ek, pipe,
         ) = self._packed_fns(spec, profile)
-        mfn, mdiag = self._mc_programs(spec, profile)
+        mfn, mdiag, mcont = self._mc_programs(spec, profile)
         pipe.multi_fn = mfn
         pipe.multi_diag_fn = mdiag
+        pipe.multi_cont_fn = mcont
 
         n = len(groups)
-        wbufs = np.zeros((self._mc_k, spec.n_words), np.uint32)
-        bbufs = np.zeros((self._mc_k, spec.n_bytes), np.uint8)
-        for i, s in enumerate(snaps):
-            w, b = packing.pack(s, spec)
-            wbufs[i] = w
-            bbufs[i] = b
-        import os as _os
-
-        if _os.environ.get("K8S_TPU_NO_DEVICE_PUT") != "1":
-            import jax as _jax
-
-            wbufs = _jax.device_put(wbufs)
-            bbufs = _jax.device_put(bbufs)
+        wbufs, bbufs = self._pack_stack(snaps, spec)
         batch_pods = [p for _t_enq, g in groups for p in g]
         try:
             stable = self._stable_state(
@@ -1618,59 +1649,184 @@ class Scheduler:
         )
         pipe.dispatch_deadline_s = self._dispatch_deadline_s
         pipe.note_encode(t_encode - t_batch)
-        # a failed batch dispatch/fetch consumes the WHOLE batch before
-        # any bind: every group's pods requeue (the caller's
+        # a failed batch dispatch consumes the WHOLE batch before any
+        # bind: every group's pods requeue (the caller's
         # retire_in_flight after this return drops only pods the
         # requeue did not re-track)
         try:
             handle = pipe.dispatch_multi(
                 wbufs, bbufs, stable, n, device_put=False
             )
-            assignment, _unsched, gang_dropped, attempted, cycles_run = (
-                handle.decisions()
-            )
         except Exception as e:
             self._cycle_failed(profile, batch_pods, e, stats, t0, None)
             return
-        t_device = self._now()
-        self.metrics.cycle_duration.labels(phase="device").observe(
-            t_device - t_encode
-        )
         self.metrics.multicycle_batch.observe(n)
-        self.metrics.multicycle_cycles.inc(min(cycles_run, n) or 0)
-        if cycles_run < n:
-            # drain early-exit cannot fire on non-empty groups, so an
-            # unran row is a driver bug: requeue its pods loudly rather
-            # than treating "never executed" as "found no node"
-            log.error(
-                "multi-cycle dispatch ran %d of %d inner cycles; "
-                "requeueing the unran groups", cycles_run, n,
-            )
-            for _t_enq, g in groups[cycles_run:]:
-                for pod in g:
-                    # a distinct event name keeps the recovery honest:
-                    # these pods never reached a bind attempt, so a
-                    # "BindError" burst would send the operator to the
-                    # API-server bind path instead of the dispatch
-                    # driver (bind_errors still counts them — the
-                    # closest CycleStats bucket for "cycle failed
-                    # through no fault of the pod")
-                    self.queue.requeue_backoff(
-                        pod, event="MultiCycleUnran"
-                    )
-                    stats.bind_errors += 1
-
-        st = pipe.stage_report()
-        device_win_s = max(
-            st.get("t_decision_end", 0.0)
-            - st.get("t_dispatch_end", 0.0),
-            0.0,
+        applied, exc = self._apply_mc_rows(
+            profile, handle, groups, spec, encoder, stats, t0, t_batch,
+            t_batch_rec, nodes, existing, ppreempt, builds_before,
+            batch_n=n, stamp_first_bind=True, stamp_compile=True,
         )
-        total_attempted = sum(
-            len(g) for _t_enq, g in groups[:cycles_run]
-        ) or 1
-        for i in range(min(cycles_run, n)):
-            t_enq, pending = groups[i][0], groups[i][1]
+        self.metrics.multicycle_cycles.inc(applied)
+        if exc is not None:
+            # a mid-stream fetch failure: groups already applied are
+            # bound and folded (exactly as sequential dispatches would
+            # be); only the unapplied tail requeues through the ladder
+            rest = [p for _t_enq, g in groups[applied:] for p in g]
+            self._cycle_failed(profile, rest, exc, stats, t0, None)
+            return
+        self._maybe_speculate(profile, spec)
+
+    def _pack_stack(self, snaps, spec):
+        """Stack packed snapshot rows into the [K, W]/[K, B] multi-
+        cycle arenas (zero-padded past the real rows) and device_put
+        them unless K8S_TPU_NO_DEVICE_PUT=1 — the one upload
+        convention every multi-cycle dispatch shape (combined batch,
+        depth-2 row 0, speculative continuation) shares."""
+        import os as _os
+
+        from ..models import packing
+
+        wbufs = np.zeros((self._mc_k, spec.n_words), np.uint32)
+        bbufs = np.zeros((self._mc_k, spec.n_bytes), np.uint8)
+        for i, s in enumerate(snaps):
+            wbufs[i], bbufs[i] = packing.pack(s, spec)
+        if _os.environ.get("K8S_TPU_NO_DEVICE_PUT") != "1":
+            import jax as _jax
+
+            wbufs = _jax.device_put(wbufs)
+            bbufs = _jax.device_put(bbufs)
+        return wbufs, bbufs
+
+    def _mc_fall_back(
+        self, profile: str, groups, stats: CycleStats, t0: float,
+        reason: "str | None",
+    ) -> None:
+        """Dispatch `groups` as sequential single-cycle dispatches
+        because the batch left the multi-cycle exactness envelope
+        (`reason`), pinning sticky capability reasons out of batching
+        for the process lifetime (host_ports stays per-snapshot)."""
+        log = logging.getLogger(__name__)
+        if reason == "host_ports":
+            # per-SNAPSHOT reason, not a sticky capability: only a
+            # PENDING pod that requests a port leaves the envelope
+            # (cycle.multicycle_unsupported_reason), so a later
+            # port-free batch is exact again — fall back for THIS
+            # batch without pinning the profile
+            log.info(
+                "multi-cycle batch for profile %r fell back to "
+                "sequential dispatches: pending set carries host "
+                "ports (batching resumes on port-free batches)",
+                profile,
+            )
+        elif reason is not None and profile not in self._mc_off:
+            # sticky encoder capability flags (affinity / topology
+            # spread / volumes / extender) are grow-only: once a
+            # profile's workload shows them, it never re-enters
+            self._mc_off[profile] = reason
+            log.warning(
+                "multi-cycle serving disabled for profile %r: "
+                "workload left the exactness envelope (%s); "
+                "falling back to sequential single-cycle "
+                "dispatches", profile, reason,
+            )
+        for _t_enq, g in groups:
+            self._schedule_profile(profile, g, stats, t0)
+
+    @staticmethod
+    def _fold_digest(
+        scheduled: int, unschedulable: int, bind_errors: int,
+        victims: int,
+    ) -> tuple:
+        """Digest of one host fold's observable cache effects — the
+        part of the post-fold state a speculative continuation batch
+        conditioned on. The speculation's PREDICATE is this digest
+        computed from the predecessor's device decisions (every winner
+        binds, nothing else changes: zero bind errors, zero
+        evictions); the fold's ACTUAL digest is computed from what the
+        apply loop really did. Equal digests mean the cache mutated
+        exactly as the speculative encode+carry assumed, so adoption
+        is bit-identical to a sequential re-dispatch; anything else
+        (a bind error, a host-plugin veto, a preemption eviction)
+        abandons. A named tuple of the four counts, not a hash: on an
+        abandon the log must say WHICH count diverged — that is the
+        datum an operator debugging speculation_thrash needs."""
+        return (
+            ("scheduled", scheduled),
+            ("unschedulable", unschedulable),
+            ("bind_errors", bind_errors),
+            ("victims", victims),
+        )
+
+    def _apply_mc_rows(
+        self,
+        profile: str,
+        handle,
+        group_slice,
+        spec,
+        encoder,
+        stats: CycleStats,
+        t0: float,
+        t_batch: float,
+        t_batch_rec: float,
+        nodes,
+        existing,
+        ppreempt,
+        builds_before: int,
+        batch_n: int,
+        stamp_first_bind: bool = False,
+        stamp_compile: bool = False,
+        resolve_after_first=None,
+    ) -> "tuple[int, BaseException | None]":
+        """STREAMED apply of one dispatched multi-cycle batch: fetch
+        decision row i (`MultiCycleHandle.decisions_row`), apply group
+        i through `_apply_phase`, commit its flight record — so inner
+        cycle i's winners bind while rows i+1… (and, under depth-2
+        speculation, the NEXT batch) are still on device, instead of
+        blocking on the whole stacked fetch.
+
+        `group_slice` is this handle's `[(t_enq, pods), …]` in row
+        order. `resolve_after_first(a_row, before)` — the speculation
+        predicate hook — runs after group 0's apply and returns the
+        speculation tag for its record. Returns `(applied, exc)`:
+        `applied` groups were fully applied; `exc` is the fetch
+        failure that stopped the walk (None when every row landed —
+        the caller requeues the unapplied tail). Rows the device loop
+        never executed (early exit on a non-empty group: a driver
+        bug) requeue loudly here with `MultiCycleUnran`."""
+        fr = self.flight
+        log = logging.getLogger(__name__)
+        framework = self.frameworks[profile]
+        pipe = handle._pipe
+        st: dict = {}
+        device_win_s = 0.0
+        total_attempted = sum(len(g) for _t, g in group_slice) or 1
+        applied = 0
+        exc: "BaseException | None" = None
+        for gi, (t_enq, pending) in enumerate(group_slice):
+            try:
+                a_full, _u_full, gd_full, att_full = (
+                    handle.decisions_row(gi)
+                )
+            except Exception as e:  # schedlint: disable=RB001 -- not swallowed: decisions_row already attributed it (note_fetch_failure: metric + events ring) and the caller routes it through _cycle_failed's ladder step + requeue
+                exc = e
+                break
+            if gi == 0:
+                # the dispatch's stage report as of its first landed
+                # row: batch-wide marks (encode/dispatch/decision
+                # fetch) come from here and land only on record 0
+                st = pipe.stage_report()
+                device_win_s = max(
+                    st.get("t_decision_end", 0.0)
+                    - st.get("t_dispatch_end", 0.0),
+                    0.0,
+                )
+                self.metrics.cycle_duration.labels(
+                    phase="device"
+                ).observe(device_win_s)
+            if pending and not att_full[: len(pending)].any():
+                # drain early-exit cannot fire on non-empty groups, so
+                # an unran row is a driver bug: stop and requeue below
+                break
             rec = fr.start(profile) if fr is not None else None
             _before = (
                 stats.scheduled, stats.unschedulable, stats.bind_errors,
@@ -1682,98 +1838,423 @@ class Scheduler:
                 # the inner cycle's pods actually experienced
                 rec.t_start = t_batch_rec
                 rec.mark("encode_start", t_batch_rec)
-            a_i = assignment[i][: len(pending)]
-            gd_i = gang_dropped[i][: len(pending)]
-            profile_gang_dropped = int(gd_i.sum())
-            stats.gang_dropped += profile_gang_dropped
-            self.metrics.decisions.inc(len(pending) * len(nodes))
-
-            if (a_i < 0).any():
-                handle.dispatch_diagnosis(i)
-            _rej_box: list = []
-
-            def reject_counts_of(
-                j: int, i=i, pending=pending, _rej_box=_rej_box
-            ):
-                if not _rej_box:
-                    _rej_box.append(
-                        handle.reject_counts(i)[: len(pending)]
-                    )
-                return _rej_box[0][j]
-
-            pre_handle = None
-            if ppreempt is not None and (a_i < 0).any():
-                self.metrics.preemption_attempts.inc()
-                pre_handle = handle.dispatch_preemption(i)
-
-            def force_pre(pre_handle=pre_handle, pending=pending):
-                if pre_handle is None:
-                    return None, None
-                return (
-                    np.asarray(pre_handle.nominated)[: len(pending)],
-                    np.asarray(pre_handle.victims)[: len(existing)],
+            try:
+                self._apply_mc_row(
+                    profile, handle, gi, pending, a_full, gd_full,
+                    spec, encoder, stats, t0, t_batch, t_batch_rec,
+                    nodes, existing, ppreempt, builds_before, batch_n,
+                    stamp_first_bind, stamp_compile,
+                    resolve_after_first, rec, st, device_win_s,
+                    total_attempted, t_enq, _before,
                 )
+            except Exception:  # schedlint: disable=RB001 -- not swallowed: the guard-release is the recovery (old stacked-fetch parity); the error re-raises to the cycle driver with its story intact
+                # a NON-fetch failure mid-apply (a deferred diagnosis/
+                # preemption force, a host-plugin bug): the stacked
+                # fetch of the old path had already marked the handle
+                # consumed before the apply loop, so the ordering guard
+                # could never be left held — restore that property
+                # before the error reaches the cycle driver, or one
+                # apply-path exception would wedge the pipeline forever
+                handle.fetched = True
+                handle.release()
+                pipe._note_inflight()
+                raise
+            applied += 1
+        if exc is None and applied < len(group_slice):
+            log.error(
+                "multi-cycle dispatch ran %d of %d inner cycles; "
+                "requeueing the unran groups", applied,
+                len(group_slice),
+            )
+            # release the guard: the unran rows will never be fetched
+            # (a distinct event name keeps the recovery honest — these
+            # pods never reached a bind attempt; bind_errors still
+            # counts them, the closest CycleStats bucket for "cycle
+            # failed through no fault of the pod")
+            handle.fetched = True
+            handle.release()
+            pipe._note_inflight()
+            for _t_enq, g in group_slice[applied:]:
+                for pod in g:
+                    self.queue.requeue_backoff(
+                        pod, event="MultiCycleUnran"
+                    )
+                    stats.bind_errors += 1
+        return applied, exc
 
-            self._apply_phase(
-                profile, framework, pending, nodes, existing, a_i,
-                gd_i, {}, reject_counts_of, force_pre,
-                stats, t0, rec, self._now(),
+    def _apply_mc_row(
+        self, profile, handle, gi, pending, a_full, gd_full, spec,
+        encoder, stats, t0, t_batch, t_batch_rec, nodes, existing,
+        ppreempt, builds_before, batch_n, stamp_first_bind,
+        stamp_compile, resolve_after_first, rec, st, device_win_s,
+        total_attempted, t_enq, before,
+    ) -> None:
+        """One inner cycle's apply + record commit (the body of
+        _apply_mc_rows' walk, split out so its guard-release failure
+        handling stays readable)."""
+        framework = self.frameworks[profile]
+        a_i = a_full[: len(pending)]
+        gd_i = gd_full[: len(pending)]
+        profile_gang_dropped = int(gd_i.sum())
+        stats.gang_dropped += profile_gang_dropped
+        self.metrics.decisions.inc(len(pending) * len(nodes))
+
+        if (a_i < 0).any():
+            handle.dispatch_diagnosis(gi)
+        _rej_box: list = []
+
+        def reject_counts_of(
+            j: int, gi=gi, pending=pending, _rej_box=_rej_box
+        ):
+            if not _rej_box:
+                _rej_box.append(
+                    handle.reject_counts(gi)[: len(pending)]
+                )
+            return _rej_box[0][j]
+
+        pre_handle = None
+        if ppreempt is not None and (a_i < 0).any():
+            self.metrics.preemption_attempts.inc()
+            pre_handle = handle.dispatch_preemption(gi)
+
+        def force_pre(pre_handle=pre_handle, pending=pending):
+            if pre_handle is None:
+                return None, None
+            return (
+                np.asarray(pre_handle.nominated)[: len(pending)],
+                np.asarray(pre_handle.victims)[: len(existing)],
             )
 
-            if rec is not None:
-                # batched decomposition (observe.PHASES): how long this
-                # group waited for the batch to fill, and its share of
-                # the batch's device window apportioned by attempted-pod
-                # counts (no clock runs under jit). multi_cycle_k marks
-                # this record as an inner cycle of an n-cycle batch —
-                # the observer reads it to excuse the full (non-delta)
-                # per-group encodes from fold_miss
-                extra_phases: dict = {
-                    "batch_wait_ms": max(t_batch - t_enq, 0.0) * 1e3,
-                    "device_share_ms": (
-                        device_win_s * len(pending)
-                        / total_attempted * 1e3
-                    ),
-                }
-                extra_marks: dict = {}
-                extra_counts: dict = {"multi_cycle_k": n}
-                # st was snapshotted BEFORE the apply loop; this inner
-                # cycle's deferred-diagnosis force (if any) stamped its
-                # lag on the handle during _apply_phase just above
-                dl = handle.diag_lag.get(i)
-                if dl is not None:
-                    lag_s, t_done = dl
-                    extra_phases["diag_lag_ms"] = lag_s * 1e3
-                    extra_marks["diag_done"] = t_done
-                    self.metrics.diag_lag.observe(lag_s)
-                compile_source = ""
-                if i == 0 and self._packed_builds > builds_before:
-                    extra_phases["compile_ms"] = (
-                        self._last_build_s * 1e3
+        self._apply_phase(
+            profile, framework, pending, nodes, existing, a_i,
+            gd_i, {}, reject_counts_of, force_pre,
+            stats, t0, rec, self._now(),
+        )
+        speculation = ""
+        if gi == 0 and resolve_after_first is not None:
+            # the speculation predicate: group 0's fold just landed —
+            # adopt or abandon the in-flight continuation before any
+            # record of this batch publishes
+            speculation = resolve_after_first(a_i, before)
+
+        if rec is not None:
+            # batched decomposition (observe.PHASES): how long this
+            # group waited for the batch to fill, and its share of
+            # the batch's device window apportioned by attempted-pod
+            # counts (no clock runs under jit). multi_cycle_k marks
+            # this record as an inner cycle of an n-cycle batch —
+            # the observer reads it to excuse the full (non-delta)
+            # per-group encodes from fold_miss
+            extra_phases: dict = {
+                "batch_wait_ms": max(t_batch - t_enq, 0.0) * 1e3,
+                "device_share_ms": (
+                    device_win_s * len(pending)
+                    / total_attempted * 1e3
+                ),
+            }
+            extra_marks: dict = {}
+            extra_counts: dict = {"multi_cycle_k": batch_n}
+            if (
+                gi == 0 and stamp_first_bind
+                and "t_first_decision" in st
+                and t_batch_rec
+            ):
+                # streamed-fetch headline: batch flush -> the first
+                # decision row landed (both on the recorder clock)
+                extra_phases["first_bind_ms"] = max(
+                    st["t_first_decision"] - t_batch_rec, 0.0
+                ) * 1e3
+            dl = handle.diag_lag.get(gi)
+            if dl is not None:
+                lag_s, t_done = dl
+                extra_phases["diag_lag_ms"] = lag_s * 1e3
+                extra_marks["diag_done"] = t_done
+                self.metrics.diag_lag.observe(lag_s)
+            compile_source = ""
+            if (
+                gi == 0 and stamp_compile
+                and self._packed_builds > builds_before
+            ):
+                extra_phases["compile_ms"] = (
+                    self._last_build_s * 1e3
+                )
+                extra_counts["regime_flip"] = 1
+                compile_source = self._last_compile_source
+            # batch-wide pipeline marks/phases (encode, dispatch,
+            # device window, decision fetch) land ONLY on inner
+            # record 0 — the one representing the dispatch. Copying
+            # them onto all K records would feed the streaming
+            # phase histograms K observations of ONE batch window
+            # (~K-fold inflated attribution) and let a single slow
+            # batch raise K duplicate stall anomalies; records i>0
+            # carry the apportioned decomposition instead
+            # (device_share/batch_wait), same spirit as zeroing
+            # their fetch_bytes
+            st_i = st if gi == 0 else {"slot": st.get("slot", -1)}
+            self._commit_record(
+                rec, st_i, spec, encoder, pending, nodes, stats,
+                before, profile_gang_dropped,
+                fetch_bytes=(
+                    int(st.get("fetch_bytes", 0)) if gi == 0 else 0
+                ),
+                extra_phases=extra_phases,
+                extra_marks=extra_marks,
+                extra_counts=extra_counts,
+                compile_source=compile_source,
+                speculation=speculation,
+            )
+
+    def _schedule_profile_multi_spec(
+        self,
+        profile: str,
+        groups: "list[tuple[float, list[Pod]]]",
+        stats: CycleStats,
+        t0: float,
+        t_batch: float,
+        t_batch_rec: float,
+        builds_before: int,
+        nodes,
+        existing,
+        kw: dict,
+    ) -> None:
+        """The depth-2 speculative split of one flushed batch
+        (ROADMAP item 2 / ISSUE 13 tentpole): batch A = row 0 alone,
+        batch B = the remaining rows, dispatched SPECULATIVELY against
+        A's predicted post-fold state while A is still on device.
+
+        Timeline (device never idles, first bind never waits K
+        cycles):
+
+            encode row 0 -> dispatch A (1 inner cycle)
+            encode rows 1..n-1          | A on device
+            dispatch B (carry0 = A's    |
+              device-resident carry)    |
+            fetch A row 0, bind, fold   | B on device
+            predicate digest match?     |
+              yes -> adopt B: stream B's rows, apply (zero added
+                     latency — B has been on device the whole time)
+              no  -> abandon B, re-dispatch rows 1..n-1 against the
+                     TRUE post-fold state (correctness never rides
+                     the speculation, only latency does)
+
+        The predicate (`_fold_digest`) covers exactly what B's encode
+        + device-carry assumed about A's fold: every device winner
+        binds, no bind errors, no host-plugin vetoes, no preemption
+        evictions. B's rows were encoded against the same pre-batch
+        cache state the combined [A;B] batch would use and chained
+        through the carry_in continuation program, so adoption is
+        bit-identical to the combined batch — and, inside the
+        envelope, to sequential dispatches with host folding
+        (tests/test_speculative.py asserts all three)."""
+        from ..models import packing
+        from .cycle import multicycle_unsupported_reason
+
+        log = logging.getLogger(__name__)
+        encoder = self._encoders[profile]
+        n = len(groups)
+        rest_groups = groups[1:]
+        batch_pods = [p for _t_enq, g in groups for p in g]
+
+        snap0 = encoder.encode(nodes, groups[0][1], existing, **kw)
+        reason = multicycle_unsupported_reason(snap0)
+        if reason is not None:
+            self._mc_fall_back(profile, groups, stats, t0, reason)
+            return
+        spec = packing.make_spec(snap0)
+        (
+            _pcycle, ppreempt, stable_fn, _keeper, _diag, _ek, pipe,
+        ) = self._packed_fns(spec, profile)
+        mfn, mdiag, mcont = self._mc_programs(spec, profile)
+        pipe.multi_fn = mfn
+        pipe.multi_diag_fn = mdiag
+        pipe.multi_cont_fn = mcont
+
+        wa, ba = self._pack_stack([snap0], spec)
+        try:
+            stable = self._stable_state(
+                spec, stable_fn, wa[0], ba[0], encoder
+            )
+        except Exception as e:
+            self._cycle_failed(profile, batch_pods, e, stats, t0, None)
+            return
+        t_encode = self._now()
+        self.metrics.cycle_duration.labels(phase="encode").observe(
+            t_encode - t_batch
+        )
+        # the speculative gate already excluded forcedSync and the
+        # degraded rungs; refresh the pipeline's knobs regardless
+        pipe.forced_sync = False
+        pipe.dispatch_deadline_s = self._dispatch_deadline_s
+        pipe.note_encode(t_encode - t_batch)
+        try:
+            handle_a = pipe.dispatch_multi(
+                wa, ba, stable, 1, device_put=False
+            )
+        except Exception as e:
+            self._cycle_failed(profile, batch_pods, e, stats, t0, None)
+            return
+
+        # rows 1..n-1 encode in A's dispatch shadow — the host work
+        # depth-2 hides behind device time (effective cycle tends to
+        # max(device_ms, encode_ms) instead of their sum)
+        t_enc_b0 = self._now()
+        snaps_b = []
+        bad_reason: "str | None" = None
+        for _t_enq, g in rest_groups:
+            s = encoder.encode(nodes, g, existing, **kw)
+            bad_reason = multicycle_unsupported_reason(s)
+            if bad_reason is not None:
+                break
+            snaps_b.append(s)
+        handle_b = None
+        if bad_reason is None:
+            if any(
+                packing.make_spec(s).key() != spec.key()
+                for s in snaps_b
+            ):
+                # a later group grew an interning dimension past row
+                # 0's regime: the continuation carry shapes no longer
+                # line up, so B cannot chain — it re-dispatches after
+                # A's fold instead (counted as speculation="none":
+                # nothing was ever speculated)
+                log.info(
+                    "speculative batch for profile %r skipped: rows "
+                    "1..%d grew the packed regime past row 0's spec",
+                    profile, n - 1,
+                )
+            else:
+                wb, bb = self._pack_stack(snaps_b, spec)
+                pipe.note_encode(self._now() - t_enc_b0)
+                try:
+                    handle_b = pipe.dispatch_multi(
+                        wb, bb, stable, n - 1, device_put=False,
+                        carry0=(
+                            handle_a.result.carry_node_requested,
+                            handle_a.result.carry_gplaced,
+                        ),
+                        speculative=True,
                     )
-                    extra_counts["regime_flip"] = 1
-                    compile_source = self._last_compile_source
-                # batch-wide pipeline marks/phases (encode, dispatch,
-                # device window, decision fetch) land ONLY on inner
-                # record 0 — the one representing the dispatch. Copying
-                # them onto all K records would feed the streaming
-                # phase histograms K observations of ONE batch window
-                # (~K-fold inflated attribution) and let a single slow
-                # batch raise K duplicate stall anomalies; records i>0
-                # carry the apportioned decomposition instead
-                # (device_share/batch_wait), same spirit as zeroing
-                # their fetch_bytes
-                st_i = st if i == 0 else {"slot": st.get("slot", -1)}
-                self._commit_record(
-                    rec, st_i, spec, encoder, pending, nodes, stats,
-                    _before, profile_gang_dropped,
-                    fetch_bytes=(
-                        int(st.get("fetch_bytes", 0)) if i == 0 else 0
-                    ),
-                    extra_phases=extra_phases,
-                    extra_marks=extra_marks,
-                    extra_counts=extra_counts,
-                    compile_source=compile_source,
+                except Exception as e:
+                    # the speculation itself failing must never fail
+                    # the batch: B simply re-dispatches sequentially
+                    # after A's fold
+                    log.warning(
+                        "speculative dispatch failed for profile %r "
+                        "(%s); re-dispatching sequentially", profile, e,
+                    )
+                    handle_b = None
+
+        outcome: dict = {}
+
+        def resolve(a_row, before):
+            # predicted fold: every device winner binds, nothing else
+            # mutates the cache — vs what the apply loop actually did
+            wins = int((a_row >= 0).sum())
+            predicted = self._fold_digest(
+                wins, len(a_row) - wins, 0, 0
+            )
+            sb, ub, bb_, _pb, vb = before
+            actual = self._fold_digest(
+                stats.scheduled - sb,
+                stats.unschedulable - ub,
+                stats.bind_errors - bb_,
+                stats.victims - vb,
+            )
+            outcome["predicted"] = predicted
+            outcome["actual"] = actual
+            if handle_b is None:
+                outcome["tag"] = "none"
+            elif actual == predicted:
+                pipe.adopt_speculative()
+                outcome["tag"] = "adopted"
+            else:
+                pipe.abandon_speculative()
+                outcome["tag"] = "abandoned"
+            return outcome["tag"]
+
+        self.metrics.multicycle_batch.observe(n)
+        try:
+            applied_a, exc_a = self._apply_mc_rows(
+                profile, handle_a, groups[:1], spec, encoder, stats,
+                t0, t_batch, t_batch_rec, nodes, existing, ppreempt,
+                builds_before, batch_n=n, stamp_first_bind=True,
+                stamp_compile=True, resolve_after_first=resolve,
+            )
+        except BaseException:  # schedlint: disable=RB001 -- not swallowed: purely a leak guard (the speculation slot must not outlive the batch) — the original error re-raises with its story intact
+            # a non-fetch apply failure escaped with the speculation
+            # possibly unresolved: free its slot before the error
+            # reaches the cycle driver (no-op if already resolved)
+            pipe.abandon_speculative()
+            raise
+        if exc_a is not None:
+            # A's fetch failed with the speculation (if any) still in
+            # flight: abandon it so its arena slot cannot leak, then
+            # consume the whole batch through the ladder — nothing was
+            # bound, every pod requeues
+            pipe.abandon_speculative()
+            self._cycle_failed(
+                profile, batch_pods, exc_a, stats, t0, None
+            )
+            return
+        if applied_a == 0:
+            # row 0 never executed (driver bug; A's group was requeued
+            # by _apply_mc_rows) — the speculation conditioned on a
+            # fold that never happened
+            pipe.abandon_speculative()
+            for _t_enq, g in rest_groups:
+                for pod in g:
+                    self.queue.requeue_backoff(
+                        pod, event="MultiCycleUnran"
+                    )
+                    stats.bind_errors += 1
+            return
+
+        tag = outcome.get("tag", "none")
+        if tag == "adopted":
+            applied_b, exc_b = self._apply_mc_rows(
+                profile, handle_b, rest_groups, spec, encoder, stats,
+                t0, t_batch, t_batch_rec, nodes, existing, ppreempt,
+                builds_before, batch_n=n,
+            )
+            self.metrics.multicycle_cycles.inc(applied_a + applied_b)
+            if exc_b is not None:
+                rest = [
+                    p for _t_enq, g in rest_groups[applied_b:]
+                    for p in g
+                ]
+                self._cycle_failed(
+                    profile, rest, exc_b, stats, t0, None
+                )
+                return
+        else:
+            self.metrics.multicycle_cycles.inc(applied_a)
+            if tag == "abandoned":
+                pipe.note_redispatch()
+                diverged = [
+                    f"{name} {pv}->{av}"
+                    for (name, pv), (_n2, av) in zip(
+                        outcome["predicted"], outcome["actual"]
+                    )
+                    if pv != av
+                ]
+                log.info(
+                    "speculative batch abandoned for profile %r (host "
+                    "fold diverged from the predicate digest: %s); "
+                    "re-dispatching %d group(s) against the true "
+                    "carry", profile, ", ".join(diverged),
+                    len(rest_groups),
+                )
+            if bad_reason is not None:
+                self._mc_fall_back(
+                    profile, rest_groups, stats, t0, bad_reason
+                )
+            elif len(rest_groups) == 1:
+                self._schedule_profile(
+                    profile, rest_groups[0][1], stats, t0
+                )
+            else:
+                self._schedule_profile_multi(
+                    profile, rest_groups, stats, t0
                 )
         self._maybe_speculate(profile, spec)
 
@@ -1793,6 +2274,7 @@ class Scheduler:
         extra_marks: "dict | None" = None,
         extra_counts: "dict | None" = None,
         compile_source: str = "",
+        speculation: str = "",
     ) -> None:
         """Assemble + commit one cycle flight record (one list store):
         pipeline stage marks/phases, pad-regime signature, queue
@@ -1830,6 +2312,11 @@ class Scheduler:
             # regime-flip cycles only: how the (re)build was paid —
             # cold compile, persistent-cache load, or a speculation win
             rec.compile_source = compile_source
+        if speculation:
+            # depth-2 dispatch speculation outcome (adopted | abandoned
+            # | none), one sample per speculation — feeds the
+            # observer's speculation_thrash abandon-rate EWMA
+            rec.speculation = speculation
         qc = self.queue.pending_counts()
         sb, ub, bb, pb, vb = before
         rec.counts.update(
@@ -2230,6 +2717,22 @@ class Scheduler:
         if self.flight is not None and self.flight.cycles:
             d = self.flight.derived()
             self.metrics.pipeline_overlap.set(d["overlap_ratio"])
+
+    def speculation_ledger(self) -> dict:
+        """Aggregate depth-2 speculation ledger: {'adopted',
+        'abandoned', 'redispatched'} counts. Read from this
+        scheduler's scheduler_speculation_total{outcome} counters, not
+        the per-pipeline dicts — a retrace rung (or plain LRU
+        eviction) drops regime pipelines along with their ledgers,
+        while the metric registry survives every memo clear. Soaks and
+        the fuzz differential read this to assert the speculative path
+        actually exercised (and abandoned without leaking a slot)."""
+        return {
+            o: int(
+                self.metrics.speculation.labels(outcome=o)._value.get()
+            )
+            for o in ("adopted", "abandoned", "redispatched")
+        }
 
     def pod_timeline(self, uid: str) -> dict | None:
         """The per-pod scheduling timeline: the flight recorder's pod

@@ -55,7 +55,13 @@ from ..internal.queue import (
 )
 from ..models.api import Pod
 from ..ops import preemption as preemption_ops
-from .trace import Trace, materialize, materialize_event
+from .trace import (
+    Trace,
+    materialize,
+    materialize_event,
+    trace_from_dict,
+    trace_to_dict,
+)
 
 
 @dataclasses.dataclass
@@ -208,6 +214,12 @@ def replay_engine(trace: Trace, *, state_dir: str = "") -> ReplayResult:
         multi_cycle_k=int(cfgd.get("multi_cycle_k", 1)),
         multi_cycle_max_wait_ms=float(
             cfgd.get("multi_cycle_max_wait_ms", 1e12)
+        ),
+        # depth-2 speculative dispatch (default OFF for traces: the
+        # committed corpus predates the key and must replay unchanged;
+        # generate_trace(speculative=True) turns the variant on)
+        speculative_dispatch=bool(
+            cfgd.get("speculative_dispatch", False)
         ),
         shard_devices=devices,
         dispatch_deadline_ms=float(cfgd.get("dispatch_deadline_ms", 0.0)),
@@ -392,6 +404,11 @@ def replay_engine(trace: Trace, *, state_dir: str = "") -> ReplayResult:
             "fired_points": sorted(
                 faults.plan().fired_points()
             ) if faults.plan() is not None else [],
+            # depth-2 speculation outcomes (all zero when the trace
+            # runs without speculativeDispatch): the variant tests
+            # assert the speculative path actually exercised AND that
+            # no slot leaked (pipeline inflight drained)
+            "speculation": sched.speculation_ledger(),
         }
     finally:
         from k8s_scheduler_tpu.core import faults as _faults
@@ -682,20 +699,70 @@ def compare(trace: Trace, eng: ReplayResult, orc: ReplayResult) -> list[Failure]
     return out
 
 
+def compare_speculative(
+    eng_on: ReplayResult, eng_off: ReplayResult
+) -> list[Failure]:
+    """Per-cycle bit-equality of the speculative engine against the
+    NON-speculative engine on the same trace. This — not the oracle —
+    is depth-2 speculation's contract: adoption/abandonment must not
+    change WHAT is decided, WHEN it lands, or in what order (the two
+    engines share the exact batching cadence, so even the cycle
+    placement must match). The oracle differential is defined against
+    sequential serving, where coalescing's documented legal
+    batch-window shifts (an unschedulable pod's re-activation moving
+    to the flush cycle) would read as divergence."""
+    out: list[Failure] = []
+    for er, orr in zip(eng_on.records, eng_off.records):
+        for key in _PER_CYCLE_KEYS + ("requeues", "rung"):
+            if er[key] != orr[key]:
+                out.append(Failure(
+                    f"speculation/{key}", er["cycle"],
+                    f"spec-on={er[key]!r} spec-off={orr[key]!r}",
+                ))
+        if out:
+            return out
+    return out
+
+
 def run_case(
     trace: Trace, *, state_dir: str = "", bug: "str | None" = None
 ) -> list[Failure]:
     """Replay one trace end to end and return every failure: engine
     invariants (+ chaos checks), oracle invariants, and — for plain
     traces — the differential divergences. `bug` injects a deliberate
-    engine mutation (see `engine_bug`) for harness self-tests."""
+    engine mutation (see `engine_bug`) for harness self-tests.
+
+    Speculative-dispatch traces differentially compare the engine
+    against ITSELF with speculation off (see compare_speculative) and
+    additionally fail when the trace never actually speculated —
+    a variant that silently stopped exercising the depth-2 path would
+    otherwise be a permanent green. Decision correctness is still
+    oracle-checked through the non-speculative variants (a shared
+    engine bug cancels out of an engine-vs-engine comparison, so this
+    variant hunts speculation bugs specifically)."""
     with engine_bug(bug):
         eng = replay_engine(trace, state_dir=state_dir)
     failures = list(eng.failures)
-    if not trace.chaos:
-        orc = replay_oracle(trace)
-        failures.extend(orc.failures)
-        failures.extend(compare(trace, eng, orc))
+    if trace.chaos:
+        return failures
+    if trace.config.get("speculative_dispatch"):
+        off = trace_from_dict(trace_to_dict(trace))
+        off.config["speculative_dispatch"] = False
+        with engine_bug(bug):
+            eng_off = replay_engine(off)
+        failures.extend(eng_off.failures)
+        failures.extend(compare_speculative(eng, eng_off))
+        led = eng.stats.get("speculation", {})
+        if not (led.get("adopted", 0) + led.get("abandoned", 0)):
+            failures.append(Failure(
+                "speculation/never_exercised", -1,
+                f"speculativeDispatch trace dispatched no speculative "
+                f"batch (ledger {led})",
+            ))
+        return failures
+    orc = replay_oracle(trace)
+    failures.extend(orc.failures)
+    failures.extend(compare(trace, eng, orc))
     return failures
 
 

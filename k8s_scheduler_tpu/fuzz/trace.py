@@ -235,6 +235,7 @@ def _gen_pod(
     churn_ok: bool,
     heavy: bool = False,
     flat_priority: bool = False,
+    envelope_only: bool = False,
 ) -> dict:
     app = rng.choice(APPS)
     if heavy:
@@ -259,11 +260,19 @@ def _gen_pod(
         b.node_selector({"node-type": rng.choice(NODE_TYPES)})
     if rng.random() < 0.30:
         b.toleration("dedicated", "special", "NoSchedule")
-    if rng.random() < 0.25:
+    # envelope_only (speculative depth-2 traces): the capability draws
+    # are still consumed — the stamp's spec flag must not shift the rng
+    # stream — but the envelope-leaving features (affinity / spread /
+    # volumes / host ports, cycle.multicycle_unsupported_reason) are
+    # not applied, so the trace actually exercises the device loop the
+    # variant pipelines instead of pinning the profile out of batching
+    # on its first affinity pod. Plain multi-cycle traces keep drawing
+    # them: the envelope-exit fallback is itself a fuzzed path.
+    if rng.random() < 0.25 and not envelope_only:
         b.pod_affinity("topology.kubernetes.io/zone", {"app": app})
-    if rng.random() < 0.25:
+    if rng.random() < 0.25 and not envelope_only:
         b.pod_affinity("kubernetes.io/hostname", {"app": app}, anti=True)
-    if rng.random() < 0.20:
+    if rng.random() < 0.20 and not envelope_only:
         b.spread(rng.choice((1, 2)), "topology.kubernetes.io/zone",
                  {"app": app},
                  when_unsatisfiable=rng.choice(
@@ -272,7 +281,7 @@ def _gen_pod(
         b.host_port(8000 + rng.randrange(4))
     if groups and rng.random() < 0.30:
         b.group(rng.choice(groups)["n"])
-    if claims and rng.random() < 0.5:
+    if claims and rng.random() < 0.5 and not envelope_only:
         b.volume(claims.pop(0)["n"])
     if rng.random() < 0.08:
         b.preemption_policy("Never")
@@ -285,6 +294,7 @@ def generate_trace(
     devices: int = 1,
     chaos: bool = False,
     multi_cycle: "bool | None" = None,
+    speculative: bool = False,
 ) -> Trace:
     """One random scenario. `devices` > 1 turns on sharded serving
     (`shardDevices`; placements must stay bit-identical — PR 9's
@@ -300,14 +310,19 @@ def generate_trace(
     defines (whose own drive freezes the clock for the same reason).
     `chaos` fuses a random `FaultPlan` over the trace (engine side
     only) and appends a recovery tail so the ladder invariants are
-    decidable."""
+    decidable. `speculative` turns on the depth-2 speculative dispatch
+    variant (speculativeDispatch; forces the K=4 coalescing path it
+    pipelines) — a pure config switch drawing nothing from the rng, so
+    a stamp's spec=<0|1> reproduces the identical trace either way."""
     rng = random.Random(seed)
     # the coin is drawn UNCONDITIONALLY so an explicit multi_cycle flag
     # (replaying a FUZZ-FAIL stamp's mc=<0|1>) consumes the same rng
     # stream as the seeded coin did — the stamp must reproduce the
     # identical trace, not a shifted one
     mc_coin = rng.random() < 0.25
-    if multi_cycle is None:
+    if speculative:
+        multi_cycle = True
+    elif multi_cycle is None:
         multi_cycle = mc_coin
     churn_ok = not multi_cycle
     uniform = rng.random() < 0.5  # identical nodes -> score ties abound
@@ -403,6 +418,7 @@ def generate_trace(
                     rng, name, created, groups=pod_groups,
                     claims=claims, churn_ok=churn_ok, heavy=heavy,
                     flat_priority=multi_cycle,
+                    envelope_only=speculative,
                 ),
             })
             created += 1.0
@@ -482,6 +498,10 @@ def generate_trace(
         # real-units bound every cycle — batches flush on K or idle pops
         "multi_cycle_max_wait_ms": 1e12,
         "shard_devices": devices if devices > 1 else 0,
+        # depth-2 speculative dispatch pipelining over the coalesced
+        # batches: the differential asserts the adopted/abandoned/
+        # re-dispatched streams stay bit-equal to the oracle's
+        "speculative_dispatch": bool(speculative),
         "pad_bucket": 8,
         "dispatch_deadline_ms": 300.0 if chaos else 0.0,
         "degrade_promote_cycles": 2,

@@ -56,9 +56,12 @@ streaming consumer of every flight record):
 - scheduler_cycle_phase_seconds{phase} — streaming per-phase latency
   attribution of every committed cycle record; phases: total, encode,
   fold, dispatch, device, decision_fetch, bind, postfilter, diag_lag,
-  compile, batch_wait, device_share (the last two are the multi-cycle
-  batched decomposition: an inner cycle's host-side coalescing wait and
-  its apportioned share of the batch's device window; the inventory is
+  compile, batch_wait, device_share, first_bind (batch_wait and
+  device_share are the multi-cycle batched decomposition: an inner
+  cycle's host-side coalescing wait and its apportioned share of the
+  batch's device window; first_bind is the streamed-fetch window from
+  batch flush to the FIRST inner cycle's decisions landing — the
+  latency a row-0 pod actually waits before its bind; the inventory is
   core/observe.PHASES, machine-checked by schedlint ID005 against the
   trace lane mapping and the README)
 - scheduler_cycle_phase_p50_seconds{phase} /
@@ -66,8 +69,9 @@ streaming consumer of every flight record):
   the observer's streaming histograms, evaluated at scrape time
 - scheduler_anomalies_total{class} — typed anomaly detections
   (tunnel_stall | fetch_stall | recompile | fold_miss |
-  wedge_precursor | degraded); each increment has a matching structured
-  event in /debug/anomalies carrying the cycle seq
+  wedge_precursor | degraded | speculation_thrash); each increment has
+  a matching structured event in /debug/anomalies carrying the cycle
+  seq
 - scheduler_slo_burn_rate{window} — latency-SLO burn rate over the
   fast/slow cycle windows (1.0 = burning the error budget exactly at
   the sustainable rate), 0 when no sloP99Ms objective is configured
@@ -82,6 +86,12 @@ round trip):
   multi-cycle device dispatch (1 = a degenerate single-cycle batch)
 - scheduler_multicycle_inner_cycles_total — scheduling cycles served
   through multi-cycle dispatches (vs one dispatch per cycle)
+- scheduler_speculation_total{outcome} — depth-2 speculative dispatch
+  outcomes (adopted | abandoned | redispatched): a batch dispatched
+  against the predicted post-predecessor carry is adopted when the
+  host fold matches the speculation's predicate digest (zero added
+  latency), abandoned on a mismatch, and its groups then re-dispatched
+  against the true carry — correctness is never speculative
 
 Multi-chip serving families (shardDevices + parallel/audit.py — the
 sharded carry path with shard-invariant tie-breaking):
@@ -355,8 +365,9 @@ class SchedulerMetrics:
             "scheduler_anomalies_total",
             "Typed anomaly detections from the cycle observer "
             "(tunnel_stall | fetch_stall | recompile | fold_miss | "
-            "wedge_precursor | degraded); each has a structured "
-            "/debug/anomalies event carrying the cycle seq.",
+            "wedge_precursor | degraded | speculation_thrash); each "
+            "has a structured /debug/anomalies event carrying the "
+            "cycle seq.",
             ["class"],
             registry=r,
         )
@@ -385,6 +396,14 @@ class SchedulerMetrics:
             "scheduler_multicycle_inner_cycles_total",
             "Scheduling cycles served through multi-cycle dispatches "
             "(each paid dispatch_rt/K instead of a full round trip).",
+            registry=r,
+        )
+        self.speculation = Counter(
+            "scheduler_speculation_total",
+            "Depth-2 speculative dispatch outcomes (adopted | abandoned"
+            " | redispatched): batches dispatched against the predicted"
+            " post-predecessor carry while it was still on device.",
+            ["outcome"],
             registry=r,
         )
         # ---- multi-chip serving (ops/argsel.py + parallel/) ----

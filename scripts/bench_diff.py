@@ -72,6 +72,14 @@ _METRICS = {
     # predate the sweep or sit outside the exactness envelope
     "tunnel_amortization": ("higher", "tunnel_amortization", "amort"),
     "effective_p50_ms": ("lower", "effective_cycle_p50_ms", "effp50"),
+    # device-saturated streaming (ISSUE 13): first-bind latency under
+    # depth-2 speculative dispatch must not RISE (a pod admitted into
+    # row 0 waits ~1 inner cycle, not the whole batch) and the
+    # speculation hit rate must not DROP (every abandoned speculation
+    # re-dispatches — a falling rate means the predicate is thrashing).
+    # Both skipped for artifacts predating the sweep (r05 and older).
+    "first_bind_p50_ms": ("lower", "first_bind_p50_ms", "fbp50"),
+    "speculation_hit_rate": ("higher", "speculation_hit_rate", "shr"),
     # compile-regime management (ISSUE 8): cold compile spend must not
     # RISE (a new program or a lost cache hit re-pays 8.8-16.8 s per
     # program) and the warm-start cache hit rate must not DROP (every
@@ -296,6 +304,17 @@ def main(argv: list[str] | None = None) -> int:
         "this many percent before it counts as a regression",
     )
     ap.add_argument(
+        "--max-first-bind-rise", type=float, default=25.0,
+        help="depth-2 speculative first_bind_p50_ms may rise this many "
+        "percent before it counts as a regression",
+    )
+    ap.add_argument(
+        "--max-speculation-hit-drop", type=float, default=10.0,
+        help="speculation_hit_rate may drop this many percent before "
+        "it counts as a regression (an abandon-heavy workload pays "
+        "the speculative dispatch for nothing)",
+    )
+    ap.add_argument(
         "--max-compile-rise", type=float, default=75.0,
         help="per-config compile_seconds may rise this many percent "
         "before it counts as a regression (compile time is rig-noisy; "
@@ -365,6 +384,8 @@ def main(argv: list[str] | None = None) -> int:
             "encode_p50_ms": args.max_encode_rise,
             "tunnel_amortization": args.max_amortization_drop,
             "effective_p50_ms": args.max_effective_p50_rise,
+            "first_bind_p50_ms": args.max_first_bind_rise,
+            "speculation_hit_rate": args.max_speculation_hit_drop,
             "compile_seconds": args.max_compile_rise,
             "compile_cache_hit_rate": args.max_hit_rate_drop,
             "mttr_ms": args.max_mttr_rise,

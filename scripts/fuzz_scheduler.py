@@ -51,6 +51,7 @@ def _stamp(trace, bug, failure) -> str:
         f"devices={max(int(trace.config.get('shard_devices', 0)), 1)} "
         f"chaos={int(trace.chaos)} "
         f"mc={int(int(trace.config.get('multi_cycle_k', 1)) > 1)} "
+        f"spec={int(bool(trace.config.get('speculative_dispatch')))} "
         f"bug={bug or '-'} fault_spec={trace.fault_spec or '-'} "
         f"class={failure.cls}"
     )
@@ -69,7 +70,8 @@ def _run_with_tmp_state(trace, bug):
 
 
 def run_one(seed, *, devices, chaos, multi_cycle, bug, artifact_dir,
-            shrink, shrink_evals) -> "tuple[int, str | None]":
+            shrink, shrink_evals,
+            speculative=False) -> "tuple[int, str | None]":
     """Returns (n_failures, artifact_path | None)."""
     from k8s_scheduler_tpu.fuzz import (
         generate_trace,
@@ -78,7 +80,8 @@ def run_one(seed, *, devices, chaos, multi_cycle, bug, artifact_dir,
     )
 
     trace = generate_trace(
-        seed, devices=devices, chaos=chaos, multi_cycle=multi_cycle
+        seed, devices=devices, chaos=chaos, multi_cycle=multi_cycle,
+        speculative=speculative,
     )
     failures = _run_with_tmp_state(trace, bug)
     if not failures:
@@ -122,6 +125,9 @@ def main() -> int:
                     help="shardDevices for --seed runs (soak mixes 1/4)")
     ap.add_argument("--chaos", action="store_true")
     ap.add_argument("--multi-cycle", action="store_true")
+    ap.add_argument("--speculative", action="store_true",
+                    help="depth-2 speculative dispatch pipelining over "
+                    "the coalesced batches (forces --multi-cycle)")
     ap.add_argument("--inject-bug", default=None, choices=("tiebreak",),
                     help="deliberately mutate the engine (self-test: "
                     "the differential must catch it)")
@@ -175,15 +181,18 @@ def main() -> int:
     if args.seed is not None:
         n, _p = run_one(
             args.seed, devices=args.devices, chaos=args.chaos,
-            multi_cycle=args.multi_cycle or None, **kw,
+            multi_cycle=args.multi_cycle or None,
+            speculative=args.speculative, **kw,
         )
         print(json.dumps({"seed": args.seed, "failures": n}), flush=True)
         return 1 if n else 0
 
-    # the soak: plain and chaos cases interleaved, devices {1, 4}
+    # the soak: plain, chaos, and speculative-depth-2 cases
+    # interleaved, devices {1, 4} — (seed, devices, chaos, speculative)
     seeds = (
-        [(s, 1, False) for s in range(100, 103)]
-        + [(110, 4, False), (111, 1, True)]
+        [(s, 1, False, False) for s in range(100, 103)]
+        + [(110, 4, False, False), (111, 1, True, False),
+           (112, 1, False, True)]
     ) if args.smoke else None
     deadline = None if args.smoke else time.time() + args.minutes * 60
     total = failures_n = cases = 0
@@ -193,7 +202,7 @@ def main() -> int:
         if seeds is not None:
             if cases >= len(seeds):
                 break
-            s, devices, chaos = seeds[cases]
+            s, devices, chaos, speculative = seeds[cases]
         else:
             if time.time() >= deadline or failures_n >= 5:
                 break
@@ -201,8 +210,13 @@ def main() -> int:
             seed += 1
             devices = 4 if s % 4 == 3 else 1
             chaos = s % 5 == 2
+            # every seventh case pipelines depth-2 over the coalesced
+            # batches (forces mc; disjoint from nothing — it composes
+            # with chaos and sharding alike)
+            speculative = s % 7 == 1
         n, path = run_one(
-            s, devices=devices, chaos=chaos, multi_cycle=None, **kw
+            s, devices=devices, chaos=chaos, multi_cycle=None,
+            speculative=speculative, **kw
         )
         cases += 1
         total += n

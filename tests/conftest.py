@@ -88,6 +88,18 @@ _SLOW_TESTS = {
     "test_fuzz_catches_seeded_tiebreak_bug",
     "test_corpus_repro_still_catches_its_bug",
     "test_fuzz_soak_smoke",
+    # depth-2 speculative dispatch (ISSUE 13) heavyweights: the
+    # 3-scheduler equivalence ladder and the 2-scheduler mismatch
+    # drive (~40 s of Scheduler+WAL each), the speculative fuzz
+    # differential (TWO engine replays per trace), the chaos
+    # mid-speculation replay (a real 15 s injected hang bounded by
+    # the watchdog), and the scheduler-driven bench sweep point —
+    # the device-level chain/pipeline/record/sentinel cases stay fast
+    "test_scheduler_speculative_matches_sequential",
+    "test_mismatch_abandons_redispatches_bit_identical",
+    "test_fuzz_differential_speculative_seed",
+    "test_fuzz_chaos_fetch_hang_mid_speculation",
+    "test_bench_sweep_reports_first_bind_and_hit_rate",
 }
 _SLOW_MODULES = {"tests.test_concurrency"}
 

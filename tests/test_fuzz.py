@@ -269,6 +269,57 @@ def test_fuzz_differential_sharded_seed():
     assert not failures, [str(f) for f in failures]
 
 
+def test_fuzz_differential_speculative_seed():
+    """The depth-2 pipelining variant (speculativeDispatch over
+    multiCycleK=4): the speculative engine must be per-cycle
+    bit-identical to the non-speculative engine on the same trace —
+    adoption/abandonment may never change what is decided, when it
+    lands, or its order — and the case fails if the trace never
+    actually dispatched a speculation (a silently-vacuous variant
+    would be a permanent green)."""
+    t = generate_trace(1, speculative=True)
+    assert t.config["speculative_dispatch"] is True
+    assert t.config["multi_cycle_k"] == 4
+    failures = run_case(t)
+    assert not failures, [str(f) for f in failures]
+
+
+def test_speculative_traces_stay_in_the_exactness_envelope():
+    """Speculative traces must actually exercise the device loop they
+    pipeline: the envelope-leaving capabilities (affinity / spread /
+    volumes / host ports) are drawn but not applied, and the mc
+    invariants (arrivals-only, frozen clock, flat priority) hold."""
+    import json
+
+    for seed in range(10):
+        t = generate_trace(seed, speculative=True)
+        assert t.tick_s == 0.0
+        blob = json.dumps(t.cycles)
+        for key in ('"af"', '"tsc"', '"vol"'):
+            assert key not in blob, (seed, key)
+
+
+def test_fuzz_chaos_fetch_hang_mid_speculation(tmp_path):
+    """Chaos fused with speculation: fetch_hang fires on the first
+    bounded fetch of a flush — AFTER the continuation batch was
+    speculatively dispatched — so the watchdog must bound it, the
+    abandoned dispatch must not leak an arena slot (the trace keeps
+    serving flushes through the same 3-slot pipeline), and the PR 8
+    soak invariants hold: no lost/duplicate binds, ladder recovered,
+    digest-verified restore."""
+    from k8s_scheduler_tpu.fuzz.replay import replay_engine
+
+    t = generate_trace(30, chaos=True, speculative=True)
+    t.fault_spec = "seed=30;fetch_hang@cycle=2..40:ms=15000:n=1"
+    eng = replay_engine(t, state_dir=str(tmp_path / "state"))
+    assert not eng.failures, [str(f) for f in eng.failures]
+    led = eng.stats["speculation"]
+    # the hang hit the predecessor's fetch mid-speculation: the
+    # in-flight continuation was abandoned (slot freed), and later
+    # flushes kept speculating (adoptions after the recovery)
+    assert led["abandoned"] >= 1, led
+
+
 def test_fuzz_chaos_seed(tmp_path):
     """Chaos fusion: a random FaultPlan over a random trace. The PR 8
     soak invariants hold throughout — watchdog bound, no lost/duplicate

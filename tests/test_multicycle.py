@@ -554,8 +554,13 @@ def test_multicycle_records_carry_batched_phases(tmp_path):
     observer exports: batch_wait, device_share, and the multi_cycle_k
     marker that excuses their full encodes from fold_miss."""
     clock = FakeClock()
+    # speculative depth-2 splits a flush into TWO dispatches, each with
+    # its own record-0 pipeline window — this test pins the COMBINED
+    # single-dispatch decomposition (the split shape is covered by
+    # tests/test_speculative.py)
     cfg = SchedulerConfiguration(
-        multi_cycle_k=2, multi_cycle_max_wait_ms=1e9
+        multi_cycle_k=2, multi_cycle_max_wait_ms=1e9,
+        speculative_dispatch=False,
     )
     sched = Scheduler(config=cfg, now=clock, pad_bucket=8)
     sched.on_node_add(MakeNode("n0").capacity({"cpu": "64"}).obj())
