@@ -33,9 +33,10 @@ TARGET_DECISIONS_PER_SEC = 50_000.0
 # (config 6 = the compile-regime churn soak: cycles per drive phase;
 # config 7 = the fault-storm soak: serving cycles under the fault plan;
 # config 8 = the sharded scale sweep: timed cycles per grid point x
-# device count)
+# device count; config 9 = the front-door load drive: ~seconds of
+# open-loop arrival split across the sustained/overload phases)
 DEFAULT_SNAPSHOTS = {1: 50, 2: 50, 3: 50, 4: 30, 5: 30, 6: 24, 7: 40,
-                     8: 4}
+                     8: 4, 9: 12}
 
 
 def _run_one_isolated(c: int, n: int):
@@ -282,6 +283,20 @@ def main() -> None:
                     "degc": r["degraded_cycles"],
                 }
                 if "mttr_ms" in r else {}
+            ),
+            # front-door load drive (config 9): submit-ack p99 (incl.
+            # the WAL-before-ack fsync barrier), end-to-end
+            # submit->bind p50/p99, and the sustained-phase shed rate
+            # (0 unless admission started refusing nominal load) —
+            # sbp99/sack99 rise and shed rise diffed by bench_diff
+            **(
+                {
+                    "sack99": r["submit_ack_p99_ms"],
+                    "sbp50": r["submit_bind_p50_ms"],
+                    "sbp99": r["submit_bind_p99_ms"],
+                    "shed": r["shed_rate"],
+                }
+                if "submit_bind_p99_ms" in r else {}
             ),
             # sharded scale sweep (config 8): scaling efficiency at the
             # largest grid point's max device count, the compiled

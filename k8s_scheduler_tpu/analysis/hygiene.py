@@ -33,6 +33,7 @@ SCRIPT_ALLOWLIST = frozenset({
     "scripts/bench_diff.py",      # BENCH artifact CI tripwire
     "scripts/fuzz_scheduler.py",  # scenario-fuzzer differential soak
     "scripts/lint_metrics.py",    # metric-inventory shim (tests)
+    "scripts/loadgen.py",         # open-loop front-door load generator
     "scripts/probe_pipeline.py",  # CPU-runnable pipeline smoke probe
     "scripts/schedlint.py",       # this framework's CLI
     "scripts/soak_chaos.py",      # slow-marked fault-injection chaos soak

@@ -32,12 +32,18 @@ use HEAD); any other method gets 405 with an Allow header.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 from ..metrics import SchedulerMetrics
+
+# POST /submit body cap (parity with gRPC's default 4 MB message
+# limit): the front door's bounded-memory contract must hold on the
+# HTTP path too — a giant Content-Length is refused BEFORE any read
+_MAX_SUBMIT_BODY_BYTES = 4 << 20
 
 
 def _parse_last(query: str, default: int = 128) -> int:
@@ -54,6 +60,7 @@ def staleness_healthz(
     max_age_seconds: float,
     observer=None,  # core/observe.CycleObserver | None
     ladder=None,  # core/degrade.DegradationLadder | None
+    admission=None,  # service/admission.AdmissionController | None
 ) -> Callable[[], tuple[bool, dict]]:
     """Health closure with flight-recorder staleness: reports
     `last_cycle_age_s` and flips to not-ok (503) once no scheduling
@@ -68,7 +75,12 @@ def staleness_healthz(
     and any rung below `normal` also reports `degraded: true` (again
     200: the ladder is actively recovering — a restart would only lose
     its progress, and at the bottom rung the standby takeover is
-    already underway via the sealed state)."""
+    already underway via the sealed state). With an `admission`
+    controller (the submission front door), its status rides the
+    payload and `degraded: true` is reported while the front door
+    would shed an arriving submission right now (an overload burst is
+    a capacity signal like budget burn — still 200: the door is doing
+    its job by shedding)."""
 
     def healthz() -> tuple[bool, dict]:
         detail = dict(base()) if base is not None else {}
@@ -95,6 +107,14 @@ def staleness_healthz(
                     f"degradation ladder at rung {st['rung']} "
                     f"({st['name']}): {st['last_reason']}",
                 )
+        if admission is not None:
+            detail["admission"] = admission.status()
+            shed_now = admission.overloaded()
+            if shed_now:
+                detail["degraded"] = True
+                detail.setdefault(
+                    "degraded_reason", f"admission shedding: {shed_now}"
+                )
         return ok, detail
 
     return healthz
@@ -109,6 +129,7 @@ def start_http_server(
     pod_timeline: Callable[[str], dict | None] | None = None,
     state=None,  # state.DurableState | None
     observer=None,  # core/observe.CycleObserver | None
+    admission=None,  # service/admission.AdmissionController | None
 ) -> ThreadingHTTPServer:
     """Serve /healthz, /readyz, /metrics and the /debug endpoints;
     returns the running server (bound port at `.server_address[1]`;
@@ -117,7 +138,11 @@ def start_http_server(
     enables /debug/pods/<uid> and the /debug/trace?pod= filter; `state`
     (DurableState) enables /debug/state (journal lag, segment counts,
     snapshot + restore stats); `observer` (CycleObserver) enables
-    /debug/anomalies."""
+    /debug/anomalies; `admission` (the submission front door) enables
+    the thin `POST /submit` path — a JSON body
+    `{"pods": [<state/codec pod dicts>]}` admitted through the same
+    controller the gRPC Submit RPC uses (200 on accept, 429 +
+    Retry-After on shed, 400 on invalid pods, 503 while draining)."""
     health_fn = healthz or (lambda: (True, {}))
 
     class Handler(BaseHTTPRequestHandler):
@@ -244,6 +269,78 @@ def start_http_server(
         def do_GET(self):  # noqa: N802  (stdlib casing)
             self._respond(include_body=True)
 
+        def _submit_route(self) -> tuple[int, bytes, dict[str, str]]:
+            """POST /submit: the thin HTTP front-door path. Pods
+            travel as state/codec dicts (the journal's own pod
+            format), so the HTTP wire needs no second codec."""
+            from ..state.codec import pod_from_state
+
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                if length > _MAX_SUBMIT_BODY_BYTES:
+                    return (
+                        413,
+                        json.dumps({
+                            "error": "submission body too large",
+                            "max_bytes": _MAX_SUBMIT_BODY_BYTES,
+                        }).encode(),
+                        {},
+                    )
+                body = json.loads(self.rfile.read(length) or b"{}")
+                pods = [
+                    pod_from_state(d) for d in body.get("pods", ())
+                ]
+            except (ValueError, KeyError, TypeError) as e:
+                return (
+                    400,
+                    json.dumps(
+                        {"error": f"unparseable submission: {e}"}
+                    ).encode(),
+                    {},
+                )
+            res = admission.submit(pods)
+            payload = {
+                "accepted": res.accepted,
+                "shed": res.shed,
+                "invalid": list(res.invalid),
+                "reason": res.reason,
+                "durable": res.durable,
+                "queue_depth": res.queue_depth,
+            }
+            if res.invalid:
+                status, extra = 400, {}
+            elif res.reason == "draining":
+                status, extra = 503, {}
+            elif res.shed:
+                status = 429
+                # RFC 7231 delta-seconds is an INTEGER — fractional
+                # values break stdlib/urllib3 retry parsers; round the
+                # hint UP so clients never retry early
+                extra = {
+                    "Retry-After": str(
+                        max(1, math.ceil(res.retry_after_ms / 1e3))
+                    )
+                }
+            else:
+                status, extra = 200, {}
+            return status, json.dumps(payload).encode(), extra
+
+        def do_POST(self):  # noqa: N802 — the ONE mutating route; every
+            # other path keeps the read-only 405 contract below
+            if admission is None or urllib.parse.urlsplit(
+                self.path
+            ).path != "/submit":
+                self._method_not_allowed()
+                return
+            status, body, extra = self._submit_route()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in extra.items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_HEAD(self):  # noqa: N802 — probes commonly use HEAD; the
             # stdlib handler would 501 without this
             self._respond(include_body=False)
@@ -257,9 +354,9 @@ def start_http_server(
             self.end_headers()
             self.wfile.write(body)
 
-        # every mutating verb is a client error on a read-only surface:
-        # 405 + Allow, not the stdlib's 501
-        do_POST = _method_not_allowed  # noqa: N815
+        # every mutating verb is a client error on a read-only surface
+        # (POST carved out above for /submit): 405 + Allow, not the
+        # stdlib's 501
         do_PUT = _method_not_allowed  # noqa: N815
         do_DELETE = _method_not_allowed  # noqa: N815
         do_PATCH = _method_not_allowed  # noqa: N815

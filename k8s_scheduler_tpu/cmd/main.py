@@ -173,6 +173,24 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         "empty) — soaks/benches/tests only, never production",
     )
     ap.add_argument(
+        "--submit-addr", default="",
+        help="submission front door: serve the admission-controlled "
+        "Submit/NodeChurn RPCs on this extra gRPC address (own accept "
+        "queue + worker pool) and run the internal serve loop — "
+        "arrivals coalesce straight into the multi-cycle batcher "
+        "instead of waiting for agent-driven Cycle RPCs. Accepted "
+        "pods are journaled through the WAL before the ack returns "
+        "when --state-dir is set. Empty = front door disabled",
+    )
+    ap.add_argument(
+        "--admission-queue-depth", type=int, default=-1,
+        help="bound on the admission queue (pending pods + coalescing "
+        "buffers): a Submit that would push the depth past this is "
+        "shed with RESOURCE_EXHAUSTED + retry-after instead of "
+        "buffered (config admissionQueueDepth; 0 = unbounded, "
+        "-1 = keep config)",
+    )
+    ap.add_argument(
         "--state-dir", default="",
         help="durable scheduler state: write-ahead journal + snapshots "
         "of the queue/cache live here (config stateDir). A process "
@@ -225,6 +243,8 @@ def main(argv: list[str] | None = None) -> int:
         config.degrade_promote_cycles = args.degrade_promote_cycles
     if args.fault_spec:
         config.fault_spec = args.fault_spec
+    if args.admission_queue_depth >= 0:
+        config.admission_queue_depth = args.admission_queue_depth
     if args.state_dir:
         config.state_dir = args.state_dir
     if args.snapshot_interval >= 0:
@@ -307,6 +327,46 @@ def main(argv: list[str] | None = None) -> int:
         state=state,
     )
     print(f"scheduler shim listening on port {port}", flush=True)
+
+    # submission front door: the admission-controlled Submit/NodeChurn
+    # RPCs on their own address (own accept queue + worker pool, so a
+    # flood of submissions cannot starve the agent channel) plus the
+    # internal serve loop — with a network feed there is no agent to
+    # drive Cycle, so the scheduler runs its own ScheduleOne loop,
+    # serialized against any stray Cycle RPC by the service cycle lock.
+    front_door = None
+    submit_server = None
+    if args.submit_addr:
+        from concurrent import futures as _futures
+
+        import grpc as _grpc
+
+        from ..service.admission import self_confirming_front_door
+        from ..service.server import add_to_server
+
+        admission = service.enable_front_door()
+        submit_server = _grpc.server(
+            _futures.ThreadPoolExecutor(max_workers=8),
+            options=(("grpc.so_reuseport", 0),),
+        )
+        add_to_server(service, submit_server)
+        sport = submit_server.add_insecure_port(args.submit_addr)
+        if sport == 0 and not args.submit_addr.rstrip().endswith(":0"):
+            raise OSError(
+                f"failed to bind submit address {args.submit_addr!r}"
+            )
+        submit_server.start()
+        # self-confirming: the local loop is the binder of record (no
+        # agent fetches bindings in this mode) — without post-cycle
+        # confirmation every assumed bind would TTL-expire and re-bind
+        front_door = self_confirming_front_door(service, admission)
+        front_door.start()
+        print(
+            f"front door: submissions on port {sport} "
+            f"(admission depth {admission.depth_bound})",
+            flush=True,
+        )
+
     if state is not None:
         r = state.last_restore
         print(
@@ -338,6 +398,7 @@ def main(argv: list[str] | None = None) -> int:
         config.health_max_cycle_age_seconds,
         observer=observer,
         ladder=service.scheduler.ladder,
+        admission=service.admission,
     )
 
     http_server = None
@@ -351,6 +412,7 @@ def main(argv: list[str] | None = None) -> int:
             pod_timeline=service.scheduler.pod_timeline,
             state=state,
             observer=observer,
+            admission=service.admission,
         )
         print(
             "serving /healthz /metrics on port "
@@ -368,6 +430,20 @@ def main(argv: list[str] | None = None) -> int:
     try:
         stop.wait()
     finally:
+        if front_door is not None:
+            # graceful drain BEFORE anything seals: admission closes
+            # (late submits answer UNAVAILABLE "draining"), buffered
+            # multi-cycle groups flush, the active tier empties — no
+            # pod stranded between ack and dispatch — then the loop
+            # thread joins
+            drained = front_door.stop()
+            print(
+                f"front door drained: {drained} "
+                f"(cycles {front_door.cycles})",
+                flush=True,
+            )
+        if submit_server is not None:
+            submit_server.stop(grace=1.0)
         server.stop(grace=2.0)
         if http_server is not None:
             # shutdown + JOIN + close, not a bare shutdown(): the serve

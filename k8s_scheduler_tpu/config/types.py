@@ -205,6 +205,19 @@ class SchedulerConfiguration:
     # named points on the real code paths (soaks/benches/tests only;
     # env SCHED_FAULTS overrides when this is empty). "" disarms.
     fault_spec: str = ""
+    # submission front door (service/admission.py): bound on the
+    # admission queue — pending pods (all queue tiers) plus pods
+    # coalescing in the multi-cycle buffers. A Submit that would push
+    # the depth past this bound is SHED whole (RESOURCE_EXHAUSTED +
+    # retry-after), never buffered: overload degrades to shedding, not
+    # to unbounded memory. Shedding also engages while the SLO
+    # fast-burn gauge fires or the degradation ladder sits below rung
+    # 0. 0 disables the front door's depth bound (tests only).
+    admission_queue_depth: int = 65536
+    # retry-after hint (milliseconds) attached to shed submissions —
+    # gRPC trailing metadata "retry-after-ms" and the HTTP
+    # Retry-After header on the debug server's POST /submit path.
+    admission_retry_after_ms: float = 250.0
     # durable scheduler state (state/ package): directory for the
     # write-ahead journal + snapshots. "" disables durability — a
     # takeover then rebuilds only what informer events re-deliver,
@@ -349,6 +362,10 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         dispatch_deadline_ms=float(data.get("dispatchDeadlineMs", 0.0)),
         degrade_promote_cycles=int(data.get("degradePromoteCycles", 8)),
         fault_spec=str(data.get("faultSpec", "")),
+        admission_queue_depth=int(data.get("admissionQueueDepth", 65536)),
+        admission_retry_after_ms=float(
+            data.get("admissionRetryAfterMs", 250.0)
+        ),
         state_dir=str(data.get("stateDir", "")),
         snapshot_interval_seconds=_duration_seconds(
             data.get("snapshotInterval", 60.0)

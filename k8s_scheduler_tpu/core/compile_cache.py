@@ -624,7 +624,7 @@ class CompileWarmer:
         self.built = 0
         self.failed = 0
 
-    def submit(self, key, thunk: Callable[[], None]) -> bool:
+    def enqueue_build(self, key, thunk: Callable[[], None]) -> bool:
         """Enqueue one speculative build; False when the same key is
         already queued or building."""
         with self._lock:

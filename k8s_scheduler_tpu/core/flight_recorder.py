@@ -81,6 +81,9 @@ TRACE_LANE_FOR_PHASE = {
     # decision row landed; renders inside the batch's device slice
     # (the window ends where row 0's transfer completes)
     "first_bind": (LANE_DEVICE, "device cycle[seq]"),
+    # front door: admission accept -> bind, a host-observed end-to-end
+    # window; renders on the host lane (it ends in the bind loop)
+    "submit_bind": (LANE_HOST, "bind winners"),
 }
 
 

@@ -92,6 +92,11 @@ PHASES = (
     # FIRST inner cycle's decision row landed (the latency a row-0 pod
     # actually waits before its bind; ~1 inner cycle under depth-2
     # speculative dispatch instead of the whole K-cycle batch)
+    "submit_bind",    # front door (service/admission.py): admission
+    # accept -> the pod's bind, end to end through the queue and the
+    # coalescing buffers; stamped per cycle as the WORST such latency
+    # among the cycle's binds, so the streaming p99 tracks the
+    # submit->bind SLO the open-loop load harness measures externally
 )
 
 ANOMALY_CLASSES = (
@@ -167,6 +172,8 @@ def phase_seconds(rec) -> dict[str, float]:
         out["device_share"] = ph["device_share_ms"] / 1e3
     if "first_bind_ms" in ph:
         out["first_bind"] = ph["first_bind_ms"] / 1e3
+    if "submit_bind_ms" in ph:
+        out["submit_bind"] = ph["submit_bind_ms"] / 1e3
     return out
 
 

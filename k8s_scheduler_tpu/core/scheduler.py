@@ -145,6 +145,10 @@ class Scheduler:
         )
         self.binder = binder or (lambda pod, node: None)
         self.evictor = evictor or (lambda pod, node: None)
+        # submission front door (service/admission.py): the controller
+        # attaches itself here so _bind can close the submit->bind
+        # window and _commit_record can stamp it on the cycle record
+        self.admission = None
         # durable state (state/ package): restore-then-journal. Attach
         # happens here — after queue/cache exist, before any cycle — so
         # a standby that just won the FileLease resumes with the exact
@@ -736,7 +740,7 @@ class Scheduler:
             with self._packed_lock:
                 if key in self._packed:
                     continue
-            warmer.submit(
+            warmer.enqueue_build(
                 ("packed",) + key,
                 lambda adj=adj, profile=profile: self._warm_regime(
                     adj, profile
@@ -838,6 +842,11 @@ class Scheduler:
     def on_pod_delete(self, pod_uid: str) -> None:
         self.cache.remove_pod(pod_uid)
         self.queue.delete(pod_uid)
+        if self.admission is not None:
+            # a pod deleted before binding must leave the front door's
+            # accepted-pending set, or its uid stays "already pending"
+            # forever and a re-created pod can never be admitted
+            self.admission.note_delete(pod_uid)
         self.queue.move_all_to_active_or_backoff(EVENT_POD_DELETE)
         if self.flight is not None:
             self.flight.pod_event(pod_uid, "", "Deleted")
@@ -2305,6 +2314,13 @@ class Scheduler:
         for k, v in (extra_marks or {}).items():
             rec.mark(k, v)
         rec.phases.update(extra_phases or {})
+        if self.admission is not None:
+            # front door: worst admission-accept -> bind latency among
+            # this record's binds (collected by _bind via note_bind);
+            # absent when the record bound no front-door pods
+            sb_ms = self.admission.take_bind_latency_ms()
+            if sb_ms > 0.0:
+                rec.phases["submit_bind_ms"] = sb_ms
         # pad-regime signature: core/observe.py diffs consecutive
         # cycles' sigs to attribute recompile dimensions
         rec.sig = _packing.shape_signature(spec)
@@ -2698,8 +2714,14 @@ class Scheduler:
         for ext in self.extenders:
             if ext.is_binder:
                 ext.bind(pod, node_name)
+                if self.admission is not None:
+                    self.admission.note_bind(pod.uid)
                 return
         self.binder(pod, node_name)
+        if self.admission is not None:
+            # after the binder: a raising binder is a bind error, and
+            # an errored bind must not close the submit->bind window
+            self.admission.note_bind(pod.uid)
 
     def _update_gauges(self) -> None:
         self.metrics.set_pending(self.queue.pending_counts())
@@ -2717,6 +2739,13 @@ class Scheduler:
         if self.flight is not None and self.flight.cycles:
             d = self.flight.derived()
             self.metrics.pipeline_overlap.set(d["overlap_ratio"])
+        if self.admission is not None:
+            # the front door also sets this at submit time; the cycle
+            # refresh keeps the gauge falling as the queue drains even
+            # when no new submission arrives to re-stamp it
+            self.metrics.admission_queue_depth.set(
+                self.admission.queue_depth()
+            )
 
     def speculation_ledger(self) -> dict:
         """Aggregate depth-2 speculation ledger: {'adopted',

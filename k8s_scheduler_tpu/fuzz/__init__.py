@@ -23,6 +23,7 @@ from .replay import (  # noqa: F401
     engine_bug,
     replay_engine,
     replay_oracle,
+    run_api_case,
     run_case,
 )
 from .shrink import shrink_trace  # noqa: F401

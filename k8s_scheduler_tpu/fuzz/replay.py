@@ -188,11 +188,24 @@ def _gang_violations(groups, existing_before, binds, all_pods) -> list[str]:
 # --------------------------------------------------------------------------
 
 
-def replay_engine(trace: Trace, *, state_dir: str = "") -> ReplayResult:
+def replay_engine(
+    trace: Trace, *, state_dir: str = "", via_api: bool = False
+) -> ReplayResult:
     """Drive the trace through a LIVE Scheduler — the real dispatch
     path (split-phase pipeline, multi-cycle coalescing and sharded
     serving included, per the trace config). Chaos traces arm the
-    trace's FaultPlan for the duration."""
+    trace's FaultPlan for the duration.
+
+    `via_api` (the ISSUE 14 `arrivals_via_api` variant) routes every
+    pending-pod arrival through a REAL gRPC Submit round trip and
+    every node add/update/delete through NodeChurn — localhost server,
+    wire-format conversion, admission layer and all — instead of the
+    direct informer calls. Deletions and bound-pod confirmations stay
+    direct (they are informer traffic, not submissions), the admission
+    depth bound is lifted (equality is the contract under test, not
+    load shedding), and the harness still drives `schedule_cycle`
+    itself so the frozen-clock cadence is identical: any stream
+    difference vs the direct-enqueue engine is the API path's doing."""
     import jax as _jax
 
     from k8s_scheduler_tpu.config import SchedulerConfiguration
@@ -280,6 +293,32 @@ def replay_engine(trace: Trace, *, state_dir: str = "") -> ReplayResult:
 
     sched.queue.requeue_backoff = backoff_capture
 
+    api_server = None
+    api_client = None
+    if via_api:
+        from concurrent import futures as _futures
+
+        import grpc as _grpc
+
+        from ..service.client import SchedulerClient
+        from ..service.server import SchedulerService, add_to_server
+
+        svc = SchedulerService(scheduler=sched)
+        # the servicer ctor rebinds the binder to its Cycle-response
+        # collector; the replay's capture binder must win (Cycle is
+        # never called here — the harness drives schedule_cycle
+        # directly so the frozen-clock cadence matches the direct run)
+        sched.binder = lambda pod, node: cycle_binds.append((pod, node))
+        svc.enable_front_door(queue_depth=0)
+        api_server = _grpc.server(
+            _futures.ThreadPoolExecutor(max_workers=2),
+            options=(("grpc.so_reuseport", 0),),
+        )
+        add_to_server(svc, api_server)
+        api_port = api_server.add_insecure_port("127.0.0.1:0")
+        api_server.start()
+        api_client = SchedulerClient(f"127.0.0.1:{api_port}")
+
     objs = materialize(trace)
     pdbs = objs["pdbs"]
     groups = objs["pod_groups"]
@@ -313,7 +352,12 @@ def replay_engine(trace: Trace, *, state_dir: str = "") -> ReplayResult:
                 if op == "add_pod":
                     all_pods[ev["pod"].uid] = ev["pod"]
                     added.add(ev["pod"].uid)
-                    sched.on_pod_add(ev["pod"])
+                    if api_client is not None:
+                        _api_submit(
+                            api_client, ev["pod"], ci, failures, sched
+                        )
+                    else:
+                        sched.on_pod_add(ev["pod"])
                 elif op == "add_bound_pod":
                     all_pods[ev["pod"].uid] = ev["pod"]
                     added.add(ev["pod"].uid)
@@ -324,11 +368,20 @@ def replay_engine(trace: Trace, *, state_dir: str = "") -> ReplayResult:
                     bound_now.discard(ev["uid"])
                     sched.on_pod_delete(ev["uid"])
                 elif op == "add_node":
-                    sched.on_node_add(ev["node"])
+                    if api_client is not None:
+                        api_client.node_churn(adds=[ev["node"]])
+                    else:
+                        sched.on_node_add(ev["node"])
                 elif op == "update_node":
-                    sched.on_node_update(ev["node"])
+                    if api_client is not None:
+                        api_client.node_churn(updates=[ev["node"]])
+                    else:
+                        sched.on_node_update(ev["node"])
                 elif op == "delete_node":
-                    sched.on_node_delete(ev["name"])
+                    if api_client is not None:
+                        api_client.node_churn(deletes=[ev["name"]])
+                    else:
+                        sched.on_node_delete(ev["name"])
                 else:
                     raise ValueError(f"unknown trace op {op!r}")
             existing_before = sched.cache.existing_pods()
@@ -414,12 +467,37 @@ def replay_engine(trace: Trace, *, state_dir: str = "") -> ReplayResult:
         from k8s_scheduler_tpu.core import faults as _faults
 
         _faults.disarm()
+        if api_client is not None:
+            with contextlib.suppress(Exception):
+                api_client.close()
+        if api_server is not None:
+            with contextlib.suppress(Exception):
+                api_server.stop(grace=0)
         if state is not None:
             with contextlib.suppress(Exception):
                 state.journal.flush()
             with contextlib.suppress(Exception):
                 state.journal.close()
     return ReplayResult(records, failures, all_binds, stats)
+
+
+def _api_submit(client, pod, cycle: int, failures: list, sched) -> None:
+    """One Submit round trip; a rejection is recorded as a failure
+    (the unbounded-depth front door must accept every generated
+    arrival — anything else is an API-path bug the variant exists to
+    catch) and the pod falls back to direct enqueue so the stream
+    comparison still runs to completion."""
+    import grpc as _grpc
+
+    try:
+        client.submit([pod])
+    except _grpc.RpcError as e:
+        failures.append(Failure(
+            "via_api/rejected", cycle,
+            f"Submit({pod.uid}) -> {e.code().name}: {e.details()}",
+        ))
+        # keep both engines' inputs identical despite the failure
+        sched.on_pod_add(pod)
 
 
 def _chaos_checks(trace, sched, walls, state_dir) -> list[Failure]:
@@ -722,6 +800,44 @@ def compare_speculative(
         if out:
             return out
     return out
+
+
+def compare_via_api(
+    eng_api: ReplayResult, eng_direct: ReplayResult
+) -> list[Failure]:
+    """Per-cycle bit-equality of the arrivals-via-API engine against
+    the direct-enqueue engine on the same trace. Both engines share
+    the exact coalescing cadence (same trace, same K, same frozen
+    clock — the coalescing-window legalities of the PR 10 generator
+    notes therefore cancel out), so even cycle placement must match:
+    any difference is the Submit/NodeChurn path perturbing state —
+    conversion loss, ordering drift, or admission side effects."""
+    out: list[Failure] = []
+    for er, orr in zip(eng_api.records, eng_direct.records):
+        for key in _PER_CYCLE_KEYS + ("requeues", "rung"):
+            if er[key] != orr[key]:
+                out.append(Failure(
+                    f"via_api/{key}", er["cycle"],
+                    f"via-api={er[key]!r} direct={orr[key]!r}",
+                ))
+        if out:
+            return out
+    return out
+
+
+def run_api_case(trace: Trace) -> list[Failure]:
+    """The `arrivals_via_api` variant (ISSUE 14): replay the trace
+    with every arrival through real Submit/NodeChurn RPCs, then again
+    with direct enqueue, and require bit-equal streams. Engine bugs
+    cancel out of an engine-vs-engine comparison — decision
+    correctness stays the oracle differential's job; this variant
+    hunts API-path bugs specifically."""
+    eng_api = replay_engine(trace, via_api=True)
+    failures = list(eng_api.failures)
+    eng_direct = replay_engine(trace)
+    failures.extend(eng_direct.failures)
+    failures.extend(compare_via_api(eng_api, eng_direct))
+    return failures
 
 
 def run_case(

@@ -170,6 +170,11 @@ class SchedulerCache:
         with self._lock:
             return pod_uid in self._assumed
 
+    def has_pod(self, pod_uid: str) -> bool:
+        """Known to the cluster state: bound or assumed."""
+        with self._lock:
+            return pod_uid in self._bound or pod_uid in self._assumed
+
     def cleanup_expired(self) -> list[tuple[Pod, str]]:
         """Drop assumed pods whose bind confirmation never arrived;
         returns (pod, node_name) pairs so the caller can requeue AND

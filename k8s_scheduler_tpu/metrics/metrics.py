@@ -56,12 +56,16 @@ streaming consumer of every flight record):
 - scheduler_cycle_phase_seconds{phase} — streaming per-phase latency
   attribution of every committed cycle record; phases: total, encode,
   fold, dispatch, device, decision_fetch, bind, postfilter, diag_lag,
-  compile, batch_wait, device_share, first_bind (batch_wait and
+  compile, batch_wait, device_share, first_bind, submit_bind
+  (batch_wait and
   device_share are the multi-cycle batched decomposition: an inner
   cycle's host-side coalescing wait and its apportioned share of the
   batch's device window; first_bind is the streamed-fetch window from
   batch flush to the FIRST inner cycle's decisions landing — the
-  latency a row-0 pod actually waits before its bind; the inventory is
+  latency a row-0 pod actually waits before its bind; submit_bind is
+  the front door's end-to-end window from admission accept to the
+  pod's bind, stamped per cycle as the worst such latency among that
+  cycle's binds; the inventory is
   core/observe.PHASES, machine-checked by schedlint ID005 against the
   trace lane mapping and the README)
 - scheduler_cycle_phase_p50_seconds{phase} /
@@ -132,6 +136,21 @@ core/pipeline.py dispatch watchdog + fetch-failure attribution):
   blocking decision fetch raised, by failure class (transport |
   corrupt | wedge | deadline | other — the `_Resilient` marker
   classifiers plus the watchdog's deadline)
+
+Submission front-door families (service/admission.py — the
+admission-controlled Submit/NodeChurn RPCs and the open-loop load
+harness that drives them):
+
+- scheduler_admission_total{outcome} — submitted pods by admission
+  outcome (accepted | shed | invalid); shed = backpressure
+  (RESOURCE_EXHAUSTED + retry-after) from a full admission queue, an
+  SLO fast-burn, or a degraded ladder rung — never silent loss
+- scheduler_admission_queue_depth — admission queue depth (pending
+  pods across all queue tiers plus pods coalescing in the multi-cycle
+  buffers) as of the last submit or cycle
+- scheduler_submit_ack_seconds — submit-to-ack latency of ACCEPTED
+  submissions, including the WAL-before-ack group-fsync barrier (the
+  durability contract's cost, paid off the scheduling hot path)
 
 Durable-state families (state/ package — write-ahead journal, snapshots,
 restore) and leader election:
@@ -469,6 +488,28 @@ class SchedulerMetrics:
             "failure class (transport | corrupt | wedge | deadline | "
             "other).",
             ["class"],
+            registry=r,
+        )
+        # ---- submission front door (service/admission.py) ----
+        self.admission_total = Counter(
+            "scheduler_admission_total",
+            "Submitted pods by admission outcome (accepted | shed | "
+            "invalid); shed = explicit backpressure, never silent loss.",
+            ["outcome"],
+            registry=r,
+        )
+        self.admission_queue_depth = Gauge(
+            "scheduler_admission_queue_depth",
+            "Admission queue depth (pending pods across all queue "
+            "tiers + pods coalescing in the multi-cycle buffers) as of "
+            "the last submit or cycle.",
+            registry=r,
+        )
+        self.submit_ack = Histogram(
+            "scheduler_submit_ack_seconds",
+            "Submit-to-ack latency of accepted submissions, including "
+            "the WAL-before-ack group-fsync barrier.",
+            buckets=_DURATION_BUCKETS,
             registry=r,
         )
         # ---- durable state (state/: journal + snapshots + restore) ----

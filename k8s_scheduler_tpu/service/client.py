@@ -53,6 +53,16 @@ class SchedulerClient:
             request_serializer=pb.InspectRequest.SerializeToString,
             response_deserializer=pb.InspectResponse.FromString,
         )
+        self._submit = mk(
+            f"/{SERVICE_NAME}/Submit",
+            request_serializer=pb.SubmitRequest.SerializeToString,
+            response_deserializer=pb.SubmitResponse.FromString,
+        )
+        self._node_churn = mk(
+            f"/{SERVICE_NAME}/NodeChurn",
+            request_serializer=pb.NodeChurnRequest.SerializeToString,
+            response_deserializer=pb.NodeChurnResponse.FromString,
+        )
 
     def update(self, request: pb.UpdateRequest, timeout: float = 10.0):
         return self._update(request, timeout=timeout)
@@ -85,6 +95,31 @@ class SchedulerClient:
         if not resp.ok:
             raise RuntimeError(f"Inspect({kind!r}): {resp.error}")
         return json.loads(resp.json.decode())
+
+    def submit(self, pods, timeout: float = 30.0) -> pb.SubmitResponse:
+        """Submit pending pods through the admission front door.
+        `pods` are models.api.Pod objects. Raises grpc.RpcError with
+        RESOURCE_EXHAUSTED on shed (retry-after hint in the trailing
+        metadata key "retry-after-ms"), INVALID_ARGUMENT on malformed
+        pods, UNAVAILABLE while the server drains."""
+        return self._submit(
+            pb.SubmitRequest(pods=[convert.pod_to(p) for p in pods]),
+            timeout=timeout,
+        )
+
+    def node_churn(
+        self, adds=(), updates=(), deletes=(), timeout: float = 30.0
+    ) -> pb.NodeChurnResponse:
+        """Node churn through the front door (journaled before ack;
+        never shed)."""
+        return self._node_churn(
+            pb.NodeChurnRequest(
+                adds=[convert.node_to(n) for n in adds],
+                updates=[convert.node_to(n) for n in updates],
+                deletes=list(deletes),
+            ),
+            timeout=timeout,
+        )
 
     def close(self) -> None:
         self.channel.close()

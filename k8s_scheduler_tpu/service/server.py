@@ -76,6 +76,34 @@ class SchedulerService:
         # plugin-latency histograms stay populated in steady serving
         self.profile_every = int(profile_every)
         self._cycle_count = 0
+        # submission front door (service/admission.py): None until
+        # enable_front_door() — the Submit/NodeChurn RPCs answer
+        # FAILED_PRECONDITION while disabled
+        self.admission = None
+
+    def enable_front_door(self, **kwargs):
+        """Attach an AdmissionController (idempotent) so the Submit /
+        NodeChurn RPCs serve; returns the controller. The CLI calls
+        this when --submit-addr is given."""
+        if self.admission is None:
+            from .admission import AdmissionController
+
+            self.admission = AdmissionController(
+                self.scheduler, **kwargs
+            )
+        return self.admission
+
+    def run_local_cycle(self):
+        """One scheduling cycle on the FRONT-DOOR serve loop,
+        serialized against agent-driven Cycle RPCs by the same lock.
+        Bindings are applied host-side (assume + events) exactly as in
+        Cycle; the response-collection list is discarded — there is no
+        RPC response to carry it."""
+        with self._cycle_lock:
+            self._bindings = []
+            stats = self.scheduler.schedule_cycle()
+            self._bindings = []
+            return stats
 
     def _collect_binding(self, pod, node_name: str) -> None:
         self._bindings.append(
@@ -236,6 +264,92 @@ class SchedulerService:
             ok=True, json=json.dumps(payload).encode()
         )
 
+    # ---- the submission front door (service/admission.py) ---------------
+
+    def Submit(self, request: pb.SubmitRequest, context) -> pb.SubmitResponse:
+        """Admission-controlled pod intake: whole-request accept or
+        reject. Shed answers RESOURCE_EXHAUSTED with a retry-after-ms
+        trailing-metadata hint; an OK ack means every pod was journaled
+        through the WAL (group fsync) first — `durable` reports
+        whether that barrier actually held (no state dir = false)."""
+        adm = self.admission
+        if adm is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "front door disabled (start with --submit-addr or "
+                "enable_front_door())",
+            )
+        try:
+            pods = [convert.pod_from(p) for p in request.pods]
+        except (ValueError, KeyError, TypeError) as e:
+            # the proto contract: malformed pods answer
+            # INVALID_ARGUMENT (an unparseable quantity here would
+            # otherwise surface as UNKNOWN, which retrying clients
+            # treat as transient and hammer forever)
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"unparseable pod in submission: {e}",
+            )
+        res = adm.submit(pods)
+        if res.invalid:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, res.reason
+            )
+        if res.reason == "draining":
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "front door draining (shutdown in progress)",
+            )
+        if res.shed:
+            context.set_trailing_metadata(
+                (("retry-after-ms", f"{res.retry_after_ms:g}"),)
+            )
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"admission shed: {res.reason}",
+            )
+        return pb.SubmitResponse(
+            boot_id=self.boot_id,
+            accepted=res.accepted,
+            durable=res.durable,
+            queue_depth=res.queue_depth,
+        )
+
+    def NodeChurn(
+        self, request: pb.NodeChurnRequest, context
+    ) -> pb.NodeChurnResponse:
+        adm = self.admission
+        if adm is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "front door disabled (start with --submit-addr or "
+                "enable_front_door())",
+            )
+        from .admission import AdmissionClosed
+
+        try:
+            adds = [convert.node_from(n) for n in request.adds]
+            updates = [convert.node_from(n) for n in request.updates]
+        except (ValueError, KeyError, TypeError) as e:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"unparseable node in churn request: {e}",
+            )
+        try:
+            durable = adm.node_churn(
+                adds=adds,
+                updates=updates,
+                deletes=list(request.deletes),
+            )
+        except AdmissionClosed:
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "front door draining (shutdown in progress)",
+            )
+        return pb.NodeChurnResponse(
+            boot_id=self.boot_id, durable=durable
+        )
+
 
 _RPCS = {
     "Update": (pb.UpdateRequest, pb.UpdateResponse),
@@ -243,6 +357,8 @@ _RPCS = {
     "Health": (pb.HealthRequest, pb.HealthResponse),
     "Metrics": (pb.MetricsRequest, pb.MetricsResponse),
     "Inspect": (pb.InspectRequest, pb.InspectResponse),
+    "Submit": (pb.SubmitRequest, pb.SubmitResponse),
+    "NodeChurn": (pb.NodeChurnRequest, pb.NodeChurnResponse),
 }
 
 

@@ -297,12 +297,21 @@ class Journal:
                 self._cur_count = 0
             return self._cur_index
 
-    def flush(self, timeout: float | None = 30.0) -> None:
+    def flush(
+        self, timeout: float | None = 30.0, upto: int | None = None
+    ) -> None:
         """Durability barrier: returns once everything appended before
-        the call has been written and fsynced."""
+        the call has been written and fsynced. `upto` narrows the
+        barrier to a specific append sequence (the value a prior
+        `append()` returned) — the WAL-before-ack path in
+        service/admission.py waits only for ITS records, so concurrent
+        submitters share one group-commit fsync instead of serializing
+        behind each other's tails."""
         deadline = None if timeout is None else _time.monotonic() + timeout
         with self._cond:
-            target = self._appended
+            target = self._appended if upto is None else min(
+                upto, self._appended
+            )
             self._cond.notify()  # expedite past the writer's poll cadence
             while self._durable < target:
                 if self.failed is not None:
@@ -320,6 +329,13 @@ class Journal:
                             " records undrained)"
                         )
                 self._cond.wait(remaining)
+
+    def seq(self) -> int:
+        """Sequence number of the newest append so far — the `upto`
+        target a caller passes to flush() to wait for exactly the
+        records it just emitted."""
+        with self._cond:
+            return self._appended
 
     def close(self) -> None:
         with self._cond:

@@ -341,6 +341,22 @@ class DurableState:
             m.snapshot_bytes.set(nbytes)
         return path
 
+    def ack_barrier(self, timeout: float = 10.0) -> bool:
+        """WAL-before-ack durability barrier (service/admission.py):
+        block until every journal record appended so far — in
+        particular the q.add records the caller just emitted — is
+        fsynced, sharing the writer's group commit with every other
+        waiter. Returns False when durability is off or already lost
+        (sealed, detached, or the writer died): the ack then goes out
+        with `durable: false` instead of blocking on a dead journal."""
+        if self._closed or self.journal.failed is not None:
+            return False
+        try:
+            self.journal.flush(timeout=timeout, upto=self.journal.seq())
+        except StateError:
+            return False
+        return True
+
     def detach(self) -> None:
         """Stop journaling: drop the queue/cache emitters (plain
         attribute stores — see _emit for the lock-order argument) and

@@ -38,6 +38,12 @@ threshold flag (percent):
                    regression = drop  > --max-hit-rate-drop
     mttr_ms        fault-storm mean recovery time
                    regression = rise  > --max-mttr-rise
+    submit_ack_p99_ms    front-door submit-ack p99 (incl. WAL barrier)
+                   regression = rise  > --max-submit-ack-rise
+    submit_bind_p99_ms   front-door end-to-end submit->bind p99
+                   regression = rise  > --max-submit-bind-rise
+    shed_rate      sustained-phase admission shed rate
+                   regression = rise  > --max-shed-rise (default 0)
     scaling_efficiency   config-8 sharded scaling efficiency
                    regression = drop  > --max-scaling-efficiency-drop
     collective_payload_mb  config-8 compiled collective payload/cycle
@@ -93,6 +99,16 @@ _METRICS = {
     # invariant still holds); degraded_cycles (higher = regressed)
     # gates via _COUNT_METRICS below.
     "mttr_ms": ("lower", "mttr_ms", "mttr"),
+    # submission front door (ISSUE 14, config 9 front_door): the
+    # submit-ack p99 (which embeds the WAL-before-ack group-fsync
+    # barrier) and the end-to-end submit->bind p99 must not RISE, and
+    # the SUSTAINED-phase shed rate must not rise above its asserted-
+    # zero baseline (any shed at nominal load means admission started
+    # refusing traffic the door used to carry). All skipped for
+    # artifacts predating config 9 (r05 and older).
+    "submit_ack_p99_ms": ("lower", "submit_ack_p99_ms", "sack99"),
+    "submit_bind_p99_ms": ("lower", "submit_bind_p99_ms", "sbp99"),
+    "shed_rate": ("lower", "shed_rate", "shed"),
     # sharded multi-chip serving (ISSUE 10, config 8 sharded_scale):
     # scaling efficiency must not DROP (sharding that stops paying for
     # itself is the headline regressing) and the compiled collective
@@ -333,6 +349,23 @@ def main(argv: list[str] | None = None) -> int:
         "promotion-cycle-quantized, so small shifts are noise)",
     )
     ap.add_argument(
+        "--max-submit-ack-rise", type=float, default=50.0,
+        help="front-door submit_ack_p99_ms may rise this many percent "
+        "before it counts as a regression (the ack path embeds one "
+        "group-commit fsync, which is disk-noisy)",
+    )
+    ap.add_argument(
+        "--max-submit-bind-rise", type=float, default=30.0,
+        help="front-door end-to-end submit_bind_p99_ms may rise this "
+        "many percent before it counts as a regression",
+    )
+    ap.add_argument(
+        "--max-shed-rise", type=float, default=0.0,
+        help="sustained-phase shed_rate above the old artifact's "
+        "(asserted-zero) baseline is a regression at any size — the "
+        "door refusing nominal load is never noise",
+    )
+    ap.add_argument(
         "--max-scaling-efficiency-drop", type=float, default=25.0,
         help="config-8 scaling_efficiency may drop this many percent "
         "before it counts as a regression (virtual-CPU sweeps are "
@@ -389,6 +422,9 @@ def main(argv: list[str] | None = None) -> int:
             "compile_seconds": args.max_compile_rise,
             "compile_cache_hit_rate": args.max_hit_rate_drop,
             "mttr_ms": args.max_mttr_rise,
+            "submit_ack_p99_ms": args.max_submit_ack_rise,
+            "submit_bind_p99_ms": args.max_submit_bind_rise,
+            "shed_rate": args.max_shed_rise,
             "scaling_efficiency": args.max_scaling_efficiency_drop,
             "collective_payload_mb": args.max_payload_rise,
         },

@@ -45,24 +45,31 @@ os.environ["XLA_FLAGS"] = (
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def _stamp(trace, bug, failure) -> str:
+def _stamp(trace, bug, failure, via_api=False) -> str:
     return (
         f"FUZZ-FAIL seed={trace.seed} "
         f"devices={max(int(trace.config.get('shard_devices', 0)), 1)} "
         f"chaos={int(trace.chaos)} "
         f"mc={int(int(trace.config.get('multi_cycle_k', 1)) > 1)} "
         f"spec={int(bool(trace.config.get('speculative_dispatch')))} "
+        f"api={int(via_api)} "
         f"bug={bug or '-'} fault_spec={trace.fault_spec or '-'} "
         f"class={failure.cls}"
     )
 
 
-def _run_with_tmp_state(trace, bug):
+def _run_with_tmp_state(trace, bug, via_api=False):
     """run_case with a self-cleaning state dir for chaos traces (the
     digest-restore check needs a journal; a soak + shrink loop must
-    not leave hundreds of journal dirs under /tmp)."""
-    from k8s_scheduler_tpu.fuzz import run_case
+    not leave hundreds of journal dirs under /tmp). `via_api` routes
+    arrivals through the real Submit/NodeChurn RPCs and compares
+    against the direct-enqueue engine (run_api_case; plain traces
+    only — the engine bug hooks and chaos state dirs stay with the
+    oracle differential)."""
+    from k8s_scheduler_tpu.fuzz import run_api_case, run_case
 
+    if via_api:
+        return run_api_case(trace)
     if not trace.chaos:
         return run_case(trace, bug=bug)
     with tempfile.TemporaryDirectory(prefix="fuzz-state-") as sd:
@@ -71,7 +78,7 @@ def _run_with_tmp_state(trace, bug):
 
 def run_one(seed, *, devices, chaos, multi_cycle, bug, artifact_dir,
             shrink, shrink_evals,
-            speculative=False) -> "tuple[int, str | None]":
+            speculative=False, via_api=False) -> "tuple[int, str | None]":
     """Returns (n_failures, artifact_path | None)."""
     from k8s_scheduler_tpu.fuzz import (
         generate_trace,
@@ -83,17 +90,17 @@ def run_one(seed, *, devices, chaos, multi_cycle, bug, artifact_dir,
         seed, devices=devices, chaos=chaos, multi_cycle=multi_cycle,
         speculative=speculative,
     )
-    failures = _run_with_tmp_state(trace, bug)
+    failures = _run_with_tmp_state(trace, bug, via_api=via_api)
     if not failures:
         return 0, None
     first = failures[0]
-    print(_stamp(trace, bug, first), flush=True)
+    print(_stamp(trace, bug, first, via_api=via_api), flush=True)
     for f in failures[:5]:
         print(f"  {f}", flush=True)
     path = None
     if shrink:
         def check(tr):
-            fs = _run_with_tmp_state(tr, bug)
+            fs = _run_with_tmp_state(tr, bug, via_api=via_api)
             return fs[0] if fs else None
 
         mint, minf = shrink_trace(
@@ -106,7 +113,7 @@ def run_one(seed, *, devices, chaos, multi_cycle, bug, artifact_dir,
         )
         save_artifact(
             path, mint, minf, bug=bug,
-            note=_stamp(trace, bug, first),
+            note=_stamp(trace, bug, first, via_api=via_api),
         )
         print(
             f"  shrunk to {sum(len(c) for c in mint.cycles)} events / "
@@ -128,6 +135,11 @@ def main() -> int:
     ap.add_argument("--speculative", action="store_true",
                     help="depth-2 speculative dispatch pipelining over "
                     "the coalesced batches (forces --multi-cycle)")
+    ap.add_argument("--via-api", action="store_true",
+                    help="arrivals_via_api variant: route every pod "
+                    "arrival through a real gRPC Submit round trip and "
+                    "node churn through NodeChurn, and require "
+                    "bit-equal streams vs the direct-enqueue engine")
     ap.add_argument("--inject-bug", default=None, choices=("tiebreak",),
                     help="deliberately mutate the engine (self-test: "
                     "the differential must catch it)")
@@ -143,6 +155,12 @@ def main() -> int:
     ap.add_argument("--shrink-evals", type=int, default=150)
     ap.add_argument("--artifact-dir", default="fuzz-artifacts")
     args = ap.parse_args()
+    if args.via_api and (args.chaos or args.inject_bug):
+        ap.error(
+            "--via-api is an engine-vs-engine variant for plain "
+            "traces; chaos and bug injection belong to the oracle "
+            "differential"
+        )
 
     from k8s_scheduler_tpu.utils.compilation_cache import (
         enable_compilation_cache,
@@ -182,17 +200,18 @@ def main() -> int:
         n, _p = run_one(
             args.seed, devices=args.devices, chaos=args.chaos,
             multi_cycle=args.multi_cycle or None,
-            speculative=args.speculative, **kw,
+            speculative=args.speculative, via_api=args.via_api, **kw,
         )
         print(json.dumps({"seed": args.seed, "failures": n}), flush=True)
         return 1 if n else 0
 
-    # the soak: plain, chaos, and speculative-depth-2 cases
-    # interleaved, devices {1, 4} — (seed, devices, chaos, speculative)
+    # the soak: plain, chaos, speculative-depth-2, and arrivals-via-API
+    # cases interleaved, devices {1, 4} —
+    # (seed, devices, chaos, speculative, via_api)
     seeds = (
-        [(s, 1, False, False) for s in range(100, 103)]
-        + [(110, 4, False, False), (111, 1, True, False),
-           (112, 1, False, True)]
+        [(s, 1, False, False, False) for s in range(100, 103)]
+        + [(110, 4, False, False, False), (111, 1, True, False, False),
+           (112, 1, False, True, False), (113, 1, False, False, True)]
     ) if args.smoke else None
     deadline = None if args.smoke else time.time() + args.minutes * 60
     total = failures_n = cases = 0
@@ -202,7 +221,7 @@ def main() -> int:
         if seeds is not None:
             if cases >= len(seeds):
                 break
-            s, devices, chaos, speculative = seeds[cases]
+            s, devices, chaos, speculative, via_api = seeds[cases]
         else:
             if time.time() >= deadline or failures_n >= 5:
                 break
@@ -214,9 +233,13 @@ def main() -> int:
             # batches (forces mc; disjoint from nothing — it composes
             # with chaos and sharding alike)
             speculative = s % 7 == 1
+            # every eleventh plain case routes arrivals through the
+            # real Submit/NodeChurn RPCs (engine-vs-engine; chaos and
+            # bug injection stay with the oracle differential)
+            via_api = s % 11 == 4 and not chaos and not speculative
         n, path = run_one(
             s, devices=devices, chaos=chaos, multi_cycle=None,
-            speculative=speculative, **kw
+            speculative=speculative, via_api=via_api, **kw
         )
         cases += 1
         total += n

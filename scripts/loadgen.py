@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the submission front door (ISSUE 14).
+
+Arrival-rate-driven, never closed-loop: submission i is DUE at
+t0 + i/rate regardless of how fast acks or binds come back, so an
+overloaded scheduler actually overloads (and must shed) instead of
+silently throttling the generator. Rates are pods/minute to match the
+10k-1M pods/min ROADMAP target.
+
+Two modes:
+
+- **inproc** (default) — spins the whole front door in this process on
+  `bench_suite.front_door_drive` (the same harness bench config 9 and
+  the soak_chaos overload phase use): exact per-pod submit->bind
+  latency from the binder's own timestamps, BENCH-diffable JSON out.
+
+      JAX_PLATFORMS=cpu python scripts/loadgen.py --rate 30000 --duration 10
+
+- **grpc** — drives a LIVE scheduler's Submit RPC (started with
+  `python -m k8s_scheduler_tpu --submit-addr ...`): client-side ack
+  latency + shed accounting, optional `--acked-log` journal of every
+  acked uid (fsynced per batch) so a kill -9 failover harness can
+  assert zero lost acked pods against the restored state. Server-side
+  submit->bind quantiles ride the `submit_bind` phase gauges on
+  /metrics and /debug/anomalies.
+
+      python scripts/loadgen.py --mode grpc --addr 127.0.0.1:50052 \\
+          --rate 60000 --duration 30 --nodes 16 --acked-log /tmp/acked
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the ONE percentile implementation (bench_suite's module level is
+# stdlib-only): the load tool and the bench must never disagree on
+# quantile indexing
+from bench_suite import _percentile as _pctl  # noqa: E402
+
+
+def run_inproc(args) -> dict:
+    import bench_suite
+
+    rate_pps = args.rate / 60.0
+    d = bench_suite.front_door_drive(
+        duration_s=args.duration,
+        rate_pps=rate_pps,
+        queue_depth=args.queue_depth,
+        n_nodes=args.nodes,
+        batch=args.batch,
+        state_dir=args.state_dir,
+        name_prefix="lg",
+    )
+    bind_ms = sorted(
+        (t - d["acked"][u]) * 1e3
+        for u, (_c, t) in d["binds"].items()
+        if u in d["acked"]
+    )
+    ack_ms = [v * 1e3 for v in d["ack_lat_s"]]
+    total = d["accepted"] + d["shed"]
+    out = {
+        "config": 9,
+        "name": "front_door",
+        "mode": "inproc",
+        "rate_pods_per_min": args.rate,
+        "duration_s": args.duration,
+        "accepted": d["accepted"],
+        "shed": d["shed"],
+        "shed_rate": round(d["shed"] / max(total, 1), 4),
+        "scheduled": len(d["binds"]),
+        "duplicate_binds": d["duplicate_binds"],
+        "lost": d["lost"],
+        "max_queue_depth": d["max_depth"],
+        "bind_rate_pps": round(d["bind_rate_pps"], 1),
+        "submit_ack_p50_ms": round(_pctl(ack_ms, 50), 3),
+        "submit_ack_p99_ms": round(_pctl(ack_ms, 99), 3),
+        "submit_bind_p50_ms": round(_pctl(bind_ms, 50), 3),
+        "submit_bind_p99_ms": round(_pctl(bind_ms, 99), 3),
+        "drained": d["drained"],
+        "durable": bool(args.state_dir),
+    }
+    if d["state"] is not None:
+        d["state"].seal()
+    return out
+
+
+def run_grpc(args) -> dict:
+    import grpc
+
+    from k8s_scheduler_tpu.service.client import SchedulerClient
+    from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+    client = SchedulerClient(args.addr)
+    if args.nodes:
+        client.node_churn(adds=make_cluster(args.nodes))
+    log_f = open(args.acked_log, "a") if args.acked_log else None
+    rate_pps = args.rate / 60.0
+    interval = args.batch / rate_pps
+    n_batches = max(int(args.duration / interval), 1)
+    ack_ms: list[float] = []
+    accepted = shed = 0
+    retry_after: list[float] = []
+    draining = False
+    t0 = time.perf_counter()
+    for i in range(n_batches):
+        due = t0 + i * interval
+        now = time.perf_counter()
+        if now < due:
+            time.sleep(due - now)
+        pods = make_pods(
+            args.batch, seed=args.seed + i,
+            name_prefix=f"{args.prefix}{i}-",
+        )
+        t_sub = time.perf_counter()
+        try:
+            resp = client.submit(pods)
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                shed += len(pods)
+                for k, v in e.trailing_metadata() or ():
+                    if k == "retry-after-ms":
+                        retry_after.append(float(v))
+                continue
+            if e.code() == grpc.StatusCode.UNAVAILABLE:
+                # server draining (shutdown) or killed mid-load: an
+                # open-loop generator records it and stops — the acks
+                # already on disk are the failover contract
+                draining = True
+                break
+            raise
+        ack_ms.append((time.perf_counter() - t_sub) * 1e3)
+        accepted += resp.accepted
+        if log_f is not None:
+            # the acked-uid journal is the failover oracle: fsync per
+            # batch so a parent that kill -9s BOTH of us still reads
+            # every uid whose ack reached this client
+            for p in pods:
+                log_f.write(f"{p.uid} durable={resp.durable}\n")
+            log_f.flush()
+            os.fsync(log_f.fileno())
+    total = accepted + shed
+    out = {
+        "config": 9,
+        "name": "front_door",
+        "mode": "grpc",
+        "addr": args.addr,
+        "rate_pods_per_min": args.rate,
+        "duration_s": args.duration,
+        "accepted": accepted,
+        "shed": shed,
+        "shed_rate": round(shed / max(total, 1), 4),
+        "submit_ack_p50_ms": round(_pctl(ack_ms, 50), 3),
+        "submit_ack_p99_ms": round(_pctl(ack_ms, 99), 3),
+        "retry_after_ms_seen": sorted(set(retry_after)),
+        "stopped_draining": draining,
+    }
+    if log_f is not None:
+        log_f.close()
+    client.close()
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=("inproc", "grpc"), default="inproc")
+    ap.add_argument(
+        "--rate", type=float, default=30000.0,
+        help="open-loop arrival rate, pods per MINUTE (default 30k)",
+    )
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="open-loop window, seconds")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="pods per Submit request")
+    ap.add_argument("--nodes", type=int, default=16,
+                    help="nodes to create (grpc: pushed via NodeChurn)")
+    ap.add_argument("--queue-depth", type=int, default=0,
+                    help="inproc: admission bound (0 = unbounded)")
+    ap.add_argument("--state-dir", default="",
+                    help="inproc: durable state dir (WAL-before-ack on)")
+    ap.add_argument("--addr", default="127.0.0.1:50052",
+                    help="grpc: the front door's --submit-addr")
+    ap.add_argument("--acked-log", default="",
+                    help="grpc: append every acked uid here (fsynced "
+                    "per batch; the kill -9 failover oracle)")
+    ap.add_argument("--seed", type=int, default=50_000)
+    ap.add_argument("--prefix", default="lg")
+    args = ap.parse_args()
+    out = run_inproc(args) if args.mode == "inproc" else run_grpc(args)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

@@ -2,7 +2,7 @@
 """Chaos soak: replay a workload while every fault class fires, assert
 the degradation ladder's invariants hold and measure MTTR.
 
-Three phases (each selectable; default = all):
+Four phases (each selectable; default = all):
 
 - **serve** — one in-process Scheduler (flight recorder + observer +
   compile cache + dispatch watchdog) serves a steady arrival stream
@@ -19,6 +19,12 @@ Three phases (each selectable; default = all):
     * the ladder recovered to rung 0 by the end (MTTR reported);
     * a warm restart against the same compile-cache dir neither
       crashes on the torn entry nor misses every entry.
+- **overload** — chaos fusion for the edge (ISSUE 14): arrivals at
+  >= 2x measured capacity through the REAL submission API
+  (bench_suite.front_door_drive, the bench-config-9 harness) with a
+  fetch_hang mid-burst. Asserts bounded admission-queue depth,
+  shed-not-lost (every acked pod binds exactly once), /healthz
+  degraded DURING the burst, and ladder recovery to rung 0 after it.
 - **enospc** — a Scheduler with durable state takes a
   `journal_enospc` hit: the writer dies, DurableState degrades to
   stateless (the documented path), and serving CONTINUES — pods still
@@ -174,6 +180,135 @@ def run_serve_phase(
         cc = sched2._compile_cache
         result["warm_cache"] = cc.status() if cc is not None else {}
         assert cc is not None and cc.hits + cc.misses > 0
+    if verbose:
+        print(json.dumps(result), flush=True)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# phase 1b: overload through the real submission API (chaos fusion for
+# the edge, ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def run_overload_phase(verbose: bool = True) -> dict:
+    """Arrival rate >= 2x measured capacity through the REAL front
+    door (bench_suite.front_door_drive — the same harness bench
+    config 9 asserts, so bench and soak can never drift), with a
+    fetch_hang firing MID-BURST so the degradation ladder engages
+    while the door is already shedding. Invariants:
+
+    - the admission queue depth never exceeds its bound (+one batch);
+    - the door actually shed (RESOURCE_EXHAUSTED, never silent drops);
+    - shed-not-lost: every ACKED pod binds exactly once by drain;
+    - /healthz reports degraded:true at some point DURING the burst
+      (admission saturation is a paging signal) and clean after it;
+    - the ladder recovers to rung 0 after the burst, with the hang
+      step deadline-classified (the watchdog ended it, not the hang).
+    """
+    import bench_suite
+
+    from k8s_scheduler_tpu.cmd.httpserver import staleness_healthz
+    from k8s_scheduler_tpu.core import faults
+
+    depth_bound = 64
+    deadline_ms, hang_ms = 300.0, 2500.0
+    try:
+        cal = bench_suite.front_door_drive(
+            duration_s=1.0, rate_pps=400.0, queue_depth=depth_bound,
+            name_prefix="oc",
+        )
+        cap = max(cal["bind_rate_pps"], 20.0)
+
+        degraded_seen = {"burst": False}
+        probe_state: dict = {}
+
+        def probe(sched, admission, _res):
+            # the REAL /healthz closure, evaluated inside the burst:
+            # admission saturation (or the hang's ladder step) must
+            # surface as degraded:true while the door sheds
+            if "fn" not in probe_state:
+                probe_state["fn"] = staleness_healthz(
+                    None, sched.flight, 0.0, observer=sched.observer,
+                    ladder=sched.ladder, admission=admission,
+                )
+            _ok, detail = probe_state["fn"]()
+            if detail.get("degraded"):
+                degraded_seen["burst"] = True
+
+        d = bench_suite.front_door_drive(
+            duration_s=6.0,
+            rate_pps=cap * 2.5,
+            queue_depth=depth_bound,
+            batch=8,
+            deadline_ms=deadline_ms,
+            fault_spec=(
+                f"seed=17;fetch_hang@cycle=8..100000:ms={hang_ms}:n=1"
+            ),
+            name_prefix="ov",
+            on_tick=probe,
+        )
+        sched = d["sched"]
+        plan = faults.plan()
+        fn_after = staleness_healthz(
+            None, sched.flight, 0.0, observer=sched.observer,
+            ladder=sched.ladder, admission=d["admission"],
+        )
+        _ok, after = fn_after()
+        result = {
+            "phase": "overload",
+            "capacity_pps": round(cap, 1),
+            "rate_pps": round(cap * 2.5, 1),
+            "accepted": d["accepted"],
+            "shed": d["shed"],
+            "bound": len(d["binds"]),
+            "duplicate_binds": d["duplicate_binds"],
+            "lost": d["lost"],
+            "max_queue_depth": d["max_depth"],
+            "depth_bound": depth_bound,
+            "degraded_during_burst": degraded_seen["burst"],
+            "degraded_after": bool(after.get("degraded", False)),
+            "final_rung": sched.ladder.rung,
+            "degradations": sched.ladder.degradations,
+            "fired_points": sorted(
+                plan.fired_points()
+            ) if plan else [],
+            "drained": d["drained"],
+        }
+    finally:
+        faults.disarm()
+
+    assert result["shed"] > 0, (
+        "overload burst never shed — the admission bound is not "
+        f"engaging at {result['rate_pps']} pps vs capacity "
+        f"{result['capacity_pps']} pps"
+    )
+    assert result["max_queue_depth"] <= depth_bound + 8, (
+        f"queue depth {result['max_queue_depth']} exceeded the bound "
+        f"{depth_bound}: backpressure is not bounding memory"
+    )
+    assert not result["lost"], (
+        f"acked pods lost under overload: {result['lost'][:6]}"
+    )
+    assert result["duplicate_binds"] == 0, "duplicate binds"
+    missing = {u for u in d["acked"] if u not in d["binds"]}
+    assert not missing, (
+        f"shed-not-lost violated: {len(missing)} acked pods never "
+        f"bound ({sorted(missing)[:4]})"
+    )
+    assert "fetch_hang" in result["fired_points"], (
+        "the mid-burst fetch_hang never fired"
+    )
+    assert result["degradations"] >= 1 and any(
+        t["reason"].startswith("deadline")
+        for t in sched.ladder.transitions
+    ), "no deadline-classified ladder step: the watchdog never expired"
+    assert result["degraded_during_burst"], (
+        "/healthz never reported degraded during the burst"
+    )
+    assert result["final_rung"] == 0 and not result["degraded_after"], (
+        "front door did not recover to rung 0 / clean healthz"
+    )
     if verbose:
         print(json.dumps(result), flush=True)
     return result
@@ -403,8 +538,8 @@ def run_crash_phase(state_dir: str, verbose: bool = True) -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
-        "--phases", default="serve,enospc,crash",
-        help="comma list: serve, enospc, crash",
+        "--phases", default="serve,overload,enospc,crash",
+        help="comma list: serve, overload, enospc, crash",
     )
     ap.add_argument("--cycles", type=int, default=48)
     ap.add_argument("--deadline-ms", type=float, default=300.0)
@@ -434,6 +569,8 @@ def main() -> int:
             hang_ms=args.hang_ms,
             cache_dir=os.path.join(base, "compile_cache"),
         ))
+    if "overload" in phases:
+        results.append(run_overload_phase())
     if "enospc" in phases:
         results.append(run_enospc_phase(os.path.join(base, "enospc")))
     if "crash" in phases:
