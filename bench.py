@@ -34,9 +34,11 @@ TARGET_DECISIONS_PER_SEC = 50_000.0
 # config 7 = the fault-storm soak: serving cycles under the fault plan;
 # config 8 = the sharded scale sweep: timed cycles per grid point x
 # device count; config 9 = the front-door load drive: ~seconds of
-# open-loop arrival split across the sustained/overload phases)
+# open-loop arrival split across the sustained/overload phases;
+# config 10 = the admission-time incremental-encode drive: ~2 seconds
+# of open-loop arrival per leg x the three rebuild/incremental/2x legs)
 DEFAULT_SNAPSHOTS = {1: 50, 2: 50, 3: 50, 4: 30, 5: 30, 6: 24, 7: 40,
-                     8: 4, 9: 12}
+                     8: 4, 9: 12, 10: 12}
 
 
 def _run_one_isolated(c: int, n: int):
@@ -297,6 +299,22 @@ def main() -> None:
                     "shed": r["shed_rate"],
                 }
                 if "submit_bind_p99_ms" in r else {}
+            ),
+            # admission-time incremental encode (config 10): hidden
+            # encode share, flush-side finalize p50, flush cadence,
+            # rebuild/finalize mean ratio, and the base/2x submit->bind
+            # p50 flatness — ehid drop and finp50 rise diffed
+            # directionally by bench_diff
+            **(
+                {
+                    "ehid": r["encode_hidden_pct"],
+                    "finp50": r["finalize_p50_ms"],
+                    "frate": r["flush_rate_per_s"],
+                    "fsx": r["finalize_speedup"],
+                    "sbp50": r["submit_bind_p50_ms"],
+                    "flat": r["submit_bind_flat_pct"],
+                }
+                if "encode_hidden_pct" in r else {}
             ),
             # sharded scale sweep (config 8): scaling efficiency at the
             # largest grid point's max device count, the compiled

@@ -146,10 +146,20 @@ CONFIG_NAMES = {
     # once — gated directionally by bench_diff (submit p99 rise / shed
     # rate rise = regressed)
     9: "front_door",
+    # admission-time incremental encode (ISSUE 16): the SAME open-loop
+    # front-door drive with incrementalEncode off (rebuild baseline),
+    # on, and on at a DOUBLED arrival rate — reporting how much encode
+    # host time hides in the ack path's shadow (encode_hidden_pct),
+    # the O(1)-finalize flush cost (finalize_p50_ms, flush rate,
+    # speedup vs the rebuild baseline), and whether submit->bind p50
+    # stays flat as the arrival rate doubles — gated by bench_diff
+    # (--max-finalize-rise / --min-encode-hidden)
+    10: "host_encode",
 }
 CONFIG_SHAPES = {1: (100, 10), 2: (1000, 100), 3: (5000, 1000),
                  4: (10000, 5000), 5: (8000, 2000), 6: (80, 16),
-                 7: (48, 16), 8: (100000, 50000), 9: (0, 16)}
+                 7: (48, 16), 8: (100000, 50000), 9: (0, 16),
+                 10: (0, 16)}
 
 
 def _draw_pending(cfg: int, i: int, prev: list | None, churn: float):
@@ -243,6 +253,8 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
         return run_sharded_scale_config(snapshots=snapshots)
     if cfg == 9:
         return run_front_door_config(snapshots=snapshots)
+    if cfg == 10:
+        return run_host_encode_config(snapshots=snapshots)
     import jax
     import numpy as np
 
@@ -1357,6 +1369,7 @@ def front_door_drive(
     promote_cycles: int = 4,
     name_prefix: str = "ld",
     release_after_bind: bool = True,
+    incremental: bool = False,
     on_tick=None,
 ) -> dict:
     """The shared open-loop front-door harness (ISSUE 14): one real
@@ -1405,6 +1418,7 @@ def front_door_drive(
         pad_pods_per_node=512,
         compile_cache_dir="off",
         speculative_compile=False,
+        incremental_encode=incremental,
     )
     binds: dict[str, tuple[int, float]] = {}
     confirm_q: "collections.deque" = collections.deque()
@@ -1657,6 +1671,152 @@ def run_front_door_config(snapshots: int = 12) -> dict:
         "max_queue_depth": o["max_depth"],
         "queue_depth_bound": depth_bound,
         "drained": bool(d["drained"] and o["drained"]),
+    }
+
+
+def run_host_encode_config(snapshots: int = 12) -> dict:
+    """Config 10: admission-time incremental encode through the REAL
+    Submit path (ISSUE 16). Four stages on the shared
+    `front_door_drive` harness:
+
+    1. **calibrate** — a short burst measures serving capacity so the
+       rates below scale to the machine;
+    2. **rebuild baseline** — ~15% capacity with incrementalEncode
+       OFF: every flush pays the O(P) full arena rebuild (the
+       `cycle_duration{phase="encode"}` mean is the rebuild cost).
+       The fraction is deliberately conservative: the calibration
+       burst runs depth-bounded (shedding keeps its backlog shallow),
+       so its bind rate overstates what an UNBOUNDED leg sustains —
+       a leg driven near that figure backlogs, the growing pending
+       set flips the pad regime mid-drive, and the recompile stall
+       degrades the watchdog ladder below `sequential`, gating off
+       the very multi-cycle buffering (and admission-time ingest)
+       this config measures;
+    3. **incremental** — the SAME rate with incrementalEncode ON:
+       ingest folds each acked pod in the ack path's shadow and the
+       flush pays only the O(1) finalize — `encode_hidden_pct` is the
+       share of encode host time that moved off the flush critical
+       path, `finalize_p50_ms` the flush-side residue;
+    4. **doubled rate** — incremental ON at 2x the rate: since the
+       per-flush cost no longer scales with the backlog,
+       `submit_bind_p50_ms` should stay flat (the ±20% acceptance
+       rides `submit_bind_flat_pct`).
+
+    All legs must shed nothing and lose nothing (sustained-load
+    invariants, same as config 9). bench_diff gates the headline pair:
+    `--max-finalize-rise` on finalize_p50_ms (lower is better) and
+    `--min-encode-hidden` on encode_hidden_pct (higher is better)."""
+    n_nodes = CONFIG_SHAPES[10][1]
+    depth_bound = 64
+    cal = front_door_drive(
+        duration_s=1.5, rate_pps=400.0, n_nodes=n_nodes,
+        batch=4, queue_depth=depth_bound, name_prefix="hec",
+    )
+    cap_pps = max(cal["bind_rate_pps"], 20.0)
+    if cal["lost"] or cal["duplicate_binds"]:
+        raise AssertionError(
+            f"host_encode calibration violated invariants: "
+            f"lost={cal['lost']} dup={cal['duplicate_binds']}"
+        )
+    leg_s = max(snapshots / 2.0, 4.0)
+    base_rate = max(cap_pps * 0.15, 8.0)
+
+    def leg(rate, inc, prefix):
+        d = front_door_drive(
+            duration_s=leg_s, rate_pps=rate, n_nodes=n_nodes,
+            batch=4, name_prefix=prefix, incremental=inc,
+        )
+        if d["shed"] or d["lost"] or d["duplicate_binds"]:
+            raise AssertionError(
+                f"host_encode leg {prefix!r} violated invariants: "
+                f"shed={d['shed']} lost={d['lost']} "
+                f"dup={d['duplicate_binds']}"
+            )
+        m = d["sched"].metrics
+        enc = m.cycle_duration.labels(phase="encode")
+        out = {
+            "binds": d["binds"], "acked": d["acked"],
+            "wall_s": d["wall_s"], "accepted": d["accepted"],
+            "encode_n": sum(b.get() for b in enc._buckets),
+            "encode_sum_ms": enc._sum.get() * 1e3,
+            "ingest_sum_ms": m.encode_ingest._sum.get() * 1e3,
+            "finalize_sum_ms": m.encode_finalize._sum.get() * 1e3,
+            "finalize_n": sum(
+                b.get() for b in m.encode_finalize._buckets
+            ),
+            "finalize_samples_ms": sorted(
+                r.phases["encode_finalize_ms"]
+                for r in d["sched"].flight.snapshot()
+                if "encode_finalize_ms" in r.phases
+            ),
+            "ingest_hits": sum(
+                e.ingest_hits for e in d["sched"]._encoders.values()
+            ),
+            "ingest_misses": sum(
+                e.ingest_misses for e in d["sched"]._encoders.values()
+            ),
+        }
+        out["bind_p50_ms"] = _percentile(sorted(
+            (t_bind - d["acked"][u]) * 1e3
+            for u, (_c, t_bind) in d["binds"].items()
+            if u in d["acked"]
+        ), 50)
+        return out
+
+    off = leg(base_rate, inc=False, prefix="heo")
+    on = leg(base_rate, inc=True, prefix="hei")
+    on2 = leg(base_rate * 2.0, inc=True, prefix="he2")
+    if not on["ingest_hits"]:
+        raise AssertionError(
+            "host_encode incremental leg never folded a staged ingest "
+            f"row (misses={on['ingest_misses']}): the variant measured "
+            "nothing but the fallback path"
+        )
+
+    ing, fin = on["ingest_sum_ms"], on["finalize_sum_ms"]
+    hidden_pct = 100.0 * ing / max(ing + fin, 1e-9)
+    rebuild_mean = off["encode_sum_ms"] / max(off["encode_n"], 1)
+    finalize_mean = on["encode_sum_ms"] / max(on["encode_n"], 1)
+    base_p50 = on["bind_p50_ms"]
+    p50_2x = on2["bind_p50_ms"]
+    return {
+        "config": 10,
+        "name": CONFIG_NAMES[10],
+        "pods": off["accepted"] + on["accepted"] + on2["accepted"],
+        "nodes": n_nodes,
+        "snapshots": snapshots,
+        "wall_s": round(
+            cal["wall_s"] + off["wall_s"] + on["wall_s"]
+            + on2["wall_s"], 2,
+        ),
+        "scheduled": (
+            len(off["binds"]) + len(on["binds"]) + len(on2["binds"])
+        ),
+        "capacity_pps": round(cap_pps, 1),
+        "rate_pps": round(base_rate, 1),
+        # the headline pair bench_diff gates
+        "encode_hidden_pct": round(hidden_pct, 2),
+        "finalize_p50_ms": round(
+            _percentile(on["finalize_samples_ms"], 50), 3
+        ),
+        # flush cadence + rebuild-vs-finalize cost (mean of the same
+        # cycle_duration{phase="encode"} instrument on both legs)
+        "flush_rate_per_s": round(
+            on["finalize_n"] / max(on["wall_s"], 1e-9), 2
+        ),
+        "rebuild_mean_ms": round(rebuild_mean, 3),
+        "finalize_mean_ms": round(finalize_mean, 3),
+        "finalize_speedup": round(
+            rebuild_mean / max(finalize_mean, 1e-9), 2
+        ),
+        "ingest_hits": on["ingest_hits"] + on2["ingest_hits"],
+        "ingest_misses": on["ingest_misses"] + on2["ingest_misses"],
+        # arrival-rate-doubling flatness: + = slower at 2x
+        "submit_bind_p50_ms": round(base_p50, 3),
+        "submit_bind_p50_2x_ms": round(p50_2x, 3),
+        "submit_bind_flat_pct": round(
+            100.0 * (p50_2x / max(base_p50, 1e-9) - 1.0), 1
+        ),
     }
 
 

@@ -153,6 +153,16 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         "-1 = keep config)",
     )
     ap.add_argument(
+        "--incremental-encode", type=int, default=-1, choices=(-1, 0, 1),
+        help="admission-time incremental encode: parse each buffered pod "
+        "into staged row data at multi-cycle buffer time (the ack "
+        "path's shadow) so the flush encode is an O(dirty) finalize "
+        "over pre-parsed rows; falls back to a full rebuild on "
+        "interning-table growth or a pad-regime flip, bit-identical "
+        "either way (config incrementalEncode; 1 on, 0 off, "
+        "-1 = keep config)",
+    )
+    ap.add_argument(
         "--dispatch-deadline-ms", type=float, default=-1.0,
         help="dispatch watchdog: bound on the blocking per-cycle "
         "decision fetch in milliseconds — on expiry the fetch is "
@@ -237,6 +247,8 @@ def main(argv: list[str] | None = None) -> int:
         config.speculative_compile = bool(args.speculative_compile)
     if args.speculative_dispatch >= 0:
         config.speculative_dispatch = bool(args.speculative_dispatch)
+    if args.incremental_encode >= 0:
+        config.incremental_encode = bool(args.incremental_encode)
     if args.dispatch_deadline_ms >= 0:
         config.dispatch_deadline_ms = args.dispatch_deadline_ms
     if args.degrade_promote_cycles > 0:

@@ -189,6 +189,16 @@ class SchedulerConfiguration:
     # multi-cycle path (multiCycleK > 1); forced off under forcedSync
     # and at/below the degradation ladder's `sequential` rung.
     speculative_dispatch: bool = True
+    # incrementalEncode — admission-time incremental encode
+    # (models/encoding.py ingest_pod + core/scheduler.py multi-cycle
+    # flush): each pod buffered for a multi-cycle batch is parsed into
+    # staged row data at buffer time, in the ack path's shadow, so the
+    # flush-time encode is an O(dirty) finalize over pre-parsed rows
+    # instead of an O(P) re-walk. Falls back to a full rebuild whenever
+    # an interning table grows during ingest or the pad regime flips —
+    # the packed arena is bit-identical either way. Effective on the
+    # multi-cycle path (multiCycleK > 1); a no-op at K=1.
+    incremental_encode: bool = False
     # dispatch watchdog (core/pipeline.py): bound, in milliseconds, on
     # the ONE blocking device->host decision fetch. On expiry the fetch
     # is abandoned (DispatchDeadlineExceeded), the cycle's pods requeue
@@ -359,6 +369,7 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         shard_devices=int(data.get("shardDevices", 0)),
         speculative_compile=bool(data.get("speculativeCompile", True)),
         speculative_dispatch=bool(data.get("speculativeDispatch", True)),
+        incremental_encode=bool(data.get("incrementalEncode", False)),
         dispatch_deadline_ms=float(data.get("dispatchDeadlineMs", 0.0)),
         degrade_promote_cycles=int(data.get("degradePromoteCycles", 8)),
         fault_spec=str(data.get("faultSpec", "")),

@@ -84,6 +84,11 @@ TRACE_LANE_FOR_PHASE = {
     # front door: admission accept -> bind, a host-observed end-to-end
     # window; renders on the host lane (it ends in the bind loop)
     "submit_bind": (LANE_HOST, "bind winners"),
+    # admission-time incremental encode: the ingest share was paid
+    # before the flush cycle started, but it is host encode work, so
+    # both halves render inside the flush cycle's encode slice
+    "encode_ingest": (LANE_HOST, "encode"),
+    "encode_finalize": (LANE_HOST, "encode"),
 }
 
 

@@ -97,6 +97,15 @@ PHASES = (
     # coalescing buffers; stamped per cycle as the WORST such latency
     # among the cycle's binds, so the streaming p99 tracks the
     # submit->bind SLO the open-loop load harness measures externally
+    # admission-time incremental encode (models/encoding.py ingest_pod
+    # + the multi-cycle flush): the encode cost splits into work paid
+    # in the ack path's shadow and the flush-time residue —
+    "encode_ingest",  # per-group parse of buffered pods into staged
+    # row data at multi-cycle buffer time (hidden behind the front
+    # door's ack; stamped on the flush cycle's record)
+    "encode_finalize", # the flush-critical encode remainder: folding
+    # staged rows into the packed arena when the batch flushes (what
+    # is left of the old O(P) rebuild)
 )
 
 ANOMALY_CLASSES = (
@@ -174,6 +183,13 @@ def phase_seconds(rec) -> dict[str, float]:
         out["first_bind"] = ph["first_bind_ms"] / 1e3
     if "submit_bind_ms" in ph:
         out["submit_bind"] = ph["submit_bind_ms"] / 1e3
+    # admission-time incremental encode split (stamped on flush cycles
+    # when incrementalEncode is on; ingest may be 0-cost on an empty
+    # buffer, so gate on presence, not value)
+    if "encode_ingest_ms" in ph:
+        out["encode_ingest"] = ph["encode_ingest_ms"] / 1e3
+    if "encode_finalize_ms" in ph:
+        out["encode_finalize"] = ph["encode_finalize_ms"] / 1e3
     return out
 
 

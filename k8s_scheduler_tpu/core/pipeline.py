@@ -373,6 +373,17 @@ class CycleHandle:
                 )
         return arr
 
+    def reject_counts_matrix(self, n: int):
+        """The per-plugin attribution as ONE forced [n, F] matrix: the
+        vectorized apply fold reads whole columns (one counter inc per
+        plugin across the cycle's losers) instead of re-entering the
+        force per pod. Falls back to the fused program's in-result
+        counts when no deferred diagnosis program exists."""
+        rc = self.reject_counts()
+        if rc is None:
+            rc = np.asarray(self.result.reject_counts)
+        return np.asarray(rc)[:n]
+
     def block(self):
         """Force everything in flight (the forced_sync escape hatch).
         Routed through the same bounded-fetch path as decisions(): at
@@ -630,6 +641,12 @@ class MultiCycleHandle:
         arr = np.asarray(d)
         self._stamp_diag_lag(i)
         return arr
+
+    def reject_counts_matrix(self, i: int, n: int):
+        """Inner cycle i's per-plugin attribution as ONE forced [n, F]
+        matrix (see CycleHandle.reject_counts_matrix — same one-force
+        contract for the vectorized apply fold)."""
+        return np.asarray(self.reject_counts(i))[:n]
 
     def block(self):
         """Force everything in flight (the forced_sync escape hatch);

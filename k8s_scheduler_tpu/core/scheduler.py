@@ -326,6 +326,12 @@ class Scheduler:
         # post_batch=1 so the observer can excuse its full re-encode
         # from the fold_miss anomaly
         self._mc_stale_arena: set[str] = set()
+        # admission-time incremental encode (incrementalEncode): per-
+        # profile accumulated ingest seconds since the last flush (the
+        # staging work hidden in the buffering pop's shadow) and the
+        # flush-time phase stamps awaiting inner record 0
+        self._ingest_s: dict[str, float] = {}
+        self._flush_phases: dict[str, dict] = {}
         if self.extenders:
             # extender verdicts are consulted per HOST cycle; inner
             # device cycles cannot re-consult a webhook, so batching is
@@ -1011,6 +1017,15 @@ class Scheduler:
                 buf = self._mc_groups[name]
                 if group:
                     buf.append((t0, group))
+                    if self.config.incremental_encode:
+                        # admission-time incremental encode: parse each
+                        # newly buffered pod's arena row NOW, in the
+                        # buffering pop's shadow — the first serve-
+                        # thread moment after the front door acked it
+                        # (the encoder is serve-thread-owned, so the
+                        # ack path proper never touches it). The flush
+                        # then finalizes with an O(dirty) apply.
+                        self._ingest_group(name, group)
                 if not buf:
                     continue
                 if (
@@ -1050,6 +1065,11 @@ class Scheduler:
                     self.queue.retire_in_flight(
                         [p.uid for _t_enq, g in buf for p in g]
                     )
+                    if self.config.incremental_encode:
+                        # staged rows the flush did not consume (shed /
+                        # dropped pods) must not outlive their batch
+                        self._encoders[name].clear_ingest()
+                        self._ingest_s.pop(name, None)
                 else:
                     # buffered, not dispatched: attempted at the flush
                     stats.attempted -= len(group)
@@ -1294,20 +1314,14 @@ class Scheduler:
             handle.dispatch_diagnosis()
         _rej_box: list = []
 
-        def reject_counts_of(i: int):
+        def reject_counts_fn():
+            # ONE force of the whole [P, F] attribution matrix — the
+            # vectorized loser fold consumes it column-wise
             if not _rej_box:
-                rc = (
-                    handle.reject_counts() if diag is not None else None
+                _rej_box.append(
+                    handle.reject_counts_matrix(len(pending))
                 )
-                if rc is not None:
-                    _rej_box.append(rc[: len(pending)])
-                else:
-                    _rej_box.append(
-                        np.asarray(handle.result.reject_counts)[
-                            : len(pending)
-                        ]
-                    )
-            return _rej_box[0][i]
+            return _rej_box[0]
 
         # preemption dispatched async too; its device time overlaps the
         # winner bind loop below and is forced only before losers are
@@ -1326,7 +1340,7 @@ class Scheduler:
 
         self._apply_phase(
             profile, framework, pending, nodes, existing, assignment,
-            gang_dropped, extender_errors, reject_counts_of, force_pre,
+            gang_dropped, extender_errors, reject_counts_fn, force_pre,
             stats, t0, rec, t_device,
         )
 
@@ -1343,6 +1357,17 @@ class Scheduler:
             fold_ms = encoder.delta_profile.get("fold")
             if fold_ms:
                 extra_phases["fold_ms"] = float(fold_ms)
+            if self.config.incremental_encode:
+                # a lone buffered group flushed through the single-
+                # cycle path with staged ingest rows: its encode WAS
+                # the finalize, so the ingest/finalize split lands
+                # here too (the mc flush stamps via _flush_phases)
+                ing_s = self._ingest_s.pop(profile, 0.0)
+                if ing_s > 0.0:
+                    fin_s = max(t_encode - t_start, 0.0)
+                    extra_phases["encode_ingest_ms"] = ing_s * 1e3
+                    extra_phases["encode_finalize_ms"] = fin_s * 1e3
+                    self.metrics.encode_finalize.observe(fin_s)
             if self._packed_builds > builds_before:
                 extra_phases["compile_ms"] = self._last_build_s * 1e3
                 extra_counts["regime_flip"] = 1
@@ -1523,6 +1548,20 @@ class Scheduler:
             return None
         return "cache" if all(s == "cache" for s in sources) else "cold"
 
+    def _ingest_group(self, profile: str, group: "list[Pod]") -> None:
+        """Stage each newly buffered pod's arena row (incrementalEncode,
+        models/encoding.SnapshotEncoder.ingest_pod) so the flush's per-
+        group delta encode skips the parse. The staging seconds
+        accumulate per profile for the flush record's encode_ingest_ms
+        phase — the host encode cost hidden from the dispatch path."""
+        enc = self._encoders[profile]
+        t_ing = self._now()
+        for p in group:
+            enc.ingest_pod(p)
+        ing_s = max(self._now() - t_ing, 0.0)
+        self._ingest_s[profile] = self._ingest_s.get(profile, 0.0) + ing_s
+        self.metrics.encode_ingest.observe(ing_s)
+
     def _schedule_profile_multi(
         self,
         profile: str,
@@ -1580,12 +1619,16 @@ class Scheduler:
         builds_before = self._packed_builds
         t_batch = self._now()
         t_batch_rec = fr.now() if fr is not None else 0.0
-        # the stacked snapshots below take plain encode() — the packed
-        # delta arena is bypassed and its _delta_state goes stale, so
-        # the next single-cycle encode_packed may legitimately fall
-        # back to a full encode (set even when the envelope precheck
-        # falls back: the plain encodes have run either way)
-        self._mc_stale_arena.add(profile)
+        inc = self.config.incremental_encode
+        if not inc:
+            # the stacked snapshots below take plain encode() — the
+            # packed delta arena is bypassed and its _delta_state goes
+            # stale, so the next single-cycle encode_packed may
+            # legitimately fall back to a full encode (set even when
+            # the envelope precheck falls back: the plain encodes have
+            # run either way). Under incrementalEncode every group
+            # folds through encode_packed, so the arena stays fresh.
+            self._mc_stale_arena.add(profile)
 
         # depth-2 speculative dispatch pipelining (speculativeDispatch):
         # row 0 dispatches alone and the remaining rows ride its
@@ -1609,28 +1652,62 @@ class Scheduler:
             )
             return
 
-        snaps = []
-        for _t_enq, g in groups:
-            snaps.append(encoder.encode(nodes, g, existing, **kw))
-            reason = multicycle_unsupported_reason(snaps[-1])
-            if reason is not None:
+        if inc:
+            rows, spec, reason = self._encode_groups_packed(
+                profile, encoder, groups, nodes, existing, kw
+            )
+            if rows is None:
                 self._mc_fall_back(profile, groups, stats, t0, reason)
                 return
-        specs = [packing.make_spec(s) for s in snaps]
-        if any(sp.key() != specs[0].key() for sp in specs[1:]):
-            # a later group grew an interning dimension: re-encode the
-            # whole batch once against the now-grown (grow-only) tables
-            # so every row shares the final spec
-            snaps = [
-                encoder.encode(nodes, g, existing, **kw)
-                for _t_enq, g in groups
-            ]
+            snaps = None
+        else:
+            snaps = []
+            lens0 = None
+            ci0 = encoder._cycle_index
+            for _t_enq, g in groups:
+                snaps.append(encoder.encode(nodes, g, existing, **kw))
+                if lens0 is None:
+                    # row 0's tables are the whole batch's stable side
+                    # (_stable_state below reads wbufs[0]/bbufs[0]), so
+                    # the growth watermark starts AFTER its encode —
+                    # anything a later group interns past this point is
+                    # invisible to the tables every inner cycle reads
+                    lens0 = encoder._table_lens()
+                reason = multicycle_unsupported_reason(snaps[-1])
+                if reason is not None:
+                    self._mc_fall_back(
+                        profile, groups, stats, t0, reason
+                    )
+                    return
             specs = [packing.make_spec(s) for s in snaps]
-            if any(sp.key() != specs[0].key() for sp in specs[1:]):
-                # cannot happen with grow-only tables; refuse to guess
-                self._mc_fall_back(profile, groups, stats, t0, None)
-                return
-        spec = specs[0]
+            if (
+                encoder._table_lens() != lens0
+                or any(sp.key() != specs[0].key() for sp in specs[1:])
+            ):
+                # a later group grew an interning structure — either
+                # past row 0's padded regime (spec keys diverge) or
+                # WITHIN the padding (keys still match, but row 0's
+                # stable tables lack the new entries and a later row's
+                # reference to them would dangle): re-encode the whole
+                # batch once against the now-grown (grow-only) tables
+                # so every row shares the final spec AND row 0 carries
+                # the full tables. The retry is a host-side do-over of
+                # the SAME logical cycles: rewind the sampling rotation
+                # so each group re-stamps the cycle_index its first
+                # encode used (otherwise the retry would skew the
+                # rotation vs a batch that needed only one pass)
+                encoder._cycle_index = ci0
+                snaps = [
+                    encoder.encode(nodes, g, existing, **kw)
+                    for _t_enq, g in groups
+                ]
+                specs = [packing.make_spec(s) for s in snaps]
+                if any(sp.key() != specs[0].key() for sp in specs[1:]):
+                    # cannot happen with grow-only tables; refuse to
+                    # guess
+                    self._mc_fall_back(profile, groups, stats, t0, None)
+                    return
+            spec = specs[0]
         (
             _pcycle, ppreempt, stable_fn, _keeper, _diag, _ek, pipe,
         ) = self._packed_fns(spec, profile)
@@ -1640,7 +1717,10 @@ class Scheduler:
         pipe.multi_cont_fn = mcont
 
         n = len(groups)
-        wbufs, bbufs = self._pack_stack(snaps, spec)
+        if inc:
+            wbufs, bbufs = self._pack_stack_rows(rows, spec)
+        else:
+            wbufs, bbufs = self._pack_stack(snaps, spec)
         batch_pods = [p for _t_enq, g in groups for p in g]
         try:
             stable = self._stable_state(
@@ -1653,6 +1733,8 @@ class Scheduler:
         self.metrics.cycle_duration.labels(phase="encode").observe(
             t_encode - t_batch
         )
+        if inc:
+            self._stamp_finalize(profile, t_encode - t_batch)
         pipe.forced_sync = (
             self.forced_sync or self.ladder.rung >= RUNG_FORCED_SYNC
         )
@@ -1705,6 +1787,100 @@ class Scheduler:
             wbufs = _jax.device_put(wbufs)
             bbufs = _jax.device_put(bbufs)
         return wbufs, bbufs
+
+    def _encode_groups_packed(
+        self, profile, encoder, groups, nodes, existing, kw
+    ):
+        """Encode a flush's groups through the packed delta arena
+        (incrementalEncode): each group folds via encode_packed —
+        staged ingest rows make it an O(dirty) apply — and its
+        wbuf/bbuf row is copied out immediately, before the next
+        group's encode rewrites the arena in place. When a later group
+        grows an interning dimension, the growing group full-encodes
+        against the grown tables and ONE delta re-encode pass re-rows
+        the earlier groups (delta_hits, not a second round of full
+        encodes — ingest already grew the tables before the flush, so
+        the whole-batch double re-encode disappears). Returns
+        (rows, spec, None) on success, (None, None, reason|None) to
+        fall back sequential."""
+        from .cycle import multicycle_unsupported_reason
+
+        mut = frozenset(self._nominated_mut[profile])
+
+        def one_pass():
+            rows, specs = [], []
+            lens0 = None
+            for _t_enq, g in groups:
+                f = encoder.encode_packed(
+                    nodes, g, existing, mutated_ids=mut, **kw
+                )
+                if lens0 is None:
+                    # growth watermark starts after row 0's encode:
+                    # its tables are the batch's stable side, so later
+                    # interning (even within the padded regime — spec
+                    # keys unchanged) leaves dangling row references
+                    lens0 = encoder._table_lens()
+                reason = multicycle_unsupported_reason(f.snap)
+                if reason is not None:
+                    return None, None, reason, lens0
+                rows.append((f.wbuf.copy(), f.bbuf.copy()))
+                specs.append(f.spec)
+            return rows, specs, None, lens0
+
+        ci0 = encoder._cycle_index
+        rows, specs, reason, lens0 = one_pass()
+        if rows is None:
+            return None, None, reason
+        if (
+            encoder._table_lens() != lens0
+            or any(sp.key() != specs[0].key() for sp in specs[1:])
+        ):
+            # host-side do-over of the same logical cycles: rewind the
+            # sampling rotation so the retry stamps the same
+            # cycle_index values as the first pass
+            encoder._cycle_index = ci0
+            rows, specs, reason, lens0 = one_pass()
+            if rows is None:
+                return None, None, reason
+            if (
+                encoder._table_lens() != lens0
+                or any(sp.key() != specs[0].key() for sp in specs[1:])
+            ):
+                # cannot happen with grow-only tables; refuse to guess
+                return None, None, None
+        self._nominated_mut[profile].clear()
+        return rows, specs[0], None
+
+    def _pack_stack_rows(self, rows, spec):
+        """_pack_stack for already-packed arena rows (the
+        incrementalEncode flush path): stack the copied wbuf/bbuf rows
+        into the [K, W]/[K, B] multi-cycle arenas and device_put them
+        under the same convention."""
+        import os as _os
+
+        wbufs = np.zeros((self._mc_k, spec.n_words), np.uint32)
+        bbufs = np.zeros((self._mc_k, spec.n_bytes), np.uint8)
+        for i, (wr, br) in enumerate(rows):
+            wbufs[i] = wr
+            bbufs[i] = br
+        if _os.environ.get("K8S_TPU_NO_DEVICE_PUT") != "1":
+            import jax as _jax
+
+            wbufs = _jax.device_put(wbufs)
+            bbufs = _jax.device_put(bbufs)
+        return wbufs, bbufs
+
+    def _stamp_finalize(self, profile: str, fin_s: float) -> None:
+        """Observe the flush's finalize window (encode_finalize
+        histogram) and park the ingest/finalize phase stamps for the
+        batch's inner record 0 (_apply_mc_row picks them up)."""
+        fin_s = max(fin_s, 0.0)
+        ing_s = self._ingest_s.pop(profile, 0.0)
+        self.metrics.encode_finalize.observe(fin_s)
+        self._flush_phases[profile] = {
+            "encode_finalize_ms": fin_s * 1e3,
+            "encode_ingest_ms": ing_s * 1e3,
+        }
 
     def _mc_fall_back(
         self, profile: str, groups, stats: CycleStats, t0: float,
@@ -1912,14 +2088,15 @@ class Scheduler:
             handle.dispatch_diagnosis(gi)
         _rej_box: list = []
 
-        def reject_counts_of(
-            j: int, gi=gi, pending=pending, _rej_box=_rej_box
+        def reject_counts_fn(
+            gi=gi, pending=pending, _rej_box=_rej_box
         ):
+            # ONE force of inner cycle gi's [P, F] attribution matrix
             if not _rej_box:
                 _rej_box.append(
-                    handle.reject_counts(gi)[: len(pending)]
+                    handle.reject_counts_matrix(gi, len(pending))
                 )
-            return _rej_box[0][j]
+            return _rej_box[0]
 
         pre_handle = None
         if ppreempt is not None and (a_i < 0).any():
@@ -1936,7 +2113,7 @@ class Scheduler:
 
         self._apply_phase(
             profile, framework, pending, nodes, existing, a_i,
-            gd_i, {}, reject_counts_of, force_pre,
+            gd_i, {}, reject_counts_fn, force_pre,
             stats, t0, rec, self._now(),
         )
         speculation = ""
@@ -1963,6 +2140,12 @@ class Scheduler:
             }
             extra_marks: dict = {}
             extra_counts: dict = {"multi_cycle_k": batch_n}
+            if gi == 0:
+                # incrementalEncode flush stamps (encode_ingest /
+                # encode_finalize): batch-wide, so they land only on
+                # the dispatch's record — same rule as the pipeline
+                # marks below
+                extra_phases.update(self._flush_phases.pop(profile, {}))
             if (
                 gi == 0 and stamp_first_bind
                 and "t_first_decision" in st
@@ -2064,12 +2247,24 @@ class Scheduler:
         rest_groups = groups[1:]
         batch_pods = [p for _t_enq, g in groups for p in g]
 
-        snap0 = encoder.encode(nodes, groups[0][1], existing, **kw)
+        inc = self.config.incremental_encode
+        mut = frozenset(self._nominated_mut[profile]) if inc else None
+        if inc:
+            f0 = encoder.encode_packed(
+                nodes, groups[0][1], existing, mutated_ids=mut, **kw
+            )
+            snap0 = f0.snap
+        else:
+            snap0 = encoder.encode(nodes, groups[0][1], existing, **kw)
         reason = multicycle_unsupported_reason(snap0)
         if reason is not None:
             self._mc_fall_back(profile, groups, stats, t0, reason)
             return
-        spec = packing.make_spec(snap0)
+        # growth watermark: A's stable side is row 0's tables; if B's
+        # encodes below intern anything new — even within the padded
+        # regime — B's rows would reference entries A's tables lack
+        lens0 = encoder._table_lens()
+        spec = f0.spec if inc else packing.make_spec(snap0)
         (
             _pcycle, ppreempt, stable_fn, _keeper, _diag, _ek, pipe,
         ) = self._packed_fns(spec, profile)
@@ -2078,7 +2273,12 @@ class Scheduler:
         pipe.multi_diag_fn = mdiag
         pipe.multi_cont_fn = mcont
 
-        wa, ba = self._pack_stack([snap0], spec)
+        if inc:
+            # the arena is rewritten by B's encodes below while A is
+            # still on device: stack a copy of row 0 now
+            wa, ba = self._pack_stack_rows([(f0.wbuf, f0.bbuf)], spec)
+        else:
+            wa, ba = self._pack_stack([snap0], spec)
         try:
             stable = self._stable_state(
                 spec, stable_fn, wa[0], ba[0], encoder
@@ -2108,31 +2308,58 @@ class Scheduler:
         # max(device_ms, encode_ms) instead of their sum)
         t_enc_b0 = self._now()
         snaps_b = []
+        rows_b = []
+        specs_b = []
         bad_reason: "str | None" = None
         for _t_enq, g in rest_groups:
-            s = encoder.encode(nodes, g, existing, **kw)
+            if inc:
+                fb_ = encoder.encode_packed(
+                    nodes, g, existing, mutated_ids=mut, **kw
+                )
+                s = fb_.snap
+            else:
+                s = encoder.encode(nodes, g, existing, **kw)
             bad_reason = multicycle_unsupported_reason(s)
             if bad_reason is not None:
                 break
-            snaps_b.append(s)
+            if inc:
+                rows_b.append((fb_.wbuf.copy(), fb_.bbuf.copy()))
+                specs_b.append(fb_.spec)
+            else:
+                snaps_b.append(s)
+        if inc:
+            if bad_reason is None:
+                # every buffered group folded with `mut` in scope; an
+                # incomplete pass keeps the set so the fall-back
+                # encodes still rewrite the mutated slots
+                self._nominated_mut[profile].clear()
+            self._stamp_finalize(
+                profile,
+                (t_encode - t_batch) + (self._now() - t_enc_b0),
+            )
         handle_b = None
         if bad_reason is None:
-            if any(
-                packing.make_spec(s).key() != spec.key()
-                for s in snaps_b
+            if encoder._table_lens() != lens0 or any(
+                (specs_b[j] if inc else packing.make_spec(s)).key()
+                != spec.key()
+                for j, s in enumerate(specs_b if inc else snaps_b)
             ):
-                # a later group grew an interning dimension past row
-                # 0's regime: the continuation carry shapes no longer
-                # line up, so B cannot chain — it re-dispatches after
-                # A's fold instead (counted as speculation="none":
-                # nothing was ever speculated)
+                # a later group grew an interning structure — past row
+                # 0's regime (carry shapes no longer line up) or within
+                # its padding (B's rows reference table entries A's
+                # stable side lacks) — so B cannot chain: it
+                # re-dispatches after A's fold instead (counted as
+                # speculation="none": nothing was ever speculated)
                 log.info(
                     "speculative batch for profile %r skipped: rows "
-                    "1..%d grew the packed regime past row 0's spec",
+                    "1..%d grew the interning tables past row 0's",
                     profile, n - 1,
                 )
             else:
-                wb, bb = self._pack_stack(snaps_b, spec)
+                if inc:
+                    wb, bb = self._pack_stack_rows(rows_b, spec)
+                else:
+                    wb, bb = self._pack_stack(snaps_b, spec)
                 pipe.note_encode(self._now() - t_enc_b0)
                 try:
                     handle_b = pipe.dispatch_multi(
@@ -2352,6 +2579,10 @@ class Scheduler:
             full_encodes=int(encoder.full_encodes),
             delta_hits=int(encoder.delta_hits),
             fold_hits=int(getattr(encoder, "fold_hits", 0)),
+            # admission-time incremental encode: dirty slots whose
+            # flush-time parse was skipped (a staged ingest row was
+            # waiting) — the bench's encode_hidden evidence
+            ingest_hits=int(getattr(encoder, "ingest_hits", 0)),
             queue_active=qc.get("active", 0),
             queue_backoff=qc.get("backoff", 0),
             queue_unschedulable=qc.get("unschedulable", 0),
@@ -2482,7 +2713,7 @@ class Scheduler:
         assignment,
         gang_dropped,
         extender_errors: "dict[int, str]",
-        reject_counts_of,
+        reject_counts_fn,
         force_pre,
         stats: CycleStats,
         t0: float,
@@ -2499,10 +2730,22 @@ class Scheduler:
         exactly as sequential dispatches would — durability semantics
         do not change across the batch boundary.
 
-        `reject_counts_of(i)` lazily forces this cycle's deferred
-        diagnosis; `force_pre()` forces its preemption program and
+        Vectorized fold: winners/losers are classified once with
+        numpy, the per-plugin attribution is forced ONCE as a matrix
+        (`reject_counts_fn()`), outcome metrics batch per cycle
+        (observe_attempts), and every journal emission of the fold
+        coalesces into ONE batch record (state.batch() — replays to
+        the identical digest as N singles, so the emit-once contract
+        holds at batch granularity). Per-pod calls that carry
+        semantics — assume, host plugins, bind, events, timelines —
+        stay per pod, in slot order, so the event and journal streams
+        are bit-identical to the scalar loop's.
+
+        `force_pre()` forces the cycle's preemption program and
         returns `(nominated[:P_real] | None, victims[:E_real] | None)`.
         """
+        import contextlib
+
         fr = self.flight
         filter_names = framework.filter_names
         if rec is not None:
@@ -2534,172 +2777,210 @@ class Scheduler:
             run_unreserve,
         )
 
-        for i, pod in enumerate(pending):
-            node_idx = int(assignment[i])
-            if node_idx < 0:
-                continue
-            node_name = nodes[node_idx].name
-            try:
-                # a per-pod scheduling error (e.g. the uid raced to
-                # bound via an informer echo mid-cycle) must not kill
-                # the loop — upstream continues with the next pod
-                self.cache.assume(pod, node_name)
-            except ValueError:
-                stats.bind_errors += 1
-                _pev(pod, "BindError", node=node_name, stage="assume")
-                self.metrics.observe_attempt(
-                    "error", per_pod_s(), profile
-                )
-                continue
-            # Reserve -> Permit -> PreBind host extension points
-            try:
-                run_reserve_permit_prebind(
-                    self.host_plugins, pod, node_name
-                )
-            except HostPluginRejection as rej:
-                self.cache.forget(pod.uid)
-                if rej.point == "PreBind":
-                    # transient pre-bind failure: retry with backoff
-                    self.queue.requeue_backoff(pod)
+        a = np.asarray(assignment[: len(pending)])
+        win_idx = np.flatnonzero(a >= 0)
+        lose_idx = np.flatnonzero(a < 0)
+        # ONE journal group-append per cycle: every record the fold
+        # emits (assume/bind/requeue/evict) buffers into a single
+        # batch frame, flushed (and fsynced by the writer as one
+        # payload) when the context exits
+        batch_cm = (
+            self.state.batch() if self.state is not None
+            else contextlib.nullcontext()
+        )
+        with batch_cm:
+            n_bound = 0
+            for i in win_idx:
+                i = int(i)
+                pod = pending[i]
+                node_name = nodes[int(a[i])].name
+                try:
+                    # a per-pod scheduling error (e.g. the uid raced to
+                    # bound via an informer echo mid-cycle) must not
+                    # kill the loop — upstream continues with the next
+                    # pod
+                    self.cache.assume(pod, node_name)
+                except ValueError:
                     stats.bind_errors += 1
                     _pev(
-                        pod, "BindError", node=node_name,
-                        stage="PreBind", plugin=rej.plugin,
+                        pod, "BindError", node=node_name, stage="assume"
                     )
                     self.metrics.observe_attempt(
                         "error", per_pod_s(), profile
                     )
-                else:
-                    # Reserve/Permit veto: unschedulable, attributed
-                    # to the vetoing host plugin
-                    self.events.failed_scheduling(
-                        pod, f"{rej.plugin} rejected at {rej.point}: "
-                        f"{rej.reason}"
+                    continue
+                # Reserve -> Permit -> PreBind host extension points
+                try:
+                    run_reserve_permit_prebind(
+                        self.host_plugins, pod, node_name
                     )
-                    self.queue.requeue_unschedulable(
-                        pod, reasons=(rej.plugin,)
-                    )
-                    stats.unschedulable += 1
-                    _pev(
-                        pod, "Rejected", node=node_name,
-                        stage=rej.point, plugin=rej.plugin,
-                    )
+                except HostPluginRejection as rej:
+                    self.cache.forget(pod.uid)
+                    if rej.point == "PreBind":
+                        # transient pre-bind failure: retry with backoff
+                        self.queue.requeue_backoff(pod)
+                        stats.bind_errors += 1
+                        _pev(
+                            pod, "BindError", node=node_name,
+                            stage="PreBind", plugin=rej.plugin,
+                        )
+                        self.metrics.observe_attempt(
+                            "error", per_pod_s(), profile
+                        )
+                    else:
+                        # Reserve/Permit veto: unschedulable, attributed
+                        # to the vetoing host plugin
+                        self.events.failed_scheduling(
+                            pod,
+                            f"{rej.plugin} rejected at {rej.point}: "
+                            f"{rej.reason}"
+                        )
+                        self.queue.requeue_unschedulable(
+                            pod, reasons=(rej.plugin,)
+                        )
+                        stats.unschedulable += 1
+                        _pev(
+                            pod, "Rejected", node=node_name,
+                            stage=rej.point, plugin=rej.plugin,
+                        )
+                        self.metrics.observe_attempt(
+                            "unschedulable", per_pod_s(), profile
+                        )
+                    continue
+                t_bind = self._now()
+                try:
+                    self._bind(pod, node_name)
+                except Exception:
+                    run_unreserve(self.host_plugins, pod, node_name)
+                    self.cache.forget(pod.uid)
+                    self.queue.requeue_backoff(pod)
+                    stats.bind_errors += 1
+                    _pev(pod, "BindError", node=node_name, stage="bind")
                     self.metrics.observe_attempt(
-                        "unschedulable", per_pod_s(), profile
+                        "error", per_pod_s(), profile
                     )
-                continue
-            t_bind = self._now()
-            try:
-                self._bind(pod, node_name)
-            except Exception:
-                run_unreserve(self.host_plugins, pod, node_name)
-                self.cache.forget(pod.uid)
-                self.queue.requeue_backoff(pod)
-                stats.bind_errors += 1
-                _pev(pod, "BindError", node=node_name, stage="bind")
-                self.metrics.observe_attempt(
-                    "error", per_pod_s(), profile
+                    continue
+                self.metrics.binding_duration.observe(
+                    self._now() - t_bind
                 )
-                continue
-            self.metrics.binding_duration.observe(self._now() - t_bind)
-            self.cache.finish_binding(pod.uid)
-            run_post_bind(self.host_plugins, pod, node_name)
-            self.events.scheduled(pod, node_name)
-            _pev(pod, "Bound", node=node_name)
-            stats.scheduled += 1
-            self.metrics.pod_scheduling_attempts.observe(
-                self.queue.attempts_of(pod.uid)
-            )
-            self.metrics.observe_attempt(
-                "scheduled", per_pod_s(), profile
-            )
+                self.cache.finish_binding(pod.uid)
+                run_post_bind(self.host_plugins, pod, node_name)
+                self.events.scheduled(pod, node_name)
+                _pev(pod, "Bound", node=node_name)
+                stats.scheduled += 1
+                self.metrics.pod_scheduling_attempts.observe(
+                    self.queue.attempts_of(pod.uid)
+                )
+                n_bound += 1
+            if n_bound:
+                # the happy-path outcome batches: one counter inc + one
+                # shared latency sample for the cycle's binds (error
+                # paths above stay per-pod — rare, and their sample
+                # time is the failure moment)
+                self.metrics.observe_attempts(
+                    "scheduled", per_pod_s(), profile, n_bound
+                )
 
-        # losers: force the (overlapped) preemption output now
-        t_winners = self._now()
-        if rec is not None:
-            rec.mark("winners_end", fr.now())
-        nominated, victims = force_pre()
-        t_post = self._now()
-        if rec is not None:
-            rec.mark("postfilter_end", fr.now())
-        self.metrics.cycle_duration.labels(phase="postfilter").observe(
-            t_post - t_winners
-        )
+            # losers: force the (overlapped) preemption output now
+            t_winners = self._now()
+            if rec is not None:
+                rec.mark("winners_end", fr.now())
+            nominated, victims = force_pre()
+            t_post = self._now()
+            if rec is not None:
+                rec.mark("postfilter_end", fr.now())
+            self.metrics.cycle_duration.labels(
+                phase="postfilter"
+            ).observe(t_post - t_winners)
 
-        for i, pod in enumerate(pending):
-            if int(assignment[i]) >= 0:
-                continue
-            if i in extender_errors:
-                # non-ignorable extender failure: retry with backoff
-                # (transient webhook errors must not park the pod)
-                self.queue.requeue_backoff(pod)
-                stats.bind_errors += 1
-                _pev(pod, "BindError", stage="extender")
-                self.metrics.observe_attempt(
-                    "error", per_pod_s(), profile
+            rej_mat = None
+            n_unsched = 0
+            reason_incs: dict[str, int] = {}
+            for i in lose_idx:
+                i = int(i)
+                pod = pending[i]
+                if i in extender_errors:
+                    # non-ignorable extender failure: retry with backoff
+                    # (transient webhook errors must not park the pod)
+                    self.queue.requeue_backoff(pod)
+                    stats.bind_errors += 1
+                    _pev(pod, "BindError", stage="extender")
+                    self.metrics.observe_attempt(
+                        "error", per_pod_s(), profile
+                    )
+                    continue
+                if nominated is not None and nominated[i] >= 0:
+                    pod.nominated_node_name = (
+                        nodes[int(nominated[i])].name
+                    )
+                    _pev(pod, "Nominated", node=pod.nominated_node_name)
+                    # in-place mutation: the delta encoder must re-read
+                    # this pod's slot next cycle (arena contract)
+                    self._nominated_mut[profile].add(id(pod))
+                    self.last_nominations.append(
+                        (pod, pod.nominated_node_name)
+                    )
+                    stats.preemptors += 1
+                if gang_dropped[i]:
+                    reasons = ("Coscheduling",)
+                    message = (
+                        f"pod group {pod.spec.pod_group!r} did not "
+                        "reach minMember; all-or-nothing placement "
+                        "rolled back"
+                    )
+                else:
+                    if rej_mat is None:
+                        rej_mat = reject_counts_fn()
+                    per_plugin = list(zip(filter_names, rej_mat[i]))
+                    reasons = tuple(
+                        name for name, n in per_plugin if n > 0
+                    )
+                    message = failed_scheduling_message(
+                        len(nodes), per_plugin
+                    )
+                for r in reasons:
+                    reason_incs[r] = reason_incs.get(r, 0) + 1
+                _pev(
+                    pod, "Unschedulable",
+                    plugin=reasons[0] if reasons else "",
                 )
-                continue
-            if nominated is not None and nominated[i] >= 0:
-                pod.nominated_node_name = nodes[int(nominated[i])].name
-                _pev(pod, "Nominated", node=pod.nominated_node_name)
-                # in-place mutation: the delta encoder must re-read
-                # this pod's slot next cycle (arena contract)
-                self._nominated_mut[profile].add(id(pod))
-                self.last_nominations.append(
-                    (pod, pod.nominated_node_name)
-                )
-                stats.preemptors += 1
-            if gang_dropped[i]:
-                reasons = ("Coscheduling",)
-                message = (
-                    f"pod group {pod.spec.pod_group!r} did not reach "
-                    "minMember; all-or-nothing placement rolled back"
-                )
-            else:
-                per_plugin = list(
-                    zip(filter_names, reject_counts_of(i))
-                )
-                reasons = tuple(
-                    name for name, n in per_plugin if n > 0
-                )
-                message = failed_scheduling_message(
-                    len(nodes), per_plugin
-                )
-            for r in reasons:
+                self.events.failed_scheduling(pod, message)
+                self.queue.requeue_unschedulable(pod, reasons=reasons)
+                stats.unschedulable += 1
+                n_unsched += 1
+            for r, cnt in reason_incs.items():
+                # column-batched attribution: one inc per plugin per
+                # cycle instead of one per (pod, plugin)
                 self.metrics.unschedulable_reasons.labels(
                     plugin=r, profile=profile
-                ).inc()
-            _pev(
-                pod, "Unschedulable",
-                plugin=reasons[0] if reasons else "",
-            )
-            self.events.failed_scheduling(pod, message)
-            self.queue.requeue_unschedulable(pod, reasons=reasons)
-            stats.unschedulable += 1
-            self.metrics.observe_attempt(
-                "unschedulable", per_pod_s(), profile
-            )
+                ).inc(cnt)
+            if n_unsched:
+                self.metrics.observe_attempts(
+                    "unschedulable", per_pod_s(), profile, n_unsched
+                )
 
-        if victims is not None and victims.any():
-            # victims belong to the preemptor nominated onto their node
-            preemptor_by_node = {
-                node: pod.name for pod, node in self.last_nominations
-            }
-            n_vict = 0
-            for e in np.flatnonzero(victims):
-                vpod, vnode = existing[int(e)]
-                self.evictor(vpod, vnode)
-                self.last_evictions.append((vpod, vnode))
-                _pev(
-                    vpod, "Evicted", node=vnode,
-                    preemptor=preemptor_by_node.get(vnode, ""),
-                )
-                self.events.preempted(
-                    vpod, preemptor_by_node.get(vnode, "<pending>")
-                )
-                n_vict += 1
-            stats.victims += n_vict
-            self.metrics.preemption_victims.observe(n_vict)
+            if victims is not None and victims.any():
+                # victims belong to the preemptor nominated onto their
+                # node
+                preemptor_by_node = {
+                    node: pod.name
+                    for pod, node in self.last_nominations
+                }
+                n_vict = 0
+                for e in np.flatnonzero(victims):
+                    vpod, vnode = existing[int(e)]
+                    self.evictor(vpod, vnode)
+                    self.last_evictions.append((vpod, vnode))
+                    _pev(
+                        vpod, "Evicted", node=vnode,
+                        preemptor=preemptor_by_node.get(vnode, ""),
+                    )
+                    self.events.preempted(
+                        vpod, preemptor_by_node.get(vnode, "<pending>")
+                    )
+                    n_vict += 1
+                stats.victims += n_vict
+                self.metrics.preemption_victims.observe(n_vict)
 
         # apply = winner bind loop + loser requeue loop (the preemption
         # force between them is the "postfilter" phase)

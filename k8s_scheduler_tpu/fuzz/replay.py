@@ -234,6 +234,10 @@ def replay_engine(
         speculative_dispatch=bool(
             cfgd.get("speculative_dispatch", False)
         ),
+        # admission-time incremental encode (default OFF for the same
+        # corpus-stability reason; generate_trace(incremental=True)
+        # turns the variant on)
+        incremental_encode=bool(cfgd.get("incremental_encode", False)),
         shard_devices=devices,
         dispatch_deadline_ms=float(cfgd.get("dispatch_deadline_ms", 0.0)),
         degrade_promote_cycles=int(cfgd.get("degrade_promote_cycles", 2)),
@@ -462,6 +466,19 @@ def replay_engine(
             # assert the speculative path actually exercised AND that
             # no slot leaked (pipeline inflight drained)
             "speculation": sched.speculation_ledger(),
+            # admission-time incremental encode ledger (all zero when
+            # the trace runs without incrementalEncode): the variant
+            # asserts staged rows were actually consumed at flush
+            "ingest": {
+                "hits": sum(
+                    int(getattr(e, "ingest_hits", 0))
+                    for e in sched._encoders.values()
+                ),
+                "misses": sum(
+                    int(getattr(e, "ingest_misses", 0))
+                    for e in sched._encoders.values()
+                ),
+            },
         }
     finally:
         from k8s_scheduler_tpu.core import faults as _faults
@@ -802,6 +819,71 @@ def compare_speculative(
     return out
 
 
+def compare_incremental(
+    eng_on: ReplayResult, eng_off: ReplayResult
+) -> list[Failure]:
+    """Per-cycle bit-equality of the incremental-encode engine against
+    the rebuild engine on the same trace. This — not the oracle — is
+    admission-time ingest's contract: staging row data at buffer time
+    must not change WHAT is encoded or decided, only WHEN the parse
+    cost is paid (the two engines share the exact coalescing cadence,
+    so even cycle placement must match). The dispatched packed arenas
+    are additionally compared byte for byte by run_case via
+    _capture_arenas — the decision streams could mask a compensating
+    arena difference, the arena bytes cannot."""
+    out: list[Failure] = []
+    for er, orr in zip(eng_on.records, eng_off.records):
+        for key in _PER_CYCLE_KEYS + ("requeues", "rung"):
+            if er[key] != orr[key]:
+                out.append(Failure(
+                    f"incremental/{key}", er["cycle"],
+                    f"inc-on={er[key]!r} inc-off={orr[key]!r}",
+                ))
+        if out:
+            return out
+    return out
+
+
+@contextlib.contextmanager
+def _capture_arenas(out: list):
+    """Record the packed-arena bytes of every dispatch (single and
+    multi-cycle) issued inside the scope: `out` collects
+    `(kind, words_bytes, bytes_bytes)` tuples in dispatch order, pulled
+    to host before the upload so device placement cannot launder a
+    difference. Class-level patch — replays are sequential, and the
+    finally-restore keeps it scoped."""
+    import numpy as _np
+
+    from ..core.pipeline import ServingPipeline
+
+    orig_d = ServingPipeline.dispatch
+    orig_m = ServingPipeline.dispatch_multi
+
+    def dispatch(self, wbuf, bbuf, *a, **kw):
+        out.append((
+            "1",
+            _np.asarray(wbuf).tobytes(),
+            _np.asarray(bbuf).tobytes(),
+        ))
+        return orig_d(self, wbuf, bbuf, *a, **kw)
+
+    def dispatch_multi(self, wbufs, bbufs, *a, **kw):
+        out.append((
+            "K",
+            _np.asarray(wbufs).tobytes(),
+            _np.asarray(bbufs).tobytes(),
+        ))
+        return orig_m(self, wbufs, bbufs, *a, **kw)
+
+    ServingPipeline.dispatch = dispatch
+    ServingPipeline.dispatch_multi = dispatch_multi
+    try:
+        yield
+    finally:
+        ServingPipeline.dispatch = orig_d
+        ServingPipeline.dispatch_multi = orig_m
+
+
 def compare_via_api(
     eng_api: ReplayResult, eng_direct: ReplayResult
 ) -> list[Failure]:
@@ -855,11 +937,48 @@ def run_case(
     otherwise be a permanent green. Decision correctness is still
     oracle-checked through the non-speculative variants (a shared
     engine bug cancels out of an engine-vs-engine comparison, so this
-    variant hunts speculation bugs specifically)."""
-    with engine_bug(bug):
+    variant hunts speculation bugs specifically).
+
+    Incremental-encode traces likewise compare the engine against
+    ITSELF with admission-time ingest off (compare_incremental), and
+    additionally require the dispatched packed arenas byte-identical
+    and the ingest path actually exercised (staged rows consumed at
+    flush) — a variant that silently fell back to full rebuilds every
+    flush would otherwise be a permanent green."""
+    inc = bool(trace.config.get("incremental_encode")) and not trace.chaos
+    arenas_on: list = []
+    cap = _capture_arenas(arenas_on) if inc else contextlib.nullcontext()
+    with engine_bug(bug), cap:
         eng = replay_engine(trace, state_dir=state_dir)
     failures = list(eng.failures)
     if trace.chaos:
+        return failures
+    if inc:
+        off = trace_from_dict(trace_to_dict(trace))
+        off.config["incremental_encode"] = False
+        arenas_off: list = []
+        with engine_bug(bug), _capture_arenas(arenas_off):
+            eng_off = replay_engine(off)
+        failures.extend(eng_off.failures)
+        failures.extend(compare_incremental(eng, eng_off))
+        if arenas_on != arenas_off:
+            i = next(
+                (j for j, (a, b) in enumerate(zip(arenas_on, arenas_off))
+                 if a != b),
+                min(len(arenas_on), len(arenas_off)),
+            )
+            failures.append(Failure(
+                "incremental/arena", -1,
+                f"dispatched packed arenas diverge at dispatch {i} "
+                f"(counts {len(arenas_on)}/{len(arenas_off)})",
+            ))
+        ing = eng.stats.get("ingest", {})
+        if not ing.get("hits", 0):
+            failures.append(Failure(
+                "incremental/never_exercised", -1,
+                f"incrementalEncode trace consumed no staged row at "
+                f"flush (ledger {ing})",
+            ))
         return failures
     if trace.config.get("speculative_dispatch"):
         off = trace_from_dict(trace_to_dict(trace))

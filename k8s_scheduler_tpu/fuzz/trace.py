@@ -295,6 +295,7 @@ def generate_trace(
     chaos: bool = False,
     multi_cycle: "bool | None" = None,
     speculative: bool = False,
+    incremental: bool = False,
 ) -> Trace:
     """One random scenario. `devices` > 1 turns on sharded serving
     (`shardDevices`; placements must stay bit-identical — PR 9's
@@ -313,14 +314,18 @@ def generate_trace(
     decidable. `speculative` turns on the depth-2 speculative dispatch
     variant (speculativeDispatch; forces the K=4 coalescing path it
     pipelines) — a pure config switch drawing nothing from the rng, so
-    a stamp's spec=<0|1> reproduces the identical trace either way."""
+    a stamp's spec=<0|1> reproduces the identical trace either way.
+    `incremental` turns on admission-time incremental encode
+    (incrementalEncode; forces the K=4 coalescing path it feeds) —
+    like `speculative`, a pure config switch drawing nothing from the
+    rng, so a stamp's inc=<0|1> reproduces the identical trace."""
     rng = random.Random(seed)
     # the coin is drawn UNCONDITIONALLY so an explicit multi_cycle flag
     # (replaying a FUZZ-FAIL stamp's mc=<0|1>) consumes the same rng
     # stream as the seeded coin did — the stamp must reproduce the
     # identical trace, not a shifted one
     mc_coin = rng.random() < 0.25
-    if speculative:
+    if speculative or incremental:
         multi_cycle = True
     elif multi_cycle is None:
         multi_cycle = mc_coin
@@ -418,7 +423,11 @@ def generate_trace(
                     rng, name, created, groups=pod_groups,
                     claims=claims, churn_ok=churn_ok, heavy=heavy,
                     flat_priority=multi_cycle,
-                    envelope_only=speculative,
+                    # envelope_only for the same reason as speculative:
+                    # the incremental variant tests the coalescing
+                    # flush's encode, so the trace must actually stay
+                    # on the multi-cycle path
+                    envelope_only=speculative or incremental,
                 ),
             })
             created += 1.0
@@ -502,6 +511,11 @@ def generate_trace(
         # batches: the differential asserts the adopted/abandoned/
         # re-dispatched streams stay bit-equal to the oracle's
         "speculative_dispatch": bool(speculative),
+        # admission-time incremental encode over the coalesced batches:
+        # the differential asserts the packed arenas stay byte-identical
+        # and the decision/journal/event streams bit-equal to the
+        # rebuild engine's
+        "incremental_encode": bool(incremental),
         "pad_bucket": 8,
         "dispatch_deadline_ms": 300.0 if chaos else 0.0,
         "degrade_promote_cycles": 2,

@@ -55,9 +55,12 @@ streaming consumer of every flight record):
 
 - scheduler_cycle_phase_seconds{phase} — streaming per-phase latency
   attribution of every committed cycle record; phases: total, encode,
-  fold, dispatch, device, decision_fetch, bind, postfilter, diag_lag,
+  fold, encode_ingest, encode_finalize, dispatch, device,
+  decision_fetch, bind, postfilter, diag_lag,
   compile, batch_wait, device_share, first_bind, submit_bind
-  (batch_wait and
+  (encode_ingest / encode_finalize are the admission-time incremental
+  encode split: the per-group ingest cost paid in the ack path's
+  shadow, and the flush-time finalize residue; batch_wait and
   device_share are the multi-cycle batched decomposition: an inner
   cycle's host-side coalescing wait and its apportioned share of the
   batch's device window; first_bind is the streamed-fetch window from
@@ -96,6 +99,12 @@ round trip):
   host fold matches the speculation's predicate digest (zero added
   latency), abandoned on a mismatch, and its groups then re-dispatched
   against the true carry — correctness is never speculative
+- scheduler_encode_ingest_seconds — admission-time incremental encode:
+  per-group cost of parsing acked pods into staged row data in the ack
+  path's shadow (work moved OFF the flush critical path)
+- scheduler_encode_finalize_seconds — flush-time residue of the
+  incremental encode: folding staged rows into the packed arena when
+  the multi-cycle buffer flushes (what is left of the old O(P) rebuild)
 
 Multi-chip serving families (shardDevices + parallel/audit.py — the
 sharded carry path with shard-invariant tie-breaking):
@@ -323,6 +332,23 @@ class SchedulerMetrics:
             "scheduler_decision_fetch_bytes_total",
             "Bytes moved device->host by the blocking per-cycle decision "
             "fetch (slimmed payload: i16 assignment + u8 flags per pod).",
+            registry=r,
+        )
+        # ---- admission-time incremental encode (models/encoding.py) ----
+        self.encode_ingest = Histogram(
+            "scheduler_encode_ingest_seconds",
+            "Admission-time incremental encode: per-group cost of parsing "
+            "acked pods into staged row data in the ack path's shadow "
+            "(work moved off the flush critical path).",
+            buckets=_DURATION_BUCKETS,
+            registry=r,
+        )
+        self.encode_finalize = Histogram(
+            "scheduler_encode_finalize_seconds",
+            "Flush-time residue of the incremental encode: folding staged "
+            "rows into the packed arena at multi-cycle flush (what is "
+            "left of the old O(P) rebuild).",
+            buckets=_DURATION_BUCKETS,
             registry=r,
         )
         # ---- flight-recorder derived gauges (core/flight_recorder.py) ----
@@ -602,6 +628,50 @@ class SchedulerMetrics:
         self.e2e_duration.labels(result=result, profile=profile).observe(
             seconds
         )
+
+    @staticmethod
+    def _observe_n(hist_child, value: float, n: int) -> bool:
+        """Record `n` identical samples on a Histogram child in O(1).
+
+        prometheus_client stores per-bucket counts non-cumulatively and
+        accumulates at exposition, so n samples of the same value are
+        exactly: sum += value*n, first-bucket-with-bound>=value += n.
+        Pokes client internals (_sum/_upper_bounds/_buckets); returns
+        False untouched if the layout ever changes, and the caller
+        falls back to n scalar observes.
+        """
+        try:
+            s = hist_child._sum
+            bounds = hist_child._upper_bounds
+            buckets = hist_child._buckets
+        except AttributeError:
+            return False
+        s.inc(value * n)
+        for i, bound in enumerate(bounds):
+            if value <= bound:
+                buckets[i].inc(n)
+                break
+        return True
+
+    def observe_attempts(
+        self,
+        result: str,
+        seconds: float,
+        profile: str = "default-scheduler",
+        n: int = 1,
+    ) -> None:
+        """Batched observe_attempt: n attempts sharing one outcome and
+        one latency sample, recorded with O(1) metric mutations per
+        cycle instead of O(n) — the apply-fold's per-pod metric cost
+        collapses to a constant."""
+        if n <= 0:
+            return
+        self.schedule_attempts.labels(result=result, profile=profile).inc(n)
+        for h in (self.attempt_duration, self.e2e_duration):
+            child = h.labels(result=result, profile=profile)
+            if not self._observe_n(child, seconds, n):
+                for _ in range(n):
+                    child.observe(seconds)
 
     def set_pending(self, counts: dict[str, int]) -> None:
         for queue, n in counts.items():

@@ -96,6 +96,32 @@ def encode_record(op: str, t: float, data: dict) -> bytes:
     return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
 
 
+# Batch record: ONE journal record carrying N logical sub-operations —
+# the group-append the vectorized apply/bind fold emits per cycle
+# (core/scheduler._apply_phase under DurableState.batch()). The wire
+# shape is an ordinary record whose op is BATCH_OP and whose payload is
+# {"ops": [[op, t, d], ...]}: each sub-op keeps its OWN clock value, so
+# replay pins the replay clock per sub-record and reproduces the exact
+# state N single records would (the digest-equivalence contract
+# tests/test_state_journal.py asserts). Because the batch is one frame,
+# a crash tears it ATOMICALLY — a torn tail discards the whole cycle's
+# fold, never a prefix of it (the per-record CRC covers all sub-ops).
+BATCH_OP = "batch"
+
+
+def encode_batch_payload(ops: list) -> dict:
+    """Payload dict for a batch record from [(op, t, data), ...]."""
+    return {"ops": [[op, t, data] for op, t, data in ops]}
+
+
+def iter_batch(data: dict):
+    """Yield (op, t, data) sub-records of a batch record's payload —
+    the replay-side inverse of encode_batch_payload (used by
+    DurableState.restore_into and the state tooling)."""
+    for op, t, d in data.get("ops", ()):
+        yield op, t, d or {}
+
+
 def segment_path(directory: str, index: int) -> str:
     return os.path.join(directory, f"wal-{index:08d}.seg")
 

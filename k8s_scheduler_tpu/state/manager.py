@@ -28,8 +28,10 @@ snapshot or in the replay tail, never both, never neither.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
+import threading
 import time as _time
 from typing import Callable
 
@@ -37,7 +39,15 @@ from .codec import (
     node_from_state,
     pod_from_state,
 )
-from .journal import Journal, StateCorruption, StateError, replay_dir
+from .journal import (
+    BATCH_OP,
+    Journal,
+    StateCorruption,
+    StateError,
+    encode_batch_payload,
+    iter_batch,
+    replay_dir,
+)
 from .snapshot import (
     prune_snapshots,
     read_latest_snapshot,
@@ -104,6 +114,15 @@ class DurableState:
         # per-op Counter children memoized so the hot emit path does one
         # dict hit, not a labels() lookup
         self._append_counters: dict = {}
+        # batch group-append state (see batch()): while a batch is open,
+        # emissions from the OWNING thread buffer here and flush as ONE
+        # journal record on exit. Lock order: _batch_lock is taken only
+        # below the queue/cache instance locks (inside a mutator's emit)
+        # or with neither held (batch exit) — never the other way, so it
+        # cannot invert the queue -> cache order snapshot() relies on.
+        self._batch_lock = threading.Lock()
+        self._batch_owner: int | None = None
+        self._batch_buf: list = []
         self._closed = False
 
     # ---- wiring ----------------------------------------------------------
@@ -122,6 +141,24 @@ class DurableState:
         return stats
 
     def _emit(self, op: str, t: float, data: dict) -> None:
+        if self._batch_owner is not None:  # racy pre-check; re-checked
+            with self._batch_lock:
+                owner = self._batch_owner
+                if owner == threading.get_ident():
+                    # the batch owner's emission: defer into the group
+                    self._batch_buf.append((op, t, data))
+                    return
+                if owner is not None:
+                    # a FOREIGN thread emitting while the serve thread's
+                    # batch is open: flush the buffered prefix first so
+                    # the journal keeps the true emission order (this
+                    # record really did land after everything buffered
+                    # so far — emits happen inside the mutators, in
+                    # lock-acquisition order)
+                    self._flush_batch_locked()
+        self._append_record(op, t, data)
+
+    def _append_record(self, op: str, t: float, data: dict) -> None:
         try:
             self.journal.append(op, t, data)
         except StateCorruption:
@@ -145,7 +182,7 @@ class DurableState:
                 self._queue._journal = None
             if self._cache is not None:
                 self._cache._journal = None
-            self._closed = True
+            self._closed = True  # schedlint: disable=TR001 -- monotonic latch: every writer stores True, readers tolerate one stale False (one extra append attempt on a dead writer); no lock needed for an idempotent one-way transition
             return
         m = self._metrics
         if m is not None:
@@ -155,6 +192,65 @@ class DurableState:
                     op=op
                 )
             c.inc()
+
+    # ---- batch group-append ----------------------------------------------
+
+    def _flush_batch_locked(self) -> None:
+        """Append the buffered batch as one record (callers hold
+        _batch_lock). One buffered op degenerates to a plain record —
+        same bytes a batchless emit would have written."""
+        ops = self._batch_buf
+        if not ops:
+            return
+        self._batch_buf = []  # schedlint: disable=TR001 -- every caller holds _batch_lock (documented contract in the docstring: _emit, batch() exit, snapshot, detach all take it first); the lint cannot see caller-held locks
+        if len(ops) == 1:
+            op, t, data = ops[0]
+            self._append_record(op, t, data)
+            return
+        # the record's own t is the newest sub-op's clock; replay never
+        # reads it (each sub-op carries its own t)
+        self._append_record(BATCH_OP, ops[-1][1], encode_batch_payload(ops))
+        m = self._metrics
+        if m is not None and not self._closed:
+            # keep per-logical-op append counters meaningful for folded
+            # ops too (op="batch" counted once by _append_record above
+            # is the record count; these are the logical-op counts)
+            for op, _t, _d in ops:
+                c = self._append_counters.get(op)
+                if c is None:
+                    c = self._append_counters[op] = (
+                        self._metrics.journal_appends.labels(op=op)
+                    )
+                c.inc()
+
+    @contextlib.contextmanager
+    def batch(self):
+        """Group-append scope for the vectorized apply/bind fold: every
+        journal emission from the CALLING thread inside the scope
+        coalesces into ONE batch record, appended on exit — one record,
+        one buffer push, one share of the group-commit fsync per cycle
+        instead of N. Replay expands the batch with each sub-op's own
+        clock value, so restored state is bit-identical to N single
+        records (tests/test_state_journal.py asserts the digests).
+
+        Emissions from OTHER threads (informer/admission paths) while a
+        batch is open first flush the buffered prefix, preserving true
+        emission order. Re-entrant and closed-state safe: a nested or
+        detached batch() is a transparent no-op."""
+        tid = threading.get_ident()
+        with self._batch_lock:
+            mine = self._batch_owner is None and not self._closed
+            if mine:
+                self._batch_owner = tid
+        try:
+            yield
+        finally:
+            if mine:
+                with self._batch_lock:
+                    try:
+                        self._flush_batch_locked()
+                    finally:
+                        self._batch_owner = None
 
     # ---- restore ---------------------------------------------------------
 
@@ -184,6 +280,14 @@ class DurableState:
         replayed = 0
         try:
             for op, t, data in replay_dir(self.dir, from_idx):
+                if op == BATCH_OP:
+                    # expand the group-append: each sub-op replays under
+                    # ITS OWN clock value, exactly as N singles would
+                    for sub_op, sub_t, sub_d in iter_batch(data):
+                        clock.t = sub_t
+                        self._apply(queue, cache, sub_op, sub_d)
+                    replayed += 1
+                    continue
                 clock.t = t
                 self._apply(queue, cache, op, data)
                 replayed += 1
@@ -295,6 +399,15 @@ class DurableState:
         # module docstring for the lock-order argument)
         with self._queue._lock:
             with self._cache._lock:
+                # flush any open batch prefix first: its mutations are
+                # already applied (hence inside the dump below) and the
+                # flush lands their record BEFORE the cut — otherwise a
+                # post-cut batch record would replay ops the snapshot
+                # already contains (double-apply). The emitters are
+                # blocked on the two locks we hold, so nothing new can
+                # buffer between this flush and the cut.
+                with self._batch_lock:
+                    self._flush_batch_locked()
                 qstate = self._queue.dump_state()
                 cstate = self._cache.dump_state()
                 tail_from = self.journal.cut()
@@ -364,18 +477,21 @@ class DurableState:
         `stateless` rung after seal(): the process keeps serving with
         no durability, and the sealed snapshot is what a standby
         restores."""
+        with self._batch_lock:
+            self._flush_batch_locked()
+            self._batch_owner = None
         if self._queue is not None:
             self._queue._journal = None
         if self._cache is not None:
             self._cache._journal = None
-        self._closed = True
+        self._closed = True  # schedlint: disable=TR001 -- monotonic latch (see _append_record): idempotent one-way True store
 
     def seal(self) -> None:
         """Clean shutdown: final snapshot (so the next start replays
         nothing), flush, close. Safe to call twice."""
         if self._closed:
             return
-        self._closed = True
+        self._closed = True  # schedlint: disable=TR001 -- monotonic latch (see _append_record): idempotent one-way True store
         try:
             if self._queue is not None and self.journal.failed is None:
                 self.snapshot(clean_shutdown=True)

@@ -118,6 +118,17 @@ _METRICS = {
     "scaling_efficiency": ("higher", "scaling_efficiency", "seff"),
     "collective_payload_mb": ("lower", "collective_payload_mb",
                               "cpmb"),
+    # admission-time incremental encode (ISSUE 16, config 10
+    # host_encode): the flush-side finalize residue must not RISE (a
+    # growing finalize means host encode cost crept back onto the
+    # dispatch critical path) and the share of encode host time hidden
+    # in the ack path's shadow must not DROP (falling hidden share
+    # means ingest stopped pre-staging rows and the flush re-parses).
+    # Both skipped for artifacts predating config 10 (r05 and older);
+    # --min-encode-hidden additionally floors the NEW artifact's
+    # absolute hidden share.
+    "finalize_p50_ms": ("lower", "finalize_p50_ms", "finp50"),
+    "encode_hidden_pct": ("higher", "encode_hidden_pct", "ehid"),
 }
 _COUNT_METRICS = ("stall_cycles", "anomalies_total", "degraded_cycles")
 
@@ -379,6 +390,26 @@ def main(argv: list[str] | None = None) -> int:
         "drift between rounds)",
     )
     ap.add_argument(
+        "--max-finalize-rise", type=float, default=50.0,
+        help="config-10 flush-side finalize_p50_ms may rise this many "
+        "percent before it counts as a regression (millisecond-scale "
+        "on CPU smoke; the --min-ms-delta noise floor also applies)",
+    )
+    ap.add_argument(
+        "--max-encode-hidden-drop", type=float, default=25.0,
+        help="config-10 encode_hidden_pct may drop this many percent "
+        "RELATIVE to the old artifact before it counts as a "
+        "regression (the absolute floor is --min-encode-hidden)",
+    )
+    ap.add_argument(
+        "--min-encode-hidden", type=float, default=0.0,
+        help="absolute floor: the NEW artifact's encode_hidden_pct "
+        "must be at least this (percent of encode host time staged in "
+        "the ack path's shadow). 0 disables — CPU smoke runs at toy "
+        "pod counts where fixed flush overhead dominates; full-scale "
+        "rounds should pass the ISSUE 16 target (95)",
+    )
+    ap.add_argument(
         "--allow-stalls", type=int, default=1,
         help="stall/anomaly count may grow by this many before it "
         "counts as a regression (one stall is a known rig flake — "
@@ -427,10 +458,34 @@ def main(argv: list[str] | None = None) -> int:
             "shed_rate": args.max_shed_rise,
             "scaling_efficiency": args.max_scaling_efficiency_drop,
             "collective_payload_mb": args.max_payload_rise,
+            "finalize_p50_ms": args.max_finalize_rise,
+            "encode_hidden_pct": args.max_encode_hidden_drop,
         },
         allow_stalls=args.allow_stalls,
         min_ms_delta=args.min_ms_delta,
     )
+    if args.min_encode_hidden > 0:
+        # absolute floor, gated on the NEW artifact only: the relative
+        # check above tolerates drift, but a full-scale round must not
+        # ship with the hidden share below the ISSUE 16 target no
+        # matter what the old artifact reported
+        for cfg in sorted(new):
+            nv = new[cfg].get("encode_hidden_pct")
+            if nv is None:
+                continue
+            check = {
+                "config": cfg,
+                "metric": "encode_hidden_pct_floor",
+                "old": args.min_encode_hidden,
+                "new": nv,
+                "delta_pct": None,
+                "limit_pct": args.min_encode_hidden,
+                "regressed": nv < args.min_encode_hidden,
+            }
+            result["checks"].append(check)
+            if check["regressed"]:
+                result["regressions"].append(check)
+                result["ok"] = False
     if args.json:
         print(json.dumps(result, indent=2))
         return 0 if result["ok"] else 1

@@ -100,6 +100,14 @@ _SLOW_TESTS = {
     "test_fuzz_differential_speculative_seed",
     "test_fuzz_chaos_fetch_hang_mid_speculation",
     "test_bench_sweep_reports_first_bind_and_hit_rate",
+    # admission-time incremental encode (ISSUE 16) heavyweights: the
+    # incremental fuzz differential (TWO engine replays per trace,
+    # same class as its sibling seeds above) and the two table-growth
+    # drives (each compiles a fresh K=4 packed program set) — the
+    # journal batch-record and bench_diff gate cases stay fast
+    "test_fuzz_differential_incremental_seed",
+    "test_multicycle_table_growth_within_padding_rebinds",
+    "test_multicycle_growth_reencode_reuses_interned_entries",
 }
 _SLOW_MODULES = {"tests.test_concurrency"}
 

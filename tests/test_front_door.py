@@ -537,6 +537,49 @@ def test_grpc_submit_shed_and_node_churn():
         server.stop(grace=0)
 
 
+def test_bench_diff_gates_host_encode_metrics(tmp_path):
+    """bench_diff config-10 gates: finalize_p50_ms rise = regressed,
+    encode_hidden_pct drop = regressed, --min-encode-hidden floors the
+    new artifact absolutely — and all three stay backward-compatible
+    with artifacts predating config 10 (r05)."""
+    old = {"configs": [{
+        "config": 10, "encode_hidden_pct": 96.0, "finalize_p50_ms": 1.0,
+    }]}
+    worse = {"configs": [{"c": 10, "ehid": 40.0, "finp50": 8.0}]}
+    r05 = {"configs": [{"config": 2, "p50_ms": 10.0}]}
+    paths = {}
+    for name, art in (("old", old), ("worse", worse), ("r05", r05)):
+        p = tmp_path / f"{name}.json"
+        p.write_text(json.dumps(art))
+        paths[name] = str(p)
+
+    def diff(a, b, *extra):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "bench_diff.py"),
+             "--json", *extra, a, b],
+            capture_output=True, text=True,
+        )
+        return proc.returncode, json.loads(proc.stdout)
+
+    rc, res = diff(paths["old"], paths["old"])
+    assert rc == 0, res
+    rc, res = diff(paths["old"], paths["worse"])
+    assert rc == 1
+    regressed = {c["metric"] for c in res["regressions"]}
+    assert {"finalize_p50_ms", "encode_hidden_pct"} <= regressed
+    # the absolute floor trips even when the relative drift passes
+    rc, res = diff(paths["old"], paths["old"], "--min-encode-hidden", "97")
+    assert rc == 1
+    assert any(
+        c["metric"] == "encode_hidden_pct_floor"
+        for c in res["regressions"]
+    )
+    # r05-era artifact without config 10: skipped, not crashed
+    rc, res = diff(paths["r05"], paths["old"])
+    assert rc == 0, res
+
+
 # ---------------------------------------------------------------------------
 # slow tier
 # ---------------------------------------------------------------------------
@@ -598,6 +641,32 @@ def test_bench_front_door_config_and_diff_gate(tmp_path):
         capture_output=True, text=True,
     )
     assert p.returncode == 1, p.stdout + p.stderr
+
+
+@pytest.mark.slow
+def test_bench_host_encode_config_and_diff_gate(tmp_path):
+    sys.path.insert(0, REPO)
+    import bench_suite
+
+    r = bench_suite.run_host_encode_config(snapshots=6)
+    assert r["config"] == 10
+    # the incremental legs actually staged rows (a vacuous variant —
+    # ladder degraded, mc gated off — raises inside the config, but
+    # belt and braces here)
+    assert r["ingest_hits"] > 0
+    assert r["finalize_p50_ms"] > 0.0
+    assert 0.0 <= r["encode_hidden_pct"] <= 100.0
+    assert r["submit_bind_p50_ms"] > 0.0
+    # self-diff round trip through the new gates is clean
+    art = tmp_path / "he.json"
+    art.write_text(json.dumps({"configs": [r]}))
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_diff.py"),
+         str(art), str(art)],
+        capture_output=True, text=True,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "encode_hidden_pct" in p.stdout
 
 
 @pytest.mark.slow

@@ -284,6 +284,20 @@ def test_fuzz_differential_speculative_seed():
     assert not failures, [str(f) for f in failures]
 
 
+def test_fuzz_differential_incremental_seed():
+    """The admission-time incremental encode variant (incrementalEncode
+    over multiCycleK=4): the same trace runs with ingest-at-ack on AND
+    off and must produce byte-identical dispatched packed arenas plus
+    bit-equal decision / journal / event streams — and the case fails
+    if the on-run never folded a staged row (a variant whose ingest
+    always misses would be a permanent vacuous green)."""
+    t = generate_trace(1, incremental=True)
+    assert t.config["incremental_encode"] is True
+    assert t.config["multi_cycle_k"] == 4
+    failures = run_case(t)
+    assert not failures, [str(f) for f in failures]
+
+
 def test_speculative_traces_stay_in_the_exactness_envelope():
     """Speculative traces must actually exercise the device loop they
     pipeline: the envelope-leaving capabilities (affinity / spread /

@@ -452,6 +452,74 @@ def test_scheduler_multicycle_matches_sequential(tmp_path, seed):
     assert arr4 == arr1
 
 
+def _drive_selector_growth(incremental):
+    """A K=4 batch where every later group interns a NEW node-selector
+    expression WITHIN the padded table regime (Ex pads to 8, so the
+    spec key never changes): the regression the table-growth re-encode
+    trigger exists for. Returns (binds, encoder)."""
+    clock = FakeClock()
+    binds = []
+    cfg = SchedulerConfiguration(
+        multi_cycle_k=4, multi_cycle_max_wait_ms=1e9,
+        incremental_encode=incremental,
+    )
+    sched = Scheduler(
+        config=cfg,
+        binder=lambda pod, node: binds.append((pod.uid, node)),
+        now=clock, pad_bucket=8,
+    )
+    for i, tier in enumerate(("gold", "silver", "bronze", "iron")):
+        sched.on_node_add(
+            MakeNode(f"n{i}").capacity({"cpu": "8", "memory": "8Gi"})
+            .labels({"tier": tier}).obj()
+        )
+    # group 0 interns nothing selector-shaped; groups 1..3 each bring a
+    # selector value the tables have never seen
+    sched.on_pod_add(MakePod("p0").req({"cpu": "1"}).obj())
+    sched.schedule_cycle()
+    for i, tier in enumerate(("silver", "bronze", "iron")):
+        sched.on_pod_add(
+            MakePod(f"p{i + 1}").req({"cpu": "1"})
+            .node_selector({"tier": tier}).obj()
+        )
+        sched.schedule_cycle()  # 4th call flushes the batch
+    return binds, sched._encoders["default-scheduler"]
+
+
+def test_multicycle_table_growth_within_padding_rebinds(tmp_path):
+    """A later group's pod may intern a new expression row WITHOUT
+    changing the padded spec key — row 0's stable tables (the whole
+    batch's stable side) would lack the entry its row references, and
+    the pod was falsely rejected as NodeAffinity-unschedulable. The
+    table-growth re-encode trigger must rebuild the batch so every
+    selector pod binds to its labeled node."""
+    binds, _enc = _drive_selector_growth(incremental=False)
+    d = dict(binds)
+    # p0 has no selector — its node is a scoring tiebreak; the
+    # selector pods MUST land on their labeled nodes (without the
+    # growth trigger they were falsely NodeAffinity-unschedulable)
+    assert "default/p0" in d
+    assert {k: d.get(k) for k in
+            ("default/p1", "default/p2", "default/p3")} == {
+        "default/p1": "n1", "default/p2": "n2", "default/p3": "n3",
+    }
+
+
+def test_multicycle_growth_reencode_reuses_interned_entries():
+    """The dim-growth re-encode's second pass must REUSE the entries
+    pass 1 interned (delta hits against the grown tables), not run a
+    second round of full encodes — and under incrementalEncode the
+    decisions are identical to the non-incremental engine."""
+    binds_off, _ = _drive_selector_growth(incremental=False)
+    binds_on, enc = _drive_selector_growth(incremental=True)
+    assert binds_on == binds_off
+    # pass 1: the growing groups full-encode; the retry pass re-rows
+    # the earlier groups via the delta path (tables already grown, so
+    # nothing forces a second full rebuild)
+    assert enc.delta_hits > 0, (enc.delta_hits, enc.full_encodes)
+    assert enc.full_encodes <= 4, (enc.delta_hits, enc.full_encodes)
+
+
 def test_scheduler_flushes_on_latency_bound(tmp_path):
     """A buffered group is never held past multiCycleMaxWaitMs even if
     arrivals keep trickling in below the K threshold."""

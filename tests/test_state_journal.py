@@ -361,6 +361,181 @@ def test_manager_degrades_to_stateless_on_journal_failure(tmp_path):
     st.journal.close()
 
 
+# ---- batch group-append (the vectorized apply/bind fold's record) ------
+
+
+class _Clock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> None:
+        self.t += dt
+
+
+def _pair(clock):
+    q = SchedulingQueue(
+        initial_backoff_seconds=0.5, max_backoff_seconds=4.0,
+        unschedulable_timeout_seconds=30.0, now=clock,
+    )
+    c = SchedulerCache(assumed_pod_ttl_seconds=2.0, now=clock)
+    return q, c
+
+
+def _drive_fold_trace(state_dir, *, batched, seed=11, n=60):
+    """A randomized mutation trace shaped like the apply/bind fold:
+    adds, pops, assumes/binds, backoff requeues — journaled either as
+    singles or with each chunk grouped under DurableState.batch()."""
+    import contextlib
+    import random
+
+    from k8s_scheduler_tpu.state import state_digest
+
+    clock = _Clock()
+    q, c = _pair(clock)
+    st = DurableState(state_dir, snapshot_interval_seconds=0)
+    st.attach(q, c)
+    c.add_node(MakeNode("n0").capacity({"cpu": "64"}).obj())
+    rng = random.Random(seed)
+    uid = 0
+    for _cycle in range(n):
+        clock.tick(rng.random())
+        scope = st.batch() if batched else contextlib.nullcontext()
+        with scope:
+            for _ in range(rng.randint(1, 5)):
+                roll = rng.random()
+                if roll < 0.5 or uid == 0:
+                    pod = MakePod(f"p{uid}").req({"cpu": "1"}).obj()
+                    uid += 1
+                    q.add(pod)
+                elif roll < 0.75:
+                    e = q.pop_ready()
+                    if e:
+                        c.assume(e[0], "n0")
+                        c.finish_binding(e[0].uid)
+                else:
+                    e = q.pop_ready()
+                    if e:
+                        q.requeue_backoff(e[0])
+    st.journal.flush()
+    digest = state_digest(q, c)
+    st.journal.close()
+    return digest
+
+
+def test_batch_record_digest_identical_to_singles(tmp_path):
+    """The group-append contract: the SAME randomized mutation trace
+    journaled as one batch record per cycle vs N single records
+    restores to a bit-identical state digest — each sub-op replays
+    under its own clock value, so nothing (backoff expiries, assumed
+    deadlines, tier order) can drift."""
+    from k8s_scheduler_tpu.state import state_digest
+    from k8s_scheduler_tpu.state.journal import BATCH_OP
+
+    da, db = str(tmp_path / "singles"), str(tmp_path / "batched")
+    live_a = _drive_fold_trace(da, batched=False)
+    live_b = _drive_fold_trace(db, batched=True)
+    assert live_a == live_b  # identical trace: journaling is a shadow
+
+    ops_a = [op for op, _t, _d in replay_dir(da)]
+    ops_b = [op for op, _t, _d in replay_dir(db)]
+    assert BATCH_OP not in ops_a
+    assert BATCH_OP in ops_b          # the variant actually folded
+    assert len(ops_b) < len(ops_a)    # fewer records, same state
+
+    for d in (da, db):
+        q2 = SchedulingQueue(
+            initial_backoff_seconds=0.5, max_backoff_seconds=4.0,
+            unschedulable_timeout_seconds=30.0, now=_Clock(),
+        )
+        c2 = SchedulerCache(assumed_pod_ttl_seconds=2.0, now=_Clock())
+        DurableState(d, snapshot_interval_seconds=0).restore_into(q2, c2)
+        assert state_digest(q2, c2) == live_a, d
+
+
+def test_torn_tail_batch_record_discarded_whole(tmp_path):
+    """Crash atomicity at batch granularity: truncate the segment at
+    EVERY byte offset inside a final BATCH record — replay must yield
+    exactly the records before it, never a partially-applied prefix of
+    the cycle's fold (the batch is one frame under one CRC)."""
+    from k8s_scheduler_tpu.state.journal import (
+        BATCH_OP,
+        encode_batch_payload,
+    )
+
+    d = str(tmp_path / "src")
+    j = Journal(d)
+    for i in range(3):
+        j.append("q.add", float(i), {"pod": {"m": {"n": f"pod-{i}"}}})
+    sub_ops = [
+        ("c.assume", 3.0 + k, {"uid": f"default/pod-{k}", "node": "n0"})
+        for k in range(4)
+    ]
+    payload = encode_batch_payload(sub_ops)
+    j.append(BATCH_OP, 6.0, payload)
+    j.flush()
+    j.close()
+    (idx,) = segment_indices(d)
+    blob = open(segment_path(d, idx), "rb").read()
+    final = encode_record(BATCH_OP, 6.0, payload)
+    body_start = len(blob) - len(final)
+    assert blob[body_start:] == final  # framing sanity
+    tdir = str(tmp_path / "torn")
+    os.makedirs(tdir)
+    tpath = segment_path(tdir, 0)
+    for cut in range(body_start, len(blob)):
+        with open(tpath, "wb") as f:
+            f.write(blob[:cut])
+        got = list(read_segment(tpath))
+        assert [r[0] for r in got] == ["q.add"] * 3, f"cut at byte {cut}"
+    with open(tpath, "wb") as f:
+        f.write(blob)
+    assert [r[0] for r in list(read_segment(tpath))][-1] == BATCH_OP
+
+
+def test_open_batch_is_invisible_until_scope_exit(tmp_path):
+    """kill -9 mid-flush: a batch scope that never exits contributes
+    NOTHING durable — the segment bytes captured while the scope is
+    open restore to the exact pre-batch state (the fold becomes
+    durable atomically at scope exit, or not at all)."""
+    import shutil
+
+    from k8s_scheduler_tpu.state import state_digest
+
+    d = str(tmp_path / "live")
+    clock = _Clock()
+    q, c = _pair(clock)
+    st = DurableState(d, snapshot_interval_seconds=0)
+    st.attach(q, c)
+    q.add(MakePod("before").req({"cpu": "1"}).obj())
+    st.journal.flush()
+    pre = state_digest(q, c)
+    (idx,) = segment_indices(d)
+
+    mid = str(tmp_path / "mid")
+    post = str(tmp_path / "post")
+    with st.batch():
+        q.add(MakePod("in-batch-1").req({"cpu": "1"}).obj())
+        q.add(MakePod("in-batch-2").req({"cpu": "1"}).obj())
+        # the crash point: nothing of the open batch may be on disk
+        st.journal.flush()
+        os.makedirs(mid)
+        shutil.copy(segment_path(d, idx), segment_path(mid, idx))
+    st.journal.flush()
+    os.makedirs(post)
+    shutil.copy(segment_path(d, idx), segment_path(post, idx))
+    st.journal.close()
+
+    q2, c2 = _pair(_Clock())
+    DurableState(mid, snapshot_interval_seconds=0).restore_into(q2, c2)
+    assert state_digest(q2, c2) == pre
+    q3, c3 = _pair(_Clock())
+    DurableState(post, snapshot_interval_seconds=0).restore_into(q3, c3)
+    assert state_digest(q3, c3) == state_digest(q, c)
+
+
 def test_debug_state_status_shape(tmp_path):
     q, c = SchedulingQueue(), SchedulerCache()
     st = DurableState(str(tmp_path), snapshot_interval_seconds=0)
