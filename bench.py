@@ -181,8 +181,15 @@ def main() -> None:
 
     from k8s_scheduler_tpu.core.cycle import RESILIENT_STRIKES
 
+    # the same fingerprint scheduler_build_info exports at startup, so
+    # a BENCH_*.json artifact names the exact jax/jaxlib/backend/tree
+    # it measured — latency diffs across artifacts stop guessing what
+    # changed underneath them
+    from k8s_scheduler_tpu.metrics.metrics import build_fingerprint
+
     detail = {
         "device": str(jax.devices()[0].platform),
+        "build": build_fingerprint(),
         "configs": results,
     }
     if errors:
@@ -299,6 +306,13 @@ def main() -> None:
                     "shed": r["shed_rate"],
                 }
                 if "submit_bind_p99_ms" in r else {}
+            ),
+            # pod-lifecycle tracing overhead (config 9 trace stage):
+            # worst-case armed (rate 1.0) latency delta vs tracing off
+            # — gated by bench_diff --max-trace-overhead
+            **(
+                {"trov": r["trace_overhead_pct"]}
+                if "trace_overhead_pct" in r else {}
             ),
             # admission-time incremental encode (config 10): hidden
             # encode share, flush-side finalize p50, flush cadence,

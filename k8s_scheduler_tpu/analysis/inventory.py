@@ -57,10 +57,19 @@ in BOTH directions:
          registries document themselves); gated like HY003 — fixture
          trees without the section are only judged when they carry the
          real registry module
+- ID010  the span-name inventory: every span name in
+         core/spans.SPAN_NAMES (the pod-lifecycle tracing inventory)
+         must appear in the metrics/metrics.py docstring entry for
+         scheduler_trace_spans_total and in the README
+         "## Distributed tracing" span table — the explain endpoint,
+         the Perfetto export, and the runbook all key on these names,
+         so a span added or renamed without its doc row leaves
+         operators reading traces the docs cannot decode
 
 The metric-registry half (ID001) imports the live package; pass
 `{"metrics_runtime": False}` to skip it when linting fixture trees.
-ID005 is pure AST + file reads, so it runs on fixture trees too.
+ID005 and ID010 are pure AST + file reads, so they run on fixture
+trees too.
 """
 
 from __future__ import annotations
@@ -141,6 +150,8 @@ class InventoryDriftPass(PassBase):
                  "README budget table",
         "ID009": "finding-code inventory drifted between the pass "
                  "registry and the README Static-analysis table",
+        "ID010": "span-name inventory drifted between spans.SPAN_NAMES, "
+                 "the metrics docstring, and the README tracing table",
     }
 
     def run(self, ctx: LintContext) -> list[Finding]:
@@ -162,6 +173,7 @@ class InventoryDriftPass(PassBase):
         ):
             findings += self._check_metrics(ctx)
         findings += self._check_phases(ctx)
+        findings += self._check_spans(ctx)
         findings += self._check_compile_key(ctx)
         findings += self._check_rungs(ctx)
         findings += self._check_collective_budgets(ctx)
@@ -424,6 +436,58 @@ class InventoryDriftPass(PassBase):
                         obs_sf.rel, obs_line, "ID005",
                         f"phase {p!r} (observe.PHASES) is not documented "
                         'in the README "## Observability" section',
+                    ))
+        return findings
+
+    # ---- ID010: span-name inventory --------------------------------------
+
+    def _check_spans(self, ctx: LintContext) -> list[Finding]:
+        sp_sf = self._find(ctx, "core/spans.py")
+        if sp_sf is None:
+            return []
+        names, sp_line = self._module_const(sp_sf, "SPAN_NAMES")
+        if not names:
+            return [Finding(
+                sp_sf.rel, 1, "ID010",
+                "core/spans.py defines no literal SPAN_NAMES tuple — "
+                "the span inventory every surface is checked against",
+            )]
+        findings: list[Finding] = []
+
+        met_sf = self._find(ctx, "metrics/metrics.py")
+        if met_sf is not None:
+            doc = ast.get_docstring(met_sf.tree) or ""
+            # scope to the scheduler_trace_spans_total bullet so an
+            # incidental word elsewhere cannot satisfy the check
+            i = doc.find("scheduler_trace_spans")
+            region = doc[i:] if i >= 0 else ""
+            j = region.find("\n- scheduler_")
+            if j > 0:
+                region = region[:j]
+            for n in sorted(names):
+                if not re.search(rf"\b{re.escape(n)}\b", region):
+                    findings.append(Finding(
+                        met_sf.rel, 1, "ID010",
+                        f"span {n!r} (spans.SPAN_NAMES) is not named in "
+                        "the metrics docstring entry for "
+                        "scheduler_trace_spans_total",
+                    ))
+
+        path = os.path.join(ctx.root, "README.md")
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            m = re.search(
+                r"^## Distributed tracing\b(.*?)(?=^## |\Z)",
+                text, re.M | re.S,
+            )
+            section = m.group(1) if m else ""
+            for n in sorted(names):
+                if not re.search(rf"\b{re.escape(n)}\b", section):
+                    findings.append(Finding(
+                        sp_sf.rel, sp_line, "ID010",
+                        f"span {n!r} (spans.SPAN_NAMES) is not documented "
+                        'in the README "## Distributed tracing" section',
                     ))
         return findings
 

@@ -7,11 +7,18 @@ paths. On top of them, the cycle flight recorder
 
 - `/debug/flightrecorder?last=N` — the last N cycle records as JSON
   (phase marks, phase durations, counts) plus the derived window stats;
-- `/debug/trace?last=N` — a Chrome-trace/Perfetto JSON download
-  reconstructing the pipeline's overlapped lanes from real serving
-  timestamps (open in ui.perfetto.dev); `/debug/trace?pod=<uid>` slices
-  the trace to the cycles that touched that pod (joined through the
-  pod timeline's per-attempt cycle seqs);
+- `/debug/traces?last=N|pod=<uid>|trace=<id>` — a Chrome-trace/Perfetto
+  JSON download reconstructing the pipeline's overlapped lanes from
+  real serving timestamps (open in ui.perfetto.dev), with per-pod
+  trace-span tracks (core/spans) merged in when tracing is armed;
+  `pod=` slices to the cycles that touched that pod (joined through
+  the pod timeline's per-attempt cycle seqs), `trace=` to the cycles
+  and spans of one trace id. `/debug/trace` is the deprecated alias
+  (same handler, `Deprecation` header);
+- `/debug/explain?pod=<uid>` — the joined schedulability verdict: the
+  pod's current state, per-attempt first-rejecting plugin, its trace
+  spans' durations, the front door's shed/retry history, and the
+  anomalies that overlapped its cycles;
 - `/debug/pods/<uid>` — the per-pod scheduling timeline
   (queued -> attempts -> bound/evicted, joined with the events ring);
 - `/debug/anomalies?last=N` — the cycle observer's typed anomaly ring
@@ -130,19 +137,25 @@ def start_http_server(
     state=None,  # state.DurableState | None
     observer=None,  # core/observe.CycleObserver | None
     admission=None,  # service/admission.AdmissionController | None
+    spans_recorder=None,  # core/spans.SpanRecorder | None
 ) -> ThreadingHTTPServer:
     """Serve /healthz, /readyz, /metrics and the /debug endpoints;
     returns the running server (bound port at `.server_address[1]`;
     pass port=0 for ephemeral). `recorder` enables /debug/flightrecorder
-    and /debug/trace; `pod_timeline` (usually Scheduler.pod_timeline)
-    enables /debug/pods/<uid> and the /debug/trace?pod= filter; `state`
-    (DurableState) enables /debug/state (journal lag, segment counts,
-    snapshot + restore stats); `observer` (CycleObserver) enables
-    /debug/anomalies; `admission` (the submission front door) enables
-    the thin `POST /submit` path — a JSON body
-    `{"pods": [<state/codec pod dicts>]}` admitted through the same
-    controller the gRPC Submit RPC uses (200 on accept, 429 +
-    Retry-After on shed, 400 on invalid pods, 503 while draining)."""
+    and /debug/traces (plus its deprecated /debug/trace alias);
+    `pod_timeline` (usually Scheduler.pod_timeline) enables
+    /debug/pods/<uid>, /debug/explain and the /debug/traces?pod=
+    filter; `state` (DurableState) enables /debug/state (journal lag,
+    segment counts, snapshot + restore stats); `observer`
+    (CycleObserver) enables /debug/anomalies; `spans_recorder` (the
+    armed span ring) merges per-pod trace tracks into /debug/traces
+    and span durations into /debug/explain; `admission` (the
+    submission front door) enables the thin `POST /submit` path — a
+    JSON body `{"pods": [<state/codec pod dicts>]}` admitted through
+    the same controller the gRPC Submit RPC uses (200 on accept, 429 +
+    Retry-After on shed, 400 on invalid pods, 503 while draining),
+    with a W3C `traceparent` request header joining the submission's
+    trace and the effective traceparent echoed as a response header."""
     health_fn = healthz or (lambda: (True, {}))
 
     class Handler(BaseHTTPRequestHandler):
@@ -174,54 +187,28 @@ def start_http_server(
                     }
                 ).encode()
                 return 200, "application/json", body, {}
-            if path == "/debug/trace" and recorder is not None:
-                from ..core.flight_recorder import to_chrome_trace
-
-                qs = urllib.parse.parse_qs(query)
-                pod_uid = (qs.get("pod") or [""])[0]
-                # a pod-filtered trace defaults to the WHOLE ring (the
-                # pod's cycles are sparse); unfiltered keeps the usual
-                # last=128 window
-                if "last" in qs:
-                    last: int | None = _parse_last(query)
-                else:
-                    last = None if pod_uid else 128
-                recs = recorder.snapshot(last=last)
-                if pod_uid:
-                    # slice to the cycles that touched this pod: every
-                    # timeline attempt carries its cycle seq, which is
-                    # the join key back to the flight records
-                    if pod_timeline is None:
-                        return (
-                            404, "text/plain",
-                            b"pod filter needs the pod timeline", {},
-                        )
-                    tl = pod_timeline(pod_uid)
-                    if tl is None:
-                        return (
-                            404,
-                            "application/json",
-                            json.dumps(
-                                {"error": f"pod {pod_uid!r} not seen"}
-                            ).encode(),
-                            {},
-                        )
-                    seqs = {
-                        e["cycle"]
-                        for e in tl.get("events", ())
-                        if e.get("cycle", -1) >= 0
-                    }
-                    recs = [r for r in recs if r.seq in seqs]
-                trace = to_chrome_trace(recs, epoch=recorder.epoch)
-                return (
-                    200,
-                    "application/json",
-                    json.dumps(trace).encode(),
-                    {
-                        "Content-Disposition":
-                        'attachment; filename="scheduler-trace.json"'
-                    },
-                )
+            if (
+                path in ("/debug/trace", "/debug/traces")
+                and recorder is not None
+            ):
+                # ONE handler for both paths: /debug/traces is the
+                # canonical route (pod= / trace= / last= filters, span
+                # tracks merged when tracing is armed); /debug/trace
+                # (PR 5) stays as a deprecation alias with identical
+                # behavior so existing tooling keeps working
+                status, ctype, body, extra = self._trace_route(query)
+                if path == "/debug/trace" and status == 200:
+                    extra = dict(extra)
+                    extra["Deprecation"] = "true"
+                    extra["Link"] = (
+                        '</debug/traces>; rel="successor-version"'
+                    )
+                return status, ctype, body, extra
+            if path == "/debug/explain" and pod_timeline is not None:
+                uid = (
+                    urllib.parse.parse_qs(query).get("pod") or [""]
+                )[0]
+                return self._explain_route(uid)
             if path == "/debug/anomalies" and observer is not None:
                 last = _parse_last(query)
                 body = json.dumps(
@@ -254,6 +241,179 @@ def start_http_server(
                     )
                 return 200, "application/json", json.dumps(tl).encode(), {}
             return 404, "text/plain", b"not found", {}
+
+        def _trace_route(
+            self, query: str
+        ) -> tuple[int, str, bytes, dict[str, str]]:
+            """GET /debug/traces (and the /debug/trace alias): the
+            Perfetto download. `pod=` slices cycle records to the
+            cycles that touched the pod and span tracks to its spans;
+            `trace=` slices both to one trace id (records join through
+            their `trace_ids` exemplar stamp); unfiltered keeps the
+            usual last=128 record window."""
+            from ..core.flight_recorder import to_chrome_trace
+
+            qs = urllib.parse.parse_qs(query)
+            pod_uid = (qs.get("pod") or [""])[0]
+            trace_id = (qs.get("trace") or [""])[0]
+            # a filtered trace defaults to the WHOLE ring (the
+            # matching cycles are sparse); unfiltered keeps the usual
+            # last=128 window
+            if "last" in qs:
+                last: int | None = _parse_last(query)
+            else:
+                last = None if (pod_uid or trace_id) else 128
+            recs = recorder.snapshot(last=last)
+            span_list = None
+            if spans_recorder is not None:
+                if trace_id:
+                    span_list = spans_recorder.for_trace(trace_id)
+                elif pod_uid:
+                    span_list = spans_recorder.for_uid(pod_uid)
+                else:
+                    span_list = spans_recorder.snapshot()
+            if pod_uid:
+                # slice to the cycles that touched this pod: every
+                # timeline attempt carries its cycle seq, which is
+                # the join key back to the flight records
+                if pod_timeline is None:
+                    return (
+                        404, "text/plain",
+                        b"pod filter needs the pod timeline", {},
+                    )
+                tl = pod_timeline(pod_uid)
+                if tl is None and not span_list:
+                    return (
+                        404,
+                        "application/json",
+                        json.dumps(
+                            {"error": f"pod {pod_uid!r} not seen"}
+                        ).encode(),
+                        {},
+                    )
+                seqs = {
+                    e["cycle"]
+                    for e in (tl or {}).get("events", ())
+                    if e.get("cycle", -1) >= 0
+                }
+                # spans carry the cycle seq as their exemplar attr —
+                # the reverse join, so the view keeps the batch cycles
+                # even when the timeline aged out of its LRU
+                for s in span_list or ():
+                    if s.attrs.get("seq", -1) >= 0:
+                        seqs.add(s.attrs["seq"])
+                recs = [r for r in recs if r.seq in seqs]
+            if trace_id:
+                recs = [r for r in recs if trace_id in r.trace_ids]
+            trace = to_chrome_trace(
+                recs, epoch=recorder.epoch, spans=span_list
+            )
+            return (
+                200,
+                "application/json",
+                json.dumps(trace).encode(),
+                {
+                    "Content-Disposition":
+                    'attachment; filename="scheduler-trace.json"'
+                },
+            )
+
+        def _explain_route(
+            self, uid: str
+        ) -> tuple[int, str, bytes, dict[str, str]]:
+            """GET /debug/explain?pod=<uid>: the joined
+            schedulability verdict — why is (was) this pod Pending."""
+            if not uid:
+                return (
+                    400,
+                    "application/json",
+                    json.dumps(
+                        {"error": "missing ?pod=<uid>"}
+                    ).encode(),
+                    {},
+                )
+            tl = pod_timeline(uid)
+            if tl is None:
+                return (
+                    404,
+                    "application/json",
+                    json.dumps(
+                        {"error": f"pod {uid!r} not seen"}
+                    ).encode(),
+                    {},
+                )
+            attempts = tl.get("attempts", [])
+            # per-plugin first-rejector counts over the attempts (the
+            # live-timeline analogue of oracle.attribute_rejects'
+            # first-rejector attribution): each failed attempt charges
+            # ONE plugin — the first one that rejected the pod
+            reject_counts: dict[str, int] = {}
+            for a in attempts:
+                if a.get("result") == "Unschedulable":
+                    plug = a.get("plugin", "") or "<unattributed>"
+                    reject_counts[plug] = (
+                        reject_counts.get(plug, 0) + 1
+                    )
+            rejectors = [
+                a.get("plugin", "")
+                for a in attempts
+                if a.get("result") == "Unschedulable"
+                and a.get("plugin")
+            ]
+            cycles = {
+                e["cycle"]
+                for e in tl.get("events", ())
+                if e.get("cycle", -1) >= 0
+            }
+            payload: dict = {
+                "uid": uid,
+                "name": tl.get("name", ""),
+                "state": tl.get("state", "Pending"),
+                "attempts": attempts,
+                "reject_counts": reject_counts,
+                "first_rejector": rejectors[0] if rejectors else "",
+                "last_rejector": rejectors[-1] if rejectors else "",
+            }
+            if admission is not None:
+                # the front door's shed/retry history (present even
+                # when tracing is unarmed)
+                payload["admission_history"] = admission.history_for(
+                    uid
+                )
+            if spans_recorder is not None:
+                sp = spans_recorder.for_uid(uid)
+                payload["spans"] = [
+                    s.to_dict(epoch=spans_recorder.epoch) for s in sp
+                ]
+                totals: dict[str, float] = {}
+                for s in sp:
+                    totals[s.name] = totals.get(s.name, 0.0) + max(
+                        s.t1 - s.t0, 0.0
+                    ) * 1e3
+                payload["span_totals_ms"] = {
+                    k: round(v, 4) for k, v in totals.items()
+                }
+                payload["trace_ids"] = sorted(
+                    {s.trace_id for s in sp}
+                )
+                for s in sp:
+                    if s.attrs.get("seq", -1) >= 0:
+                        cycles.add(s.attrs["seq"])
+            if observer is not None:
+                # anomalies whose cycle seq overlapped this pod's
+                # cycles: the "something else went wrong in the same
+                # batch" half of the verdict
+                payload["anomalies"] = [
+                    a
+                    for a in observer.anomalies(last=512)
+                    if a.get("seq", -1) in cycles
+                ]
+            return (
+                200,
+                "application/json",
+                json.dumps(payload).encode(),
+                {},
+            )
 
         def _respond(self, include_body: bool) -> None:
             status, ctype, body, extra = self._route()
@@ -298,7 +458,10 @@ def start_http_server(
                     ).encode(),
                     {},
                 )
-            res = admission.submit(pods)
+            res = admission.submit(
+                pods,
+                traceparent=self.headers.get("traceparent", ""),
+            )
             payload = {
                 "accepted": res.accepted,
                 "shed": res.shed,
@@ -323,6 +486,13 @@ def start_http_server(
                 }
             else:
                 status, extra = 200, {}
+            if res.traceparent:
+                # echo the effective trace context (the caller's own
+                # header, or the head-sampled root the scheduler
+                # minted) — the HTTP twin of the gRPC trailing
+                # metadata echo
+                extra = dict(extra)
+                extra["traceparent"] = res.traceparent
             return status, json.dumps(payload).encode(), extra
 
         def do_POST(self):  # noqa: N802 — the ONE mutating route; every

@@ -213,6 +213,23 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         help="seconds between journal-compacting snapshots (config "
         "snapshotInterval; 0 = journal only, -1 = keep config)",
     )
+    ap.add_argument(
+        "--trace-sample-rate", type=float, default=-1.0,
+        help="pod-lifecycle tracing: head-sampling probability for "
+        "submissions arriving without a traceparent (deterministic "
+        "per pod uid; an explicit traceparent always samples). Spans "
+        "serve at /debug/traces and join /debug/explain (config "
+        "traceSampleRate, default 1/64; 0 disables tracing, "
+        "-1 = keep config)",
+    )
+    ap.add_argument(
+        "--trace-export-dir", default="",
+        help="on shutdown, dump the span ring as OTLP-JSON "
+        "(spans-NNNNNN.json) into this directory for external "
+        "ingestion; repeated runs append the next file and the "
+        "directory is size-rotated (oldest dumps deleted past 64 MB). "
+        "Empty = no OTLP export (spans still serve at /debug/traces)",
+    )
     return ap
 
 
@@ -261,6 +278,8 @@ def main(argv: list[str] | None = None) -> int:
         config.state_dir = args.state_dir
     if args.snapshot_interval >= 0:
         config.snapshot_interval_seconds = args.snapshot_interval
+    if args.trace_sample_rate >= 0:
+        config.trace_sample_rate = args.trace_sample_rate
     if (
         config.health_max_cycle_age_seconds > 0
         and config.flight_recorder_size <= 0
@@ -307,6 +326,19 @@ def main(argv: list[str] | None = None) -> int:
 
     gm = global_metrics()
 
+    # build identity: one constant-1 gauge stamped at startup so
+    # dashboards can correlate latency shifts with binary/runtime
+    # changes (bench headlines carry the same fingerprint)
+    from ..metrics.metrics import build_fingerprint
+
+    fp = build_fingerprint()
+    gm.set_build_info(fp)
+    print(
+        "build: "
+        + " ".join(f"{k}={v}" for k, v in sorted(fp.items())),
+        flush=True,
+    )
+
     # leader gauges evaluate at scrape so a failover is visible the
     # moment it happens, not at the next heartbeat write
     gm.leader_state.set_function(
@@ -348,6 +380,7 @@ def main(argv: list[str] | None = None) -> int:
     # serialized against any stray Cycle RPC by the service cycle lock.
     front_door = None
     submit_server = None
+    spans_recorder = None
     if args.submit_addr:
         from concurrent import futures as _futures
 
@@ -355,6 +388,26 @@ def main(argv: list[str] | None = None) -> int:
 
         from ..service.admission import self_confirming_front_door
         from ..service.server import add_to_server
+
+        # pod-lifecycle tracing: armed BEFORE the front door starts so
+        # the very first submission can be sampled. Only the front-door
+        # path mints trace contexts (Submit is where a pod's lifecycle
+        # begins), so agent-driven runs skip the armed cost entirely.
+        if config.trace_sample_rate > 0:
+            from ..core import spans as _spans
+
+            spans_recorder = _spans.arm(
+                rate=config.trace_sample_rate,
+                counter=(
+                    lambda name: gm.trace_spans.labels(name=name).inc()
+                ),
+            )
+            print(
+                "tracing armed: sample rate "
+                f"{config.trace_sample_rate:g} "
+                "(/debug/traces, /debug/explain)",
+                flush=True,
+            )
 
         admission = service.enable_front_door()
         submit_server = _grpc.server(
@@ -425,6 +478,7 @@ def main(argv: list[str] | None = None) -> int:
             state=state,
             observer=observer,
             admission=service.admission,
+            spans_recorder=spans_recorder,
         )
         print(
             "serving /healthz /metrics on port "
@@ -494,11 +548,37 @@ def main(argv: list[str] | None = None) -> int:
             with open(path, "w") as f:
                 json.dump(
                     to_chrome_trace(
-                        recorder.snapshot(), epoch=recorder.epoch
+                        recorder.snapshot(),
+                        epoch=recorder.epoch,
+                        # pod-trace tracks merged into the cycle lanes
+                        # when tracing was armed this run
+                        spans=(
+                            spans_recorder.snapshot()
+                            if spans_recorder is not None
+                            else None
+                        ),
                     ),
                     f,
                 )
             print(f"flight-recorder trace written to {path}", flush=True)
+        if spans_recorder is not None:
+            from ..core import spans as _spans
+
+            if args.trace_export_dir:
+                # post-mortem OTLP dump (same pattern as --trace-dir):
+                # guarded — a failing export must not abort shutdown
+                try:
+                    opath = _spans.export_otlp_dir(
+                        spans_recorder, args.trace_export_dir
+                    )
+                    if opath:
+                        print(
+                            f"OTLP span export written to {opath}",
+                            flush=True,
+                        )
+                except Exception as e:  # schedlint: disable=RB001 -- best-effort shutdown dump
+                    print(f"OTLP span export FAILED: {e}", flush=True)
+            _spans.disarm()
         if lease is not None:
             lease.release()
     return 0

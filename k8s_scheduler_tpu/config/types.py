@@ -228,6 +228,13 @@ class SchedulerConfiguration:
     # gRPC trailing metadata "retry-after-ms" and the HTTP
     # Retry-After header on the debug server's POST /submit path.
     admission_retry_after_ms: float = 250.0
+    # pod-lifecycle tracing (core/spans): head-sampling probability
+    # for submissions that arrive WITHOUT an explicit traceparent —
+    # deterministic per pod uid, so a shed retry keeps its sampling
+    # fate. An explicit traceparent always samples. 0 disables
+    # arming entirely (stamp sites pay one flag load); 1 traces every
+    # pod (bench overhead stages and acceptance runs).
+    trace_sample_rate: float = 1.0 / 64.0
     # durable scheduler state (state/ package): directory for the
     # write-ahead journal + snapshots. "" disables durability — a
     # takeover then rebuilds only what informer events re-deliver,
@@ -376,6 +383,9 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         admission_queue_depth=int(data.get("admissionQueueDepth", 65536)),
         admission_retry_after_ms=float(
             data.get("admissionRetryAfterMs", 250.0)
+        ),
+        trace_sample_rate=float(
+            data.get("traceSampleRate", 1.0 / 64.0)
         ),
         state_dir=str(data.get("stateDir", "")),
         snapshot_interval_seconds=_duration_seconds(

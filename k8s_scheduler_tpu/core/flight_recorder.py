@@ -131,6 +131,12 @@ class CycleRecord:
     # dispatched — e.g. spec mismatch), "" = no speculation involved.
     # Feeds the observer's speculation_thrash abandon-rate EWMA.
     speculation: str = ""
+    # trace ids of the sampled pods this cycle served (core/spans):
+    # the exemplar join from a flight record back to its pod traces —
+    # span attrs carry the cycle `seq` for the reverse direction.
+    # Stamped only when tracing is armed AND a sampled pod rode the
+    # cycle; empty tuple otherwise (and omitted from to_dict).
+    trace_ids: tuple = ()
 
     def mark(self, name: str, t: float) -> None:
         self.marks[name] = t
@@ -161,6 +167,10 @@ class CycleRecord:
             **(
                 {"speculation": self.speculation}
                 if self.speculation else {}
+            ),
+            **(
+                {"trace_ids": list(self.trace_ids)}
+                if self.trace_ids else {}
             ),
         }
 
@@ -384,11 +394,17 @@ def _slice(
 
 
 def to_chrome_trace(
-    records: Iterable[CycleRecord], epoch: float = 0.0
+    records: Iterable[CycleRecord], epoch: float = 0.0,
+    spans: Iterable | None = None,
 ) -> dict:
     """Chrome-trace (JSON object format) reconstruction of the serving
     pipeline's lanes from committed records. Open the serialized dict in
     ui.perfetto.dev or chrome://tracing.
+
+    When `spans` (core/spans.Span, the same perf_counter clock as the
+    cycle marks) is given, per-trace pod tracks render in a second
+    process group below the cycle lanes — one Perfetto view shows a
+    pod's submit→bind spans overlapping the batch that served it.
 
     Lane layout (one pid, three tids — see LANE_NAMES):
 
@@ -530,5 +546,10 @@ def to_chrome_trace(
                     epoch, {"seq": rec.seq},
                 )
             )
+
+    if spans is not None:
+        from .spans import spans_to_chrome_events
+
+        events.extend(spans_to_chrome_events(spans, epoch=epoch))
 
     return {"traceEvents": events, "displayTimeUnit": "ms"}

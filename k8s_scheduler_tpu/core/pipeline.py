@@ -66,6 +66,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import faults as _faults
+from . import spans as _spans
 from .cycle import CycleDecision, _jit
 
 
@@ -491,6 +492,12 @@ class MultiCycleHandle:
         )
         st["t_decision_end"] = t1
         st.setdefault("t_first_decision", t1)
+        if _spans.ARMED:
+            # per-row decision window for the decision.row trace span
+            # (scheduler._apply_mc_row reads it back by row index; a
+            # plain-list key, so the stage report's t_*/"*_ms" copy
+            # loops never see it and flight records stay unchanged)
+            st.setdefault("decision_rows", []).append((i, t0, t1))
         nbytes = int(a.nbytes + flags.nbytes)
         st["fetch_bytes"] = st.get("fetch_bytes", 0) + nbytes
         self._pipe._fetch_bytes_total += nbytes

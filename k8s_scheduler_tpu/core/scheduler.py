@@ -68,6 +68,7 @@ from .degrade import (
 )
 from .events import EventRecorder, failed_scheduling_message
 from .flight_recorder import FlightRecorder
+from . import spans as _spans
 
 # binder(pod, node_name) -> None; raise to signal bind failure
 Binder = Callable[[Pod, str], None]
@@ -1034,6 +1035,24 @@ class Scheduler:
                     or (t0 - buf[0][0]) >= self._mc_wait_s
                 ):
                     self._mc_groups[name] = []
+                    if _spans.ARMED:
+                        # mc.buffer_wait: admission-group enqueue ->
+                        # this flush, one span per sampled pod. The
+                        # wait is a scheduler-clock delta (t0/t_enq
+                        # may ride an injected test clock); the span
+                        # anchors its END at the recorder clock so it
+                        # abuts the dispatch span that follows.
+                        t_flush = _spans.now()
+                        for t_enq, g in buf:
+                            wait_s = max(t0 - t_enq, 0.0)
+                            for p in g:
+                                c = _spans.ctx_for(p.uid)
+                                if c is not None:
+                                    _spans.record_span(
+                                        "mc.buffer_wait", c,
+                                        t_flush - wait_s, t_flush,
+                                        uid=p.uid, groups=len(buf),
+                                    )
                     # a pod is "attempted" in the cycle whose dispatch
                     # carries it: groups popped by EARLIER buffering
                     # cycles count NOW (their buffering cycle
@@ -1561,6 +1580,18 @@ class Scheduler:
         ing_s = max(self._now() - t_ing, 0.0)
         self._ingest_s[profile] = self._ingest_s.get(profile, 0.0) + ing_s
         self.metrics.encode_ingest.observe(ing_s)
+        if _spans.ARMED:
+            # encode.ingest: this group's admission-time row staging
+            # (scheduler-clock duration anchored at the recorder clock,
+            # same discipline as mc.buffer_wait)
+            t1 = _spans.now()
+            for p in group:
+                c = _spans.ctx_for(p.uid)
+                if c is not None:
+                    _spans.record_span(
+                        "encode.ingest", c, t1 - ing_s, t1,
+                        uid=p.uid, pods=len(group),
+                    )
 
     def _schedule_profile_multi(
         self,
@@ -1734,7 +1765,9 @@ class Scheduler:
             t_encode - t_batch
         )
         if inc:
-            self._stamp_finalize(profile, t_encode - t_batch)
+            self._stamp_finalize(
+                profile, t_encode - t_batch, pods=batch_pods
+            )
         pipe.forced_sync = (
             self.forced_sync or self.ladder.rung >= RUNG_FORCED_SYNC
         )
@@ -1870,7 +1903,9 @@ class Scheduler:
             bbufs = _jax.device_put(bbufs)
         return wbufs, bbufs
 
-    def _stamp_finalize(self, profile: str, fin_s: float) -> None:
+    def _stamp_finalize(
+        self, profile: str, fin_s: float, pods=(),
+    ) -> None:
         """Observe the flush's finalize window (encode_finalize
         histogram) and park the ingest/finalize phase stamps for the
         batch's inner record 0 (_apply_mc_row picks them up)."""
@@ -1881,6 +1916,17 @@ class Scheduler:
             "encode_finalize_ms": fin_s * 1e3,
             "encode_ingest_ms": ing_s * 1e3,
         }
+        if _spans.ARMED and pods:
+            # flush.finalize: the O(dirty) flush apply this batch paid
+            # (scheduler-clock duration, recorder-clock anchor)
+            t1 = _spans.now()
+            for p in pods:
+                c = _spans.ctx_for(p.uid)
+                if c is not None:
+                    _spans.record_span(
+                        "flush.finalize", c, t1 - fin_s, t1,
+                        uid=p.uid,
+                    )
 
     def _mc_fall_back(
         self, profile: str, groups, stats: CycleStats, t0: float,
@@ -2183,6 +2229,15 @@ class Scheduler:
             # (device_share/batch_wait), same spirit as zeroing
             # their fetch_bytes
             st_i = st if gi == 0 else {"slot": st.get("slot", -1)}
+            # armed-only: this inner cycle's streamed decision-row
+            # window (pipeline.decisions_row stamps it per row) — the
+            # decision.row span override for records of a batch
+            row_window = None
+            if _spans.ARMED:
+                row_window = dict(
+                    (ri, (rt0, rt1))
+                    for ri, rt0, rt1 in st.get("decision_rows", ())
+                ).get(gi)
             self._commit_record(
                 rec, st_i, spec, encoder, pending, nodes, stats,
                 before, profile_gang_dropped,
@@ -2194,6 +2249,7 @@ class Scheduler:
                 extra_counts=extra_counts,
                 compile_source=compile_source,
                 speculation=speculation,
+                row_window=row_window,
             )
 
     def _schedule_profile_multi_spec(
@@ -2336,6 +2392,7 @@ class Scheduler:
             self._stamp_finalize(
                 profile,
                 (t_encode - t_batch) + (self._now() - t_enc_b0),
+                pods=batch_pods,
             )
         handle_b = None
         if bad_reason is None:
@@ -2511,6 +2568,7 @@ class Scheduler:
         extra_counts: "dict | None" = None,
         compile_source: str = "",
         speculation: str = "",
+        row_window: "tuple | None" = None,
     ) -> None:
         """Assemble + commit one cycle flight record (one list store):
         pipeline stage marks/phases, pad-regime signature, queue
@@ -2598,7 +2656,61 @@ class Scheduler:
             ),
             **(extra_counts or {}),
         )
+        if _spans.ARMED:
+            self._emit_cycle_spans(rec, pending, speculation, row_window)
         self.flight.commit(rec)
+
+    def _emit_cycle_spans(
+        self, rec, pending, speculation: str,
+        row_window: "tuple | None",
+    ) -> None:
+        """Armed-only: emit this record's serve-side spans for every
+        sampled pod it carried and stamp the record's `trace_ids`
+        exemplar join. All windows come from the record's own marks
+        (recorder perf_counter clock — the same base the span ring
+        uses), so span slices and cycle lanes rebase identically;
+        `row_window` overrides the decision window for an inner cycle
+        of a multi-cycle batch (its streamed row, not the batch-wide
+        fetch envelope)."""
+        ctxs = []
+        for p in pending:
+            c = _spans.ctx_for(p.uid)
+            if c is not None:
+                ctxs.append((p.uid, c))
+        if not ctxs:
+            return
+        m = rec.marks
+        d0, d1 = m.get("dispatch_start"), m.get("dispatch_end")
+        r0, r1 = row_window or (
+            m.get("decision_start"), m.get("decision_end")
+        )
+        a0, a1 = m.get("apply_start"), m.get("winners_end")
+        for uid, c in ctxs:
+            if d0 is not None and d1 is not None:
+                _spans.record_span(
+                    "dispatch", c, d0, d1, uid=uid, seq=rec.seq,
+                )
+            if speculation in ("adopted", "abandoned"):
+                # the speculative continuation this batch resolved:
+                # anchor it on the dispatch window (the speculation
+                # rode that dispatch's shadow)
+                _spans.record_span(
+                    "dispatch.speculative", c,
+                    d0 if d0 is not None else rec.t_start,
+                    d1 if d1 is not None else rec.t_start,
+                    uid=uid, seq=rec.seq, outcome=speculation,
+                )
+            if r0 is not None and r1 is not None:
+                _spans.record_span(
+                    "decision.row", c, r0, r1, uid=uid, seq=rec.seq,
+                )
+            if a0 is not None and a1 is not None:
+                _spans.record_span(
+                    "apply.fold", c, a0, a1, uid=uid, seq=rec.seq,
+                )
+        rec.trace_ids = tuple(
+            dict.fromkeys(c.trace_id for _u, c in ctxs)
+        )
 
     def _cycle_failed(
         self,
@@ -2966,9 +3078,19 @@ class Scheduler:
                     node: pod.name
                     for pod, node in self.last_nominations
                 }
+                # armed-only: the preemptor pod (not just its name) by
+                # node, so a victim's span joins the PREEMPTOR's trace
+                preemptor_pod_by_node = (
+                    {
+                        node: pod
+                        for pod, node in self.last_nominations
+                    }
+                    if _spans.ARMED else {}
+                )
                 n_vict = 0
                 for e in np.flatnonzero(victims):
                     vpod, vnode = existing[int(e)]
+                    t_ev0 = _spans.now() if _spans.ARMED else 0.0
                     self.evictor(vpod, vnode)
                     self.last_evictions.append((vpod, vnode))
                     _pev(
@@ -2978,6 +3100,19 @@ class Scheduler:
                     self.events.preempted(
                         vpod, preemptor_by_node.get(vnode, "<pending>")
                     )
+                    if _spans.ARMED:
+                        pre = preemptor_pod_by_node.get(vnode)
+                        c = (
+                            _spans.ctx_for(pre.uid)
+                            if pre is not None else None
+                        )
+                        if c is not None:
+                            _spans.record_span(
+                                "preempt.victim", c, t_ev0,
+                                _spans.now(), uid=pre.uid,
+                                victim=vpod.uid, node=vnode,
+                                seq=rec.seq if rec is not None else -1,
+                            )
                     n_vict += 1
                 stats.victims += n_vict
                 self.metrics.preemption_victims.observe(n_vict)
@@ -2992,17 +3127,35 @@ class Scheduler:
     def _bind(self, pod: Pod, node_name: str) -> None:
         """Bind, delegating to the first bind-verb extender (upstream: an
         extender with a bind verb replaces the default binder)."""
+        t_b0 = _spans.now() if _spans.ARMED else 0.0
         for ext in self.extenders:
             if ext.is_binder:
                 ext.bind(pod, node_name)
                 if self.admission is not None:
                     self.admission.note_bind(pod.uid)
+                self._span_bind_confirm(pod, node_name, t_b0)
                 return
         self.binder(pod, node_name)
         if self.admission is not None:
             # after the binder: a raising binder is a bind error, and
             # an errored bind must not close the submit->bind window
             self.admission.note_bind(pod.uid)
+        self._span_bind_confirm(pod, node_name, t_b0)
+
+    def _span_bind_confirm(
+        self, pod: Pod, node_name: str, t_b0: float
+    ) -> None:
+        """Armed-only: the pod's bind.confirm span — binder call
+        through note_bind, the moment its trace's submit->bind window
+        closes. A raising binder never reaches here (a bind error is
+        not a confirm)."""
+        if _spans.ARMED:
+            c = _spans.ctx_for(pod.uid)
+            if c is not None:
+                _spans.record_span(
+                    "bind.confirm", c, t_b0, _spans.now(),
+                    uid=pod.uid, node=node_name,
+                )
 
     def _update_gauges(self) -> None:
         self.metrics.set_pending(self.queue.pending_counts())

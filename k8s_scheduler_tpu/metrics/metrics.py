@@ -161,6 +161,23 @@ harness that drives them):
   submissions, including the WAL-before-ack group-fsync barrier (the
   durability contract's cost, paid off the scheduling hot path)
 
+Tracing / build-identity families (core/spans.py span recorder +
+cmd/main.py startup stamp):
+
+- scheduler_trace_spans_total{name} — pod-lifecycle trace spans
+  recorded, by span name (submit.validate | submit.journal |
+  ack.barrier | mc.buffer_wait | encode.ingest | flush.finalize |
+  dispatch | dispatch.speculative | decision.row | apply.fold |
+  bind.confirm | preempt.victim; the inventory is
+  core/spans.SPAN_NAMES, machine-checked by schedlint ID010 against
+  this docstring and the README span table); spans serve at
+  /debug/traces and join /debug/explain verdicts
+- scheduler_build_info{python,jax,jaxlib,backend,git} — constant 1
+  gauge carrying the process's build/runtime fingerprint as labels,
+  set once at startup so dashboards can correlate latency shifts with
+  binary or runtime changes; bench headline artifacts carry the same
+  stamp (build_fingerprint())
+
 Durable-state families (state/ package — write-ahead journal, snapshots,
 restore) and leader election:
 
@@ -538,6 +555,22 @@ class SchedulerMetrics:
             buckets=_DURATION_BUCKETS,
             registry=r,
         )
+        # ---- pod-lifecycle tracing / build identity (core/spans.py) ----
+        self.trace_spans = Counter(
+            "scheduler_trace_spans_total",
+            "Pod-lifecycle trace spans recorded, by span name (the "
+            "core/spans.SPAN_NAMES inventory; serves /debug/traces).",
+            ["name"],
+            registry=r,
+        )
+        self.build_info = Gauge(
+            "scheduler_build_info",
+            "Constant 1 gauge carrying the build/runtime fingerprint "
+            "as labels (python | jax | jaxlib | backend | git), set "
+            "once at startup (build_fingerprint()).",
+            ["python", "jax", "jaxlib", "backend", "git"],
+            registry=r,
+        )
         # ---- durable state (state/: journal + snapshots + restore) ----
         self.journal_appends = Counter(
             "scheduler_journal_appends_total",
@@ -682,9 +715,61 @@ class SchedulerMetrics:
         self.cache_size.labels(type="pods").set(pods)
         self.cache_size.labels(type="assumed_pods").set(assumed)
 
+    def set_build_info(self, info: dict[str, str] | None = None) -> None:
+        """Stamp scheduler_build_info once from a build_fingerprint()
+        dict (computed fresh when omitted)."""
+        self.build_info.labels(**(info or build_fingerprint())).set(1)
+
     def expose(self) -> bytes:
         """Prometheus text exposition (the /metrics payload)."""
         return generate_latest(self.registry)
+
+
+def build_fingerprint() -> dict[str, str]:
+    """Best-effort build/runtime identity for scheduler_build_info and
+    bench headline stamps: python/jax/jaxlib versions, the JAX backend
+    actually serving cycles, and `git describe` of the working tree.
+    Every probe degrades to a placeholder — this must never fail in a
+    wheel install without git or on a box without jax.
+    """
+    import platform
+
+    info = {
+        "python": platform.python_version(),
+        "jax": "unavailable",
+        "jaxlib": "unavailable",
+        "backend": "unavailable",
+        "git": "unknown",
+    }
+    try:  # schedlint: disable=RB001 -- identity probe, never load-bearing
+        import jax
+
+        info["jax"] = str(getattr(jax, "__version__", "unknown"))
+        info["backend"] = str(jax.default_backend())
+    except Exception:  # schedlint: disable=RB001 -- jax optional here
+        pass
+    try:  # schedlint: disable=RB001 -- identity probe, never load-bearing
+        import jaxlib
+
+        info["jaxlib"] = str(getattr(jaxlib, "__version__", "unknown"))
+    except Exception:  # schedlint: disable=RB001 -- jaxlib optional here
+        pass
+    try:  # schedlint: disable=RB001 -- identity probe, never load-bearing
+        import os
+        import subprocess
+
+        out = subprocess.run(
+            ["git", "describe", "--always", "--dirty", "--tags"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=5.0,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            info["git"] = out.stdout.strip()
+    except Exception:  # schedlint: disable=RB001 -- git optional here
+        pass
+    return info
 
 
 _global_lock = threading.Lock()

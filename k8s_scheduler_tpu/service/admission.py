@@ -61,12 +61,19 @@ import logging
 import threading
 import time as _time
 
+from ..core import spans as _spans
+
 log = logging.getLogger(__name__)
 
 # accepted-but-unbound timestamps kept at most this many deep: a pod
 # parked unschedulable for hours should age out of the latency join
 # (its eventual submit->bind sample would only poison the histogram)
 _MAX_TRACKED = 262_144
+
+# per-uid admission history (shed / invalid / accepted / bound) kept
+# for /debug/explain — bounded LRU on uid, bounded events per uid
+_MAX_HISTORY_UIDS = 4096
+_MAX_HISTORY_EVENTS = 32
 
 
 @dataclasses.dataclass
@@ -80,6 +87,12 @@ class SubmitResult:
     retry_after_ms: float = 0.0  # > 0 on shed
     durable: bool = False  # the WAL ack barrier held
     queue_depth: int = 0  # admission queue depth after the request
+    # trace context echoed back to the submitter (W3C traceparent):
+    # the caller's own header when one was supplied, else the first
+    # sampled pod's locally minted root context, "" when tracing is
+    # unarmed or nothing sampled — rides the gRPC trailing metadata
+    # and the HTTP response header
+    traceparent: str = ""
 
     @property
     def ok(self) -> bool:
@@ -111,6 +124,11 @@ class AdmissionController:
             collections.OrderedDict()
         )
         self._max_tracked = max_tracked
+        # uid -> [admission events] for /debug/explain (shed/invalid
+        # attempts, the accept, the bind) — LRU-bounded both ways
+        self._history: collections.OrderedDict[str, list] = (
+            collections.OrderedDict()
+        )
         self._bind_lat_ms = 0.0  # worst since last take (per record)
         self._closed = False
         self.accepted_total = 0
@@ -143,9 +161,38 @@ class AdmissionController:
                 n += len(group)
         return n
 
+    # ---- admission history (the /debug/explain join) ----------------------
+
+    def _note_history(self, uids, kind: str, **detail) -> None:
+        """Append one admission event per uid (callers hold the lock).
+        Tracing-independent: the shed/retry history is part of the
+        explain contract whether or not spans are armed."""
+        wall = _time.time()
+        for uid in uids:
+            if not uid:
+                continue
+            events = self._history.get(uid)
+            if events is None:
+                events = []
+                self._history[uid] = events
+                while len(self._history) > _MAX_HISTORY_UIDS:
+                    self._history.popitem(last=False)
+            else:
+                self._history.move_to_end(uid)
+            events.append({"wall": wall, "kind": kind, **detail})
+            if len(events) > _MAX_HISTORY_EVENTS:
+                del events[: len(events) - _MAX_HISTORY_EVENTS]
+
+    def history_for(self, uid: str) -> list:
+        """This uid's admission history, oldest first (empty when the
+        uid was never seen or aged out of the LRU)."""
+        with self._lock:
+            events = self._history.get(uid)
+            return [dict(e) for e in events] if events else []
+
     # ---- submission -------------------------------------------------------
 
-    def submit(self, pods) -> SubmitResult:
+    def submit(self, pods, traceparent: str = "") -> SubmitResult:
         t0 = _time.perf_counter()
         m = self.scheduler.metrics
         if self._closed:
@@ -153,6 +200,7 @@ class AdmissionController:
                 shed=len(pods), reason="draining",
                 retry_after_ms=self.retry_after_ms,
                 queue_depth=self.queue_depth(),
+                traceparent=traceparent,
             )
         # validation first: an invalid request must journal NOTHING
         bad: list[str] = []
@@ -167,11 +215,13 @@ class AdmissionController:
         if bad:
             with self._lock:
                 self.invalid_total += len(pods)
+                self._note_history(bad, "invalid", reason="malformed")
             m.admission_total.labels(outcome="invalid").inc(len(pods))
             return SubmitResult(
                 invalid=tuple(bad),
                 reason=f"invalid pods: {bad[:4]!r}",
                 queue_depth=self.queue_depth(),
+                traceparent=traceparent,
             )
         # a uid the cache already knows (assumed or bound) is a
         # duplicate too: a client retrying a Submit whose ack was lost
@@ -185,18 +235,25 @@ class AdmissionController:
         if known:
             with self._lock:
                 self.invalid_total += len(pods)
+                self._note_history(
+                    known, "invalid", reason="already bound"
+                )
             m.admission_total.labels(outcome="invalid").inc(len(pods))
             return SubmitResult(
                 invalid=tuple(known),
                 reason=f"uids already bound: {known[:4]!r}",
                 queue_depth=self.queue_depth(),
+                traceparent=traceparent,
             )
+        t_valid = _time.perf_counter()
+        ctxs: list = []  # (uid, TraceContext) for sampled pods
         with self._lock:
             if self._closed:
                 return SubmitResult(
                     shed=len(pods), reason="draining",
                     retry_after_ms=self.retry_after_ms,
                     queue_depth=self.queue_depth(),
+                    traceparent=traceparent,
                 )
             # a uid still pending from an earlier accepted submission
             # is a duplicate, not an update — re-queueing it would
@@ -204,6 +261,9 @@ class AdmissionController:
             dup = [u for u in seen if u in self._accept_t]
             if dup:
                 self.invalid_total += len(pods)
+                self._note_history(
+                    dup, "invalid", reason="already pending"
+                )
                 m.admission_total.labels(outcome="invalid").inc(
                     len(pods)
                 )
@@ -211,17 +271,23 @@ class AdmissionController:
                     invalid=tuple(dup),
                     reason=f"uids already pending: {dup[:4]!r}",
                     queue_depth=self.queue_depth(),
+                    traceparent=traceparent,
                 )
             depth = self.queue_depth()
             reason = self._shed_reason(depth, len(pods))
             if reason:
                 self.shed_total += len(pods)
                 self.last_shed_reason = reason
+                self._note_history(
+                    seen, "shed", reason=reason,
+                    retry_after_ms=self.retry_after_ms,
+                )
                 m.admission_total.labels(outcome="shed").inc(len(pods))
                 return SubmitResult(
                     shed=len(pods), reason=reason,
                     retry_after_ms=self.retry_after_ms,
                     queue_depth=depth,
+                    traceparent=traceparent,
                 )
             # accept: enqueue through the informer path — queue.add
             # journals q.add with the same codec/clock discipline every
@@ -229,11 +295,20 @@ class AdmissionController:
             # digest machinery need nothing new for submitted pods
             now = self.scheduler._now()
             for p in pods:
+                # bind the trace context BEFORE the enqueue: the serve
+                # loop can pop and flush the pod the instant queue.add
+                # releases, and its mc.buffer_wait/dispatch spans join
+                # the trace by uid lookup
+                if _spans.ARMED:
+                    c = _spans.register(p.uid, traceparent)
+                    if c is not None:
+                        ctxs.append((p.uid, c))
                 self.scheduler.on_pod_add(p)
                 self._accept_t[p.uid] = now
             while len(self._accept_t) > self._max_tracked:
                 self._accept_t.popitem(last=False)
             self.accepted_total += len(pods)
+            self._note_history(seen, "accepted", depth=depth)
             depth += len(pods)
         m.admission_total.labels(outcome="accepted").inc(len(pods))
         m.admission_queue_depth.set(depth)
@@ -242,11 +317,39 @@ class AdmissionController:
         # serializing it under the lock would turn group commit back
         # into one fsync per request
         durable = False
+        t_ack0 = _time.perf_counter()
+        flush_seq = -1
         if self._durable is not None:
             durable = self._durable.ack_barrier()
+            if ctxs:
+                flush_seq = self._durable.flush_seq()
         m.submit_ack.observe(_time.perf_counter() - t0)
+        tp = traceparent
+        if ctxs:
+            # one span triple per sampled pod, stamped from the shared
+            # request timestamps: validate (request entry -> dup checks
+            # done), journal (the informer-path enqueue, which stamped
+            # itself inside the lock window), ack.barrier (the shared
+            # group-commit fsync wait — every submitter's span carries
+            # the flush seq it rode)
+            t_ack1 = _time.perf_counter()
+            for uid, c in ctxs:
+                _spans.record_span(
+                    "submit.validate", c, t0, t_valid, uid=uid
+                )
+                _spans.record_span(
+                    "submit.journal", c, t_valid, t_ack0, uid=uid
+                )
+                if self._durable is not None:
+                    _spans.record_span(
+                        "ack.barrier", c, t_ack0, t_ack1, uid=uid,
+                        flush_seq=flush_seq, durable=durable,
+                    )
+            if not tp:
+                tp = ctxs[0][1].traceparent()
         return SubmitResult(
             accepted=len(pods), durable=durable, queue_depth=depth,
+            traceparent=tp,
         )
 
     def _shed_reason(self, depth: int, incoming: int) -> str:
@@ -327,6 +430,10 @@ class AdmissionController:
             lat_ms = max(self.scheduler._now() - t0, 0.0) * 1e3
             if lat_ms > self._bind_lat_ms:
                 self._bind_lat_ms = lat_ms
+            if uid in self._history:
+                self._note_history(
+                    (uid,), "bound", latency_ms=round(lat_ms, 3)
+                )
 
     def note_delete(self, uid: str) -> None:
         """Called by Scheduler.on_pod_delete: a pod deleted before it
@@ -336,6 +443,10 @@ class AdmissionController:
         it). Must never raise — it sits on the informer path."""
         with self._lock:
             self._accept_t.pop(uid, None)
+        # a deleted pod's trace is over — drop its live context (the
+        # recorded spans stay in the ring for /debug queries)
+        if _spans.ARMED:
+            _spans.release(uid)
 
     def take_bind_latency_ms(self) -> float:
         """Worst submit->bind latency among binds since the last take

@@ -27,6 +27,9 @@ class SchedulerClient:
 
     def __init__(self, target: str, channel: grpc.Channel | None = None) -> None:
         self.channel = channel or grpc.insecure_channel(target)
+        # effective W3C traceparent from the last submit's trailing
+        # metadata ("" until a traced submit acks)
+        self.last_traceparent = ""
         mk = self.channel.unary_unary
         self._update = mk(
             f"/{SERVICE_NAME}/Update",
@@ -96,16 +99,35 @@ class SchedulerClient:
             raise RuntimeError(f"Inspect({kind!r}): {resp.error}")
         return json.loads(resp.json.decode())
 
-    def submit(self, pods, timeout: float = 30.0) -> pb.SubmitResponse:
+    def submit(
+        self, pods, timeout: float = 30.0, traceparent: str = "",
+    ) -> pb.SubmitResponse:
         """Submit pending pods through the admission front door.
         `pods` are models.api.Pod objects. Raises grpc.RpcError with
         RESOURCE_EXHAUSTED on shed (retry-after hint in the trailing
         metadata key "retry-after-ms"), INVALID_ARGUMENT on malformed
-        pods, UNAVAILABLE while the server drains."""
-        return self._submit(
-            pb.SubmitRequest(pods=[convert.pod_to(p) for p in pods]),
-            timeout=timeout,
+        pods, UNAVAILABLE while the server drains.
+
+        `traceparent` (W3C) joins the submission's trace spans to the
+        caller's trace; either way the server's effective traceparent
+        (the caller's, or a head-sampled root it minted) comes back in
+        the trailing metadata and lands in `self.last_traceparent`
+        ("" when tracing is unarmed or the pod was not sampled)."""
+        request = pb.SubmitRequest(
+            pods=[convert.pod_to(p) for p in pods]
         )
+        metadata = (
+            (("traceparent", traceparent),) if traceparent else None
+        )
+        resp, call = self._submit.with_call(
+            request, timeout=timeout, metadata=metadata
+        )
+        self.last_traceparent = ""
+        for key, value in call.trailing_metadata() or ():
+            if key == "traceparent":
+                self.last_traceparent = value
+                break
+        return resp
 
     def node_churn(
         self, adds=(), updates=(), deletes=(), timeout: float = 30.0

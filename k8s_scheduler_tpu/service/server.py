@@ -271,7 +271,13 @@ class SchedulerService:
         reject. Shed answers RESOURCE_EXHAUSTED with a retry-after-ms
         trailing-metadata hint; an OK ack means every pod was journaled
         through the WAL (group fsync) first — `durable` reports
-        whether that barrier actually held (no state dir = false)."""
+        whether that barrier actually held (no state dir = false).
+
+        Trace context (core/spans) rides gRPC metadata, not the proto:
+        a W3C `traceparent` invocation-metadata entry joins the
+        submission's spans to the caller's trace, and the ack's
+        trailing metadata echoes the effective traceparent back (the
+        caller's own, or the head-sampled root the scheduler minted)."""
         adm = self.admission
         if adm is None:
             context.abort(
@@ -290,7 +296,12 @@ class SchedulerService:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"unparseable pod in submission: {e}",
             )
-        res = adm.submit(pods)
+        traceparent = ""
+        for key, value in context.invocation_metadata() or ():
+            if key == "traceparent":
+                traceparent = value
+                break
+        res = adm.submit(pods, traceparent=traceparent)
         if res.invalid:
             context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT, res.reason
@@ -307,6 +318,10 @@ class SchedulerService:
             context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 f"admission shed: {res.reason}",
+            )
+        if res.traceparent:
+            context.set_trailing_metadata(
+                (("traceparent", res.traceparent),)
             )
         return pb.SubmitResponse(
             boot_id=self.boot_id,

@@ -470,6 +470,14 @@ class DurableState:
             return False
         return True
 
+    def flush_seq(self) -> int:
+        """The journal's current append sequence — the group-commit
+        flush seq a just-returned ack_barrier rode. Stamped as the
+        `flush_seq` attr on ack.barrier trace spans (core/spans) so
+        concurrent submitters that shared one fsync are visibly joined
+        to it."""
+        return self.journal.seq()
+
     def detach(self) -> None:
         """Stop journaling: drop the queue/cache emitters (plain
         attribute stores — see _emit for the lock-order argument) and

@@ -44,6 +44,13 @@ threshold flag (percent):
                    regression = rise  > --max-submit-bind-rise
     shed_rate      sustained-phase admission shed rate
                    regression = rise  > --max-shed-rise (default 0)
+    trace_overhead_pct   config-9 pod-lifecycle tracing overhead
+                   (armed at sample rate 1.0 vs off, worst of the
+                   submit-ack p99 / submit-bind p50 deltas); gated as
+                   an ABSOLUTE ceiling on the new artifact via
+                   --max-trace-overhead, not as a relative diff — the
+                   asserted-near-zero baseline makes percentages of a
+                   percentage pure noise
     scaling_efficiency   config-8 sharded scaling efficiency
                    regression = drop  > --max-scaling-efficiency-drop
     collective_payload_mb  config-8 compiled collective payload/cycle
@@ -175,6 +182,11 @@ def _normalize(row: dict) -> dict | None:
     degc = row.get("degraded_cycles", row.get("degc"))
     if degc is not None:
         out["degraded_cycles"] = int(degc)
+    # tracing overhead is gated as an absolute ceiling (see module
+    # docstring), so it rides outside _METRICS' relative comparison
+    trov = row.get("trace_overhead_pct", row.get("trov"))
+    if trov is not None:
+        out["trace_overhead_pct"] = float(trov)
     anom = row.get("anomalies", row.get("anom"))
     if anom is not None:
         out["anomalies"] = dict(anom)
@@ -410,6 +422,18 @@ def main(argv: list[str] | None = None) -> int:
         "rounds should pass the ISSUE 16 target (95)",
     )
     ap.add_argument(
+        "--max-trace-overhead", type=float, default=50.0,
+        help="absolute ceiling on the NEW artifact's config-9 "
+        "trace_overhead_pct (worst-case armed-at-rate-1.0 latency "
+        "delta vs tracing off; the ack axis only counts past the "
+        "group-commit fsync-jitter floor, see "
+        "bench_suite.trace_overhead_pct). Applied to the new "
+        "artifact alone: the old side is shown for context only, "
+        "because relative diffs of a near-zero percentage are pure "
+        "noise. Loose by default — CPU smoke's sub-ms latencies make "
+        "small absolute moves read as big percentages; 0 disables",
+    )
+    ap.add_argument(
         "--allow-stalls", type=int, default=1,
         help="stall/anomaly count may grow by this many before it "
         "counts as a regression (one stall is a known rig flake — "
@@ -481,6 +505,28 @@ def main(argv: list[str] | None = None) -> int:
                 "delta_pct": None,
                 "limit_pct": args.min_encode_hidden,
                 "regressed": nv < args.min_encode_hidden,
+            }
+            result["checks"].append(check)
+            if check["regressed"]:
+                result["regressions"].append(check)
+                result["ok"] = False
+    if args.max_trace_overhead > 0:
+        # absolute ceiling, gated on the NEW artifact only (see the
+        # module docstring for why this is not a relative diff)
+        for cfg in sorted(new):
+            nv = new[cfg].get("trace_overhead_pct")
+            if nv is None:
+                continue
+            check = {
+                "config": cfg,
+                "metric": "trace_overhead_ceiling",
+                "old": old.get(cfg, {}).get(
+                    "trace_overhead_pct", 0.0
+                ),
+                "new": nv,
+                "delta_pct": None,
+                "limit_pct": args.max_trace_overhead,
+                "regressed": nv > args.max_trace_overhead,
             }
             result["checks"].append(check)
             if check["regressed"]:

@@ -108,6 +108,15 @@ _SLOW_TESTS = {
     "test_fuzz_differential_incremental_seed",
     "test_multicycle_table_growth_within_padding_rebinds",
     "test_multicycle_growth_reencode_reuses_interned_entries",
+    # tier-1 headroom re-survey (ISSUE 17 --durations audit): the four
+    # slowest fast-tier tests, each a compile-bound integration drive
+    # (92 s dominance-group claims, 69 s shard-invariance digest, 26 s
+    # 8-device dryrun, 23 s randomized preemption differential) — the
+    # properties they prove have faster fast-tier siblings
+    "test_eight_slot_claims_via_dominance_groups",
+    "test_scheduler_shard_devices_bind_stream_and_digest_invariant",
+    "test_dryrun_multichip_8",
+    "test_randomized_differential_preemption",
 }
 _SLOW_MODULES = {"tests.test_concurrency"}
 

@@ -1482,6 +1482,86 @@ def test_inventory_drift_code_table_id009(tmp_path):
     )
 
 
+# ---- ID010: the span-name inventory pin ----------------------------------
+
+
+def test_inventory_drift_span_names_id010(tmp_path):
+    """ID010: spans.SPAN_NAMES, the metrics docstring entry for
+    scheduler_trace_spans_total, and the README '## Distributed
+    tracing' span table cannot drift — a span stamped but undocumented
+    is invisible to the operator reading the trace."""
+    result = lint_fixture(tmp_path, {
+        # a NEW span "mystery.span" joined the inventory...
+        "core/spans.py": """\
+            SPAN_NAMES = (
+                "submit.validate",
+                "bind.confirm",
+                "mystery.span",
+            )
+        """,
+        # ...the metrics docstring never heard of it...
+        "metrics/metrics.py": '''\
+            """Metric families.
+
+            - scheduler_trace_spans_total{name}: spans recorded by
+              name: submit.validate | bind.confirm
+            - scheduler_decisions_total: decisions
+            """
+        ''',
+        # ...and the README table dropped bind.confirm instead
+        "README.md": """\
+            # fixture
+
+            ## Distributed tracing
+
+            | `submit.validate` | validation |
+            | `mystery.span` | ??? |
+        """,
+    }, passes=["INVENTORY-DRIFT"])
+    msgs = [f.message for f in codes_at(result, "ID010")]
+    assert any(
+        "'mystery.span'" in m and "metrics docstring" in m for m in msgs
+    )
+    assert any(
+        "'bind.confirm'" in m and "README" in m for m in msgs
+    )
+    assert len(msgs) == 2
+
+    # a consistent tree lints clean
+    clean = lint_fixture(tmp_path / "clean", {
+        "core/spans.py":
+            'SPAN_NAMES = ("submit.validate", "bind.confirm")\n',
+        "metrics/metrics.py":
+            '"""M.\n\n- scheduler_trace_spans_total{name}:\n'
+            '  submit.validate | bind.confirm\n"""\n',
+        "README.md":
+            "## Distributed tracing\n\n"
+            "`submit.validate` then `bind.confirm`\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert codes_at(clean, "ID010") == []
+
+    # no literal SPAN_NAMES tuple: the anchor itself is flagged
+    anchorless = lint_fixture(tmp_path / "anchorless", {
+        "core/spans.py":
+            "SPAN_NAMES = tuple(n for n in ())\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "no literal SPAN_NAMES tuple" in f.message
+        for f in codes_at(anchorless, "ID010")
+    )
+
+    # a missing README section flags every span (nothing is documented)
+    sectionless = lint_fixture(tmp_path / "sectionless", {
+        "core/spans.py":
+            'SPAN_NAMES = ("submit.validate",)\n',
+        "README.md": "# no such section\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "Distributed tracing" in f.message
+        for f in codes_at(sectionless, "ID010")
+    )
+
+
 # ---- wall-clock satellites: parse cache, fingerprints, --changed ---------
 
 

@@ -1,0 +1,703 @@
+"""Pod-lifecycle tracing (core/spans.py + wiring): span ring
+semantics, W3C traceparent propagation, deterministic head sampling,
+the cross-thread trace join (submit thread -> serve thread -> bind),
+the unarmed-overhead bound, chrome/OTLP export, the /debug/traces +
+/debug/explain endpoints with the deprecated /debug/trace alias, and
+the bench_diff --max-trace-overhead ceiling."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from k8s_scheduler_tpu.cmd.httpserver import start_http_server
+from k8s_scheduler_tpu.config import SchedulerConfiguration
+from k8s_scheduler_tpu.core import spans as _spans
+from k8s_scheduler_tpu.core.scheduler import Scheduler
+from k8s_scheduler_tpu.core.spans import (
+    SPAN_NAMES,
+    SpanRecorder,
+    TraceContext,
+    export_otlp_dir,
+    format_traceparent,
+    parse_traceparent,
+    sampled,
+    spans_to_chrome_events,
+    to_otlp_json,
+)
+from k8s_scheduler_tpu.metrics import SchedulerMetrics
+from k8s_scheduler_tpu.service.admission import (
+    AdmissionController,
+    FrontDoor,
+)
+from k8s_scheduler_tpu.state import DurableState
+from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sched(state=None, binds=None, **cfg):
+    cfg.setdefault("pod_initial_backoff_seconds", 0.05)
+    cfg.setdefault("pod_max_backoff_seconds", 0.2)
+    binds = binds if binds is not None else {}
+    sched = Scheduler(
+        config=SchedulerConfiguration(**cfg),
+        binder=lambda p, n: binds.__setitem__(
+            p.uid, binds.get(p.uid, 0) + 1
+        ),
+        state=state,
+    )
+    return sched, binds
+
+
+def _ctx() -> TraceContext:
+    return TraceContext(_spans.new_trace_id(), _spans.new_span_id())
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_span_ring_bounds_and_wrap():
+    rec = SpanRecorder(capacity=8)
+    c = _ctx()
+    for i in range(20):
+        rec.record("dispatch", c, float(i), float(i) + 0.5, uid=f"u{i}")
+    assert rec.count == 20
+    spans = rec.snapshot()
+    # bounded at capacity, oldest-first, the newest window survives
+    assert len(spans) == 8
+    assert [s.seq for s in spans] == list(range(12, 20))
+    # last=N trims from the newest end
+    assert [s.seq for s in rec.snapshot(last=3)] == [17, 18, 19]
+    assert rec.for_uid("u19")[0].seq == 19
+    assert rec.for_uid("u0") == []  # overwritten by the wrap
+    # to_dicts is JSON-clean and rebased against the recorder epoch
+    json.dumps(rec.to_dicts(last=5))
+
+
+def test_span_snapshot_consistent_under_concurrent_writers():
+    """Snapshots taken while SEVERAL writer threads hammer the ring
+    (the real deployment shape: gRPC/HTTP submit workers + the serve
+    loop) must never contain torn windows: seqs strictly ascending,
+    all inside one capacity window, every span fully formed."""
+    rec = SpanRecorder(capacity=16)
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def writer(tag: str):
+        c = _ctx()
+        i = 0
+        while not stop.is_set():
+            rec.record(
+                "decision.row", c, float(i), float(i) + 0.1,
+                uid=f"{tag}-{i}",
+            )
+            i += 1
+
+    def reader():
+        for _ in range(2000):
+            spans = rec.snapshot()
+            seqs = [s.seq for s in spans]
+            if seqs != sorted(set(seqs)):
+                errors.append(f"non-ascending window {seqs}")
+                return
+            if seqs and seqs[0] <= seqs[-1] - rec.capacity:
+                errors.append(f"window wider than capacity {seqs}")
+                return
+            for s in spans:
+                if not s.trace_id or s.name != "decision.row":
+                    errors.append(f"torn span at seq {s.seq}")
+                    return
+
+    ws = [
+        threading.Thread(target=writer, args=(t,)) for t in ("a", "b", "c")
+    ]
+    rs = [threading.Thread(target=reader) for _ in range(2)]
+    for t in ws + rs:
+        t.start()
+    for t in rs:
+        t.join()
+    stop.set()
+    for t in ws:
+        t.join()
+    assert not errors, errors[0]
+    assert rec.count > 16  # the ring actually wrapped under test
+
+
+# ---------------------------------------------------------------------------
+# traceparent + sampling
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip_and_malformed_rejection():
+    tid, sid = _spans.new_trace_id(), _spans.new_span_id()
+    tp = format_traceparent(tid, sid)
+    assert tp == f"00-{tid}-{sid}-01"
+    assert parse_traceparent(tp) == (tid, sid)
+    # tolerant of case and surrounding whitespace (header transports)
+    assert parse_traceparent(f"  {tp.upper()}  ") == (tid, sid)
+    for bad in (
+        "",
+        "garbage",
+        f"01-{tid}-{sid}-01",  # unknown version
+        f"00-{tid[:-1]}-{sid}-01",  # short trace id
+        f"00-{tid}-{sid}",  # missing flags
+        f"00-{'0' * 32}-{sid}-01",  # all-zero trace id (spec invalid)
+        f"00-{tid}-{'0' * 16}-01",  # all-zero span id
+    ):
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_sampling_deterministic_and_rate_bounds():
+    uids = [f"pod-{i}" for i in range(2000)]
+    # deterministic: the same uid at the same rate always decides the
+    # same way (a shed retry keeps its sampling fate)
+    for u in uids[:50]:
+        assert sampled(u, 0.25) == sampled(u, 0.25)
+    assert all(sampled(u, 1.0) for u in uids)
+    assert not any(sampled(u, 0.0) for u in uids)
+    assert not any(sampled(u, -1.0) for u in uids)
+    # the coin is unbiased enough to be a rate: 2000 uids at 0.5
+    hits = sum(sampled(u, 0.5) for u in uids)
+    assert 800 < hits < 1200
+    # distinct uids decide independently (both outcomes occur at 1/64)
+    verdicts = {sampled(u, 1.0 / 64.0) for u in uids}
+    assert verdicts == {True, False}
+
+
+def test_register_idempotent_adopts_traceparent_and_releases():
+    _spans.arm(rate=1.0)
+    try:
+        c1 = _spans.register("uid-a")
+        assert c1 is not None
+        # idempotent: a duplicate submit keeps the original binding
+        assert _spans.register("uid-a") is c1
+        assert _spans.ctx_for("uid-a") is c1
+        # an explicit traceparent joins the CALLER's trace verbatim
+        tid, sid = _spans.new_trace_id(), _spans.new_span_id()
+        c2 = _spans.register("uid-b", format_traceparent(tid, sid))
+        assert (c2.trace_id, c2.span_id) == (tid, sid)
+        assert c2.traceparent() == format_traceparent(tid, sid)
+        # release drops the live join only
+        _spans.release("uid-a")
+        assert _spans.ctx_for("uid-a") is None
+        assert _spans.ctx_for("uid-b") is c2
+    finally:
+        _spans.disarm()
+    # disarm cleared the context map and the stamp-site flag
+    assert _spans.ctx_for("uid-b") is None
+    assert _spans.register("uid-c") is None  # unarmed: no binding
+
+
+def test_rate_zero_still_joins_explicit_traceparent():
+    """Head sampling gates LOCAL trace starts only: a caller that
+    already carries a trace always gets its spans, whatever the armed
+    rate — that is what makes traceparent an operator debugging tool."""
+    _spans.arm(rate=0.0)
+    try:
+        assert _spans.register("uid-z") is None
+        tid, sid = _spans.new_trace_id(), _spans.new_span_id()
+        c = _spans.register("uid-z", format_traceparent(tid, sid))
+        assert c is not None and c.trace_id == tid
+    finally:
+        _spans.disarm()
+
+
+# ---------------------------------------------------------------------------
+# overhead: the unarmed fast path
+# ---------------------------------------------------------------------------
+
+
+def _guard_cost_s(n: int) -> float:
+    """Wall time of `n` unarmed stamp-site guards (`if _spans.ARMED`)
+    — exactly the bytecode every hot site pays when tracing is off."""
+    sink = 0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if _spans.ARMED:
+            sink += 1
+    dt = time.perf_counter() - t0
+    assert sink == 0
+    return dt
+
+
+def test_unarmed_overhead_below_one_percent():
+    """ISSUE 17's <1% bound, measured structurally rather than as a
+    flaky A/B latency diff: a pod's whole life crosses ~8 stamp sites,
+    so the unarmed tax on N pods is N*8 guard evaluations — time those
+    directly and compare against the REAL submit+cycle cost of the
+    same N pods."""
+    assert not _spans.ARMED
+    sched, _binds = _sched()
+    adm = AdmissionController(sched, queue_depth=10_000)
+    adm.node_churn(adds=make_cluster(8))
+    # warm-up: pay the first-compile outside the measured window
+    assert adm.submit(make_pods(8, seed=70, name_prefix="warm-")).ok
+    sched.schedule_cycle()
+    n = 100
+    pods = make_pods(n, seed=71, name_prefix="ovh-")
+    t0 = time.perf_counter()
+    for i in range(0, n, 4):
+        assert adm.submit(pods[i:i + 4]).ok
+    sched.schedule_cycle()
+    lifecycle_s = time.perf_counter() - t0
+    guard_s = min(_guard_cost_s(n * 8) for _ in range(5))
+    assert guard_s < 0.01 * lifecycle_s, (
+        f"unarmed guards cost {guard_s * 1e6:.1f}us for {n} pods vs "
+        f"{lifecycle_s * 1e3:.1f}ms submit+cycle — over the 1% budget"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the cross-thread trace join: Submit -> serve -> bind, one trace
+# ---------------------------------------------------------------------------
+
+
+def test_cross_thread_trace_join_submit_to_bind(tmp_path):
+    """Spans stamped on the submit thread (validate/journal/ack), the
+    serve thread (buffer wait, dispatch, decision row, apply fold,
+    bind confirm) and the WAL writer's barrier must all land in ONE
+    trace — the caller's, when an explicit traceparent rode the
+    Submit — with the registration span id as every span's parent."""
+    st = DurableState(str(tmp_path), snapshot_interval_seconds=0)
+    sched, binds = _sched(
+        state=st, multi_cycle_k=4, multi_cycle_max_wait_ms=1e6
+    )
+    adm = AdmissionController(sched, queue_depth=100)
+    adm.node_churn(adds=make_cluster(4))
+    fd = FrontDoor(adm)
+    tid, sid = _spans.new_trace_id(), _spans.new_span_id()
+    tp = format_traceparent(tid, sid)
+    rec = _spans.arm(rate=1.0)
+    try:
+        fd.start()
+        pods = make_pods(4, seed=72, name_prefix="tj-")
+        result: dict = {}
+
+        def submit():
+            result["res"] = adm.submit(pods, traceparent=tp)
+
+        t = threading.Thread(target=submit)
+        t.start()
+        t.join()
+        res = result["res"]
+        assert res.ok and res.durable
+        # the effective traceparent echoes back to the submitter
+        assert res.traceparent == tp
+        deadline = time.time() + 60.0
+        while len(binds) < 4 and time.time() < deadline:
+            time.sleep(0.02)
+        fd.stop()
+    finally:
+        _spans.disarm()
+    assert len(binds) == 4
+    spans = rec.snapshot()
+    assert spans, "no spans recorded"
+    # one trace: every span joined the caller's trace id, and every
+    # span is a direct child of the registration parent (flat tree)
+    assert {s.trace_id for s in spans} == {tid}
+    assert {s.parent for s in spans} == {sid}
+    assert {s.name for s in spans} <= set(SPAN_NAMES)
+    names = {s.name for s in spans}
+    assert {
+        "submit.validate", "submit.journal", "ack.barrier",
+        "mc.buffer_wait", "dispatch", "decision.row", "apply.fold",
+        "bind.confirm",
+    } <= names, f"missing lifecycle spans, got {sorted(names)}"
+    # every pod's life is individually complete
+    for p in pods:
+        mine = {s.name for s in spans if s.attrs.get("uid") == p.uid}
+        assert {"submit.validate", "bind.confirm"} <= mine
+    # the ack barrier carries its group-commit join + durability
+    ack = [s for s in spans if s.name == "ack.barrier"]
+    assert all(s.attrs.get("durable") for s in ack)
+    assert all(s.attrs.get("flush_seq", -1) >= 0 for s in ack)
+    # serve-side spans carry the cycle-seq exemplar join, and the
+    # flight records carry the reverse trace_ids stamp
+    serve = [s for s in spans if s.name == "dispatch"]
+    assert all(s.attrs.get("seq", -1) >= 0 for s in serve)
+    traced_recs = [
+        r for r in sched.flight.snapshot() if tid in r.trace_ids
+    ]
+    assert traced_recs, "no flight record carries the trace exemplar"
+    # bind released the live context; the ring stays queryable by uid
+    assert _spans.ctx_for(pods[0].uid) is None
+    assert rec.for_uid(pods[0].uid)
+
+
+def test_tracing_on_off_streams_bit_identical():
+    """Satellite 3's fuzz spot check: replaying the same corpus trace
+    through the REAL Submit/NodeChurn API with tracing armed at rate
+    1.0 vs disarmed must leave the decision/bind streams bit-identical
+    — tracing observes the schedule, it must never perturb it."""
+    from k8s_scheduler_tpu.fuzz.corpus import load_artifact
+    from k8s_scheduler_tpu.fuzz.replay import (
+        _PER_CYCLE_KEYS,
+        replay_engine,
+    )
+
+    art = load_artifact(os.path.join(
+        REPO, "tests", "corpus", "attribution_static_dyn_split.json"
+    ))
+    trace = art["trace"]
+    eng_off = replay_engine(trace, via_api=True)
+    rec = _spans.arm(rate=1.0)
+    try:
+        eng_on = replay_engine(trace, via_api=True)
+    finally:
+        _spans.disarm()
+    assert not eng_off.failures and not eng_on.failures
+    assert len(eng_on.records) == len(eng_off.records)
+    for a, b in zip(eng_off.records, eng_on.records):
+        for key in _PER_CYCLE_KEYS + ("requeues", "rung"):
+            assert a[key] == b[key], (key, a["cycle"])
+    assert eng_on.binds == eng_off.binds
+    assert rec.count > 0  # the armed replay actually traced
+
+
+# ---------------------------------------------------------------------------
+# export: chrome tracks, OTLP-JSON, the rotated dump directory
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_events_tracks_and_merge():
+    assert spans_to_chrome_events([]) == []
+    rec = SpanRecorder(capacity=64)
+    c1, c2 = _ctx(), _ctx()
+    rec.record("dispatch", c1, 1.0, 1.5, uid="u1", seq=7)
+    rec.record("bind.confirm", c1, 1.5, 1.6, uid="u1", node="n1")
+    rec.record("dispatch", c2, 2.0, 2.2, uid="u2", seq=8)
+    events = spans_to_chrome_events(rec.snapshot(), epoch=1.0)
+    procs = [e for e in events if e["name"] == "process_name"]
+    assert procs == [{
+        "name": "process_name", "ph": "M",
+        "pid": _spans.TRACE_TRACK_PID, "args": {"name": "pod traces"},
+    }]
+    # one tid per trace, named by the trace's pods
+    tnames = {
+        e["args"]["name"] for e in events if e["name"] == "thread_name"
+    }
+    assert any("pod=u1" in n for n in tnames)
+    assert any("pod=u2" in n for n in tnames)
+    slices = [e for e in events if e["ph"] == "X"]
+    assert len(slices) == 3
+    assert all(e["cat"] == "pod-trace" for e in slices)
+    d = next(e for e in slices if e["name"] == "bind.confirm")
+    assert d["ts"] == pytest.approx(0.5e6)
+    assert d["dur"] == pytest.approx(0.1e6)
+    assert d["args"]["node"] == "n1" and d["args"]["parent"] == c1.span_id
+    # the two traces render on distinct tracks
+    assert len({e["tid"] for e in slices}) == 2
+
+    # and to_chrome_trace merges span tracks beside the cycle lanes
+    from k8s_scheduler_tpu.core.flight_recorder import (
+        FlightRecorder,
+        to_chrome_trace,
+    )
+
+    fr = FlightRecorder(capacity=8)
+    r = fr.start()
+    r.mark("dispatch_start", r.t_start + 0.001)
+    r.mark("decision_end", r.t_start + 0.004)
+    fr.commit(r)
+    trace = to_chrome_trace(fr.snapshot(), spans=rec.snapshot())
+    pids = {e.get("pid") for e in trace["traceEvents"]}
+    assert _spans.TRACE_TRACK_PID in pids  # span tracks present
+    assert len(pids) > 1  # alongside the cycle lanes
+
+
+def test_otlp_json_shape():
+    rec = SpanRecorder(capacity=8)
+    root = _ctx()
+    child = TraceContext(root.trace_id, _spans.new_span_id())
+    rec.record(
+        "submit.validate",
+        TraceContext(root.trace_id, ""),  # root: no parent
+        rec.epoch + 1.0, rec.epoch + 1.5, uid="u1",
+    )
+    rec.record(
+        "ack.barrier", child, rec.epoch + 1.5, rec.epoch + 2.0,
+        uid="u1", flush_seq=3, durable=True, frac=0.5,
+    )
+    out = to_otlp_json(
+        rec.snapshot(), rec.epoch, rec.wall_epoch, service_name="t"
+    )
+    json.dumps(out)  # JSON-clean
+    (rs,) = out["resourceSpans"]
+    attrs = rs["resource"]["attributes"]
+    assert {"key": "service.name", "value": {"stringValue": "t"}} in attrs
+    (ss,) = rs["scopeSpans"]
+    s_root, s_child = ss["spans"]
+    assert "parentSpanId" not in s_root  # root omits the parent key
+    assert s_child["parentSpanId"] == child.span_id
+    assert s_child["traceId"] == root.trace_id
+    assert s_child["kind"] == 1
+    # nanos anchor at the wall epoch; duration survives the rebase
+    t0 = int(s_child["startTimeUnixNano"])
+    t1 = int(s_child["endTimeUnixNano"])
+    assert t1 - t0 == pytest.approx(0.5e9)
+    assert t0 == pytest.approx((rec.wall_epoch + 1.5) * 1e9, rel=1e-6)
+    # attrs map to typed OTLP values
+    by_key = {a["key"]: a["value"] for a in s_child["attributes"]}
+    assert by_key["uid"] == {"stringValue": "u1"}
+    assert by_key["flush_seq"] == {"intValue": "3"}
+    assert by_key["durable"] == {"boolValue": True}
+    assert by_key["frac"] == {"doubleValue": 0.5}
+
+
+def test_export_otlp_dir_sequence_and_rotation(tmp_path):
+    d = str(tmp_path / "otlp")
+    rec = SpanRecorder(capacity=64)
+    assert export_otlp_dir(rec, d) is None  # empty ring: no file
+    c = _ctx()
+    for i in range(20):
+        rec.record("dispatch", c, float(i), float(i) + 0.1, uid=f"u{i}")
+    p0 = export_otlp_dir(rec, d)
+    p1 = export_otlp_dir(rec, d)
+    assert os.path.basename(p0) == "spans-000000.json"
+    assert os.path.basename(p1) == "spans-000001.json"
+    with open(p1) as f:
+        assert json.load(f)["resourceSpans"]
+    # a tiny budget rotates the OLDEST dumps out, never the new one
+    for _ in range(3):
+        newest = export_otlp_dir(rec, d, max_bytes=1)
+    left = sorted(os.listdir(d))
+    assert left == [os.path.basename(newest)]
+    assert newest.endswith("spans-000004.json")  # numbering continued
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface: /debug/traces, the alias, /debug/explain
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _request(url, method):
+    req = urllib.request.Request(url, method=method)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _trace_server():
+    """A server with 3 committed cycles, one pod timeline, and a span
+    ring holding two traces (uid-1 in cycle 2, uid-2 in cycle 0)."""
+    from k8s_scheduler_tpu.core.flight_recorder import FlightRecorder
+
+    fr = FlightRecorder(capacity=16)
+    for _ in range(3):
+        r = fr.start()
+        r.mark("dispatch_start", r.t_start + 0.001)
+        r.mark("decision_end", r.t_start + 0.004)
+        fr.commit(r)
+    rec = SpanRecorder(capacity=64)
+    c1, c2 = _ctx(), _ctx()
+    rec.record("dispatch", c1, rec.epoch, rec.epoch + 0.01,
+               uid="uid-1", seq=2)
+    rec.record("bind.confirm", c1, rec.epoch + 0.01, rec.epoch + 0.02,
+               uid="uid-1", node="n1")
+    rec.record("dispatch", c2, rec.epoch, rec.epoch + 0.01,
+               uid="uid-2", seq=0)
+    timelines = {
+        "uid-1": {
+            "uid": "uid-1", "name": "pod-1", "state": "Pending",
+            "attempts": [
+                {"result": "Unschedulable", "plugin": "TaintToleration",
+                 "cycle": 1},
+                {"result": "Unschedulable", "plugin": "NodeResourcesFit",
+                 "cycle": 2},
+                {"result": "Unschedulable", "plugin": "TaintToleration",
+                 "cycle": 2},
+            ],
+            "events": [{"cycle": 1}, {"cycle": 2}],
+        }
+    }
+    server = start_http_server(
+        SchedulerMetrics(), port=0, recorder=fr,
+        pod_timeline=timelines.get, spans_recorder=rec,
+    )
+    return server, c1, c2
+
+
+def test_debug_traces_filters_and_deprecated_alias():
+    server, c1, c2 = _trace_server()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        st, headers, body = _get(f"{base}/debug/traces?last=8")
+        assert st == 200
+        assert "attachment" in headers["Content-Disposition"]
+        assert "Deprecation" not in headers  # canonical route
+        trace = json.loads(body)
+        slices = [
+            e for e in trace["traceEvents"]
+            if e.get("cat") == "pod-trace"
+        ]
+        assert len(slices) == 3  # both traces' spans merged in
+        # pod= slices spans to the pod and records to its cycles (the
+        # span seq exemplar keeps cycle 2 even without timeline events)
+        st, _, body = _get(f"{base}/debug/traces?pod=uid-1")
+        t = json.loads(body)
+        pod_slices = [
+            e for e in t["traceEvents"] if e.get("cat") == "pod-trace"
+        ]
+        assert {e["args"]["trace_id"] for e in pod_slices} == {c1.trace_id}
+        assert len(pod_slices) == 2
+        # trace= slices to one trace id
+        st, _, body = _get(f"{base}/debug/traces?trace={c2.trace_id}")
+        t = json.loads(body)
+        ids = {
+            e["args"]["trace_id"] for e in t["traceEvents"]
+            if e.get("cat") == "pod-trace"
+        }
+        assert ids == {c2.trace_id}
+        # a pod nobody ever saw is a 404
+        st, _, _ = _request(f"{base}/debug/traces?pod=ghost", "GET")
+        assert st == 404
+        # the deprecated alias: identical payload, deprecation headers
+        gs, gh, gbody = _get(f"{base}/debug/traces?last=8")
+        as_, ah, abody = _get(f"{base}/debug/trace?last=8")
+        assert (gs, as_) == (200, 200)
+        assert abody == gbody
+        assert ah["Deprecation"] == "true"
+        assert "successor-version" in ah["Link"]
+        assert "/debug/traces" in ah["Link"]
+    finally:
+        server.shutdown()
+
+
+def test_debug_explain_joined_verdict():
+    server, c1, _c2 = _trace_server()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        st, _, body = _request(f"{base}/debug/explain", "GET")
+        assert st == 400  # missing ?pod=
+        st, _, body = _request(f"{base}/debug/explain?pod=ghost", "GET")
+        assert st == 404
+        st, _, body = _get(f"{base}/debug/explain?pod=uid-1")
+        assert st == 200
+        v = json.loads(body)
+        # first-rejector attribution: each failed attempt charges the
+        # FIRST plugin that rejected the pod
+        assert v["first_rejector"] == "TaintToleration"
+        assert v["last_rejector"] == "TaintToleration"
+        assert v["reject_counts"] == {
+            "TaintToleration": 2, "NodeResourcesFit": 1,
+        }
+        assert v["state"] == "Pending" and len(v["attempts"]) == 3
+        # the span join: durations, totals, and the trace ids
+        assert v["trace_ids"] == [c1.trace_id]
+        names = {s["name"] for s in v["spans"]}
+        assert names == {"dispatch", "bind.confirm"}
+        assert v["span_totals_ms"]["dispatch"] == pytest.approx(10.0)
+    finally:
+        server.shutdown()
+
+
+def test_new_endpoints_head_and_mutations_405():
+    """HEAD/405 parity for every endpoint this PR added (the ISSUE 17
+    satellite): probes HEAD them, and mutating verbs stay refused."""
+    server, _c1, _c2 = _trace_server()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        for path in (
+            "/debug/traces?last=4",
+            "/debug/trace?last=4",
+            "/debug/explain?pod=uid-1",
+        ):
+            gs, _gh, gbody = _request(f"{base}{path}", "GET")
+            hs, hh, hbody = _request(f"{base}{path}", "HEAD")
+            assert (gs, hs) == (200, 200), path
+            assert hbody == b""  # HEAD: headers only
+            assert hh["Content-Length"] == str(len(gbody)), path
+        for path in ("/debug/traces", "/debug/explain"):
+            for method in ("POST", "PUT", "DELETE", "PATCH"):
+                st, headers, _ = _request(f"{base}{path}", method)
+                assert st == 405, (path, method)
+                assert headers["Allow"] == "GET, HEAD"
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bench_diff: the --max-trace-overhead ceiling
+# ---------------------------------------------------------------------------
+
+
+def _bench_diff(tmp_path, old_row, new_row, *extra):
+    for name, row in (("old.json", old_row), ("new.json", new_row)):
+        (tmp_path / name).write_text(json.dumps({"configs": [row]}))
+    return subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "bench_diff.py"),
+            *extra,
+            str(tmp_path / "old.json"), str(tmp_path / "new.json"),
+        ],
+        capture_output=True, text=True,
+    )
+
+
+def test_trace_overhead_pct_absorbs_fsync_bimodality():
+    sys.path.insert(0, REPO)
+    try:
+        import bench_suite
+    finally:
+        sys.path.remove(REPO)
+    f = bench_suite.trace_overhead_pct
+    # the measured rig flip: untraced stage lands the lucky fsync mode
+    # (0.34 ms ack p99), traced stage the slow one (4.5 ms) — same
+    # code, same disk. The naive p99 ratio reads +1219%; the floored
+    # axis must not count it (bind p50 barely moves)
+    assert f(0.341, 4.5, 18385.0, 18553.0) < 5.0
+    # and the reverse flip clamps at 0, never negative
+    assert f(4.361, 0.341, 18500.0, 18400.0) == 0.0
+    # a catastrophic ack regression (far past the jitter floor) still
+    # trips a 50% ceiling regardless of which mode the base landed in
+    assert f(0.341, 30.0, 18385.0, 18553.0) > 50.0
+    assert f(4.361, 30.0, 18385.0, 18553.0) > 50.0
+    # a serve-loop-serializing bug shows on the bind p50 axis plainly
+    assert f(4.0, 4.0, 10000.0, 40000.0) == pytest.approx(300.0)
+
+
+def test_bench_diff_trace_overhead_ceiling(tmp_path):
+    base = {"config": 9, "submit_ack_p99_ms": 5.0}
+    # under the ceiling: clean (the old side has no trov at all —
+    # pre-PR artifacts must keep diffing against traced ones)
+    r = _bench_diff(
+        tmp_path, dict(base), dict(base, trace_overhead_pct=12.0),
+        "--max-trace-overhead", "50",
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "trace_overhead_ceiling" in r.stdout
+    # over the ceiling: the absolute gate trips on the NEW artifact
+    r = _bench_diff(
+        tmp_path, dict(base), dict(base, trace_overhead_pct=80.0),
+        "--max-trace-overhead", "50",
+    )
+    assert r.returncode == 1
+    assert "REGRESSED" in r.stdout
+    # 0 disables the gate entirely
+    r = _bench_diff(
+        tmp_path, dict(base), dict(base, trace_overhead_pct=80.0),
+        "--max-trace-overhead", "0",
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    # artifacts without the metric (both sides pre-PR) diff clean
+    r = _bench_diff(tmp_path, dict(base), dict(base))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "trace_overhead_ceiling" not in r.stdout
