@@ -55,6 +55,7 @@ def default_registry() -> PassRegistry:
     from .races import RacesPass
     from .robustness import RobustnessPass
     from .shard_safety import ShardSafetyPass
+    from .tenancy_isolation import TenancyIsolationPass
     from .threads import ThreadsPass
     from .trace_safety import TraceSafetyPass
 
@@ -69,6 +70,7 @@ def default_registry() -> PassRegistry:
         ThreadsPass,
         RacesPass,
         ShardSafetyPass,
+        TenancyIsolationPass,
     ):
         r.register(cls.name, lambda args, _cls=cls: _cls(args))
     return r

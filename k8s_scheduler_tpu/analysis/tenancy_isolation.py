@@ -1,0 +1,62 @@
+"""TENANCY-ISOLATION (TN0xx): the cross-tenant state boundary.
+
+tenancy/registry.py keeps every per-tenant container — nodes, pending
+and bound pods, the per-tenant encoder and its arena buffers — behind
+`_tn_`-prefixed attributes, and tests/test_tenancy.py proves
+dynamically that no tenant's decisions depend on another's state (the
+packed N-tenant run is bit-equal per tenant to N sequential runs).
+That property only holds while nothing OUTSIDE the tenancy package
+reaches into a tenant's slices: a core/framework/service code path
+reading another tenant's arena row or queue would be invisible to the
+equivalence suite the day its inputs happen to match, and a capacity
+or affinity leak the day they don't.
+
+This pass pins the boundary statically: any `_tn_*` attribute access
+(read or write) in a module outside `k8s_scheduler_tpu/tenancy/` is a
+finding. Name-based and deliberately over-approximate, like the
+sibling passes — the prefix is the contract, so the fix is to go
+through TenantRegistry's public API (or to move the code into
+tenancy/), never to rename the attribute.
+
+- TN001  `_tn_*` tenant-state attribute accessed outside tenancy/
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintContext
+from .registry import PassBase
+
+_TENANCY_PREFIX = "k8s_scheduler_tpu/tenancy/"
+
+
+class TenancyIsolationPass(PassBase):
+    name = "TENANCY-ISOLATION"
+    codes = {
+        "TN001": (
+            "per-tenant state (_tn_* attribute) accessed outside "
+            "the tenancy package"
+        ),
+    }
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in ctx.files:
+            if sf.rel.startswith(_TENANCY_PREFIX):
+                continue
+            for node in sf.walk():
+                if not isinstance(node, ast.Attribute):
+                    continue
+                if not node.attr.startswith("_tn_"):
+                    continue
+                findings.append(Finding(
+                    sf.rel, node.lineno, "TN001",
+                    f"access to tenant-private attribute "
+                    f"{node.attr!r} outside tenancy/ crosses the "
+                    "virtual-cluster isolation boundary (the "
+                    "bit-equality property tests/test_tenancy.py "
+                    "checks dynamically): go through the "
+                    "TenantRegistry API instead",
+                ))
+        return findings

@@ -809,6 +809,59 @@ def build_packed_cycle_fn(spec, **kw):
     )
 
 
+def build_arena_cycle_fn(spec, **kw):
+    """The MULTI-TENANT arena program: a vmapped build_packed_cycle_fn.
+    Takes STACKED packed buffers (u32 [T, W], u8 [T, B]) — one row per
+    virtual cluster, all sharing one pad regime (`spec`) — and returns a
+    CycleResult whose every field carries a leading tenant axis. One
+    compiled program, one compile-cache entry, schedules every tenant in
+    the stack per dispatch; tenant count T is baked into the trace, so
+    the arena packer (tenancy/arena.py) pads T to pow2 buckets to keep
+    the set of executables small and churn-stable.
+
+    The per-row op chain is the EXACT `_make_cycle_body` chain of a
+    single packed dispatch — the per-tenant bit-equality contract
+    (tests/test_tenancy.py: packed N-tenant run == N sequential
+    single-tenant runs) rests on vmap's batching rules preserving each
+    row's reduction/sort/scan structure. Zero-filled pad rows unpack to
+    all-invalid snapshots and decide nothing; callers discard them.
+
+    `stable` precomputes are not supported here: they are per-tenant
+    state and stacking them would tie every tenant's stable regime to
+    the bucket's — the small-snapshot arena regime recomputes them
+    in-trace instead."""
+    from ..models import packing
+
+    fw = kw.get("framework") or Framework.from_config()
+    commit_mode = kw.get("commit_mode", "scan")
+    if commit_mode == "rounds":
+        fw.check_batched_parity()
+    cycle = _make_cycle_body(
+        fw,
+        kw.get("gang_scheduling", True),
+        commit_mode,
+        kw.get("max_rounds", 64),
+        kw.get("percentage_of_nodes_to_score", 0),
+        kw.get("rounds_kw"),
+        kw.get("outputs", "full"),
+    )
+
+    def row(wbuf, bbuf):
+        return cycle(packing.unpack(wbuf, bbuf, spec), None)
+
+    def arena(wbufs, bbufs):
+        return jax.vmap(row)(wbufs, bbufs)
+
+    scalars = {k: v for k, v in kw.items() if k != "framework"}
+    return _jit(
+        arena, "arena_cycle",
+        disc=(
+            repr(spec.key()) + repr(sorted(scalars.items()))
+            + _fw_disc(kw.get("framework"))
+        ),
+    )
+
+
 def build_packed_multicycle_fn(
     spec,
     framework: Framework | None = None,

@@ -137,6 +137,13 @@ class CycleRecord:
     # Stamped only when tracing is armed AND a sampled pod rode the
     # cycle; empty tuple otherwise (and omitted from to_dict).
     trace_ids: tuple = ()
+    # virtual cluster this cycle scheduled for (tenancy/): stamped by
+    # _commit_record when the scheduler runs tenant-scoped (the
+    # sequential per-tenant reference path); "" = single-tenant, and
+    # omitted from to_dict. Arena-mode attribution rides the tenancy
+    # metrics + span attrs instead — one record per tenant would undo
+    # the batching the arena exists for.
+    tenant: str = ""
 
     def mark(self, name: str, t: float) -> None:
         self.marks[name] = t
@@ -171,6 +178,10 @@ class CycleRecord:
             **(
                 {"trace_ids": list(self.trace_ids)}
                 if self.trace_ids else {}
+            ),
+            **(
+                {"tenant": self.tenant}
+                if self.tenant else {}
             ),
         }
 

@@ -125,6 +125,13 @@ ANOMALY_CLASSES = (
     # speculation off for the profile for `spec_hold_cycles` cycles
     # (the scheduler consults speculation_ok before speculating).
     "speculation_thrash",
+    # a tenant with pending demand bound NOTHING for `starve_after`
+    # consecutive arena cycles while other tenants bound — raised
+    # externally by tenancy/arena.py (the schedule-side unfairness the
+    # per-tenant bit-equality property cannot see; admission's
+    # weighted-fair shed is the intake-side guard). The detail carries
+    # the tenant id, its pending depth, and the streak length.
+    "tenant_starved",
 )
 
 # Fixed log-ish bucket edges (seconds) for the streaming phase

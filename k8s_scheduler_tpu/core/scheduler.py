@@ -113,7 +113,12 @@ class Scheduler:
         # durable queue/cache journal + snapshots; attach() below
         # restores any existing state BEFORE the first cycle (the
         # standby-takeover path) and starts journaling mutations
+        tenant_id: str = "",  # non-empty when this scheduler serves ONE
+        # virtual cluster (the tenancy sequential reference path):
+        # stamped on every flight record so per-tenant traces, SLO burn
+        # and /debug joins attribute to the right tenant
     ) -> None:
+        self.tenant_id = str(tenant_id)
         self.config = config or SchedulerConfiguration()
         # one Framework per profile (SURVEY.md §2 C12 / §5.6: multiple
         # schedulers by schedulerName); pods route by
@@ -2583,6 +2588,8 @@ class Scheduler:
 
         rec.slot = int(st.get("slot", -1))
         rec.forced_sync = bool(self.forced_sync)
+        if self.tenant_id:
+            rec.tenant = self.tenant_id
         # absolute pipeline marks (same perf_counter clock as the
         # recorder) -> trace lanes; "t_dispatch_start" -> mark
         # "dispatch_start" etc.

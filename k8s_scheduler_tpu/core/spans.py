@@ -139,10 +139,14 @@ class TraceContext:
     """A pod's binding to a trace, created at admission. `span_id` is
     the parent every span emitted for the pod names: a locally minted
     root id for head-sampled pods, the caller's span id when an
-    explicit traceparent joined us to an existing trace."""
+    explicit traceparent joined us to an existing trace. `tenant` is
+    the pod's virtual cluster ("" in single-tenant mode): every span
+    recorded under the context inherits it as a `tenant` attr, so one
+    trace view shows per-tenant lanes."""
 
     trace_id: str
     span_id: str
+    tenant: str = ""
 
     def traceparent(self) -> str:
         return format_traceparent(self.trace_id, self.span_id)
@@ -230,7 +234,11 @@ class SpanRecorder:
         **attrs: Any,
     ) -> Span:
         """Record one completed span under `ctx` (parent = the
-        context's root/caller span id)."""
+        context's root/caller span id). A tenant-scoped context stamps
+        its tenant on every span it records — one stamp site, so no
+        emitter can forget the attribution."""
+        if ctx.tenant and "tenant" not in attrs:
+            attrs["tenant"] = ctx.tenant
         span = Span(
             trace_id=ctx.trace_id,
             span_id=new_span_id(),
@@ -347,9 +355,13 @@ def now() -> float:
 # ---- context registry (the cross-thread trace join) ----------------------
 
 
-def register(uid: str, traceparent: str = "") -> "TraceContext | None":
+def register(
+    uid: str, traceparent: str = "", tenant: str = ""
+) -> "TraceContext | None":
     """Bind `uid` to a trace at admission: join the caller's trace
     when `traceparent` parses, else head-sample at the armed rate.
+    `tenant` names the pod's virtual cluster (multi-tenant front door;
+    "" otherwise) and rides the context onto every recorded span.
     Returns the context (None = unsampled or unarmed). Idempotent for
     an already-registered uid (a duplicate submit keeps the original
     binding)."""
@@ -362,9 +374,11 @@ def register(uid: str, traceparent: str = "") -> "TraceContext | None":
         ctx = _contexts.get(uid)
         if ctx is None:
             if parsed is not None:
-                ctx = TraceContext(*parsed)
+                ctx = TraceContext(*parsed, tenant=tenant)
             else:
-                ctx = TraceContext(new_trace_id(), new_span_id())
+                ctx = TraceContext(
+                    new_trace_id(), new_span_id(), tenant=tenant
+                )
             _contexts[uid] = ctx
             if len(_contexts) > _MAX_CONTEXTS:
                 # drop the oldest insertion (dicts iterate in order)
@@ -424,12 +438,16 @@ def spans_to_chrome_events(
     events: "list[dict]" = []
     tids: "dict[str, int]" = {}
     uids: "dict[str, set]" = {}
+    tenants: "dict[str, set]" = {}
     spans = list(spans)
     for s in spans:
         tid = tids.setdefault(s.trace_id, len(tids) + 1)
         uid = s.attrs.get("uid")
         if uid:
             uids.setdefault(s.trace_id, set()).add(uid)
+        tn = s.attrs.get("tenant")
+        if tn:
+            tenants.setdefault(s.trace_id, set()).add(tn)
     if not tids:
         return events
     events.append(
@@ -442,13 +460,19 @@ def spans_to_chrome_events(
     )
     for trace_id, tid in tids.items():
         pods = ",".join(sorted(uids.get(trace_id, ()))) or "?"
+        # tenant-scoped traces lead with the tenant so Perfetto's
+        # track list groups one virtual cluster's lanes together
+        tn = ",".join(sorted(tenants.get(trace_id, ())))
+        prefix = f"tenant {tn} " if tn else ""
         events.append(
             {
                 "name": "thread_name",
                 "ph": "M",
                 "pid": TRACE_TRACK_PID,
                 "tid": tid,
-                "args": {"name": f"trace {trace_id[:8]} pod={pods}"},
+                "args": {
+                    "name": f"{prefix}trace {trace_id[:8]} pod={pods}"
+                },
             }
         )
         events.append(

@@ -25,6 +25,13 @@ from .replay import (  # noqa: F401
     replay_oracle,
     run_api_case,
     run_case,
+    run_tenant_case,
 )
 from .shrink import shrink_trace  # noqa: F401
-from .trace import Trace, generate_trace, trace_from_dict, trace_to_dict  # noqa: F401
+from .trace import (  # noqa: F401
+    Trace,
+    generate_multitenant_trace,
+    generate_trace,
+    trace_from_dict,
+    trace_to_dict,
+)

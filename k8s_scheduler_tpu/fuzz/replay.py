@@ -922,6 +922,107 @@ def run_api_case(trace: Trace) -> list[Failure]:
     return failures
 
 
+def _tenant_registry(trace: Trace):
+    """One fresh TenantRegistry per replay side — fresh objects too
+    (the engine mutates pods in place, same rule as materialize)."""
+    from ..state.codec import node_from_state
+    from ..tenancy import TenantRegistry
+
+    reg = TenantRegistry()
+    for tid, cfg in sorted(trace.config["tenancy"]["tenants"].items()):
+        reg.create(
+            tid, quota=int(cfg.get("quota", 0)),
+            weight=float(cfg.get("weight", 1.0)),
+        )
+    for d in trace.nodes:
+        n = node_from_state(d)
+        reg.add_node(n.metadata.namespace, n)
+    return reg
+
+
+def run_tenant_case(
+    trace: Trace, *, bug: "str | None" = None
+) -> list[Failure]:
+    """Replay one multi-tenant trace (generate_multitenant_trace)
+    through the packed arena AND the per-tenant sequential reference,
+    and require each tenant's decision stream bit-equal between the
+    two — the isolation property: no tenant's placements may depend on
+    which other tenants share its bucket. Also checks the decision
+    streams never cross tenants (a decision's pod uid must carry its
+    tenant's namespace). `bug="tenant_row_skew"` arms the arena's
+    deliberate cross-tenant leak (rolling result rows within a bucket)
+    for harness self-tests — the differential must CATCH it."""
+    from ..state.codec import node_from_state, pod_from_state
+    from ..tenancy import MultiTenantArena, TenantError
+
+    kw = dict(
+        commit_mode=trace.config.get("commit_mode", "scan"),
+        gang_scheduling=bool(trace.config.get("gang_scheduling", True)),
+    )
+    regs = (_tenant_registry(trace), _tenant_registry(trace))
+    packed = MultiTenantArena(regs[0], **kw)
+    seq = MultiTenantArena(regs[1], sequential=True, **kw)
+    if bug == "tenant_row_skew":
+        packed.inject = "row_skew"
+    elif bug is not None:
+        raise ValueError(f"unknown tenant-case bug {bug!r}")
+
+    failures: list[Failure] = []
+    for ci, evs in enumerate(trace.cycles):
+        for ev in evs:
+            op = ev["op"]
+            for reg in regs:
+                # TenantError is a legal no-op during shrinking (the
+                # event that created the target may have been dropped);
+                # both sides raise identically, so skipping keeps them
+                # in lockstep
+                try:
+                    if op == "add_pod":
+                        reg.route(pod_from_state(ev["pod"]))
+                    elif op == "delete_pod":
+                        reg.remove_pod(ev["tenant"], ev["uid"])
+                    elif op == "suspend_tenant":
+                        reg.suspend(ev["tenant"])
+                    elif op == "resume_tenant":
+                        reg.resume(ev["tenant"])
+                    elif op == "add_node":
+                        n = node_from_state(ev["node"])
+                        reg.add_node(n.metadata.namespace, n)
+                    else:
+                        raise ValueError(
+                            f"unknown tenant-trace op {op!r}"
+                        )
+                except TenantError:
+                    continue
+        packed.run_cycle()
+        seq.run_cycle()
+        for tid, uid, _node in packed.last_decisions:
+            if not uid.startswith(f"{tid}/"):
+                failures.append(Failure(
+                    "tenant/cross_leak", ci,
+                    f"decision for tenant {tid!r} carries foreign pod "
+                    f"{uid!r}",
+                ))
+        by_t: dict[str, list] = {}
+        by_t_ref: dict[str, list] = {}
+        for tid, uid, node in packed.last_decisions:
+            by_t.setdefault(tid, []).append((uid, node))
+        for tid, uid, node in seq.last_decisions:
+            by_t_ref.setdefault(tid, []).append((uid, node))
+        if by_t != by_t_ref:
+            tid = next(
+                t for t in sorted(set(by_t) | set(by_t_ref))
+                if by_t.get(t) != by_t_ref.get(t)
+            )
+            failures.append(Failure(
+                "tenant/decision_divergence", ci,
+                f"tenant {tid!r} packed {by_t.get(tid)} != sequential "
+                f"{by_t_ref.get(tid)}",
+            ))
+            break  # registries diverged; later cycles are noise
+    return failures
+
+
 def run_case(
     trace: Trace, *, state_dir: str = "", bug: "str | None" = None
 ) -> list[Failure]:
@@ -944,7 +1045,13 @@ def run_case(
     additionally require the dispatched packed arenas byte-identical
     and the ingest path actually exercised (staged rows consumed at
     flush) — a variant that silently fell back to full rebuilds every
-    flush would otherwise be a permanent green."""
+    flush would otherwise be a permanent green.
+
+    Multi-tenant traces (config["tenancy"]) route to the arena-vs-
+    sequential differential instead (run_tenant_case) — same plain-data
+    trace format, same shrinker, same corpus, different oracle."""
+    if trace.config.get("tenancy"):
+        return run_tenant_case(trace, bug=bug)
     inc = bool(trace.config.get("incremental_encode")) and not trace.chaos
     arenas_on: list = []
     cap = _capture_arenas(arenas_on) if inc else contextlib.nullcontext()

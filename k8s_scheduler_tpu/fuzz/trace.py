@@ -288,6 +288,116 @@ def _gen_pod(
     return pod_to_state(b.obj())
 
 
+def _gen_tenant_node(rng: random.Random, tenant: str, name: str) -> dict:
+    n = MakeNode(name).capacity(
+        {"cpu": str(rng.choice((4, 8))),
+         "memory": f"{rng.choice((8, 16))}Gi", "pods": 110}
+    ).labels({
+        "topology.kubernetes.io/zone": rng.choice(ZONES),
+        "node-type": rng.choice(NODE_TYPES),
+    }).obj()
+    # virtual clusters own their nodes: tenant identity rides the
+    # namespace, uid stays namespace-qualified like every object
+    n.metadata.namespace = tenant
+    n.metadata.uid = f"{tenant}/{name}"
+    return node_to_state(n)
+
+
+def _gen_tenant_pod(rng: random.Random, tenant: str, name: str,
+                    created: float) -> dict:
+    """Deliberately inside the shared-shape envelope: requests, labels
+    and selectors from the SAME vocabulary every tenant draws from, no
+    affinity/volumes/gangs — tenant workloads must quantize into a
+    small set of PackSpec keys for the arena to stack them, and the
+    leak-injection self-test needs >= 2 tenants per bucket to have a
+    row to roll."""
+    b = (
+        MakePod(name, namespace=tenant)
+        .req({"cpu": f"{rng.choice((250, 500, 1000))}m",
+              "memory": f"{rng.choice((256, 512))}Mi"})
+        .labels({"app": rng.choice(APPS)})
+        .created(created)
+    )
+    if rng.random() < 0.25:
+        b.node_selector({"node-type": rng.choice(NODE_TYPES)})
+    return pod_to_state(b.obj())
+
+
+def generate_multitenant_trace(
+    seed: int, *, tenants: "int | None" = None
+) -> Trace:
+    """Multi-tenant arena scenario: N virtual clusters, each with its
+    own namespaced nodes and pod arrivals, plus tenant lifecycle churn
+    (suspend/resume, pod deletes). Replayed by `replay.run_tenant_case`
+    — the packed arena against the per-tenant sequential reference,
+    per-tenant decision streams bit-equal — NOT by the single-cluster
+    engine/oracle differential (`config["tenancy"]` is the routing
+    flag run_case dispatches on). Every tenant draws the same node
+    count and the same pod vocabulary so shapes quantize into shared
+    PackSpec keys; the same seed + kwargs reproduce the same trace."""
+    rng = random.Random(seed)
+    n_t = tenants if tenants is not None else rng.randint(2, 4)
+    tids = [f"team-{i}" for i in range(n_t)]
+    n_nodes = rng.randint(2, 6)  # one draw: same N pad bucket fleet-wide
+    nodes = [
+        _gen_tenant_node(rng, tid, f"{tid}-n{i}")
+        for tid in tids
+        for i in range(n_nodes)
+    ]
+    tenancy = {
+        tid: {"quota": 0, "weight": rng.choice((1.0, 1.0, 2.0))}
+        for tid in tids
+    }
+
+    n_cycles = rng.randint(3, 6)
+    cycles: list[list[dict]] = []
+    live: dict[str, list[str]] = {tid: [] for tid in tids}
+    suspended: set[str] = set()
+    uid_counter = 0
+    created = 0.0
+    for _c in range(n_cycles):
+        evs: list[dict] = []
+        for tid in tids:
+            if tid in suspended:
+                continue
+            for _ in range(rng.randint(0, 3)):
+                name = f"p{uid_counter}"
+                uid_counter += 1
+                evs.append({
+                    "op": "add_pod",
+                    "pod": _gen_tenant_pod(rng, tid, name, created),
+                })
+                created += 1.0
+                live[tid].append(f"{tid}/{name}")
+        r = rng.random()
+        if r < 0.15 and len(tids) - len(suspended) > 1:
+            tid = rng.choice([t for t in tids if t not in suspended])
+            suspended.add(tid)
+            evs.append({"op": "suspend_tenant", "tenant": tid})
+        elif r < 0.25 and suspended:
+            tid = rng.choice(sorted(suspended))
+            suspended.discard(tid)
+            evs.append({"op": "resume_tenant", "tenant": tid})
+        elif r < 0.35:
+            all_live = [(t, u) for t in tids for u in live[t]]
+            if all_live:
+                tid, u = all_live[rng.randrange(len(all_live))]
+                live[tid].remove(u)
+                evs.append({"op": "delete_pod", "tenant": tid, "uid": u})
+        cycles.append(evs)
+    cycles.extend([[], []])  # drain ticks: losers get their next cycle
+
+    config = {
+        "commit_mode": "scan",
+        "gang_scheduling": True,
+        "tenancy": {"tenants": tenancy},
+    }
+    return Trace(
+        seed=seed, config=config, nodes=nodes, pod_groups=[], pvcs=[],
+        pvs=[], storage_classes=[], pdbs=[], cycles=cycles, tick_s=0.0,
+    )
+
+
 def generate_trace(
     seed: int,
     *,

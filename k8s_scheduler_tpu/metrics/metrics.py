@@ -161,6 +161,21 @@ harness that drives them):
   submissions, including the WAL-before-ack group-fsync barrier (the
   durability contract's cost, paid off the scheduling hot path)
 
+Multi-tenant arena families (tenancy/ package — virtual-cluster
+lifecycle, per-tenant admission, and the batched arena dispatch):
+
+- scheduler_tenancy_events_total{event} — tenant-lifecycle and
+  per-tenant admission events (created | suspended | resumed |
+  deleted | quota_shed | fair_shed | starved); labels stay
+  event-typed, never tenant-id-typed, so a 1000-tenant fleet does not
+  explode the registry cardinality
+- scheduler_tenant_arena_dispatches_total — arena programs launched
+  (one per (pad-regime bucket, tenant-count bucket) per fleet cycle);
+  with scheduler_tenant_arena_tenants this gives tenants-per-dispatch,
+  the batching amortization the 1000-tenant headline bench gates
+- scheduler_tenant_arena_tenants — histogram of real (non-pad)
+  tenants packed per arena dispatch
+
 Tracing / build-identity families (core/spans.py span recorder +
 cmd/main.py startup stamp):
 
@@ -553,6 +568,28 @@ class SchedulerMetrics:
             "Submit-to-ack latency of accepted submissions, including "
             "the WAL-before-ack group-fsync barrier.",
             buckets=_DURATION_BUCKETS,
+            registry=r,
+        )
+        # ---- multi-tenant arena (tenancy/) ----
+        self.tenancy_events = Counter(
+            "scheduler_tenancy_events_total",
+            "Tenant-lifecycle and per-tenant admission events "
+            "(created | suspended | resumed | deleted | quota_shed | "
+            "fair_shed | starved); event-typed labels only, never "
+            "per-tenant ids.",
+            ["event"],
+            registry=r,
+        )
+        self.arena_dispatches = Counter(
+            "scheduler_tenant_arena_dispatches_total",
+            "Arena programs launched (one per pad-regime/tenant-count "
+            "bucket per fleet cycle).",
+            registry=r,
+        )
+        self.arena_tenants = Histogram(
+            "scheduler_tenant_arena_tenants",
+            "Real (non-pad) tenants packed per arena dispatch.",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
             registry=r,
         )
         # ---- pod-lifecycle tracing / build identity (core/spans.py) ----

@@ -106,9 +106,22 @@ class AdmissionController:
         queue_depth: int | None = None,  # None = config
         retry_after_ms: float | None = None,  # None = config
         max_tracked: int = _MAX_TRACKED,
+        tenants=None,  # tenancy.TenantRegistry | None
     ) -> None:
         self.scheduler = scheduler
         cfg = scheduler.config
+        # multi-tenant mode: a Submit carries its tenant in the pod
+        # namespace. Admission validates the tenant exists and is
+        # active (invalid otherwise — nothing journaled), and the shed
+        # predicate consults THAT tenant's accepted-unbound depth
+        # against its quota and weighted-fair share of the global
+        # bound, so one flooding tenant backpressures itself instead
+        # of starving the fleet's front door.
+        self.tenants = tenants
+        # uid -> tenant id for accepted-unbound pods; the per-tenant
+        # depth is its value multiset (kept as a counter dict)
+        self._tenant_of: dict[str, str] = {}
+        self._tenant_depth: dict[str, int] = {}
         self.depth_bound = int(
             cfg.admission_queue_depth if queue_depth is None
             else queue_depth
@@ -245,6 +258,39 @@ class AdmissionController:
                 queue_depth=self.queue_depth(),
                 traceparent=traceparent,
             )
+        # tenant validity: an unknown or suspended tenant is INVALID
+        # (a caller bug or a deliberate lockout), not backpressure —
+        # nothing journaled, no retry-after
+        if self.tenants is not None:
+            bad_t: list[str] = []
+            t_reason = ""
+            for p in pods:
+                t = self.tenants.get(p.namespace)
+                if t is None:
+                    bad_t.append(p.uid)
+                    t_reason = t_reason or (
+                        f"unknown tenant {p.namespace!r}"
+                    )
+                elif t.lifecycle != "active":
+                    bad_t.append(p.uid)
+                    t_reason = t_reason or (
+                        f"tenant {p.namespace!r} suspended"
+                    )
+            if bad_t:
+                with self._lock:
+                    self.invalid_total += len(pods)
+                    self._note_history(
+                        bad_t, "invalid", reason=t_reason
+                    )
+                m.admission_total.labels(outcome="invalid").inc(
+                    len(pods)
+                )
+                return SubmitResult(
+                    invalid=tuple(bad_t),
+                    reason=t_reason,
+                    queue_depth=self.queue_depth(),
+                    traceparent=traceparent,
+                )
         t_valid = _time.perf_counter()
         ctxs: list = []  # (uid, TraceContext) for sampled pods
         with self._lock:
@@ -275,6 +321,8 @@ class AdmissionController:
                 )
             depth = self.queue_depth()
             reason = self._shed_reason(depth, len(pods))
+            if not reason and self.tenants is not None:
+                reason = self._tenant_shed_reason(depth, pods)
             if reason:
                 self.shed_total += len(pods)
                 self.last_shed_reason = reason
@@ -300,13 +348,26 @@ class AdmissionController:
                 # releases, and its mc.buffer_wait/dispatch spans join
                 # the trace by uid lookup
                 if _spans.ARMED:
-                    c = _spans.register(p.uid, traceparent)
+                    c = _spans.register(
+                        p.uid, traceparent,
+                        tenant=(
+                            p.namespace
+                            if self.tenants is not None else ""
+                        ),
+                    )
                     if c is not None:
                         ctxs.append((p.uid, c))
                 self.scheduler.on_pod_add(p)
                 self._accept_t[p.uid] = now
+                if self.tenants is not None:
+                    tid = p.namespace
+                    self._tenant_of[p.uid] = tid
+                    self._tenant_depth[tid] = (
+                        self._tenant_depth.get(tid, 0) + 1
+                    )
             while len(self._accept_t) > self._max_tracked:
-                self._accept_t.popitem(last=False)
+                old_uid, _t = self._accept_t.popitem(last=False)
+                self._tenant_untrack(old_uid)
             self.accepted_total += len(pods)
             self._note_history(seen, "accepted", depth=depth)
             depth += len(pods)
@@ -394,6 +455,75 @@ class AdmissionController:
                 return reason
         return ""
 
+    def _tenant_shed_reason(self, depth: int, pods) -> str:
+        """Per-tenant backpressure (callers hold the lock; global shed
+        already passed). Two predicates, both scoped to the submitting
+        tenant so the reason names who to back off and why:
+
+        - **quota**: the tenant's accepted-unbound depth may not exceed
+          its configured ceiling (0 = unlimited). Absolute — fires at
+          any fleet load.
+        - **weighted-fair share**: under global pressure (the fleet
+          past half its depth bound), a tenant may not hold more than
+          `depth_bound * weight / total_active_weight` of the
+          admission queue. A flooding tenant saturates its share and
+          sheds; a light tenant's submissions keep landing — the
+          admission-side half of the starved-tenant story (the arena's
+          anomaly is the schedule-side half). Idle fleets skip the
+          share cap so a lone tenant stays work-conserving."""
+        tn = self.tenants
+        m = self.scheduler.metrics
+        by: dict[str, int] = {}
+        for p in pods:
+            by[p.namespace] = by.get(p.namespace, 0) + 1
+        pressured = (
+            self.depth_bound > 0
+            and depth + len(pods) > self.depth_bound // 2
+        )
+        for tid in sorted(by):
+            t = tn.get(tid)
+            if t is None:
+                continue  # tenant deleted after validation: not shed
+            n = by[tid]
+            tdepth = self._tenant_depth.get(tid, 0)
+            if t.quota > 0 and tdepth + n > t.quota:
+                m.tenancy_events.labels(event="quota_shed").inc()
+                return (
+                    f"tenant {tid} quota exceeded "
+                    f"({tdepth}+{n} > {t.quota})"
+                )
+            if pressured:
+                share = max(
+                    int(self.depth_bound * t.weight / tn.total_weight()),
+                    1,
+                )
+                if tdepth + n > share:
+                    m.tenancy_events.labels(event="fair_shed").inc()
+                    return (
+                        f"tenant {tid} over weighted-fair share "
+                        f"({tdepth}+{n} > {share} of "
+                        f"{self.depth_bound})"
+                    )
+        return ""
+
+    def _tenant_untrack(self, uid: str) -> None:
+        """Drop one uid from the per-tenant depth accounting (callers
+        hold the lock): bind, delete, or LRU eviction."""
+        tid = self._tenant_of.pop(uid, None)
+        if tid is None:
+            return
+        left = self._tenant_depth.get(tid, 0) - 1
+        if left > 0:
+            self._tenant_depth[tid] = left
+        else:
+            self._tenant_depth.pop(tid, None)
+
+    def tenant_depth(self, tenant_id: str) -> int:
+        """Accepted-unbound pods this controller tracks for a tenant
+        (the quota/fair-share denominator) — /debug surface."""
+        with self._lock:
+            return self._tenant_depth.get(tenant_id, 0)
+
     # ---- node churn -------------------------------------------------------
 
     def node_churn(self, adds=(), updates=(), deletes=()) -> bool:
@@ -427,6 +557,7 @@ class AdmissionController:
             t0 = self._accept_t.pop(uid, None)
             if t0 is None:
                 return
+            self._tenant_untrack(uid)
             lat_ms = max(self.scheduler._now() - t0, 0.0) * 1e3
             if lat_ms > self._bind_lat_ms:
                 self._bind_lat_ms = lat_ms
@@ -443,6 +574,7 @@ class AdmissionController:
         it). Must never raise — it sits on the informer path."""
         with self._lock:
             self._accept_t.pop(uid, None)
+            self._tenant_untrack(uid)
         # a deleted pod's trace is over — drop its live context (the
         # recorded spans stay in the ring for /debug queries)
         if _spans.ARMED:
@@ -489,6 +621,7 @@ class AdmissionController:
                 "pending_accepted": len(self._accept_t),
                 "last_shed_reason": self.last_shed_reason,
                 "closed": self._closed,
+                "tenant_depths": dict(self._tenant_depth),
             }
 
 

@@ -335,6 +335,10 @@ def pod_from_state(d: dict) -> Pod:
 def node_to_state(n: Node) -> dict:
     m = n.metadata
     meta: dict = {"n": m.name, "u": m.uid}
+    if m.namespace != "default":
+        # cluster-scoped in stock k8s, but virtual clusters own their
+        # nodes: tenant identity rides the namespace (tenancy/)
+        meta["ns"] = m.namespace
     if m.labels:
         meta["l"] = dict(m.labels)
     if m.creation_timestamp:
@@ -363,6 +367,7 @@ def node_from_state(d: dict) -> Node:
     return Node(
         metadata=ObjectMeta(
             name=m.get("n", ""),
+            namespace=m.get("ns", "default"),
             uid=m.get("u", ""),
             labels=dict(m.get("l", {})),
             creation_timestamp=m.get("ct", 0.0),

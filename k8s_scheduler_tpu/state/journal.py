@@ -108,6 +108,21 @@ def encode_record(op: str, t: float, data: dict) -> bytes:
 # fold, never a prefix of it (the per-record CRC covers all sub-ops).
 BATCH_OP = "batch"
 
+# Tenancy journal ops (tenancy/registry.py): a TenantRegistry journals
+# every virtual-cluster mutation — lifecycle (create/suspend/resume/
+# delete), membership (node/pod adds, removals), and binds — under
+# "tn."-prefixed ops into its OWN Journal directory, using this exact
+# wire format and the same emit-once clock discipline (JE001-003).
+# The streams never mix by construction: DurableState.restore_into
+# refuses unknown ops, and restore_registry refuses non-tn ops, so a
+# misconfigured shared directory fails loudly on the first replay
+# instead of silently cross-applying records.
+TENANCY_OP_PREFIX = "tn."
+TENANCY_OPS = (
+    "tn.create", "tn.suspend", "tn.resume", "tn.delete",
+    "tn.node", "tn.pod", "tn.unpod", "tn.bind",
+)
+
 
 def encode_batch_payload(ops: list) -> dict:
     """Payload dict for a batch record from [(op, t, data), ...]."""

@@ -1,0 +1,31 @@
+"""Multi-tenant arena: thousands of virtual clusters on one compiled
+program. See registry.py (tenant lifecycle + durability), arena.py
+(the batched dispatch), host.py (the front-door adapter)."""
+
+from .arena import ArenaPacker, MultiTenantArena, pow2_bucket
+from .host import TenantFrontHost
+from .registry import (
+    TENANT_ACTIVE,
+    TENANT_SUSPENDED,
+    Tenant,
+    TenantError,
+    TenantRegistry,
+    TenantSuspended,
+    UnknownTenant,
+    restore_registry,
+)
+
+__all__ = [
+    "ArenaPacker",
+    "MultiTenantArena",
+    "TenantFrontHost",
+    "TENANT_ACTIVE",
+    "TENANT_SUSPENDED",
+    "Tenant",
+    "TenantError",
+    "TenantRegistry",
+    "TenantSuspended",
+    "UnknownTenant",
+    "pow2_bucket",
+    "restore_registry",
+]

@@ -1,0 +1,258 @@
+"""The arena packer: one compiled program schedules every tenant.
+
+Each tenant's snapshot packs (models/packing.py) into two flat buffers
+whose layout is fully determined by its PackSpec key — and tenant
+workloads quantize into a SMALL set of keys, because the encoder
+already pads every dimension to pow2/bucketed sizes. The arena stacks
+same-key tenants' buffers along a leading batch axis (u32 [T, W] /
+u8 [T, B]) and dispatches core.cycle.build_arena_cycle_fn ONCE per
+(spec bucket, T bucket): one compile-cache entry, one pad regime, all
+tenants scheduled per dispatch. T is padded to pow2 with zero rows —
+a zero buffer unpacks to an all-invalid snapshot that decides nothing
+— so tenant churn moves between a handful of executables instead of
+recompiling.
+
+The per-row op chain is exactly the single-tenant packed program's
+(`_make_cycle_body` shared), which is what makes the isolation
+contract testable: a packed N-tenant run is BIT-EQUAL per tenant to N
+sequential single-tenant runs (tests/test_tenancy.py, including under
+the fuzz multi-tenant grammar). `MultiTenantArena.inject` exists for
+those tests: it plants a deliberate cross-tenant leak (rolling result
+rows within a bucket) so the property suite and the fuzz shrinker can
+prove they would catch one.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..core.cycle import build_arena_cycle_fn, build_packed_cycle_fn
+from .registry import Tenant, TenantError, TenantRegistry
+
+
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n (min 1): the tenant-count pad policy.
+    Buckets keep the executable set logarithmic in fleet size; zero
+    rows make the pad inert."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class ArenaPacker:
+    """Builds, caches, and dispatches arena programs. One entry per
+    (PackSpec.key(), padded tenant count): `builds` counts entries
+    created (= warmup compiles), `dispatches` counts launches — the
+    bench's zero-compiles-after-warmup gate is `builds` staying flat
+    while `dispatches` grows."""
+
+    def __init__(self, *, framework=None, commit_mode: str = "rounds",
+                 gang_scheduling: bool = True, max_rounds: int = 64) -> None:
+        self._kw = dict(
+            framework=framework,
+            commit_mode=commit_mode,
+            gang_scheduling=gang_scheduling,
+            max_rounds=max_rounds,
+        )
+        self._fns: dict = {}  # (spec_key, t_pad) -> arena fn
+        self.builds = 0
+        self.dispatches = 0
+        self.tenants_packed = 0
+
+    def fn_for(self, spec, t_pad: int):
+        key = (spec.key(), t_pad)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = build_arena_cycle_fn(spec, **self._kw)
+            self._fns[key] = fn
+            self.builds += 1
+        return fn
+
+    def dispatch(self, spec, bufs: "list[tuple]"):
+        """Stack [(wbuf, bbuf), ...] (all layout-compatible with
+        `spec`), pad T to its pow2 bucket, run the arena program.
+        Returns the batched CycleResult; rows >= len(bufs) are pad."""
+        t_real = len(bufs)
+        t_pad = pow2_bucket(t_real)
+        ws = np.zeros((t_pad, len(bufs[0][0])), np.uint32)
+        bs = np.zeros((t_pad, len(bufs[0][1])), np.uint8)
+        for i, (w, b) in enumerate(bufs):
+            ws[i] = w
+            bs[i] = b
+        fn = self.fn_for(spec, t_pad)
+        self.dispatches += 1
+        self.tenants_packed += t_real
+        return fn(ws, bs)
+
+
+class MultiTenantArena:
+    """The multi-tenant serve loop: encode every active tenant (delta
+    per tenant), group by spec key, one arena dispatch per (bucket,
+    T-pad), fold each row's decisions back into its tenant. In
+    `sequential=True` mode the same cycle runs one single-tenant
+    packed dispatch per tenant instead — the reference stream the
+    bit-equality property (and the headline bench) compares against."""
+
+    def __init__(self, registry: TenantRegistry, *, framework=None,
+                 commit_mode: str = "rounds", gang_scheduling: bool = True,
+                 max_rounds: int = 64, sequential: bool = False,
+                 observer=None, metrics=None, starve_after: int = 8) -> None:
+        self.registry = registry
+        self.sequential = sequential
+        self.packer = ArenaPacker(
+            framework=framework, commit_mode=commit_mode,
+            gang_scheduling=gang_scheduling, max_rounds=max_rounds,
+        )
+        self._seq_kw = dict(
+            framework=framework, commit_mode=commit_mode,
+            gang_scheduling=gang_scheduling, max_rounds=max_rounds,
+        )
+        self._seq_fns: dict = {}  # spec_key -> packed single-tenant fn
+        self.observer = observer
+        self.metrics = metrics
+        self.starve_after = int(starve_after)
+        self.on_bind = None  # callable(uid): admission bind-latency hook
+        self.cycle_seq = 0
+        # test-only fault injection ("row_skew"): roll decision rows
+        # within a bucket — a synthetic cross-tenant leak the property
+        # suite and the fuzz shrinker must catch
+        self.inject: str | None = None
+        self.last_decisions: list[tuple] = []
+
+    # ---- dispatch -------------------------------------------------------
+
+    def _seq_fn(self, spec):
+        key = spec.key()
+        fn = self._seq_fns.get(key)
+        if fn is None:
+            fn = build_packed_cycle_fn(spec, **self._seq_kw)
+            self._seq_fns[key] = fn
+        return fn
+
+    def run_cycle(self) -> dict:
+        """One fleet-wide scheduling cycle. Returns per-cycle stats;
+        the full decision stream (tenant_id, pod_uid, node_name|None)
+        is kept on `last_decisions` in (bucket, tenant, slot) order."""
+        self.cycle_seq += 1
+        # one consistent snapshot+encode under the registry lock; the
+        # fold below maps decisions through the CAPTURED pending order
+        # and node table, immune to concurrent admission traffic
+        work = self.registry.encode_active()
+
+        decisions: list[tuple] = []
+        bound_by: dict[str, int] = {}
+        dispatches = 0
+        # device window only (launch + decision fetch, np.asarray is
+        # the sync point): what the arena packing actually amortizes,
+        # vs the per-tenant host encode/fold both modes pay alike
+        device_s = 0.0
+        if self.sequential:
+            for t, frame, pending, nodes in work:
+                t0 = time.perf_counter()
+                res = self._seq_fn(frame.spec)(frame.wbuf, frame.bbuf)
+                asg = np.asarray(res.assignment)
+                device_s += time.perf_counter() - t0
+                dispatches += 1
+                self._fold_row(
+                    t, pending, nodes, asg, decisions, bound_by,
+                )
+        else:
+            groups: dict = {}  # spec_key -> (canonical spec, items)
+            for item in work:
+                k = item[1].spec.key()
+                if k not in groups:
+                    groups[k] = (item[1].spec, [])
+                groups[k][1].append(item)
+            for spec, items in groups.values():
+                t0 = time.perf_counter()
+                res = self.packer.dispatch(
+                    spec, [(f.wbuf, f.bbuf) for _, f, _, _ in items]
+                )
+                asg = np.asarray(res.assignment)
+                device_s += time.perf_counter() - t0
+                dispatches += 1
+                if self.inject == "row_skew" and len(items) > 1:
+                    asg = np.roll(asg[: len(items)], 1, axis=0)
+                for i, (t, _frame, pending, nodes) in enumerate(items):
+                    self._fold_row(
+                        t, pending, nodes, asg[i], decisions, bound_by
+                    )
+            m = self.metrics
+            if m is not None:
+                for _spec, items in groups.values():
+                    m.arena_dispatches.inc()
+                    m.arena_tenants.observe(len(items))
+
+        self._note_starvation(bound_by)
+        self.last_decisions = decisions
+        bound = sum(bound_by.values())
+        return {
+            "cycle": self.cycle_seq,
+            "tenants": len(work),
+            "dispatches": dispatches,
+            "bound": bound,
+            "unschedulable": len(decisions) - bound,
+            "builds": self.packer.builds,
+            "device_s": device_s,
+        }
+
+    def _fold_row(self, tenant: Tenant, pending, nodes, asg_row,
+                  decisions: list, bound_by: dict) -> None:
+        """Fold one tenant's decision row: winners bind into the
+        tenant's virtual cluster (same nodes[assignment] mapping as the
+        scheduler's apply phase), losers stay pending for the next
+        cycle. `pending`/`nodes` are the encode-time captures from
+        encode_active — the decision slots index THOSE, not whatever
+        the live tenant holds by fold time. Slots >= the tenant's real
+        pending count are pad."""
+        for j, pod in enumerate(pending):
+            a = int(asg_row[j])
+            if 0 <= a < len(nodes):
+                node_name = nodes[a].name
+                try:
+                    self.registry.bind(tenant.id, pod.uid, node_name)
+                except TenantError:
+                    # the pod or tenant left between encode and fold
+                    # (delete/suspend raced the cycle): drop the
+                    # decision, nothing to roll back
+                    decisions.append((tenant.id, pod.uid, None))
+                    continue
+                bound_by[tenant.id] = bound_by.get(tenant.id, 0) + 1
+                if self.on_bind is not None:
+                    self.on_bind(pod.uid)
+                decisions.append((tenant.id, pod.uid, node_name))
+            else:
+                decisions.append((tenant.id, pod.uid, None))
+
+    def _note_starvation(self, bound_by: dict) -> None:
+        """A tenant with pending demand that binds nothing for
+        `starve_after` consecutive cycles WHILE other tenants bind is
+        starved — cross-tenant unfairness the per-tenant bit-equality
+        property cannot see (each tenant's stream is individually
+        correct). Raised once per streak through the observer so
+        /debug/anomalies is the one place to look."""
+        others_bound = bool(bound_by)
+        for t in self.registry.active():
+            if t.depth() == 0 or bound_by.get(t.id):
+                t.starve_streak = 0
+                continue
+            if not others_bound:
+                continue  # fleet-wide stall is not per-tenant starvation
+            t.starve_streak += 1
+            if t.starve_streak == self.starve_after:
+                if self.observer is not None:
+                    self.observer.raise_anomaly(
+                        "tenant_starved",
+                        seq=self.cycle_seq,
+                        profile=t.id,
+                        phase="arena",
+                        tenant=t.id,
+                        pending=t.depth(),
+                        streak=t.starve_streak,
+                    )
+                m = self.metrics
+                if m is not None:
+                    m.tenancy_events.labels(event="starved").inc()

@@ -1,0 +1,462 @@
+"""Tenant registry: thousands of virtual clusters in one scheduler.
+
+The ROADMAP's north star read at fleet scale is not one giant cluster
+but many SMALL ones — per-team, per-model, per-job virtual clusters.
+A `Tenant` here is a self-contained virtual cluster: its own nodes,
+its own pending/bound pods, and its OWN SnapshotEncoder, so the
+incremental-encode machinery works per tenant: the arena packer's
+per-cycle `encode_packed` is an O(dirty) delta against that tenant's
+arena (the existing-set identity precheck), not a fleet-wide rebuild.
+The encoder is serve-thread-owned, same as the single-cluster one —
+the admission path never touches it (see `_add_pod_locked`), and the
+arena snapshots + encodes under the registry lock (`encode_active`).
+
+Isolation boundary: every per-tenant container lives behind a `_tn_`-
+prefixed attribute. schedlint's TENANCY-ISOLATION pass (TN001) forbids
+touching `_tn_*` attributes outside this package — the static pin of
+the boundary tests/test_tenancy.py checks dynamically (a packed
+N-tenant run is bit-equal per tenant to N sequential runs, so no code
+path can have read another tenant's slice).
+
+Durability: the registry journals every mutation under `tn.*` ops into
+its OWN state.journal.Journal directory. DurableState.restore_into
+refuses unknown ops by design, so tenancy neither shares nor corrupts
+the scheduler WAL — `restore_registry(directory)` replays the tenancy
+directory and reconstructs every virtual cluster (pods, nodes, binds,
+quotas, suspensions) after failover. Emission follows the state/
+discipline (JE001-003): each public mutator reads the clock exactly
+once and emits exactly one record carrying that clock value.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable
+
+from ..models.api import Node, Pod
+from ..models.encoding import SnapshotEncoder
+from ..state import codec
+
+TENANT_ACTIVE = "active"
+TENANT_SUSPENDED = "suspended"
+
+# journal ops this registry emits; see state/journal.py TENANCY_OPS
+OP_CREATE = "tn.create"
+OP_SUSPEND = "tn.suspend"
+OP_RESUME = "tn.resume"
+OP_DELETE = "tn.delete"
+OP_NODE = "tn.node"
+OP_POD = "tn.pod"
+OP_UNPOD = "tn.unpod"
+OP_BIND = "tn.bind"
+
+
+class TenantError(ValueError):
+    """Base for tenant routing failures (admission maps these to an
+    invalid Submit outcome with a tenant-scoped reason)."""
+
+
+class UnknownTenant(TenantError):
+    pass
+
+
+class TenantSuspended(TenantError):
+    pass
+
+
+class Tenant:
+    """One virtual cluster. Mutated only through TenantRegistry (which
+    holds the lock and the journal); read freely via the accessors."""
+
+    def __init__(self, tenant_id: str, *, quota: int = 0,
+                 weight: float = 1.0) -> None:
+        self.id = str(tenant_id)
+        # admission ceiling on accepted-unbound pods; 0 = unlimited
+        self.quota = int(quota)
+        # weighted-fair share of the global admission depth bound
+        self.weight = float(weight)
+        # active/suspended; named `lifecycle` (not `state`) on purpose —
+        # the name `state` collides with the device keepers' `state`
+        # methods in schedlint's name-based callgraph, which would smear
+        # the HTTP role across the dispatch path (the admission.py
+        # `_durable` precedent)
+        self.lifecycle = TENANT_ACTIVE
+        # the virtual cluster proper — `_tn_` prefix IS the isolation
+        # boundary (TN001): nothing outside tenancy/ may touch these
+        self._tn_nodes: list[Node] = []
+        self._tn_node_names: set[str] = set()
+        self._tn_pending: dict[str, Pod] = {}  # uid -> pod, arrival order
+        self._tn_bound: dict[str, tuple[Pod, str]] = {}
+        self._tn_existing: tuple[tuple[Pod, str], ...] = ()
+        self._tn_encoder = SnapshotEncoder()
+        self.submitted_total = 0
+        self.bound_total = 0
+        # consecutive arena cycles with pending pods and zero binds
+        # while other tenants bound — the starved-tenant signal
+        self.starve_streak = 0
+
+    # ---- read side ------------------------------------------------------
+
+    def depth(self) -> int:
+        return len(self._tn_pending)
+
+    def node_count(self) -> int:
+        return len(self._tn_nodes)
+
+    def bound_count(self) -> int:
+        return len(self._tn_bound)
+
+    def pending_pods(self) -> list[Pod]:
+        return list(self._tn_pending.values())
+
+    def has_pod(self, uid: str) -> bool:
+        return uid in self._tn_pending or uid in self._tn_bound
+
+    def bound_node(self, uid: str) -> str | None:
+        entry = self._tn_bound.get(uid)
+        return entry[1] if entry else None
+
+    def encode_frame(self):
+        """Encode this tenant's snapshot into ITS arena buffers (delta
+        when only the pending set moved). Returns models.encoding
+        EncodedFrame. Serve-thread only, like the encoder itself."""
+        return self._tn_encoder.encode_packed(
+            self._tn_nodes,
+            list(self._tn_pending.values()),
+            self._tn_existing,
+        )
+
+    def status(self) -> dict:
+        return {
+            "id": self.id,
+            "state": self.lifecycle,
+            "quota": self.quota,
+            "weight": self.weight,
+            "nodes": len(self._tn_nodes),
+            "pending": len(self._tn_pending),
+            "bound": len(self._tn_bound),
+            "submitted_total": self.submitted_total,
+            "bound_total": self.bound_total,
+            "starve_streak": self.starve_streak,
+        }
+
+
+class TenantRegistry:
+    """Create/suspend/delete virtual clusters; route pods and nodes
+    into them; fold binds back. Thread-safe; journaled (see module
+    docstring). The arena packer (tenancy/arena.py) drives the
+    schedule side; service/admission.py consults quotas and depths."""
+
+    def __init__(self, *, metrics=None,
+                 now: Callable[[], float] = time.monotonic) -> None:
+        self._lock = threading.RLock()
+        self._tenants: dict[str, Tenant] = {}
+        self._journal: Callable[[str, float, dict], None] | None = None
+        self._now = now
+        self._metrics = metrics
+
+    def set_journal(
+        self, journal: Callable[[str, float, dict], None] | None
+    ) -> None:
+        with self._lock:
+            self._journal = journal
+
+    def _emit(self, op: str, t: float, data: dict) -> None:
+        if self._journal is not None:
+            self._journal(op, t, data)
+
+    def _event(self, event: str) -> None:
+        m = self._metrics
+        if m is not None:
+            m.tenancy_events.labels(event=event).inc()
+
+    # ---- lifecycle mutators ---------------------------------------------
+
+    def create(self, tenant_id: str, *, quota: int = 0,
+               weight: float = 1.0) -> Tenant:
+        with self._lock:
+            now = self._now()
+            t = self._create_locked(tenant_id, quota, weight)
+            self._event("created")
+            self._emit(OP_CREATE, now, {
+                "id": t.id, "quota": t.quota, "weight": t.weight,
+            })
+            return t
+
+    def suspend(self, tenant_id: str) -> None:
+        with self._lock:
+            now = self._now()
+            self._require_locked(tenant_id).lifecycle = TENANT_SUSPENDED
+            self._event("suspended")
+            self._emit(OP_SUSPEND, now, {"id": tenant_id})
+
+    def resume(self, tenant_id: str) -> None:
+        with self._lock:
+            now = self._now()
+            self._require_locked(tenant_id).lifecycle = TENANT_ACTIVE
+            self._event("resumed")
+            self._emit(OP_RESUME, now, {"id": tenant_id})
+
+    def delete(self, tenant_id: str) -> None:
+        with self._lock:
+            now = self._now()
+            self._require_locked(tenant_id)
+            del self._tenants[tenant_id]
+            self._event("deleted")
+            self._emit(OP_DELETE, now, {"id": tenant_id})
+
+    # ---- membership mutators --------------------------------------------
+
+    def add_node(self, tenant_id: str, node: Node) -> None:
+        with self._lock:
+            now = self._now()
+            self._add_node_locked(tenant_id, node)
+            self._emit(OP_NODE, now, {
+                "id": tenant_id, "node": codec.node_to_state(node),
+            })
+
+    def add_pod(self, tenant_id: str, pod: Pod) -> None:
+        """Route one pod into its tenant's pending set (raises
+        UnknownTenant / TenantSuspended — admission turns these into
+        invalid outcomes). The next encode_frame picks it up as a
+        delta row (existing-set precheck), not a rebuild."""
+        with self._lock:
+            now = self._now()
+            self._add_pod_locked(tenant_id, pod)
+            self._emit(OP_POD, now, {
+                "id": tenant_id, "pod": codec.pod_to_state(pod),
+            })
+
+    def remove_pod(self, tenant_id: str, uid: str) -> None:
+        with self._lock:
+            now = self._now()
+            self._remove_pod_locked(tenant_id, uid)
+            self._emit(OP_UNPOD, now, {"id": tenant_id, "uid": uid})
+
+    def bind(self, tenant_id: str, uid: str, node_name: str) -> None:
+        """Fold one arena decision: pending -> bound on `node_name`."""
+        with self._lock:
+            now = self._now()
+            self._bind_locked(tenant_id, uid, node_name)
+            self._emit(OP_BIND, now, {
+                "id": tenant_id, "uid": uid, "node": node_name,
+            })
+
+    def route(self, pod: Pod) -> None:
+        """Tenant identity rides the pod's namespace (ObjectMeta.uid is
+        namespace-qualified, so same-named pods in different tenants
+        never collide)."""
+        self.add_pod(pod.namespace, pod)
+
+    # ---- non-emitting internals (replay shares these) -------------------
+
+    def _create_locked(self, tenant_id: str, quota, weight) -> Tenant:
+        if tenant_id in self._tenants:
+            raise TenantError(f"tenant {tenant_id!r} already exists")
+        t = Tenant(str(tenant_id), quota=int(quota), weight=float(weight))
+        self._tenants[t.id] = t
+        return t
+
+    def _require_locked(self, tenant_id: str) -> Tenant:
+        t = self._tenants.get(tenant_id)
+        if t is None:
+            raise UnknownTenant(f"unknown tenant {tenant_id!r}")
+        return t
+
+    def _add_node_locked(self, tenant_id: str, node: Node) -> None:
+        t = self._require_locked(tenant_id)
+        if node.name in t._tn_node_names:
+            raise TenantError(
+                f"node {node.name!r} already in tenant {tenant_id!r}"
+            )
+        t._tn_nodes.append(node)
+        t._tn_node_names.add(node.name)
+
+    def _add_pod_locked(self, tenant_id: str, pod: Pod) -> None:
+        t = self._require_locked(tenant_id)
+        if t.lifecycle != TENANT_ACTIVE:
+            raise TenantSuspended(f"tenant {tenant_id!r} is suspended")
+        if pod.uid in t._tn_pending or pod.uid in t._tn_bound:
+            raise TenantError(
+                f"pod {pod.uid!r} already in tenant {tenant_id!r}"
+            )
+        t._tn_pending[pod.uid] = pod
+        t.submitted_total += 1
+        # deliberately NO encoder touch here: this runs on the admission
+        # (httpserver) thread, and the per-tenant encoder is serve-
+        # thread-owned exactly like the single-cluster one (scheduler's
+        # _ingest_group comment). The PR 16 reuse is the delta path in
+        # encode_frame — the existing-set identity precheck makes the
+        # cycle-time encode O(new pods), not a fleet rebuild.
+
+    def _remove_pod_locked(self, tenant_id: str, uid: str) -> None:
+        t = self._require_locked(tenant_id)
+        if t._tn_pending.pop(uid, None) is None:
+            if t._tn_bound.pop(uid, None) is None:
+                raise TenantError(
+                    f"pod {uid!r} not in tenant {tenant_id!r}"
+                )
+            t._tn_existing = tuple(t._tn_bound.values())
+
+    def _bind_locked(self, tenant_id: str, uid: str,
+                     node_name: str) -> None:
+        t = self._require_locked(tenant_id)
+        pod = t._tn_pending.pop(uid, None)
+        if pod is None:
+            raise TenantError(
+                f"pod {uid!r} not pending in tenant {tenant_id!r}"
+            )
+        if node_name not in t._tn_node_names:
+            raise TenantError(
+                f"node {node_name!r} not in tenant {tenant_id!r}"
+            )
+        t._tn_bound[uid] = (pod, node_name)
+        # a NEW tuple only when the bound set actually changed: the
+        # per-tenant delta encoder keys its existing-set precheck on
+        # object identity first, element ids second
+        t._tn_existing = tuple(t._tn_bound.values())
+        t.bound_total += 1
+
+    # ---- replay ---------------------------------------------------------
+
+    def apply(self, op: str, t: float, data: dict) -> None:
+        """Apply one journal record WITHOUT re-emitting (restore path).
+        Unknown `tn.*` ops refuse loudly: the tenancy journal directory
+        is owned by this class alone, so an unknown op is corruption or
+        version skew, and silently skipping it would resurrect as a
+        divergent virtual cluster after failover."""
+        if op == OP_CREATE:
+            self._create_locked(
+                data["id"], data.get("quota", 0), data.get("weight", 1.0)
+            )
+        elif op == OP_SUSPEND:
+            self._require_locked(data["id"]).lifecycle = TENANT_SUSPENDED
+        elif op == OP_RESUME:
+            self._require_locked(data["id"]).lifecycle = TENANT_ACTIVE
+        elif op == OP_DELETE:
+            self._require_locked(data["id"])
+            del self._tenants[data["id"]]
+        elif op == OP_NODE:
+            self._add_node_locked(
+                data["id"], codec.node_from_state(data["node"])
+            )
+        elif op == OP_POD:
+            tid = data["id"]
+            # replay must land pods into suspended tenants too (the
+            # suspension may postdate the pod in the op sequence)
+            t_obj = self._require_locked(tid)
+            st, t_obj.lifecycle = t_obj.lifecycle, TENANT_ACTIVE
+            try:
+                self._add_pod_locked(tid, codec.pod_from_state(data["pod"]))
+            finally:
+                t_obj.lifecycle = st
+        elif op == OP_UNPOD:
+            self._remove_pod_locked(data["id"], data["uid"])
+        elif op == OP_BIND:
+            self._bind_locked(data["id"], data["uid"], data["node"])
+        else:
+            raise ValueError(f"unknown tenancy journal op {op!r}")
+
+    # ---- serve-thread encode --------------------------------------------
+
+    def encode_active(self) -> list[tuple]:
+        """One consistent fleet snapshot for the arena cycle: under the
+        lock, encode every active tenant with pending demand and capture
+        the EXACT pending order and node table each frame was built
+        from. The fold maps decision slots back through these captured
+        lists — never through live `_tn_pending`/`_tn_nodes`, which the
+        admission thread keeps mutating once the lock drops. Serve
+        thread only (the encoders are serve-thread-owned); admission
+        blocks for the encode, which the per-tenant delta path keeps to
+        O(new pods). Returns [(tenant, frame, pending, nodes), ...]."""
+        with self._lock:
+            out = []
+            for t in self._tenants.values():
+                if t.lifecycle != TENANT_ACTIVE or not t._tn_pending:
+                    continue
+                out.append((
+                    t,
+                    t.encode_frame(),
+                    list(t._tn_pending.values()),
+                    tuple(t._tn_nodes),
+                ))
+            return out
+
+    # ---- read side ------------------------------------------------------
+
+    def get(self, tenant_id: str) -> Tenant | None:
+        with self._lock:
+            return self._tenants.get(tenant_id)
+
+    def require(self, tenant_id: str) -> Tenant:
+        with self._lock:
+            return self._require_locked(tenant_id)
+
+    def ids(self) -> list[str]:
+        with self._lock:
+            return list(self._tenants)
+
+    def tenants(self) -> list[Tenant]:
+        with self._lock:
+            return list(self._tenants.values())
+
+    def active(self) -> list[Tenant]:
+        with self._lock:
+            return [
+                t for t in self._tenants.values()
+                if t.lifecycle == TENANT_ACTIVE
+            ]
+
+    def depth(self, tenant_id: str) -> int:
+        with self._lock:
+            t = self._tenants.get(tenant_id)
+            return t.depth() if t else 0
+
+    def has_pod(self, uid: str) -> bool:
+        with self._lock:
+            return any(t.has_pod(uid) for t in self._tenants.values())
+
+    def total_weight(self) -> float:
+        with self._lock:
+            return sum(
+                t.weight for t in self._tenants.values()
+                if t.lifecycle == TENANT_ACTIVE
+            ) or 1.0
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "tenants": len(self._tenants),
+                "active": sum(
+                    1 for t in self._tenants.values()
+                    if t.lifecycle == TENANT_ACTIVE
+                ),
+                "pending": sum(
+                    t.depth() for t in self._tenants.values()
+                ),
+                "bound": sum(
+                    t.bound_count() for t in self._tenants.values()
+                ),
+            }
+
+
+def restore_registry(
+    directory: str, *, metrics=None,
+    now: Callable[[], float] = time.monotonic,
+) -> TenantRegistry:
+    """Failover: rebuild every virtual cluster from the tenancy journal
+    directory (see state/journal.py replay_dir for torn-tail rules)."""
+    from ..state import journal as _journal
+
+    reg = TenantRegistry(metrics=metrics, now=now)
+    for op, t, data in _journal.replay_dir(directory):
+        reg.apply(op, t, data)
+    return reg
+
+
+def iter_pods(tenants: Iterable[Tenant]):
+    """(tenant_id, pod) across tenants' pending sets, arrival order."""
+    for t in tenants:
+        for pod in t.pending_pods():
+            yield t.id, pod

@@ -136,6 +136,19 @@ _METRICS = {
     # absolute hidden share.
     "finalize_p50_ms": ("lower", "finalize_p50_ms", "finp50"),
     "encode_hidden_pct": ("higher", "encode_hidden_pct", "ehid"),
+    # multi-tenant arena (ISSUE 18, config 11 tenant_arena): the
+    # packed-vs-sequential speedup must not DROP (the whole point of
+    # stacking tenants into one program) and tenants-per-dispatch must
+    # not DROP (falling packing density means tenant shapes stopped
+    # quantizing into shared spec buckets — each stray bucket is a
+    # compile and a dispatch). arena_warm_builds additionally gates as
+    # an ABSOLUTE ceiling (--max-arena-warm-builds, default 0): any
+    # executable built inside the timed window is a compile the fleet
+    # pays at serving time. All skipped for artifacts predating
+    # config 11.
+    "arena_speedup": ("higher", "arena_speedup", "aspd"),
+    "arena_device_speedup": ("higher", "arena_device_speedup", "adspd"),
+    "tenants_per_dispatch": ("higher", "tenants_per_dispatch", "tpd"),
 }
 _COUNT_METRICS = ("stall_cycles", "anomalies_total", "degraded_cycles")
 
@@ -187,6 +200,11 @@ def _normalize(row: dict) -> dict | None:
     trov = row.get("trace_overhead_pct", row.get("trov"))
     if trov is not None:
         out["trace_overhead_pct"] = float(trov)
+    # config-11 warm-window compile count: absolute ceiling, rides
+    # outside the relative comparison like trace_overhead_pct
+    awb = row.get("arena_warm_builds", row.get("awb"))
+    if awb is not None:
+        out["arena_warm_builds"] = int(awb)
     anom = row.get("anomalies", row.get("anom"))
     if anom is not None:
         out["anomalies"] = dict(anom)
@@ -422,6 +440,23 @@ def main(argv: list[str] | None = None) -> int:
         "rounds should pass the ISSUE 16 target (95)",
     )
     ap.add_argument(
+        "--max-arena-speedup-drop", type=float, default=25.0,
+        help="config-11 packed-vs-sequential arena_speedup may drop "
+        "this many percent before it counts as a regression",
+    )
+    ap.add_argument(
+        "--max-tenants-per-dispatch-drop", type=float, default=25.0,
+        help="config-11 tenants_per_dispatch (packing density) may "
+        "drop this many percent before it counts as a regression",
+    )
+    ap.add_argument(
+        "--max-arena-warm-builds", type=int, default=0,
+        help="absolute ceiling on the NEW artifact's config-11 "
+        "arena_warm_builds: executables compiled inside the timed "
+        "window (the zero-compiles-after-warmup contract). -1 "
+        "disables",
+    )
+    ap.add_argument(
         "--max-trace-overhead", type=float, default=50.0,
         help="absolute ceiling on the NEW artifact's config-9 "
         "trace_overhead_pct (worst-case armed-at-rate-1.0 latency "
@@ -484,6 +519,9 @@ def main(argv: list[str] | None = None) -> int:
             "collective_payload_mb": args.max_payload_rise,
             "finalize_p50_ms": args.max_finalize_rise,
             "encode_hidden_pct": args.max_encode_hidden_drop,
+            "arena_speedup": args.max_arena_speedup_drop,
+            "arena_device_speedup": args.max_arena_speedup_drop,
+            "tenants_per_dispatch": args.max_tenants_per_dispatch_drop,
         },
         allow_stalls=args.allow_stalls,
         min_ms_delta=args.min_ms_delta,
@@ -527,6 +565,27 @@ def main(argv: list[str] | None = None) -> int:
                 "delta_pct": None,
                 "limit_pct": args.max_trace_overhead,
                 "regressed": nv > args.max_trace_overhead,
+            }
+            result["checks"].append(check)
+            if check["regressed"]:
+                result["regressions"].append(check)
+                result["ok"] = False
+    if args.max_arena_warm_builds >= 0:
+        # absolute ceiling on the NEW artifact only: a compile inside
+        # config 11's timed window is a serving-time stall regardless
+        # of what the old artifact did
+        for cfg in sorted(new):
+            nv = new[cfg].get("arena_warm_builds")
+            if nv is None:
+                continue
+            check = {
+                "config": cfg,
+                "metric": "arena_warm_builds_ceiling",
+                "old": old.get(cfg, {}).get("arena_warm_builds", 0),
+                "new": nv,
+                "delta_pct": None,
+                "limit_pct": args.max_arena_warm_builds,
+                "regressed": nv > args.max_arena_warm_builds,
             }
             result["checks"].append(check)
             if check["regressed"]:

@@ -925,7 +925,7 @@ def test_registry_mirrors_framework_semantics():
     assert reg.names() == sorted([
         "TRACE-SAFETY", "LOCK-DISCIPLINE", "JOURNAL-EMIT-ONCE",
         "INVENTORY-DRIFT", "HYGIENE", "ROBUSTNESS",
-        "THREADS", "RACES", "SHARD-SAFETY",
+        "THREADS", "RACES", "SHARD-SAFETY", "TENANCY-ISOLATION",
     ])
     with pytest.raises(KeyError):
         reg.make("NOPE")
@@ -937,7 +937,7 @@ def test_registry_mirrors_framework_semantics():
     assert codes["TS001"].startswith("import executed")
     # the mesh-era families are registered with their full code span
     assert {"TR001", "TR002", "TR003", "TR004",
-            "SH001", "SH002", "SH003", "ID009"} <= set(codes)
+            "SH001", "SH002", "SH003", "ID009", "TN001"} <= set(codes)
 
 
 # ---- the tier-1 gate: the real tree lints clean --------------------------
@@ -1433,6 +1433,71 @@ def test_shard_safety_seeded_mutation_in_real_rounds(tmp_path):
     )
     assert clean.findings == [], [str(f) for f in clean.findings]
     assert clean.suppressed  # the inventoried SH002/SH003 sites
+
+
+# ---- TENANCY-ISOLATION ---------------------------------------------------
+
+
+def test_tenancy_isolation_tn001_outside_package(tmp_path):
+    """Any `_tn_*` attribute access outside k8s_scheduler_tpu/tenancy/
+    crosses the virtual-cluster boundary — reads and writes both."""
+    result = lint_fixture(tmp_path, {
+        "pkg/core.py": """\
+            def drain(tenant):
+                pods = list(tenant._tn_pending.values())
+                tenant._tn_bound = {}
+                return pods
+        """,
+    }, passes=["TENANCY-ISOLATION"])
+    tn = codes_at(result, "TN001")
+    assert [f.line for f in tn] == [2, 3]
+    assert "_tn_pending" in tn[0].message
+    assert "TenantRegistry" in tn[0].message
+
+
+def test_tenancy_isolation_clean_inside_package(tmp_path):
+    """The same access is the NORMAL idiom inside tenancy/ — the pass
+    pins the boundary, not the prefix."""
+    result = lint_fixture(tmp_path, {
+        "k8s_scheduler_tpu/tenancy/inside.py": """\
+            def fold(tenant):
+                return list(tenant._tn_pending.values())
+        """,
+        "k8s_scheduler_tpu/core/clean.py": """\
+            def depth(registry, tid):
+                return registry.depth(tid)
+        """,
+    }, passes=["TENANCY-ISOLATION"])
+    assert result.findings == []
+
+
+def test_tenancy_isolation_seeded_mutation_in_real_arena(tmp_path):
+    """Acceptance mutation: make arena's fold read the LIVE pending
+    dict from outside the package (the exact race encode_active's
+    captures exist to prevent) and TN001 must fire; the committed
+    tenancy files lint clean (they live inside the boundary)."""
+    src = open(
+        os.path.join(REPO, "k8s_scheduler_tpu/tenancy/arena.py"),
+        encoding="utf-8",
+    ).read()
+    good = "for j, pod in enumerate(pending):"
+    assert good in src, "arena fold changed; update this test"
+    mutated = src.replace(
+        good, "for j, pod in enumerate(tenant._tn_pending.values()):"
+    )
+    bad = lint_fixture(
+        tmp_path, {"pkg/rogue_arena.py": mutated},
+        passes=["TENANCY-ISOLATION"],
+    )
+    assert any(
+        "_tn_pending" in f.message for f in codes_at(bad, "TN001")
+    )
+    clean = lint_fixture(
+        tmp_path / "clean",
+        {"k8s_scheduler_tpu/tenancy/arena.py": mutated},
+        passes=["TENANCY-ISOLATION"],
+    )
+    assert clean.findings == []
 
 
 # ---- ID009: the pass/code table pin --------------------------------------

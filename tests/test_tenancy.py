@@ -1,0 +1,526 @@
+"""Multi-tenant arena (ISSUE 18): virtual clusters on one compiled
+program.
+
+Three layers under test, matching the tenancy/ package:
+
+- TenantRegistry: virtual-cluster lifecycle, pod/node routing, journal
+  replay (`restore_registry` failover rebuilds every tenant).
+- MultiTenantArena + ArenaPacker: the central property — a packed
+  N-tenant arena run is BIT-EQUAL per tenant to N sequential
+  single-tenant runs — checked directly on synth clusters and through
+  the fuzz grammar (`generate_multitenant_trace` / `run_tenant_case`),
+  plus the negative control: the deliberate row_skew cross-tenant leak
+  MUST be caught.
+- AdmissionController in tenant mode: unknown/suspended tenants are
+  invalid, per-tenant quota sheds with retry-after, the weighted-fair
+  share sheds a flooding tenant under global pressure, and the
+  starved-tenant anomaly fires on the schedule side.
+
+The small-config tests here are tier-1; the 1000-tenant scale check is
+additionally `slow` (run with `-m slow`).
+"""
+
+import numpy as np
+import pytest
+
+from k8s_scheduler_tpu.core import spans
+from k8s_scheduler_tpu.core.observe import CycleObserver
+from k8s_scheduler_tpu.fuzz import (
+    generate_multitenant_trace,
+    run_case,
+    run_tenant_case,
+)
+from k8s_scheduler_tpu.metrics import SchedulerMetrics
+from k8s_scheduler_tpu.service.admission import AdmissionController
+from k8s_scheduler_tpu.state.journal import Journal
+from k8s_scheduler_tpu.tenancy import (
+    MultiTenantArena,
+    TenantError,
+    TenantFrontHost,
+    TenantRegistry,
+    TenantSuspended,
+    UnknownTenant,
+    pow2_bucket,
+    restore_registry,
+)
+from k8s_scheduler_tpu.utils.synth import make_cluster, make_pods
+
+pytestmark = pytest.mark.tenancy
+
+
+def _sample(metrics, name, labels=None):
+    v = metrics.registry.get_sample_value(name, labels or {})
+    return 0.0 if v is None else v
+
+
+def _retenant(objs, tenant_id):
+    """Move synth objects into a virtual cluster: tenant identity rides
+    the namespace, and the namespace-qualified uid keeps same-named
+    objects in different tenants from colliding."""
+    for o in objs:
+        o.metadata.namespace = tenant_id
+        o.metadata.uid = f"{tenant_id}/{o.metadata.name}"
+    return objs
+
+
+def _populate(reg, tenant_ids, *, nodes=3, pods=5, node_seed=7,
+              pod_seed=11):
+    """Identical small shapes per tenant (shared spec bucket): same
+    node/pod SEEDS so layouts match, namespace-scoped names so content
+    is still per-tenant."""
+    for i, tid in enumerate(tenant_ids):
+        if reg.get(tid) is None:
+            reg.create(tid)
+        for nd in _retenant(make_cluster(nodes, seed=node_seed), tid):
+            reg.add_node(tid, nd)
+        for p in _retenant(
+            make_pods(pods, seed=pod_seed, name_prefix=f"t{i}-pod"), tid
+        ):
+            reg.add_pod(tid, p)
+
+
+def _tenant_decisions(arena):
+    """last_decisions regrouped per tenant (order preserved)."""
+    out: dict = {}
+    for tid, uid, node in arena.last_decisions:
+        out.setdefault(tid, []).append((uid, node))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_pow2_bucket():
+    assert [pow2_bucket(n) for n in (1, 2, 3, 5, 8, 9, 1000)] == [
+        1, 2, 4, 8, 8, 16, 1024,
+    ]
+
+
+def test_registry_lifecycle_and_routing():
+    reg = TenantRegistry()
+    reg.create("team-a", quota=10, weight=2.0)
+    reg.create("team-b")
+    with pytest.raises(TenantError):
+        reg.create("team-a")  # duplicate
+
+    node = _retenant(make_cluster(1), "team-a")[0]
+    reg.add_node("team-a", node)
+    pod = _retenant(make_pods(1, name_prefix="p"), "team-a")[0]
+    reg.route(pod)  # tenant rides the namespace
+    assert reg.depth("team-a") == 1
+    assert reg.has_pod(pod.uid)
+
+    reg.suspend("team-a")
+    with pytest.raises(TenantSuspended):
+        reg.add_pod(
+            "team-a", _retenant(make_pods(1, name_prefix="q"), "team-a")[0]
+        )
+    assert reg.require("team-a").lifecycle == "suspended"
+    reg.resume("team-a")
+    assert reg.require("team-a").lifecycle == "active"
+
+    reg.bind("team-a", pod.uid, node.name)
+    t = reg.require("team-a")
+    assert t.bound_node(pod.uid) == node.name
+    assert t.depth() == 0 and t.bound_count() == 1
+
+    with pytest.raises(TenantError):
+        reg.bind("team-a", pod.uid, node.name)  # no longer pending
+    with pytest.raises(UnknownTenant):
+        reg.add_pod("ghost", pod)
+
+    reg.delete("team-b")
+    assert reg.ids() == ["team-a"]
+    st = reg.status()
+    assert st["tenants"] == 1 and st["bound"] == 1
+
+
+def test_registry_suspended_tenant_skipped_by_encode():
+    reg = TenantRegistry()
+    _populate(reg, ["a", "b"], nodes=2, pods=2)
+    assert {t.id for t, *_ in reg.encode_active()} == {"a", "b"}
+    reg.suspend("b")
+    assert {t.id for t, *_ in reg.encode_active()} == {"a"}
+
+
+def test_restore_registry_failover(tmp_path):
+    """Crash/failover drill: every tn.* mutation journals, and a fresh
+    registry rebuilt from the journal directory alone carries the same
+    virtual clusters — lifecycle, quotas, nodes, pending order, binds."""
+    wal = tmp_path / "tenancy-wal"
+    j = Journal(str(wal))
+    reg = TenantRegistry()
+    reg.set_journal(j.append)
+
+    reg.create("team-a", quota=4, weight=2.0)
+    reg.create("team-b")
+    reg.create("team-c")
+    node = _retenant(make_cluster(2), "team-a")
+    for nd in node:
+        reg.add_node("team-a", nd)
+    pods = _retenant(make_pods(3, name_prefix="p"), "team-a")
+    for p in pods:
+        reg.add_pod("team-a", p)
+    reg.bind("team-a", pods[0].uid, node[0].name)
+    reg.remove_pod("team-a", pods[2].uid)
+    reg.suspend("team-b")
+    reg.delete("team-c")
+    j.flush()
+    j.close()
+
+    restored = restore_registry(str(wal))
+    assert sorted(restored.ids()) == ["team-a", "team-b"]
+    a = restored.require("team-a")
+    assert (a.quota, a.weight, a.lifecycle) == (4, 2.0, "active")
+    assert a.node_count() == 2
+    assert [p.uid for p in a.pending_pods()] == [pods[1].uid]
+    assert a.bound_node(pods[0].uid) == node[0].name
+    assert restored.require("team-b").lifecycle == "suspended"
+
+
+def test_restore_refuses_unknown_op(tmp_path):
+    reg = TenantRegistry()
+    with pytest.raises(ValueError, match="unknown tenancy journal op"):
+        reg.apply("tn.frobnicate", 0.0, {})
+
+
+# ---------------------------------------------------------------------------
+# arena: the bit-equality property
+# ---------------------------------------------------------------------------
+
+
+def test_packed_equals_sequential_synth():
+    """The isolation contract, directly: a packed 3-tenant arena cycle
+    produces per-tenant decision streams bit-equal to 3 sequential
+    single-tenant runs — and same-shape tenants share ONE dispatch."""
+    tids = ["team-a", "team-b", "team-c"]
+    reg_p = TenantRegistry()
+    reg_s = TenantRegistry()
+    _populate(reg_p, tids, nodes=3, pods=6)
+    _populate(reg_s, tids, nodes=3, pods=6)
+
+    packed = MultiTenantArena(reg_p)
+    seq = MultiTenantArena(reg_s, sequential=True)
+    sp = packed.run_cycle()
+    ss = seq.run_cycle()
+
+    assert sp["tenants"] == ss["tenants"] == 3
+    # same spec bucket -> one arena launch vs three sequential ones
+    assert sp["dispatches"] == 1 and ss["dispatches"] == 3
+    assert sp["bound"] == ss["bound"] > 0
+    assert _tenant_decisions(packed) == _tenant_decisions(seq)
+    for tid in tids:
+        tp, ts = reg_p.require(tid), reg_s.require(tid)
+        assert tp.bound_count() == ts.bound_count()
+        for uid in list(tp._tn_bound):
+            assert tp.bound_node(uid) == ts.bound_node(uid)
+
+
+def test_arena_builds_flat_across_cycles():
+    """Zero compiles after warmup: a second wave of same-shape demand
+    reuses the cached (spec bucket, T-pad) executable — `builds` stays
+    flat while `dispatches` grows."""
+    tids = [f"vc-{i}" for i in range(4)]
+    reg = TenantRegistry()
+    _populate(reg, tids, nodes=2, pods=3)
+    arena = MultiTenantArena(reg)
+    s1 = arena.run_cycle()
+    builds = arena.packer.builds
+    assert builds >= 1
+    for i, tid in enumerate(tids):
+        for p in _retenant(
+            make_pods(3, seed=23, name_prefix=f"w2-{i}"), tid
+        ):
+            reg.add_pod(tid, p)
+    s2 = arena.run_cycle()
+    assert arena.packer.builds == builds
+    assert s2["dispatches"] >= 1
+    assert arena.packer.dispatches == s1["dispatches"] + s2["dispatches"]
+
+
+def test_row_skew_leak_breaks_equality():
+    """Negative control for the property itself: the planted cross-
+    tenant leak (rolling decision rows within a bucket) must separate
+    packed from sequential — otherwise the equality check is vacuous."""
+    tids = ["team-a", "team-b", "team-c"]
+    reg_p = TenantRegistry()
+    reg_s = TenantRegistry()
+    # distinct pod seeds per tenant so neighboring rows differ (a roll
+    # of identical rows would be invisible)
+    for i, tid in enumerate(tids):
+        for reg in (reg_p, reg_s):
+            reg.create(tid)
+            for nd in _retenant(make_cluster(2, seed=3), tid):
+                reg.add_node(tid, nd)
+            for p in _retenant(
+                make_pods(4, seed=31 + i, name_prefix=f"t{i}-p"), tid
+            ):
+                reg.add_pod(tid, p)
+    packed = MultiTenantArena(reg_p)
+    packed.inject = "row_skew"
+    seq = MultiTenantArena(reg_s, sequential=True)
+    packed.run_cycle()
+    seq.run_cycle()
+    assert _tenant_decisions(packed) != _tenant_decisions(seq)
+
+
+# ---------------------------------------------------------------------------
+# fuzz grammar: multi-tenant differential cases
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_multitenant_clean(seed):
+    """The multi-tenant fuzz grammar (tenant churn, suspends, deletes,
+    per-tenant arrivals) replays with zero failures — and run_case
+    routes tenancy traces to the tenant oracle automatically."""
+    trace = generate_multitenant_trace(seed)
+    assert run_case(trace) == []
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_multitenant_catches_row_skew(seed):
+    failures = run_tenant_case(
+        generate_multitenant_trace(seed), bug="tenant_row_skew"
+    )
+    assert failures, "planted cross-tenant leak escaped the oracle"
+    assert all(f.cls.startswith("tenant/") for f in failures)
+
+
+# ---------------------------------------------------------------------------
+# admission: tenant validity, quota, weighted-fair share
+# ---------------------------------------------------------------------------
+
+
+def _front(reg, **adm_kw):
+    host = TenantFrontHost(reg)
+    adm = AdmissionController(host, tenants=reg, **adm_kw)
+    return host, adm
+
+
+def test_admission_unknown_and_suspended_tenant_invalid():
+    reg = TenantRegistry()
+    reg.create("team-a")
+    _host, adm = _front(reg)
+
+    ghost = _retenant(make_pods(1, name_prefix="g"), "nobody")
+    res = adm.submit(ghost)
+    assert not res.ok and res.accepted == 0
+    assert res.invalid == (ghost[0].uid,)
+    assert "unknown tenant" in res.reason and "nobody" in res.reason
+    assert res.retry_after_ms == 0.0  # caller bug, not backpressure
+
+    reg.suspend("team-a")
+    locked = _retenant(make_pods(1, name_prefix="s"), "team-a")
+    res = adm.submit(locked)
+    assert res.invalid == (locked[0].uid,)
+    assert "suspended" in res.reason and "team-a" in res.reason
+    assert reg.depth("team-a") == 0  # nothing routed
+
+    reg.resume("team-a")
+    res = adm.submit(locked)
+    assert res.ok and res.accepted == 1
+    assert reg.depth("team-a") == 1
+
+
+def test_admission_tenant_quota_shed():
+    reg = TenantRegistry()
+    reg.create("team-a", quota=4)
+    reg.create("team-b")
+    _host, adm = _front(reg)
+
+    first = _retenant(make_pods(3, name_prefix="a"), "team-a")
+    assert adm.submit(first).ok
+    assert adm.tenant_depth("team-a") == 3
+
+    over = _retenant(make_pods(3, seed=5, name_prefix="b"), "team-a")
+    res = adm.submit(over)
+    assert res.shed == 3 and res.accepted == 0
+    assert res.retry_after_ms > 0
+    assert "team-a" in res.reason and "quota exceeded" in res.reason
+    assert reg.depth("team-a") == 3  # the over-quota wave never routed
+
+    # the quota is tenant-scoped: team-b's traffic still lands
+    other = _retenant(make_pods(3, seed=6, name_prefix="c"), "team-b")
+    assert adm.submit(other).ok
+    m = _host.metrics
+    assert _sample(
+        m, "scheduler_tenancy_events_total", {"event": "quota_shed"}
+    ) == 1
+
+
+def test_admission_weighted_fair_share_under_pressure():
+    """Two tenants saturating a small front door: the heavy-weight
+    tenant keeps its larger share, the light tenant sheds once past
+    its own — and only under global pressure (idle fleets are
+    work-conserving)."""
+    reg = TenantRegistry()
+    reg.create("heavy", weight=3.0)
+    reg.create("light", weight=1.0)
+    _host, adm = _front(reg, queue_depth=16)
+
+    # no pressure: light may exceed its static share of 4
+    early = _retenant(make_pods(5, name_prefix="e"), "light")
+    assert adm.submit(early).ok
+
+    # push the fleet past depth_bound // 2 from the heavy tenant
+    # (share = 16 * 3/4 = 12, so this is within its own cap)
+    wave = _retenant(make_pods(6, seed=5, name_prefix="h"), "heavy")
+    assert adm.submit(wave).ok
+
+    # light is now over its weighted share (5 held + 2 > 4) under
+    # pressure -> fair shed with a tenant-scoped reason
+    res = adm.submit(
+        _retenant(make_pods(2, seed=6, name_prefix="l"), "light")
+    )
+    assert res.shed == 2 and res.retry_after_ms > 0
+    assert "light" in res.reason
+    assert "weighted-fair share" in res.reason
+
+    # the heavy tenant still has headroom at the same fleet depth
+    assert adm.submit(
+        _retenant(make_pods(1, seed=7, name_prefix="h2"), "heavy")
+    ).ok
+    assert _sample(
+        _host.metrics,
+        "scheduler_tenancy_events_total",
+        {"event": "fair_shed"},
+    ) == 1
+
+
+def test_admission_note_bind_untracks_tenant_depth():
+    reg = TenantRegistry()
+    reg.create("team-a")
+    host, adm = _front(reg)
+    for nd in _retenant(make_cluster(2), "team-a"):
+        host.on_node_add(nd)
+    pods = _retenant(make_pods(2, name_prefix="p"), "team-a")
+    assert adm.submit(pods).ok
+    assert adm.tenant_depth("team-a") == 2
+    stats = host.schedule_cycle()
+    assert stats.bound == 2
+    # arena folds call note_bind -> the quota denominator drains
+    assert adm.tenant_depth("team-a") == 0
+
+
+# ---------------------------------------------------------------------------
+# starvation + observability attribution
+# ---------------------------------------------------------------------------
+
+
+def test_starved_tenant_anomaly():
+    """A tenant with standing demand that binds nothing while others
+    bind trips `tenant_starved` after starve_after cycles — once per
+    streak, attributed to the tenant."""
+    m = SchedulerMetrics()
+    obs = CycleObserver(metrics=m, warmup_cycles=0)
+    reg = TenantRegistry(metrics=m)
+    reg.create("fed")
+    reg.create("starved")
+    for nd in _retenant(make_cluster(2), "fed"):
+        reg.add_node("fed", nd)
+    # the starved tenant has demand but zero capacity: every cycle
+    # leaves it pending while `fed` binds
+    for p in _retenant(make_pods(2, name_prefix="s"), "starved"):
+        reg.add_pod("starved", p)
+    arena = MultiTenantArena(reg, observer=obs, metrics=m, starve_after=2)
+
+    for cycle in range(3):
+        for p in _retenant(
+            make_pods(1, seed=cycle, name_prefix=f"f{cycle}"), "fed"
+        ):
+            reg.add_pod("fed", p)
+        arena.run_cycle()
+
+    assert obs.anomaly_counts["tenant_starved"] == 1  # once per streak
+    ev = [e for e in obs.ring if e["class"] == "tenant_starved"][0]
+    assert ev["profile"] == "starved"
+    assert ev["detail"]["tenant"] == "starved"
+    assert ev["detail"]["streak"] == 2
+    assert _sample(
+        m, "scheduler_tenancy_events_total", {"event": "starved"}
+    ) == 1
+    # binding the starved tenant's demand resets the streak machinery
+    for nd in _retenant(make_cluster(2), "starved"):
+        reg.add_node("starved", nd)
+    arena.run_cycle()
+    assert reg.require("starved").starve_streak == 0
+
+
+def test_tenancy_lifecycle_metrics():
+    m = SchedulerMetrics()
+    reg = TenantRegistry(metrics=m)
+    reg.create("a")
+    reg.suspend("a")
+    reg.resume("a")
+    reg.delete("a")
+    for event in ("created", "suspended", "resumed", "deleted"):
+        assert _sample(
+            m, "scheduler_tenancy_events_total", {"event": event}
+        ) == 1
+
+
+def test_spans_carry_tenant_attribution():
+    """Submit-path spans inherit the tenant from their trace context,
+    and the Perfetto export leads the track name with it so one
+    virtual cluster's lanes group together."""
+    rec = spans.arm(rate=1.0)
+    try:
+        reg = TenantRegistry()
+        reg.create("team-a")
+        _host, adm = _front(reg)
+        pods = _retenant(make_pods(1, name_prefix="p"), "team-a")
+        res = adm.submit(pods)
+        assert res.ok
+        got = rec.snapshot()
+        assert got, "submit path recorded no spans while armed"
+        assert all(s.attrs.get("tenant") == "team-a" for s in got)
+        events = spans.spans_to_chrome_events(got)
+        names = [
+            e["args"]["name"] for e in events
+            if e["name"] == "thread_name"
+        ]
+        assert any(n.startswith("tenant team-a trace ") for n in names)
+    finally:
+        spans.disarm()
+
+
+# ---------------------------------------------------------------------------
+# scale (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_thousand_tenants_one_bucket():
+    """The headline shape: 1000 small same-spec virtual clusters pack
+    into ONE arena dispatch per cycle (T padded to 1024), and a second
+    wave of same-shape demand compiles nothing new."""
+    reg = TenantRegistry()
+    T = 1000
+    for i in range(T):
+        tid = f"vc-{i:04d}"
+        reg.create(tid)
+        for nd in _retenant(make_cluster(2, seed=7), tid):
+            reg.add_node(tid, nd)
+        for p in _retenant(
+            make_pods(2, seed=11, name_prefix=f"p{i}"), tid
+        ):
+            reg.add_pod(tid, p)
+    arena = MultiTenantArena(reg)
+    s1 = arena.run_cycle()
+    assert s1["tenants"] == T
+    assert s1["dispatches"] == 1  # one spec bucket, one launch
+    assert s1["bound"] > 0
+    builds = arena.packer.builds
+
+    for i in range(T):
+        tid = f"vc-{i:04d}"
+        for p in _retenant(
+            make_pods(2, seed=13, name_prefix=f"q{i}"), tid
+        ):
+            reg.add_pod(tid, p)
+    s2 = arena.run_cycle()
+    assert arena.packer.builds == builds  # zero compiles after warmup
+    assert s2["dispatches"] == 1
