@@ -16,10 +16,15 @@ Passes (see each module's docstring for codes):
 
 - TRACE-SAFETY   (trace_safety.py)    TS0xx — impure Python reachable
   from the jitted cycle programs / plugin compute fns
+- JIT-PURITY     (jit_purity.py)      JP0xx — interprocedural effect
+  summaries (effects.py) over the traced region: host effects under
+  trace, unstable jit discriminators, jit wrappers built in loops
 - LOCK-DISCIPLINE (lock_discipline.py) LD0xx — lock-order inversions and
   blocking calls under the scheduler's state locks
 - JOURNAL-EMIT-ONCE (journal_emit.py)  JE0xx — the durable-state
   clock-once / record-once mutator contract
+- DURABILITY-ORDER (durability_order.py) DO0xx — journal-before-mutate
+  and barrier-before-ack, path-sensitively over service/state/tenancy
 - INVENTORY-DRIFT (inventory.py)       ID0xx — metrics/config/CLI/README
   documentation drift (absorbs scripts/lint_metrics.py)
 - HYGIENE        (hygiene.py)          HY0xx — unused module-level
@@ -31,6 +36,8 @@ Passes (see each module's docstring for codes):
 - RACES          (races.py)            TR001/2/4 — cross-role unlocked
   writes, whole-tree lock-order cycles, serve-loop blocking under
   contended locks
+- TENANCY-ISOLATION (tenancy_isolation.py) TN001 — `_tn_*` per-tenant
+  state stays behind the tenancy/ boundary
 - SHARD-SAFETY   (shard_safety.py)     SH0xx — the PR 9 shard-exactness
   rules: argsel reduces, no axis-0 concat of sharded vectors, specs
   only via mesh_pin

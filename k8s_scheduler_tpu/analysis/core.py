@@ -210,6 +210,7 @@ class LintContext:
         self._by_rel = {f.rel: f for f in files}
         self._by_module = {f.module: f for f in files}
         self._index = None
+        self._effects = None
 
     def file(self, rel: str) -> SourceFile | None:
         return self._by_rel.get(rel.replace(os.sep, "/"))
@@ -225,13 +226,25 @@ class LintContext:
             self._index = CodeIndex(self.files)
         return self._index
 
+    @property
+    def effects(self):
+        """The shared interprocedural effect engine (lazy, like the
+        call-graph index it stands on): JIT-PURITY and
+        DURABILITY-ORDER both read it, fixpoint summaries and the
+        traced region are computed once per lint run."""
+        if self._effects is None:
+            from .effects import EffectEngine
+
+            self._effects = EffectEngine(self.index)
+        return self._effects
+
 
 # ---- baseline ------------------------------------------------------------
 
 
 def load_baseline(path: str) -> list[dict]:
-    """The committed grandfather list: [{"file", "code", "message"}, ...].
-    A missing file is an empty baseline."""
+    """The committed grandfather list: [{"file", "code", "message",
+    "count"?}, ...]. A missing file is an empty baseline."""
     if not path or not os.path.exists(path):
         return []
     with open(path, encoding="utf-8") as f:
@@ -240,17 +253,30 @@ def load_baseline(path: str) -> list[dict]:
 
 
 def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    """One entry per (file, code, message) identity, carrying an
+    explicit "count" when the same identity occurs more than once —
+    the count IS the grandfather budget, so a second identical
+    violation added later is new, not silently absorbed."""
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = []
+    for (file, code, message), n in sorted(counts.items()):
+        e: dict[str, Any] = {"file": file, "code": code,
+                             "message": message}
+        if n > 1:
+            e["count"] = n
+        entries.append(e)
     data = {
         "comment": (
             "schedlint grandfathered findings — entries match on "
-            "(file, code, message), line-independent. Regenerate with "
+            "(file, code, message), line-independent and count-aware "
+            "(the optional \"count\" is the budget for identical "
+            "findings; absent = 1). Regenerate with "
             "scripts/schedlint.py --write-baseline; shrink it, don't "
             "grow it."
         ),
-        "findings": [
-            {"file": f.file, "code": f.code, "message": f.message}
-            for f in sorted(findings, key=lambda f: (f.file, f.code, f.line))
-        ],
+        "findings": entries,
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(data, f, indent=2, sort_keys=False)
@@ -260,12 +286,14 @@ def write_baseline(path: str, findings: Iterable[Finding]) -> None:
 def apply_baseline(
     findings: list[Finding], baseline: list[dict]
 ) -> tuple[list[Finding], list[Finding]]:
-    """Split into (new, grandfathered). Matching is count-aware: two
-    identical findings need two baseline entries."""
+    """Split into (new, grandfathered). Matching is count-aware: an
+    entry grandfathers at most its "count" (default 1) identical
+    findings, so a SECOND identical violation in the same file is
+    reported as new instead of riding the first one's entry."""
     budget: dict[tuple[str, str, str], int] = {}
     for e in baseline:
         k = (e.get("file", ""), e.get("code", ""), e.get("message", ""))
-        budget[k] = budget.get(k, 0) + 1
+        budget[k] = budget.get(k, 0) + int(e.get("count", 1))
     new: list[Finding] = []
     old: list[Finding] = []
     for f in findings:
@@ -276,6 +304,23 @@ def apply_baseline(
         else:
             new.append(f)
     return new, old
+
+
+def stale_baseline_entries(
+    baseline: list[dict], grandfathered: list[Finding]
+) -> list[tuple[tuple[str, str, str], int]]:
+    """Baseline budget that matched nothing this run: [(identity,
+    leftover), ...] — the entries --fail-on-new nags about so the
+    baseline shrinks instead of fossilizing."""
+    budget: dict[tuple[str, str, str], int] = {}
+    for e in baseline:
+        k = (e.get("file", ""), e.get("code", ""), e.get("message", ""))
+        budget[k] = budget.get(k, 0) + int(e.get("count", 1))
+    for f in grandfathered:
+        budget[f.key()] -= 1
+    return sorted(
+        (k, left) for k, left in budget.items() if left > 0
+    )
 
 
 # ---- driver --------------------------------------------------------------
@@ -302,6 +347,62 @@ class LintResult:
             "suppressed": [f.to_dict() for f in self.suppressed],
             "grandfathered": [f.to_dict() for f in self.grandfathered],
         }
+
+
+def to_sarif(result: LintResult, rules: dict[str, str]) -> dict[str, Any]:
+    """SARIF 2.1.0 for CI annotation UIs: new findings at error level,
+    suppressed/grandfathered carried along with their suppression kind
+    (inSource = an inline pragma, external = the baseline file) so a
+    viewer can show them greyed out instead of losing them. `rules` is
+    code -> description (registry.all_codes)."""
+
+    def _result(f: Finding, level: str, suppression: str | None) -> dict:
+        r: dict[str, Any] = {
+            "ruleId": f.code,
+            "level": level,
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.file},
+                    "region": {"startLine": f.line},
+                },
+            }],
+            "partialFingerprints": {
+                "schedlintFingerprint/v1": f.fingerprint(),
+            },
+        }
+        if suppression is not None:
+            r["suppressions"] = [{"kind": suppression}]
+        return r
+
+    results = (
+        [_result(f, "error", None) for f in result.findings]
+        + [_result(f, "note", "inSource") for f in result.suppressed]
+        + [_result(f, "note", "external") for f in result.grandfathered]
+    )
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "schedlint",
+                    "informationUri": "README.md#static-analysis",
+                    "rules": [
+                        {
+                            "id": code,
+                            "shortDescription": {"text": desc},
+                        }
+                        for code, desc in sorted(rules.items())
+                    ],
+                },
+            },
+            "results": results,
+        }],
+    }
 
 
 def run_lint(

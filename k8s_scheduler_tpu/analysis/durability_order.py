@@ -1,0 +1,350 @@
+"""DURABILITY-ORDER (DO0xx): journal-before-mutate, barrier-before-ack.
+
+The WAL contract (PR 13): every mutation of tracked scheduler state
+appends its journal record FIRST (the journaled queue/cache mutators do
+this internally, under their own lock), and a Submit is acknowledged
+only after `ack_barrier()` proves the records that admitted it are on
+disk. This pass walks the statement flow of every function under
+`service/`, `state/`, and `tenancy/` (the durability perimeter) with
+the effect engine's interprocedural summaries folded in at call sites:
+
+- DO001  a tracked-store write (queue/cache WAL containers — _active,
+         _bound, ...) reachable on a path with no preceding journal
+         append: crash here and replay diverges from memory
+- DO002  a SubmitResult acknowledging accepted work constructed on a
+         path with no preceding ack_barrier(): the client is told
+         "accepted" before the WAL proves it
+- DO003  a broad handler swallows (no re-raise) over a try body that
+         both journals and mutates: an exception between the two
+         strands a half-applied transaction that replay will re-apply
+         differently
+
+Precision model (deliberate, documented):
+
+- Branch joins are optimistic (union of branches): a mutation is
+  flagged only when NO path establishes the journal first. The guard
+  `if self._durable is not None: durable = ...ack_barrier()` in
+  service/admission.py therefore counts as an ack.
+- Exception edges are pessimistic: an except handler starts from the
+  pre-try state (any effect inside the try may not have happened).
+- A call that the engine proves journals-and-mutates (the journaled
+  funnel, e.g. `self.queue.add`) is atomic-and-safe and establishes
+  `journal` for the rest of the path.
+- Call-carried mutations (via the callee's summary) are flagged at the
+  call site only when the callee is OUTSIDE the durability perimeter —
+  an in-perimeter callee is analyzed directly, and flagging both
+  would double-report one bug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import FuncInfo, attribute_chain
+from .core import Finding, LintContext
+from .effects import EffectEngine, _store_effects
+from .registry import PassBase
+
+_SCOPE_SEGMENTS = frozenset({"service", "state", "tenancy"})
+
+_BROAD = frozenset({"Exception", "BaseException"})
+
+
+def _in_scope(rel: str) -> bool:
+    return bool(_SCOPE_SEGMENTS & set(rel.split("/")[:-1]))
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        chain = attribute_chain(n)
+        if chain and chain[-1] in _BROAD:
+            return True
+    return False
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    return not any(
+        isinstance(n, ast.Raise) for n in ast.walk(handler)
+    )
+
+
+class DurabilityOrderPass(PassBase):
+    name = "DURABILITY-ORDER"
+    codes = {
+        "DO001": "tracked-state mutation with no preceding journal "
+                 "append on some path",
+        "DO002": "Submit acknowledged with no preceding durability "
+                 "barrier on some path",
+        "DO003": "broad handler swallows between journal append and "
+                 "state mutation (half-applied transaction survives)",
+    }
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        engine: EffectEngine = ctx.effects
+        index = ctx.index
+        out: list[Finding] = []
+        for fid in sorted(index.funcs):
+            f = index.funcs[fid]
+            if not _in_scope(f.file.rel):
+                continue
+            if isinstance(f.node, ast.Lambda):
+                continue
+            self._scan_function(engine, f, out)
+        return out
+
+    # ---- per-function flow walk ------------------------------------------
+
+    def _scan_function(
+        self, engine: EffectEngine, f: FuncInfo, out: list[Finding]
+    ) -> None:
+        self._walk(engine, f, list(f.node.body), set(), out)
+
+    def _walk(
+        self,
+        engine: EffectEngine,
+        f: FuncInfo,
+        stmts: list[ast.stmt],
+        est: set[str],
+        out: list[Finding],
+    ) -> set[str]:
+        """Forward walk: `est` is the set of effects established on
+        every path into the current statement ('journal', 'ack').
+        Returns the state after the block."""
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                self._exprs(engine, f, [stmt.test], est, out)
+                a = self._walk(engine, f, stmt.body, set(est), out)
+                b = self._walk(engine, f, stmt.orelse, set(est), out)
+                est = a | b  # optimistic join (see module docstring)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                iters = [stmt.iter] if isinstance(
+                    stmt, (ast.For, ast.AsyncFor)
+                ) else [stmt.test]
+                self._exprs(engine, f, iters, est, out)
+                body = self._walk(engine, f, stmt.body, set(est), out)
+                els = self._walk(engine, f, stmt.orelse, set(est), out)
+                est = est | body | els
+            elif isinstance(stmt, ast.Try):
+                self._check_try(engine, f, stmt, est, out)
+                body = self._walk(engine, f, stmt.body, set(est), out)
+                after = set(body)
+                for h in stmt.handlers:
+                    # pessimistic: the try may have failed before any
+                    # of its effects happened
+                    after |= self._walk(
+                        engine, f, h.body, set(est), out
+                    )
+                after |= self._walk(engine, f, stmt.orelse, set(body), out)
+                est = self._walk(
+                    engine, f, stmt.finalbody, after, out
+                )
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._exprs(
+                    engine, f,
+                    [i.context_expr for i in stmt.items], est, out,
+                )
+                est = self._walk(engine, f, stmt.body, est, out)
+            elif isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # its own frame; analyzed separately
+            else:
+                self._stmt_events(engine, f, stmt, est, out)
+        return est
+
+    def _stmt_events(
+        self,
+        engine: EffectEngine,
+        f: FuncInfo,
+        stmt: ast.stmt,
+        est: set[str],
+        out: list[Finding],
+    ) -> None:
+        # value expressions first (they evaluate before the store)
+        self._exprs(
+            engine, f, list(ast.iter_child_nodes(stmt)), est, out,
+        )
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            if isinstance(stmt, ast.AnnAssign) and stmt.value is None:
+                targets = []
+        elif isinstance(stmt, ast.Delete):
+            targets = stmt.targets
+        else:
+            return
+        for t in targets:
+            for kind, detail in _store_effects(t, stmt.lineno):
+                if kind == "mutation" and "journal" not in est:
+                    out.append(Finding(
+                        f.file.rel, stmt.lineno, "DO001",
+                        f"{f.qualname} writes tracked store "
+                        f"`{detail.rstrip(' =')}` with no journal "
+                        "append on this path: a crash here leaves "
+                        "memory ahead of the WAL, and replay "
+                        "diverges (journal first, or go through the "
+                        "journaled queue/cache mutators)",
+                    ))
+
+    def _exprs(
+        self,
+        engine: EffectEngine,
+        f: FuncInfo,
+        exprs: list[ast.AST],
+        est: set[str],
+        out: list[Finding],
+    ) -> None:
+        """Classify every call in the given expressions (source order),
+        update `est`, and emit DO001/DO002 hazards."""
+        stack = [e for e in reversed(exprs) if isinstance(e, ast.expr)]
+        calls: list[ast.Call] = []
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue  # its own frame
+            if isinstance(node, ast.Call):
+                calls.append(node)
+            stack.extend(
+                c for c in reversed(list(ast.iter_child_nodes(node)))
+                if isinstance(c, ast.expr)
+            )
+        calls.sort(key=lambda c: (c.lineno, c.col_offset))
+        for node in calls:
+            kinds = engine.call_kinds(f, node)
+            chain = attribute_chain(node.func)
+            if chain and chain[-1] == "SubmitResult":
+                self._check_submit(f, node, est, out)
+            if "mutation" in kinds and "journal" not in kinds and (
+                "journal" not in est
+            ):
+                detail, hop = kinds["mutation"]
+                if hop is None:
+                    where = f"`{detail}`"
+                    flag = True
+                else:
+                    where = f"call into {hop} (reaches `{detail}`)"
+                    # in-perimeter callees are analyzed directly;
+                    # flagging the call site too would double-report
+                    hop_in_scope = any(
+                        _in_scope(self.index_rel(engine, t))
+                        for t in sorted(
+                            engine.index.resolve_callback(f, node.func)
+                        )
+                    )
+                    flag = not hop_in_scope
+                if flag:
+                    out.append(Finding(
+                        f.file.rel, node.lineno, "DO001",
+                        f"{f.qualname} mutates tracked state via "
+                        f"{where} with no journal append on this "
+                        "path: a crash here leaves memory ahead of "
+                        "the WAL (journal first, or go through the "
+                        "journaled queue/cache mutators)",
+                    ))
+            if "journal" in kinds:
+                est.add("journal")
+            if "ack" in kinds:
+                est.add("ack")
+
+    @staticmethod
+    def index_rel(engine: EffectEngine, fid: str) -> str:
+        info = engine.index.funcs.get(fid)
+        return info.file.rel if info is not None else ""
+
+    def _check_submit(
+        self,
+        f: FuncInfo,
+        node: ast.Call,
+        est: set[str],
+        out: list[Finding],
+    ) -> None:
+        acked = False
+        for kw in node.keywords:
+            if kw.arg == "accepted" and not (
+                isinstance(kw.value, ast.Constant)
+                and not kw.value.value
+            ):
+                acked = True
+            if kw.arg == "durable" and (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+            ):
+                acked = True
+        if acked and "ack" not in est:
+            out.append(Finding(
+                f.file.rel, node.lineno, "DO002",
+                f"{f.qualname} acknowledges accepted work "
+                "(SubmitResult) with no ack_barrier() on this path: "
+                "the client is told \"accepted\" before the WAL "
+                "records that admitted it are proven on disk "
+                "(acked => durable, PR 13)",
+            ))
+
+    def _check_try(
+        self,
+        engine: EffectEngine,
+        f: FuncInfo,
+        stmt: ast.Try,
+        est: set[str],
+        out: list[Finding],
+    ) -> None:
+        """DO003: a broad swallowing handler over a try body that both
+        journals and mutates — an exception between the two strands a
+        half-applied transaction."""
+        journal_at: int | None = None
+        mutate_at: int | None = None
+        for sub in stmt.body:
+            kinds = self._block_kinds(engine, f, sub)
+            if "journal" in kinds and journal_at is None:
+                journal_at = sub.lineno
+            if "mutation" in kinds and mutate_at is None:
+                mutate_at = sub.lineno
+        if journal_at is None or mutate_at is None:
+            return
+        if journal_at == mutate_at:
+            return  # one atomic funnel call (journaled mutator)
+        for h in stmt.handlers:
+            if _is_broad_handler(h) and _swallows(h):
+                out.append(Finding(
+                    f.file.rel, h.lineno, "DO003",
+                    f"broad handler in {f.qualname} swallows over a "
+                    f"try that journals (line {journal_at}) and "
+                    f"mutates (line {mutate_at}): an exception "
+                    "between the two strands a half-applied "
+                    "transaction that replay re-applies differently "
+                    "— narrow the except or re-raise after cleanup",
+                ))
+
+    def _block_kinds(
+        self, engine: EffectEngine, f: FuncInfo, stmt: ast.stmt
+    ) -> set[str]:
+        """Effect kinds a statement (including nested blocks, but not
+        nested function frames) may perform — textual + summaries."""
+        kinds: set[str] = set()
+        stack: list[ast.AST] = [stmt]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if isinstance(node, ast.Call):
+                kinds.update(engine.call_kinds(f, node))
+            elif isinstance(
+                node, (ast.Assign, ast.AugAssign, ast.AnnAssign)
+            ):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    kinds.update(
+                        k for k, _ in _store_effects(t, node.lineno)
+                    )
+        return kinds

@@ -1,0 +1,578 @@
+"""Interprocedural effect engine for schedlint (v3).
+
+Per-function *effect summaries* propagated to a fixpoint over the
+callgraph.py call graph, plus the computed *traced region* (every
+function reachable from a jit/vmap entry point). Two pass families
+stand on this engine — JIT-PURITY (jit_purity.py) and DURABILITY-ORDER
+(durability_order.py) — and TRACE-SAFETY's root discovery delegates
+here so the three cannot disagree about what is traced.
+
+Effect kinds:
+
+    io          host I/O: open/os.*/shutil/socket/subprocess/logging/print
+    time        clock read (time.*, datetime.now/utcnow/today/fromtimestamp)
+    rng         host RNG (random.*, numpy.random.*)
+    lock        lock/condition acquired (`with self._lock:` / .acquire())
+    journal     WAL append (self._journal/_emit/_append_record,
+                journal.append, or a journaled queue/cache mutator)
+    ack         durability barrier (ack_barrier)
+    metrics     metric emit (.labels(...).inc/observe/set, counter.inc)
+    self_write  attribute written on self/cls
+    mutation    write into a tracked WAL-backed container (_active,
+                _bound, ... — see TRACKED_STORES)
+    global_write  `global` declaration
+
+Precision model (documented so pass authors know what they stand on):
+
+- Direct effects are extracted textually from a function's own frame
+  (`own_body_nodes`); nested defs/lambdas carry their own effects.
+- Summaries union a function's direct effects with every reference's
+  summary (callgraph's deliberately over-approximate resolution: a
+  callback passed counts as called). A summary entry records the
+  concrete detail plus the first callee hop it arrived through.
+- journal/mutation classification is *textual* on attribute chains
+  (`self.queue.add`, `self._journal`, `journal.append`): the call
+  graph cannot resolve generic container-method names (`add`,
+  `update` are in callgraph._GENERIC_ATTRS by design), so the WAL
+  funnel is recognized by shape, not resolution. A journaled mutator
+  (queue/cache public method) counts as journal AND mutation — it
+  appends before it mutates, under its own lock, by contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+from typing import Iterable
+
+from .callgraph import CodeIndex, FuncInfo, attribute_chain, own_body_nodes
+from .core import SourceFile
+
+# ---- traced-root vocabulary (shared with trace_safety.py) ----------------
+
+# the PluginBase hooks that are traced inside the cycle programs
+TRACED_PLUGIN_METHODS = frozenset({
+    "static_mask", "static_score", "dyn_mask", "dyn_score",
+    "extra_init", "extra_update", "dyn_mask_batched", "dyn_score_batched",
+    "extra_update_batched", "score_node_anchor", "post_filter",
+})
+
+# names whose call wraps its first argument in a compiled program; _jit
+# is the repo's resilient wrapper in core/cycle.py, vmap callbacks are
+# traced by the batching transform exactly like jit callbacks
+JIT_NAMES = frozenset({"jit", "pjit", "pmap", "_jit"})
+TRACE_CALL_NAMES = JIT_NAMES | frozenset({"vmap"})
+
+_DATETIME_IMPURE = frozenset({"now", "utcnow", "today", "fromtimestamp"})
+
+# real module name -> canonical tag for the alias table
+ALIAS_TARGETS = {
+    "time": "time",
+    "datetime": "datetime",
+    "random": "random",
+    "numpy": "np",
+    "jax.numpy": "jnp",
+    "os": "os",
+    "shutil": "shutil",
+    "socket": "socket",
+    "subprocess": "subprocess",
+    "logging": "logging",
+    "uuid": "uuid",
+}
+
+# modules whose bare-name from-imports we track (`from time import
+# monotonic` -> the bound name carries the effect)
+_BARE_NAME_TAGS = frozenset({
+    "time", "random", "os", "socket", "subprocess", "shutil", "uuid",
+})
+
+
+def module_aliases(sf: SourceFile, targets: dict[str, str]) -> dict:
+    """alias -> canonical target for stdlib-ish modules we care about
+    (`targets` maps real module name -> canonical tag)."""
+    out: dict[str, str] = {}
+    for node in sf.walk():
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in targets:
+                    out[a.asname or a.name.split(".")[0]] = targets[a.name]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0 and node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":  # from jax import numpy as jnp
+                        out[a.asname or a.name] = "jnp"
+            elif node.level == 0 and node.module in targets:
+                tag = targets[node.module]
+                for a in node.names:
+                    if tag in _BARE_NAME_TAGS:
+                        # from time import monotonic -> bare-name call
+                        out[a.asname or a.name] = f"{tag}.{a.name}"
+                    elif tag == "datetime":
+                        # from datetime import datetime/date: the bound
+                        # class carries the impure .now()/.today()
+                        out[a.asname or a.name] = "datetime"
+    return out
+
+
+def is_jit_expr(expr: ast.AST) -> bool:
+    """True for `jax.jit`, `@jit`, `@partial(jax.jit, ...)` shapes."""
+    chain = attribute_chain(expr)
+    if chain and chain[-1] in TRACE_CALL_NAMES:
+        return True
+    if isinstance(expr, ast.Call):
+        fchain = attribute_chain(expr.func)
+        if fchain and fchain[-1] in TRACE_CALL_NAMES:
+            return True  # @jax.jit(static_argnums=...) factory form
+        if fchain and fchain[-1] == "partial" and expr.args:
+            achain = attribute_chain(expr.args[0])
+            return bool(achain and achain[-1] in TRACE_CALL_NAMES)
+    return False
+
+
+def jit_call_targets(index: CodeIndex, f, node: ast.Call) -> set[str]:
+    """Function ids traced by a `jit(...)`/`vmap(...)` call expression."""
+    chain = attribute_chain(node.func)
+    if not chain or chain[-1] not in TRACE_CALL_NAMES or not node.args:
+        return set()
+    # jax.jit(fn) / jax.jit(partial(fn, ...)) / jax.jit(lambda ...):
+    # the one shared callback-resolution ladder (callgraph.py) —
+    # Thread targets and observer registrations resolve identically
+    return index.resolve_callback(f, node.args[0])
+
+
+def module_shim(sf: SourceFile) -> FuncInfo:
+    """A FuncInfo standing in for module scope, so module-level
+    `cycle = jax.jit(fn)` resolves through the same ladder."""
+    return FuncInfo(
+        id=f"{sf.rel}::<module>", file=sf, node=sf.tree,
+        name="<module>", qualname="<module>", cls=None,
+        parent=None, lineno=1,
+    )
+
+
+def traced_roots(index: CodeIndex) -> dict[str, str]:
+    """Every jit/vmap entry point: function id -> a short witness label
+    of WHY it is a root (for pass messages)."""
+    roots: dict[str, str] = {}
+
+    def note(fid: str, label: str) -> None:
+        roots.setdefault(fid, label)
+
+    # 1) first argument of jit-wrapping calls — inside any function,
+    #    and at module scope (`cycle = jax.jit(fn)` in a script)
+    for f in sorted(index.funcs.values(), key=lambda i: i.id):
+        for node in own_body_nodes(f.node):
+            if isinstance(node, ast.Call):
+                chain = attribute_chain(node.func)
+                for fid in sorted(jit_call_targets(index, f, node)):
+                    note(fid, f"{'.'.join(chain)}() at "
+                              f"{f.file.rel}:{node.lineno}")
+    for sf in index.files:
+        shim = module_shim(sf)
+        for node in own_body_nodes(sf.tree):
+            if isinstance(node, ast.Call):
+                chain = attribute_chain(node.func)
+                for fid in sorted(jit_call_targets(index, shim, node)):
+                    note(fid, f"{'.'.join(chain)}() at "
+                              f"{sf.rel}:{node.lineno}")
+    # 2) decorator-form jit: @jax.jit / @jit / @partial(jax.jit, ..)
+    for fid in sorted(index.funcs):
+        f = index.funcs[fid]
+        node = f.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(is_jit_expr(d) for d in node.decorator_list):
+                note(fid, f"@jit on {f.qualname}")
+    # 3) every compute hook of a PluginBase-derived class
+    for ci in sorted(
+        index.subclasses_of("PluginBase"), key=lambda c: (c.module, c.name)
+    ):
+        for mname, fid in sorted(ci.methods.items()):
+            if mname in TRACED_PLUGIN_METHODS:
+                note(fid, f"plugin hook {ci.name}.{mname}")
+    return roots
+
+
+# ---- effect vocabulary ---------------------------------------------------
+
+# the WAL-backed containers of internal/queue.py + internal/cache.py;
+# a write that bypasses their journaled mutators is a durability bug
+TRACKED_STORES = frozenset({
+    "_active", "_backoff", "_unschedulable", "_in_flight",
+    "_deleted_in_flight", "_nodes", "_bound", "_assumed",
+})
+
+# public queue/cache mutators: they append their journal record before
+# mutating, under their own lock — the WAL-correct funnel. The names
+# unique to the queue/cache API match any queue/cache-ish receiver;
+# the three that collide with dict/set methods (add/update/delete)
+# require the receiver to literally be the queue, or `ctx._cache`
+# memo-dict writes would read as the WAL funnel
+JOURNALED_MUTATORS = frozenset({
+    "pop_ready", "retire_in_flight",
+    "requeue_backoff", "flush_backoff", "flush_unschedulable_timeout",
+    "move_all_to_active_or_backoff", "recover_in_flight", "load_state",
+    "add_node", "update_node", "remove_node", "add_pod", "remove_pod",
+    "assume", "finish_binding", "confirm", "forget", "cleanup_expired",
+})
+_AMBIGUOUS_MUTATORS = frozenset({"add", "update", "delete"})
+_QUEUE_SEGMENTS = frozenset({"queue", "_queue"})
+
+_JOURNAL_FUNNELS = frozenset({
+    "_journal", "_emit", "_emit_node", "_append_record",
+})
+
+_MUTATING_METHODS = frozenset({
+    "pop", "popitem", "clear", "update", "setdefault", "append", "add",
+    "remove", "discard", "insert", "extend", "move_to_end",
+})
+
+_LOCK_SUFFIXES = ("_lock", "_cond", "_condition")
+
+_OS_IO = frozenset({
+    "fsync", "replace", "rename", "unlink", "remove", "listdir",
+    "makedirs", "open", "fdopen", "stat", "mkdir", "rmdir", "scandir",
+    "walk", "close", "write", "read",
+})
+
+_LOG_METHODS = frozenset({
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+})
+_LOG_ROOTS = frozenset({"logging", "logger", "log", "_log", "_logger"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Effect:
+    kind: str
+    detail: str  # concrete source shape, e.g. "self.queue.add()"
+    line: int  # where it occurs, in the owning function's file
+
+
+def _has_store_segment(chain: tuple[str, ...]) -> bool:
+    return any(seg in TRACKED_STORES for seg in chain)
+
+
+def _is_containerish(seg: str) -> bool:
+    low = seg.lower()
+    return "queue" in low or "cache" in low
+
+
+def call_effects(
+    chain: tuple[str, ...], aliases: dict[str, str]
+) -> list[tuple[str, str]]:
+    """Textual classification of one call's attribute chain into
+    (kind, detail) pairs. Pure shape matching — see module docstring."""
+    dotted = ".".join(chain)
+    out: list[tuple[str, str]] = []
+    last = chain[-1]
+    tag = aliases.get(chain[0])
+
+    if last == "ack_barrier":
+        return [("ack", f"{dotted}()")]
+    if last in _JOURNAL_FUNNELS and chain[0] in ("self", "cls"):
+        return [("journal", f"{dotted}()")]
+    if (
+        last == "append" and len(chain) >= 2
+        and any(seg in ("journal", "_journal", "wal", "_wal")
+                for seg in chain[:-1])
+    ):
+        return [("journal", f"{dotted}()")]
+    if len(chain) >= 2 and (
+        (last in JOURNALED_MUTATORS
+         and any(_is_containerish(seg) for seg in chain[:-1]))
+        or (last in _AMBIGUOUS_MUTATORS
+            and any(seg in _QUEUE_SEGMENTS for seg in chain[:-1]))
+    ):
+        # the journaled funnel: appends, then mutates, under its lock
+        return [("journal", f"{dotted}()"), ("mutation", f"{dotted}()")]
+    if last in _MUTATING_METHODS and _has_store_segment(chain[:-1]):
+        out.append(("mutation", f"{dotted}()"))
+    if last == "acquire" and len(chain) >= 2 and (
+        chain[-2].endswith(_LOCK_SUFFIXES)
+    ):
+        out.append(("lock", f"{dotted}()"))
+
+    if chain == ("print",):
+        out.append(("io", "print"))
+    elif chain == ("open",):
+        out.append(("io", "open()"))
+    elif tag == "os" and len(chain) > 1 and chain[-1] in _OS_IO:
+        out.append(("io", f"os.{chain[-1]}()"))
+    elif tag in ("socket", "subprocess", "shutil") and len(chain) > 1:
+        out.append(("io", f"{tag}.{chain[-1]}()"))
+    elif tag == "logging" and len(chain) > 1:
+        out.append(("io", f"logging.{chain[-1]}()"))
+    elif chain[0] in _LOG_ROOTS and last in _LOG_METHODS:
+        out.append(("io", f"{dotted}()"))
+    elif tag and "." in tag and len(chain) == 1:
+        # bare name bound by `from <mod> import <name>`
+        base = tag.split(".", 1)[0]
+        if base in ("socket", "subprocess", "shutil"):
+            out.append(("io", f"{tag}()"))
+        elif base == "os" and tag.split(".", 1)[1] in _OS_IO:
+            out.append(("io", f"{tag}()"))
+        elif base == "time":
+            out.append(("time", f"{tag}()"))
+        elif base == "random":
+            out.append(("rng", f"{tag}()"))
+    elif tag == "time" and len(chain) > 1:
+        out.append(("time", f"time.{chain[-1]}()"))
+    elif tag == "datetime" and last in _DATETIME_IMPURE:
+        out.append(("time", f"datetime.{last}()"))
+    elif tag == "random" and len(chain) > 1:
+        out.append(("rng", f"random.{chain[-1]}()"))
+    elif tag == "np" and len(chain) >= 3 and chain[1] == "random":
+        out.append(("rng", f"numpy.random.{chain[-1]}()"))
+    return out
+
+
+def _store_effects(
+    target: ast.AST, line: int
+) -> list[tuple[str, str]]:
+    """Effects of one assignment/delete TARGET."""
+    sub = isinstance(target, ast.Subscript)
+    node = target.value if sub else target
+    chain = attribute_chain(node)
+    if chain is None:
+        return []
+    dotted = ".".join(chain) + ("[...]" if sub else "")
+    out: list[tuple[str, str]] = []
+    if _has_store_segment(chain):
+        out.append(("mutation", f"{dotted} ="))
+    if chain[0] in ("self", "cls") and len(chain) >= 2:
+        out.append(("self_write", f"{dotted} ="))
+    return out
+
+
+def direct_effects(f: FuncInfo, aliases: dict[str, str]) -> tuple:
+    """The effects f performs in its own frame (nested defs excluded)."""
+    out: list[Effect] = []
+
+    def emit(pairs: Iterable[tuple[str, str]], line: int) -> None:
+        out.extend(Effect(kind, detail, line) for kind, detail in pairs)
+
+    for node in own_body_nodes(f.node):
+        if isinstance(node, ast.Call):
+            chain = attribute_chain(node.func)
+            if chain is not None:
+                emit(call_effects(chain, aliases), node.lineno)
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("inc", "observe", "set")
+                and isinstance(node.func.value, ast.Call)
+                and isinstance(node.func.value.func, ast.Attribute)
+                and node.func.value.func.attr == "labels"
+            ):
+                # family.labels(...).inc() — chain is rooted at a Call,
+                # so attribute_chain is None; match the shape directly
+                emit([("metrics",
+                       f".labels(...).{node.func.attr}()")], node.lineno)
+            if chain is not None and len(chain) >= 2 and (
+                chain[-1] in ("inc", "observe")
+                and any("metric" in seg.lower() for seg in chain[:-1])
+            ):
+                emit([("metrics", ".".join(chain) + "()")], node.lineno)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                chain = attribute_chain(item.context_expr)
+                if chain and chain[-1].endswith(_LOCK_SUFFIXES):
+                    emit([("lock", f"with {'.'.join(chain)}:")],
+                         node.lineno)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            if isinstance(node, ast.AnnAssign) and node.value is None:
+                targets = []
+            for t in targets:
+                emit(_store_effects(t, node.lineno), node.lineno)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                emit(_store_effects(t, node.lineno), node.lineno)
+        elif isinstance(node, ast.Global):
+            emit([("global_write", f"global {', '.join(node.names)}")],
+                 node.lineno)
+    return tuple(out)
+
+
+# ---- the engine ----------------------------------------------------------
+
+
+class EffectEngine:
+    """Whole-program effect summaries + the traced region, computed
+    lazily and shared by every pass through LintContext.effects."""
+
+    def __init__(self, index: CodeIndex) -> None:
+        self.index = index
+        self._aliases: dict[str, dict[str, str]] = {}
+        self._direct: dict[str, tuple] = {}
+        self._call_refs: dict[str, frozenset[str]] = {}
+        # fid -> kind -> (detail, first-callee-hop qualname | None)
+        self._summaries: dict[str, dict[str, tuple[str, str | None]]] = {}
+        self._summaries_built = False
+        self._roots: dict[str, str] | None = None
+        self._region: dict[str, tuple[str, ...]] | None = None
+
+    def aliases_for(self, sf: SourceFile) -> dict[str, str]:
+        hit = self._aliases.get(sf.rel)
+        if hit is None:
+            hit = module_aliases(sf, ALIAS_TARGETS)
+            self._aliases[sf.rel] = hit
+        return hit
+
+    def direct(self, fid: str) -> tuple:
+        hit = self._direct.get(fid)
+        if hit is None:
+            f = self.index.funcs[fid]
+            hit = direct_effects(f, self.aliases_for(f.file))
+            self._direct[fid] = hit
+        return hit
+
+    def call_references(self, f: FuncInfo) -> frozenset[str]:
+        """Functions f may CALL: call targets, callback-position
+        arguments (lax.scan/cond bodies, Thread targets), and nested
+        lambdas. Narrower than CodeIndex.references on purpose — that
+        one also follows bare attribute READS through the by-name
+        fallback (`node.spec.unschedulable` would drag a method named
+        `unschedulable` into the traced region), which is the right
+        over-approximation for TRACE-SAFETY's import walk but smears
+        effect summaries with never-executed frames."""
+        hit = self._call_refs.get(f.id)
+        if hit is not None:
+            return hit
+        index = self.index
+        out: set[str] = set()
+        for node in own_body_nodes(f.node):
+            if not isinstance(node, ast.Call):
+                continue
+            out |= index.resolve_callback(f, node.func)
+            for arg in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                out |= self._arg_targets(f, arg)
+        for name, fid in index._children.get(f.id, {}).items():
+            if name.startswith("<lambda"):
+                out.add(fid)
+        result = frozenset(out - {f.id})
+        self._call_refs[f.id] = result
+        return result
+
+    def _arg_targets(self, f: FuncInfo, arg: ast.AST) -> set[str]:
+        """A callback passed as an argument. Bare names resolve through
+        the lexical ladder (no by-name fallback — safe); attribute
+        chains resolve only when rooted at a module alias or a real
+        `self.`/`cls.` method, because the by-name fallback would turn
+        every data-attribute read passed to a builtin (`len(x.nodes)`)
+        into a phantom call edge."""
+        index = self.index
+        if isinstance(arg, (ast.Name, ast.Lambda)):
+            return index.resolve_callback(f, arg)
+        if isinstance(arg, ast.Call):  # functools.partial(fn, ...)
+            fchain = attribute_chain(arg.func)
+            if fchain and fchain[-1] == "partial" and arg.args:
+                return self._arg_targets(f, arg.args[0])
+            return set()
+        if isinstance(arg, ast.Attribute):
+            chain = attribute_chain(arg)
+            if chain is None:
+                return set()
+            if index._aliases.get(f.file.rel, {}).get(chain[0]):
+                return index.resolve_chain(f, chain)
+            if (
+                chain[0] in ("self", "cls") and f.cls is not None
+                and len(chain) == 2
+            ):
+                return index.class_method(f.module, f.cls, chain[1])
+        return set()
+
+    def summary(self, fid: str) -> dict[str, tuple[str, str | None]]:
+        """kind -> (concrete detail, first callee hop or None if the
+        effect is f's own). Fixpoint over the full call graph."""
+        if not self._summaries_built:
+            self._build_summaries()
+        return self._summaries.get(fid, {})
+
+    def _build_summaries(self) -> None:
+        index = self.index
+        refs = {
+            fid: sorted(self.call_references(f))
+            for fid, f in index.funcs.items()
+        }
+        rev: dict[str, set[str]] = {}
+        for fid, rs in refs.items():
+            for r in rs:
+                rev.setdefault(r, set()).add(fid)
+        summ: dict[str, dict[str, tuple[str, str | None]]] = {}
+        for fid in index.funcs:
+            summ[fid] = {
+                e.kind: (e.detail, None) for e in self.direct(fid)
+            }
+        work = deque(sorted(index.funcs))
+        queued = set(work)
+        while work:
+            fid = work.popleft()
+            queued.discard(fid)
+            s = summ[fid]
+            changed = False
+            for callee in refs[fid]:
+                cs = summ.get(callee)
+                if not cs:
+                    continue
+                hop = index.funcs[callee].qualname
+                for kind, (detail, _via) in cs.items():
+                    if kind not in s:
+                        s[kind] = (detail, hop)
+                        changed = True
+            if changed:
+                for caller in sorted(rev.get(fid, ())):
+                    if caller not in queued:
+                        queued.add(caller)
+                        work.append(caller)
+        self._summaries = summ
+        self._summaries_built = True
+
+    def call_kinds(
+        self, f: FuncInfo, node: ast.Call
+    ) -> dict[str, tuple[str, str | None]]:
+        """Effect kinds one call expression may perform: the chain's
+        textual classification unioned with the summaries of every
+        function the callee expression resolves to."""
+        out: dict[str, tuple[str, str | None]] = {}
+        chain = attribute_chain(node.func)
+        if chain is not None:
+            for kind, detail in call_effects(chain, self.aliases_for(f.file)):
+                out.setdefault(kind, (detail, None))
+        for t in sorted(self.index.resolve_callback(f, node.func)):
+            hop = self.index.funcs[t].qualname
+            for kind, (detail, _via) in self.summary(t).items():
+                out.setdefault(kind, (detail, hop))
+        return out
+
+    def traced_roots(self) -> dict[str, str]:
+        if self._roots is None:
+            self._roots = traced_roots(self.index)
+        return self._roots
+
+    def traced_region(self) -> dict[str, tuple[str, ...]]:
+        """fid -> witness path of qualnames from a root to fid (roots
+        map to a 1-element path). Deterministic BFS so finding messages
+        are baseline-stable."""
+        if self._region is not None:
+            return self._region
+        index = self.index
+        region: dict[str, tuple[str, ...]] = {}
+        q: deque[str] = deque()
+        for fid in sorted(self.traced_roots()):
+            if fid in index.funcs and fid not in region:
+                region[fid] = (index.funcs[fid].qualname,)
+                q.append(fid)
+        while q:
+            fid = q.popleft()
+            path = region[fid]
+            for ref in sorted(self.call_references(index.funcs[fid])):
+                if ref not in region and ref in index.funcs:
+                    region[ref] = path + (index.funcs[ref].qualname,)
+                    q.append(ref)
+        self._region = region
+        return region

@@ -1,0 +1,229 @@
+"""JIT-PURITY (JP0xx): effect leaks into traced regions + compile-churn.
+
+Stands on the interprocedural effect engine (analysis/effects.py): the
+*traced region* is every function reachable from a jit/vmap entry
+point, and each in-region function is checked for the host effects its
+own frame performs. TRACE-SAFETY already owns time/RNG/print/import/
+global under trace (TS001-TS003); this pass covers the effect kinds a
+per-file matcher cannot see are traced, plus two compile-cache-churn
+hazards that defeat the arena's program cache:
+
+- JP001  host I/O (file/os/socket/subprocess/logging) reachable in a
+         traced region: runs at trace time only, then never again —
+         the compiled program silently stops doing it
+- JP002  lock acquired inside a traced region: trace-time-only mutual
+         exclusion is a no-op on replay (and a deadlock seed if the
+         trace happens under the same lock)
+- JP003  journal append / metric emit inside a traced region: records
+         written once at trace time read as live progress (silent
+         staleness — the WAL and dashboards lie)
+- JP004  object attribute written inside a traced region: Python-side
+         state mutated at trace time only, then frozen (the compiled
+         program replays without it); `__init__` of objects built
+         during the trace is exempt
+- JP005  non-deterministic jit discriminator argument (id()/hash()/
+         clock/RNG/uuid/pid, or unsorted dict iteration): every run
+         mints a fresh cache key, so the compile cache never hits
+         (the `_fw_disc` sorted(...) contract in core/cycle.py)
+- JP006  jit wrapper constructed inside a loop: each iteration builds
+         a fresh callable with an empty compile cache — memoize the
+         wrapper or hoist it out of the loop
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import FuncInfo, attribute_chain, own_body_nodes
+from .core import Finding, LintContext
+from .effects import (
+    JIT_NAMES,
+    EffectEngine,
+    module_shim,
+)
+from .registry import PassBase
+
+# effect kinds this pass reports, and how (TS002/TS003 own time/rng/
+# global — double-flagging one line under two codes would force double
+# suppressions)
+_KIND_TO_CODE = {
+    "io": "JP001",
+    "lock": "JP002",
+    "journal": "JP003",
+    "metrics": "JP003",
+    "self_write": "JP004",
+}
+
+_KIND_WHY = {
+    "io": "host I/O runs at trace time only; the compiled program "
+          "silently stops doing it on replay",
+    "lock": "a trace-time lock acquisition is a no-op in the compiled "
+            "program (and a deadlock seed if tracing happens under "
+            "the same lock)",
+    "journal": "a journal record appended at trace time is written "
+               "once, then never again — acked work would look "
+               "durable while the WAL goes stale",
+    "metrics": "a metric emitted at trace time moves once, then "
+               "freezes — dashboards read live progress that is not "
+               "happening",
+    "self_write": "an attribute written at trace time mutates Python "
+                  "state once; the compiled program replays without "
+                  "it",
+}
+
+_NONDET_CALLS = frozenset({"id", "hash"})
+_DICT_ITER = frozenset({"items", "keys", "values"})
+
+
+class JitPurityPass(PassBase):
+    name = "JIT-PURITY"
+    codes = {
+        "JP001": "host I/O reachable inside a traced region",
+        "JP002": "lock acquired inside a traced region",
+        "JP003": "journal append / metric emit inside a traced region "
+                 "(trace-time-only: silent staleness)",
+        "JP004": "object attribute written inside a traced region",
+        "JP005": "non-deterministic jit discriminator (defeats the "
+                 "compile cache)",
+        "JP006": "jit wrapper constructed inside a loop (fresh compile "
+                 "cache per iteration)",
+    }
+
+    def run(self, ctx: LintContext) -> list[Finding]:
+        engine: EffectEngine = ctx.effects
+        index = ctx.index
+        out: list[Finding] = []
+
+        # JP001-JP004: direct effects of every in-region function; the
+        # region is interprocedural, the attribution is the function's
+        # own frame so the finding lands on the offending line
+        region = engine.traced_region()
+        for fid in sorted(region):
+            f = index.funcs[fid]
+            if f.name in ("__init__", "__post_init__"):
+                # constructing a fresh object during the trace writes
+                # self by definition; the hazard JP004 targets is
+                # mutation of pre-existing state
+                continue
+            path = region[fid]
+            via = " -> ".join(path)
+            for e in engine.direct(fid):
+                code = _KIND_TO_CODE.get(e.kind)
+                if code is None or e.detail == "print":
+                    continue  # time/rng/print/global are TS002/TS003
+                out.append(Finding(
+                    f.file.rel, e.line, code,
+                    f"{e.detail} in traced-reachable {f.qualname} "
+                    f"(traced via {via}): {_KIND_WHY[e.kind]}",
+                ))
+
+        # JP005/JP006: jit call-site shape checks, everywhere
+        for f in self._all_frames(ctx):
+            out.extend(self._check_frames(engine, f))
+        return out
+
+    def _all_frames(self, ctx: LintContext):
+        index = ctx.index
+        for fid in sorted(index.funcs):
+            yield index.funcs[fid]
+        for sf in index.files:
+            yield module_shim(sf)
+
+    # ---- JP005: discriminator determinism --------------------------------
+
+    def _check_frames(
+        self, engine: EffectEngine, f: FuncInfo
+    ) -> list[Finding]:
+        out: list[Finding] = []
+        aliases = engine.aliases_for(f.file)
+        loops = self._loop_lines(f)
+        for node in own_body_nodes(f.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attribute_chain(node.func)
+            if not chain or chain[-1] not in JIT_NAMES:
+                continue
+            label = ".".join(chain)
+            for arg in list(node.args[1:]) + [
+                kw.value for kw in node.keywords
+            ]:
+                for line, why in self._nondet(arg, aliases, False):
+                    out.append(Finding(
+                        f.file.rel, line, "JP005",
+                        f"non-deterministic {label}() discriminator in "
+                        f"{f.qualname}: {why} — every run mints a "
+                        "fresh compile-cache key, so the cache never "
+                        "hits across runs (sort / use stable inputs, "
+                        "like _fw_disc in core/cycle.py)",
+                    ))
+            if node.lineno in loops and node.args:
+                out.append(Finding(
+                    f.file.rel, node.lineno, "JP006",
+                    f"{label}() constructed inside a loop in "
+                    f"{f.qualname}: each iteration builds a fresh "
+                    "callable with an empty compile cache "
+                    "(re-trace + re-compile per iteration); hoist "
+                    "the wrapper or memoize it keyed on the callee",
+                ))
+        return out
+
+    def _loop_lines(self, f: FuncInfo) -> set[int]:
+        """Line numbers inside a For/While body of f's own frame. Since
+        the JP006 call check itself only looks at f's own frame, a jit
+        call on one of these lines really does repeat per iteration
+        (loops belonging to nested defs are not seen here — a nested
+        def is its own frame)."""
+        lines: set[int] = set()
+        for node in own_body_nodes(f.node):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                first = node.body[0].lineno
+                last = node.body[-1].end_lineno or node.body[-1].lineno
+                lines.update(range(first, last + 1))
+        return lines
+
+    def _nondet(
+        self, expr: ast.AST, aliases: dict[str, str], in_sorted: bool
+    ) -> list[tuple[int, str]]:
+        """(line, reason) for every non-deterministic construct in a
+        discriminator expression; `sorted(...)` neutralizes dict-order
+        dependence below it (the core/cycle.py _fw_disc contract)."""
+        out: list[tuple[int, str]] = []
+        if isinstance(expr, ast.Call):
+            chain = attribute_chain(expr.func)
+            if chain:
+                tag = aliases.get(chain[0])
+                last = chain[-1]
+                if chain == ("sorted",):
+                    for a in expr.args:
+                        out.extend(self._nondet(a, aliases, True))
+                    return out
+                if len(chain) == 1 and last in _NONDET_CALLS:
+                    out.append((expr.lineno,
+                                f"{last}() is process-random (ASLR / "
+                                "PYTHONHASHSEED)"))
+                elif tag in ("time", "datetime") or (
+                    tag and tag.startswith("time.")
+                ):
+                    out.append((expr.lineno, "clock read"))
+                elif tag == "random" or (
+                    tag and tag.startswith("random.")
+                ):
+                    out.append((expr.lineno, "host RNG"))
+                elif tag == "uuid" or chain[0] == "uuid":
+                    out.append((expr.lineno, "uuid mint"))
+                elif tag == "os" and last in ("getpid", "urandom"):
+                    out.append((expr.lineno, f"os.{last}()"))
+                elif last in _DICT_ITER and not in_sorted:
+                    out.append((expr.lineno,
+                                f".{last}() iterates in container "
+                                "order; wrap in sorted(...)"))
+            for a in list(expr.args) + [kw.value for kw in expr.keywords]:
+                out.extend(self._nondet(a, aliases, in_sorted))
+            return out
+        if isinstance(expr, (ast.Set, ast.SetComp)) and not in_sorted:
+            out.append((expr.lineno,
+                        "set iteration order is hash-random"))
+        for child in ast.iter_child_nodes(expr):
+            if not isinstance(child, (ast.Lambda, ast.FunctionDef)):
+                out.extend(self._nondet(child, aliases, in_sorted))
+        return out

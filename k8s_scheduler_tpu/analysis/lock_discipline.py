@@ -32,7 +32,7 @@ import os
 from .callgraph import FuncInfo, attribute_chain
 from .core import Finding, LintContext
 from .registry import PassBase
-from .trace_safety import _module_aliases
+from .effects import module_aliases as _module_aliases
 
 _DEFAULT_SCOPE = ("internal/", "state/", "core/flight_recorder")
 _RANK = {"queue": 0, "cache": 1, "journal": 2}

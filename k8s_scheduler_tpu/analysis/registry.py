@@ -48,8 +48,10 @@ class PassRegistry:
 
 
 def default_registry() -> PassRegistry:
+    from .durability_order import DurabilityOrderPass
     from .hygiene import HygienePass
     from .inventory import InventoryDriftPass
+    from .jit_purity import JitPurityPass
     from .journal_emit import JournalEmitOncePass
     from .lock_discipline import LockDisciplinePass
     from .races import RacesPass
@@ -62,8 +64,10 @@ def default_registry() -> PassRegistry:
     r = PassRegistry()
     for cls in (
         TraceSafetyPass,
+        JitPurityPass,
         LockDisciplinePass,
         JournalEmitOncePass,
+        DurabilityOrderPass,
         InventoryDriftPass,
         HygienePass,
         RobustnessPass,
@@ -78,9 +82,21 @@ def default_registry() -> PassRegistry:
 
 def all_codes(registry: PassRegistry | None = None) -> dict[str, str]:
     """code -> description across every registered pass (the README
-    table's source of truth)."""
+    table's source of truth). Raises when two passes claim the same
+    code — last-write-wins here would silently document one pass's
+    description for another pass's findings, and suppressions/baseline
+    entries keyed on the code would hit both."""
     registry = registry or default_registry()
     out: dict[str, str] = {}
+    owner: dict[str, str] = {}
     for name in registry.names():
-        out.update(registry.make(name).codes)
+        for code, desc in registry.make(name).codes.items():
+            if code in owner:
+                raise ValueError(
+                    f"finding code {code!r} claimed by both "
+                    f"{owner[code]!r} and {name!r}; codes must be "
+                    "unique across passes"
+                )
+            owner[code] = name
+            out[code] = desc
     return out

@@ -30,54 +30,14 @@ import ast
 
 from .callgraph import CodeIndex, FuncInfo, attribute_chain, own_body_nodes
 from .core import Finding, LintContext, SourceFile
+from .effects import (
+    ALIAS_TARGETS as _ALIAS_TARGETS,
+    module_aliases as _module_aliases,
+    traced_roots,
+)
 from .registry import PassBase
 
-# the PluginBase hooks that are traced inside the cycle programs
-TRACED_PLUGIN_METHODS = frozenset({
-    "static_mask", "static_score", "dyn_mask", "dyn_score",
-    "extra_init", "extra_update", "dyn_mask_batched", "dyn_score_batched",
-    "extra_update_batched", "score_node_anchor", "post_filter",
-})
-
-_JIT_NAMES = frozenset({"jit", "pjit", "pmap", "_jit"})
-
 _DATETIME_IMPURE = frozenset({"now", "utcnow", "today", "fromtimestamp"})
-
-
-def _module_aliases(sf: SourceFile, targets: dict[str, str]) -> dict:
-    """alias -> canonical target for stdlib-ish modules we care about
-    (`targets` maps real module name -> canonical tag)."""
-    out: dict[str, str] = {}
-    for node in sf.walk():
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name in targets:
-                    out[a.asname or a.name.split(".")[0]] = targets[a.name]
-        elif isinstance(node, ast.ImportFrom):
-            if node.level == 0 and node.module == "jax":
-                for a in node.names:
-                    if a.name == "numpy":  # from jax import numpy as jnp
-                        out[a.asname or a.name] = "jnp"
-            elif node.level == 0 and node.module in targets:
-                tag = targets[node.module]
-                for a in node.names:
-                    if tag in ("time", "random"):
-                        # from time import monotonic -> bare-name call
-                        out[a.asname or a.name] = f"{tag}.{a.name}"
-                    elif tag == "datetime":
-                        # from datetime import datetime/date: the bound
-                        # class carries the impure .now()/.today()
-                        out[a.asname or a.name] = "datetime"
-    return out
-
-
-_ALIAS_TARGETS = {
-    "time": "time",
-    "datetime": "datetime",
-    "random": "random",
-    "numpy": "np",
-    "jax.numpy": "jnp",
-}
 
 
 def module_jnp_constants(sf: SourceFile) -> list[int]:
@@ -115,70 +75,15 @@ class TraceSafetyPass(PassBase):
 
     def run(self, ctx: LintContext) -> list[Finding]:
         index = ctx.index
-        roots = self._roots(index)
+        # root discovery lives in effects.py (one ladder shared with
+        # the JIT-PURITY engine, so the two cannot drift)
+        roots = set(traced_roots(index))
         reachable = index.reachable(roots)
         findings: list[Finding] = []
         for fid in sorted(reachable):
             f = index.funcs[fid]
             findings.extend(self._check_function(ctx, index, f))
         return findings
-
-    # ---- root discovery --------------------------------------------------
-
-    def _roots(self, index: CodeIndex) -> set[str]:
-        roots: set[str] = set()
-        # 1) first argument of jit-wrapping calls — inside any function,
-        #    and at module scope (`cycle = jax.jit(fn)` in a script)
-        for f in index.funcs.values():
-            for node in own_body_nodes(f.node):
-                if isinstance(node, ast.Call):
-                    roots |= self._jit_call_targets(index, f, node)
-        for sf in index.files:
-            shim = FuncInfo(
-                id=f"{sf.rel}::<module>", file=sf, node=sf.tree,
-                name="<module>", qualname="<module>", cls=None,
-                parent=None, lineno=1,
-            )
-            for node in own_body_nodes(sf.tree):
-                if isinstance(node, ast.Call):
-                    roots |= self._jit_call_targets(index, shim, node)
-        # 2) decorator-form jit: @jax.jit / @jit / @partial(jax.jit, ..)
-        for f in index.funcs.values():
-            node = f.node
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if any(self._is_jit_expr(d) for d in node.decorator_list):
-                    roots.add(f.id)
-        # 3) every compute hook of a PluginBase-derived class
-        for ci in index.subclasses_of("PluginBase"):
-            for mname, fid in ci.methods.items():
-                if mname in TRACED_PLUGIN_METHODS:
-                    roots.add(fid)
-        return roots
-
-    @staticmethod
-    def _is_jit_expr(expr: ast.AST) -> bool:
-        chain = attribute_chain(expr)
-        if chain and chain[-1] in _JIT_NAMES:
-            return True
-        if isinstance(expr, ast.Call):
-            fchain = attribute_chain(expr.func)
-            if fchain and fchain[-1] in _JIT_NAMES:
-                return True  # @jax.jit(static_argnums=...) factory form
-            if fchain and fchain[-1] == "partial" and expr.args:
-                achain = attribute_chain(expr.args[0])
-                return bool(achain and achain[-1] in _JIT_NAMES)
-        return False
-
-    def _jit_call_targets(
-        self, index: CodeIndex, f, node: ast.Call
-    ) -> set[str]:
-        chain = attribute_chain(node.func)
-        if not chain or chain[-1] not in _JIT_NAMES or not node.args:
-            return set()
-        # jax.jit(fn) / jax.jit(partial(fn, ...)) / jax.jit(lambda ...):
-        # the one shared callback-resolution ladder (callgraph.py) —
-        # Thread targets and observer registrations resolve identically
-        return index.resolve_callback(f, node.args[0])
 
     # ---- per-function checks ---------------------------------------------
 

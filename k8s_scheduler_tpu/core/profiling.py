@@ -70,7 +70,7 @@ def profile_plugins(
 
     for plugin in framework.filters:
         if ("static", plugin.name, "Filter") not in cache:
-            cache[("static", plugin.name, "Filter")] = jax.jit(
+            cache[("static", plugin.name, "Filter")] = jax.jit(  # schedlint: disable=JP006 -- _probe_cache guard above: built once per plugin per process, then reused
                 lambda s, p=plugin: p.static_mask(CycleContext(s))
             )
         fn = cache[("static", plugin.name, "Filter")]
@@ -91,7 +91,7 @@ def profile_plugins(
 
     for plugin, weight in framework.scores:
         if ("static", plugin.name, "Score") not in cache:
-            cache[("static", plugin.name, "Score")] = jax.jit(
+            cache[("static", plugin.name, "Score")] = jax.jit(  # schedlint: disable=JP006 -- _probe_cache guard above: built once per plugin per process, then reused
                 lambda s, p=plugin: p.static_score(CycleContext(s))
             )
         fn = cache[("static", plugin.name, "Score")]

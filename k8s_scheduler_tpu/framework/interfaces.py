@@ -51,7 +51,11 @@ class CycleContext:
 
     def get(self, key: str, compute) -> Any:
         if key not in self._cache:
-            self._cache[key] = compute(self.snap)
+            # a CycleContext lives exactly as long as one trace: the
+            # memo is MEANT to be written at trace time (it dedupes
+            # recomputation across plugins within the trace) and is
+            # garbage the moment tracing ends
+            self._cache[key] = compute(self.snap)  # schedlint: disable=JP004 -- per-trace memo; the object dies with the trace
         return self._cache[key]
 
     @property

@@ -166,7 +166,9 @@ class SchedulerAgent:
         self.evict_applier = evict_applier or (lambda uid, node: None)
         # posts each drained scheduler event as a Kubernetes Event
         self.event_applier = event_applier or (lambda ev: None)
-        self._nodes: dict[str, Node] = {}
+        # informer-side mirror of the cluster view, NOT WAL-tracked
+        # state (the server's cache._nodes is the durable copy)
+        self._node_mirror: dict[str, Node] = {}
         self._pods: dict[str, tuple[Pod, str]] = {}  # uid -> (pod, bound_node)
         self._groups: dict[str, PodGroup] = {}
         self._pvcs: dict[str, object] = {}
@@ -180,8 +182,8 @@ class SchedulerAgent:
     # ---- informer-side entry points -------------------------------------
 
     def upsert_node(self, node: Node) -> None:
-        known = node.name in self._nodes
-        self._nodes[node.name] = node
+        known = node.name in self._node_mirror
+        self._node_mirror[node.name] = node
         self._send(
             pb.UpdateRequest(
                 **{
@@ -193,7 +195,7 @@ class SchedulerAgent:
         )
 
     def delete_node(self, name: str) -> None:
-        self._nodes.pop(name, None)
+        self._node_mirror.pop(name, None)
         self._send(pb.UpdateRequest(node_deletes=[name]))
 
     def upsert_pod(self, pod: Pod, bound_node: str = "") -> None:
@@ -347,7 +349,7 @@ class SchedulerAgent:
     def relist(self) -> None:
         """Replay everything we know into a (possibly fresh) shim."""
         req = pb.UpdateRequest()
-        for node in self._nodes.values():
+        for node in self._node_mirror.values():
             req.node_adds.append(convert.node_to(node))
         for g in self._groups.values():
             req.pod_groups.append(

@@ -11,6 +11,11 @@ path keeps the historical entry point working:
 and `tests/test_metrics.py` keeps importing `check_inventory` from
 here. Prefer `python scripts/schedlint.py` (optionally
 `--passes INVENTORY-DRIFT`) for the full surface.
+
+The output also carries one machine-readable `schedlint-summary` JSON
+row — per-pass new/suppressed/grandfathered finding counts over the
+full tree — so bench/CI harnesses that already scrape this script can
+diff lint posture across PRs without a second invocation.
 """
 
 from __future__ import annotations
@@ -35,6 +40,7 @@ __all__ = [
     "docstring_names",
     "readme_names",
     "registered_names",
+    "schedlint_summary",
 ]
 
 
@@ -43,7 +49,45 @@ def check_inventory() -> list[str]:
     return metric_inventory_problems(REPO)
 
 
+def schedlint_summary() -> dict:
+    """Per-pass finding counts over the full tree: {pass_name:
+    {"findings": n, "suppressed": n, "grandfathered": n}} plus a
+    "total" row. Codes map back to their owning pass through the
+    registry, so a pass with zero findings still shows up (a silently
+    skipped pass would read identically to a clean one otherwise)."""
+    from k8s_scheduler_tpu.analysis import default_registry, run_lint
+
+    registry = default_registry()
+    owner: dict[str, str] = {}
+    for name in registry.names():
+        for code in registry.make(name).codes:
+            owner[code] = name
+    result = run_lint(REPO)
+    rows = {
+        name: {"findings": 0, "suppressed": 0, "grandfathered": 0}
+        for name in registry.names()
+    }
+    for bucket, findings in (
+        ("findings", result.findings),
+        ("suppressed", result.suppressed),
+        ("grandfathered", result.grandfathered),
+    ):
+        for f in findings:
+            rows[owner[f.code]][bucket] += 1
+    return {
+        "files_scanned": result.files_scanned,
+        "passes": rows,
+        "total": {
+            "findings": len(result.findings),
+            "suppressed": len(result.suppressed),
+            "grandfathered": len(result.grandfathered),
+        },
+    }
+
+
 def main() -> int:
+    import json
+
     problems = check_inventory()
     if problems:
         for p in problems:
@@ -51,6 +95,8 @@ def main() -> int:
         return 1
     print(f"lint_metrics: ok ({len(registered_names())} metric families "
           "documented in both surfaces)")
+    print("schedlint-summary: "
+          + json.dumps(schedlint_summary(), sort_keys=True))
     return 0
 
 

@@ -3,20 +3,28 @@
 
     JAX_PLATFORMS=cpu python scripts/schedlint.py            # lint the tree
     python scripts/schedlint.py --json                       # machine output
-    python scripts/schedlint.py --changed                    # diff-scoped
+    python scripts/schedlint.py --changed --fail-on-new      # pre-commit loop
     python scripts/schedlint.py --passes TRACE-SAFETY        # one pass
+    python scripts/schedlint.py --sarif out.sarif            # CI annotations
     python scripts/schedlint.py --list-codes                 # code inventory
     python scripts/schedlint.py --write-baseline             # regrandfather
 
 Exit status: 0 = no unsuppressed, non-baselined findings; 1 = findings;
 2 = usage error. The committed baseline is .schedlint-baseline.json at
-the repo root (line-independent entries; shrink it, don't grow it).
-`--changed` scopes the scan to the .py files git reports modified or
-untracked under the default lint roots — the fast pre-commit loop (the
-parse cache makes repeats near-free); the full-tree run stays the
-tier-1/CI gate, since cross-file inventories can only be judged whole.
-See README "Static analysis" for pass/code docs and the
-`# schedlint: disable=CODE` suppression syntax.
+the repo root (line-independent, count-aware entries; shrink it, don't
+grow it). `--changed` scopes the scan to the .py files git reports
+modified or untracked under the default lint roots — the fast
+pre-commit loop (the parse cache makes repeats near-free); the
+full-tree run stays the tier-1/CI gate, since cross-file inventories
+can only be judged whole. A --changed run whose modifications all fall
+OUTSIDE the lint roots says so explicitly instead of printing a pass
+that looks like a clean lint. `--fail-on-new` is the regression gate:
+it requires a baseline, prints each new finding with its stable
+fingerprint, and nags about stale baseline entries that matched
+nothing so the file shrinks. `--sarif FILE` additionally writes SARIF
+2.1.0 for code-scanning UIs. See README "Static analysis" for
+pass/code docs and the `# schedlint: disable=CODE -- why` suppression
+syntax.
 """
 
 from __future__ import annotations
@@ -32,12 +40,15 @@ sys.path.insert(0, REPO)
 
 DEFAULT_BASELINE = os.path.join(REPO, ".schedlint-baseline.json")
 
-def changed_paths(repo: str) -> list[str] | None:
-    """Repo-relative .py files under the lint roots that git reports
-    modified (vs HEAD) or untracked. None when git is unavailable or
-    this is not a work tree (the caller turns that into a usage error —
-    silently linting nothing would be a permanent green). NUL-separated
-    output (-z) so octal-quoted non-ASCII names cannot be dropped."""
+def changed_paths(repo: str) -> tuple[list[str], list[str]] | None:
+    """(lintable, skipped): repo-relative files git reports modified
+    (vs HEAD) or untracked, split into .py files under the lint roots
+    and everything else — the caller reports the skipped set so a
+    "no changed files" pass can never be mistaken for a clean lint of
+    the change. None when git is unavailable or this is not a work
+    tree (the caller turns that into a usage error — silently linting
+    nothing would be a permanent green). NUL-separated output (-z) so
+    octal-quoted non-ASCII names cannot be dropped."""
     from k8s_scheduler_tpu.analysis.core import DEFAULT_PATHS
 
     roots = tuple(p.rstrip("/") + "/" for p in DEFAULT_PATHS)
@@ -54,12 +65,14 @@ def changed_paths(repo: str) -> list[str] | None:
             rels.update(r for r in out.split("\0") if r)
     except (OSError, subprocess.CalledProcessError):
         return None
-    return sorted(
-        r for r in rels
-        if r.endswith(".py")
-        and r.startswith(roots)
-        and os.path.exists(os.path.join(repo, r))
+    present = sorted(
+        r for r in rels if os.path.exists(os.path.join(repo, r))
     )
+    lintable = [
+        r for r in present if r.endswith(".py") and r.startswith(roots)
+    ]
+    skipped = [r for r in present if r not in lintable]
+    return lintable, skipped
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -92,6 +105,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--baseline", default=DEFAULT_BASELINE,
         help="baseline file ('' = none)",
+    )
+    ap.add_argument(
+        "--fail-on-new", action="store_true",
+        help="regression-gate mode: requires a baseline, prints each "
+        "new finding with its stable fingerprint, and warns about "
+        "stale baseline entries that matched nothing",
+    )
+    ap.add_argument(
+        "--sarif", default="", metavar="FILE",
+        help="also write a SARIF 2.1.0 report (new findings at error "
+        "level; suppressed/baselined carried with suppression kind)",
     )
     ap.add_argument(
         "--write-baseline", action="store_true",
@@ -135,17 +159,47 @@ def main(argv: list[str] | None = None) -> int:
                 "not --changed", file=sys.stderr,
             )
             return 2
-        changed = changed_paths(REPO)
-        if changed is None:
+        split = changed_paths(REPO)
+        if split is None:
             print(
                 "schedlint: --changed needs a git work tree",
                 file=sys.stderr,
             )
             return 2
+        changed, skipped = split
+        if skipped:
+            # loud, not silent: "ok" below must never read as a clean
+            # lint of files this scan never looked at
+            print(
+                f"schedlint: warning — {len(skipped)} changed file(s) "
+                "outside the lint roots were NOT scanned: "
+                + ", ".join(skipped[:5])
+                + (" ..." if len(skipped) > 5 else ""),
+                file=sys.stderr,
+            )
         if not changed:
-            print("schedlint: ok — no changed files under the lint roots")
+            note = " (nothing was linted)" if skipped else ""
+            print(
+                "schedlint: ok — no changed files under the lint "
+                f"roots{note}"
+            )
             return 0
         args.paths = changed
+
+    if args.fail_on_new and not args.baseline:
+        print(
+            "schedlint: --fail-on-new is a baseline diff; it needs "
+            "--baseline pointing at a file (the default works even "
+            "when the file does not exist yet)", file=sys.stderr,
+        )
+        return 2
+    if args.fail_on_new and args.write_baseline:
+        print(
+            "schedlint: --fail-on-new and --write-baseline are "
+            "mutually exclusive (one gates on the baseline, the other "
+            "replaces it)", file=sys.stderr,
+        )
+        return 2
 
     passes = None
     if args.passes:
@@ -184,12 +238,37 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 0
 
+    if args.sarif:
+        from k8s_scheduler_tpu.analysis.core import to_sarif
+        from k8s_scheduler_tpu.analysis.registry import all_codes
+
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(to_sarif(result, all_codes(registry)), fh, indent=2)
+            fh.write("\n")
+        print(f"schedlint: SARIF written -> {args.sarif}", file=sys.stderr)
+
+    if args.fail_on_new:
+        from k8s_scheduler_tpu.analysis.core import (
+            load_baseline,
+            stale_baseline_entries,
+        )
+
+        for (file, code, message), left in stale_baseline_entries(
+            load_baseline(args.baseline), result.grandfathered
+        ):
+            print(
+                f"schedlint: stale baseline entry ({left} unmatched): "
+                f"{file} {code} {message!r} — the finding is gone; "
+                "shrink the baseline", file=sys.stderr,
+            )
+
     if args.json:
         print(json.dumps(result.to_dict(), indent=2))
         return 0 if result.ok else 1
 
     for f in result.findings:
-        print(str(f), file=sys.stderr)
+        suffix = f"  [{f.fingerprint()}]" if args.fail_on_new else ""
+        print(f"{f}{suffix}", file=sys.stderr)
     tail = []
     if result.suppressed:
         tail.append(f"{len(result.suppressed)} suppressed")

@@ -923,7 +923,8 @@ def test_baseline_round_trip(tmp_path):
 def test_registry_mirrors_framework_semantics():
     reg = default_registry()
     assert reg.names() == sorted([
-        "TRACE-SAFETY", "LOCK-DISCIPLINE", "JOURNAL-EMIT-ONCE",
+        "TRACE-SAFETY", "JIT-PURITY", "LOCK-DISCIPLINE",
+        "JOURNAL-EMIT-ONCE", "DURABILITY-ORDER",
         "INVENTORY-DRIFT", "HYGIENE", "ROBUSTNESS",
         "THREADS", "RACES", "SHARD-SAFETY", "TENANCY-ISOLATION",
     ])
@@ -938,6 +939,35 @@ def test_registry_mirrors_framework_semantics():
     # the mesh-era families are registered with their full code span
     assert {"TR001", "TR002", "TR003", "TR004",
             "SH001", "SH002", "SH003", "ID009", "TN001"} <= set(codes)
+    # the effect-engine families likewise
+    assert {"JP001", "JP002", "JP003", "JP004", "JP005", "JP006",
+            "DO001", "DO002", "DO003"} <= set(codes)
+
+
+def test_all_codes_raises_on_cross_pass_collision():
+    """Two passes claiming the same finding code would make baselines,
+    suppressions, and SARIF rules ambiguous — registration-time error."""
+    from k8s_scheduler_tpu.analysis.registry import PassBase
+
+    class A(PassBase):
+        name = "A-PASS"
+        codes = {"XX001": "from A"}
+
+        def run(self, ctx):
+            return []
+
+    class B(PassBase):
+        name = "B-PASS"
+        codes = {"XX001": "from B", "XX002": "fine"}
+
+        def run(self, ctx):
+            return []
+
+    reg = PassRegistry()
+    reg.register("A-PASS", lambda args: A())
+    reg.register("B-PASS", lambda args: B())
+    with pytest.raises(ValueError, match="XX001.*A-PASS.*B-PASS"):
+        all_codes(reg)
 
 
 # ---- the tier-1 gate: the real tree lints clean --------------------------
@@ -1683,13 +1713,14 @@ def test_schedlint_changed_paths(tmp_path):
     (repo / "outside.py").write_text("B = 1\n")
     git("add", "-A")
     git("commit", "-q", "-m", "seed")
-    assert mod.changed_paths(str(repo)) == []  # clean work tree
+    assert mod.changed_paths(str(repo)) == ([], [])  # clean work tree
     (repo / "k8s_scheduler_tpu" / "mod.py").write_text("A = 2\n")
     (repo / "scripts" / "probe.py").write_text("C = 1\n")  # untracked
     (repo / "outside.py").write_text("B = 2\n")  # outside lint roots
-    assert mod.changed_paths(str(repo)) == [
-        "k8s_scheduler_tpu/mod.py", "scripts/probe.py",
-    ]
+    assert mod.changed_paths(str(repo)) == (
+        ["k8s_scheduler_tpu/mod.py", "scripts/probe.py"],
+        ["outside.py"],  # reported, never silently dropped
+    )
 
 
 def test_threads_tr003_multi_target_and_tuple_assigns(tmp_path):
@@ -1737,3 +1768,553 @@ def test_schedlint_changed_rejects_write_baseline(tmp_path, capsys):
     spec.loader.exec_module(mod)
     assert mod.main(["--changed", "--write-baseline"]) == 2
     assert "full-tree" in capsys.readouterr().err
+
+
+# ---- the effect engine (effects.py) --------------------------------------
+
+
+def make_ctx(tmp_path, files: dict[str, str]):
+    from k8s_scheduler_tpu.analysis.core import LintContext, load_tree
+
+    for rel, src in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(textwrap.dedent(src))
+    return LintContext(str(tmp_path), load_tree(str(tmp_path), ["."]))
+
+
+def fid_of(ctx, qualname: str) -> str:
+    return next(
+        fid for fid, fi in ctx.index.funcs.items()
+        if fi.qualname == qualname
+    )
+
+
+def test_effect_engine_summary_propagates_to_fixpoint(tmp_path):
+    """An effect three calls deep reaches the top summary, tagged with
+    the FIRST hop it arrived through (that's what the witness in pass
+    messages points at)."""
+    ctx = make_ctx(tmp_path, {"pkg/chain.py": """\
+        import os
+
+
+        def leaf(path):
+            os.fsync(path)
+
+
+        def mid(path):
+            leaf(path)
+
+
+        def top(path):
+            mid(path)
+    """})
+    engine = ctx.effects
+    assert engine.summary(fid_of(ctx, "leaf"))["io"] == ("os.fsync()", None)
+    assert engine.summary(fid_of(ctx, "mid"))["io"] == ("os.fsync()", "leaf")
+    assert engine.summary(fid_of(ctx, "top"))["io"] == ("os.fsync()", "mid")
+
+
+def test_effect_engine_traced_region_and_witness_path(tmp_path):
+    ctx = make_ctx(tmp_path, {"pkg/prog.py": """\
+        import jax
+
+
+        def helper(x):
+            return x + 1
+
+
+        def kernel(x):
+            return helper(x)
+
+
+        cycle = jax.jit(kernel)
+    """})
+    engine = ctx.effects
+    region = engine.traced_region()
+    k, h = fid_of(ctx, "kernel"), fid_of(ctx, "helper")
+    assert region[k] == ("kernel",)
+    assert region[h] == ("kernel", "helper")
+    assert engine.traced_roots()[k].startswith("jax.jit() at pkg/prog.py:")
+
+
+def test_call_references_skip_attribute_reads(tmp_path):
+    """The precision split that makes JIT-PURITY usable: a bare
+    attribute READ passed to a builtin must NOT become a call edge
+    (callgraph's by-name fallback would drag `Node.unschedulable` into
+    the traced region), while TRACE-SAFETY's broad walk still sees it."""
+    ctx = make_ctx(tmp_path, {"pkg/prec.py": """\
+        import os
+
+        import jax
+
+
+        class Node:
+            def unschedulable(self):
+                os.fsync(0)
+
+
+        def kernel(node):
+            return bool(node.unschedulable)
+
+
+        cycle = jax.jit(kernel)
+    """})
+    engine = ctx.effects
+    k_fid = fid_of(ctx, "kernel")
+    f = ctx.index.funcs[k_fid]
+    meth = fid_of(ctx, "Node.unschedulable")
+    assert meth not in engine.call_references(f)  # data read, not a call
+    assert meth in ctx.index.references(f)  # the broad TS walk still does
+    assert "io" not in engine.summary(k_fid)
+
+
+# ---- JIT-PURITY ----------------------------------------------------------
+
+
+def test_jp001_host_io_interprocedural(tmp_path):
+    """os.fsync two frames below the jitted entry point is reported in
+    the frame that performs it, with the traced-via witness — and is
+    provably missed when the pass is off."""
+    files = {"pkg/prog.py": """\
+        import os
+
+        import jax
+
+
+        def _flush(fd):
+            os.fsync(fd)
+
+
+        def kernel(x):
+            _flush(x)
+            return x
+
+
+        cycle = jax.jit(kernel)
+    """}
+    result = lint_fixture(tmp_path, files, passes=["JIT-PURITY"])
+    jp = codes_at(result, "JP001")
+    assert len(jp) == 1 and jp[0].line == 7
+    assert "os.fsync()" in jp[0].message
+    assert "traced via kernel -> _flush" in jp[0].message
+    off = lint_fixture(tmp_path, files, passes=["TRACE-SAFETY"])
+    assert not codes_at(off, "JP001")
+
+
+def test_jp002_lock_under_trace(tmp_path):
+    files = {"pkg/locky.py": """\
+        import threading
+
+        import jax
+
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def kernel(self, x):
+                with self._lock:
+                    return x
+
+            def build(self):
+                return jax.jit(self.kernel)
+    """}
+    result = lint_fixture(tmp_path, files, passes=["JIT-PURITY"])
+    jp = codes_at(result, "JP002")
+    assert len(jp) == 1 and jp[0].line == 11
+    assert "no-op in the compiled program" in jp[0].message
+    off = lint_fixture(tmp_path, files, passes=["LOCK-DISCIPLINE"])
+    assert not codes_at(off, "JP002")
+
+
+def test_jp003_journal_emit_under_trace(tmp_path):
+    files = {"pkg/emitty.py": """\
+        import jax
+
+
+        class Cycle:
+            def kernel(self, x):
+                self._emit({"t": int(x)})
+                return x
+
+            def build(self):
+                return jax.jit(self.kernel)
+    """}
+    result = lint_fixture(tmp_path, files, passes=["JIT-PURITY"])
+    jp = codes_at(result, "JP003")
+    assert len(jp) == 1 and jp[0].line == 6
+    assert "WAL goes stale" in jp[0].message
+    off = lint_fixture(tmp_path, files, passes=["JOURNAL-EMIT-ONCE"])
+    assert not codes_at(off, "JP003")
+
+
+def test_jp004_attr_write_under_trace_init_exempt(tmp_path):
+    files = {"pkg/statey.py": """\
+        import jax
+
+
+        class Counter:
+            def __init__(self):
+                self.calls = 0
+
+            def kernel(self, x):
+                self.calls += 1
+                return x
+
+            def build(self):
+                return jax.jit(self.kernel)
+    """}
+    result = lint_fixture(tmp_path, files, passes=["JIT-PURITY"])
+    jp = codes_at(result, "JP004")
+    assert len(jp) == 1 and jp[0].line == 9  # __init__ write exempt
+    off = lint_fixture(tmp_path, files, passes=["TRACE-SAFETY"])
+    assert not codes_at(off, "JP004")
+
+
+def test_jp005_nondeterministic_discriminator(tmp_path):
+    """id() and unsorted .keys() in jit keyword args churn the compile
+    cache; sorted(...) neutralizes the dict-order dependence."""
+    files = {"pkg/disc.py": """\
+        import jax
+
+
+        def build_bad_id(fn, x):
+            return jax.jit(fn, backend=str(id(x)))
+
+
+        def build_bad_keys(fn, cfg):
+            return jax.jit(fn, static_argnames=tuple(cfg.keys()))
+
+
+        def build_ok(fn, cfg):
+            return jax.jit(fn, static_argnames=tuple(sorted(cfg.keys())))
+    """}
+    result = lint_fixture(tmp_path, files, passes=["JIT-PURITY"])
+    jp = codes_at(result, "JP005")
+    assert [f.line for f in jp] == [5, 9]
+    assert "id() is process-random" in jp[0].message
+    assert "wrap in sorted" in jp[1].message
+    off = lint_fixture(tmp_path, files, passes=["TRACE-SAFETY"])
+    assert not codes_at(off, "JP005")
+
+
+def test_jp006_jit_wrapper_in_loop(tmp_path):
+    files = {"pkg/loopy.py": """\
+        import jax
+
+
+        def compile_each(fns):
+            out = []
+            for fn in fns:
+                out.append(jax.jit(fn))
+            return out
+
+
+        def compile_once(fn):
+            return jax.jit(fn)
+    """}
+    result = lint_fixture(tmp_path, files, passes=["JIT-PURITY"])
+    jp = codes_at(result, "JP006")
+    assert len(jp) == 1 and jp[0].line == 7
+    assert "fresh callable" in jp[0].message
+    off = lint_fixture(tmp_path, files, passes=["TRACE-SAFETY"])
+    assert not codes_at(off, "JP006")
+
+
+# ---- DURABILITY-ORDER ----------------------------------------------------
+
+
+def test_do001_mutate_without_journal(tmp_path):
+    """A tracked-store write with no preceding journal append fires;
+    the journal-first twin and the journaled-funnel call stay clean."""
+    files = {"pkg/service/binder.py": """\
+        class Binder:
+            def apply_bad(self, uid, pod):
+                self._bound[uid] = pod
+
+            def apply_good(self, uid, pod):
+                self._journal({"op": "bind", "uid": uid})
+                self._bound[uid] = pod
+
+            def apply_funnel(self, node):
+                self.cache.add_node(node)
+    """}
+    result = lint_fixture(tmp_path, files, passes=["DURABILITY-ORDER"])
+    do = codes_at(result, "DO001")
+    assert len(do) == 1 and do[0].line == 3
+    assert "_bound" in do[0].message and "replay" in do[0].message
+    off = lint_fixture(tmp_path, files, passes=["JOURNAL-EMIT-ONCE"])
+    assert not codes_at(off, "DO001")
+
+
+def test_do001_interprocedural_out_of_perimeter_callee(tmp_path):
+    """A service-side caller reaching a tracked-store write through a
+    helper OUTSIDE the durability perimeter is flagged at the call site
+    (the helper's own file is never scanned by this pass)."""
+    files = {
+        "pkg/internal/rawstore.py": """\
+            class RawStore:
+                def raw_write(self, uid, pod):
+                    self._bound[uid] = pod
+        """,
+        "pkg/service/svc.py": """\
+            from ..internal.rawstore import RawStore
+
+
+            class Svc:
+                def commit_bad(self, uid, pod):
+                    self.store.raw_write(uid, pod)
+
+                def commit_good(self, uid, pod):
+                    self._journal({"op": "bind", "uid": uid})
+                    self.store.raw_write(uid, pod)
+        """,
+    }
+    result = lint_fixture(tmp_path, files, passes=["DURABILITY-ORDER"])
+    do = codes_at(result, "DO001")
+    assert len(do) == 1
+    assert do[0].file == "pkg/service/svc.py" and do[0].line == 6
+    assert "RawStore.raw_write" in do[0].message
+    off = lint_fixture(tmp_path, files, passes=["TRACE-SAFETY"])
+    assert not codes_at(off, "DO001")
+
+
+def test_do002_ack_without_barrier(tmp_path):
+    files = {"pkg/service/admit.py": """\
+        class Admission:
+            def submit_bad(self, pods):
+                return SubmitResult(accepted=len(pods))
+
+            def submit_good(self, pods):
+                self._manager.ack_barrier()
+                return SubmitResult(accepted=len(pods))
+
+            def submit_rejected(self, pods):
+                return SubmitResult(accepted=0)
+    """}
+    result = lint_fixture(tmp_path, files, passes=["DURABILITY-ORDER"])
+    do = codes_at(result, "DO002")
+    assert len(do) == 1 and do[0].line == 3
+    assert "ack_barrier" in do[0].message
+    off = lint_fixture(tmp_path, files, passes=["TRACE-SAFETY"])
+    assert not codes_at(off, "DO002")
+
+
+def test_do002_conditional_barrier_branch_join(tmp_path):
+    """Optimistic branch join: a barrier under `if` counts for the
+    fall-through path (the admission.py shape)."""
+    files = {"pkg/service/admit2.py": """\
+        class Admission:
+            def submit(self, pods, durable):
+                if durable:
+                    self._manager.ack_barrier()
+                return SubmitResult(accepted=len(pods))
+    """}
+    result = lint_fixture(tmp_path, files, passes=["DURABILITY-ORDER"])
+    assert not codes_at(result, "DO002")
+
+
+def test_do003_broad_swallow_between_journal_and_mutate(tmp_path):
+    files = {"pkg/state/mgr.py": """\
+        class Manager:
+            def apply_bad(self, rec, pod):
+                try:
+                    self._journal(rec)
+                    self._active[rec["uid"]] = pod
+                except Exception:
+                    pass
+
+            def apply_good(self, rec, pod):
+                try:
+                    self._journal(rec)
+                    self._active[rec["uid"]] = pod
+                except Exception:
+                    raise
+    """}
+    result = lint_fixture(tmp_path, files, passes=["DURABILITY-ORDER"])
+    do = codes_at(result, "DO003")
+    assert len(do) == 1 and do[0].line == 6
+    assert "half-applied" in do[0].message
+    assert not codes_at(result, "DO001")  # journal precedes the write
+    off = lint_fixture(tmp_path, files, passes=["ROBUSTNESS"])
+    assert not codes_at(off, "DO003")
+
+
+def test_do_passes_ignore_files_outside_perimeter(tmp_path):
+    files = {"pkg/core/engine.py": """\
+        class Engine:
+            def apply(self, uid, pod):
+                self._bound[uid] = pod
+    """}
+    result = lint_fixture(tmp_path, files, passes=["DURABILITY-ORDER"])
+    assert not result.findings
+
+
+# ---- count-aware baseline (satellite) ------------------------------------
+
+
+def test_baseline_count_aware_roundtrip(tmp_path):
+    from k8s_scheduler_tpu.analysis.core import (
+        Finding,
+        apply_baseline,
+        stale_baseline_entries,
+    )
+
+    f1 = Finding("a.py", 1, "XX001", "m")
+    f2 = Finding("a.py", 9, "XX001", "m")  # same identity, moved line
+    p = str(tmp_path / "b.json")
+    write_baseline(p, [f1, f2])
+    entries = load_baseline(p)
+    assert entries == [
+        {"file": "a.py", "code": "XX001", "message": "m", "count": 2},
+    ]
+    new, old = apply_baseline([f1, f2], entries)
+    assert not new and len(old) == 2
+    # a THIRD identical violation exceeds the grandfather budget
+    f3 = Finding("a.py", 20, "XX001", "m")
+    new, old = apply_baseline([f1, f2, f3], entries)
+    assert len(new) == 1 and len(old) == 2
+    # and when one of the two disappears, the leftover budget is stale
+    assert stale_baseline_entries(entries, [f1]) == [
+        (("a.py", "XX001", "m"), 1),
+    ]
+    # singleton entries carry no count key (diff noise)
+    write_baseline(p, [f1])
+    assert "count" not in load_baseline(p)[0]
+
+
+# ---- suppression edges (satellite) ---------------------------------------
+
+
+def test_disable_file_with_justification(tmp_path):
+    """`# schedlint: disable-file=CODE -- why` parses: the justification
+    after `--` does not break the code list."""
+    result = lint_fixture(tmp_path, {"pkg/probe.py": """\
+        # schedlint: disable-file=HY001 -- exploratory probe, imports vary
+        import os
+        import json
+    """}, passes=["HYGIENE"])
+    assert not codes_at(result, "HY001")
+    assert len([f for f in result.suppressed if f.code == "HY001"]) == 2
+
+
+def test_disable_all_beats_baseline_and_goes_stale(tmp_path):
+    """disable=all suppresses BEFORE the baseline is consulted, so the
+    baseline entry for the same identity matches nothing and
+    --fail-on-new reports it stale — suppressing a grandfathered
+    finding is how the baseline is meant to shrink."""
+    from k8s_scheduler_tpu.analysis.core import stale_baseline_entries
+
+    files = {"pkg/probe2.py": """\
+        import os  # schedlint: disable=all
+    """}
+    bare = lint_fixture(tmp_path, files, passes=["HYGIENE"])
+    assert not bare.findings
+    [supp] = bare.suppressed
+    base = str(tmp_path / "base.json")
+    write_baseline(base, [supp])  # identity IS in the baseline...
+    again = lint_fixture(
+        tmp_path, files, passes=["HYGIENE"], baseline_path=base,
+    )
+    assert not again.findings and not again.grandfathered
+    assert len(again.suppressed) == 1  # ...but suppression wins
+    assert stale_baseline_entries(load_baseline(base),
+                                  again.grandfathered) == [
+        ((supp.file, supp.code, supp.message), 1),
+    ]
+
+
+def test_baseline_matches_moved_line(tmp_path):
+    """The baseline identity is (file, code, message) — a finding that
+    moves lines between runs still rides its entry; an inline
+    suppression added on the NEW line flips it from grandfathered to
+    suppressed."""
+    v1 = {"pkg/mv.py": """\
+        import os
+    """}
+    r1 = lint_fixture(tmp_path, v1, passes=["HYGIENE"])
+    base = str(tmp_path / "base.json")
+    write_baseline(base, r1.findings)
+    v2 = {"pkg/mv.py": """\
+        \"\"\"now with a docstring: the finding moved down two lines.\"\"\"
+
+        import os
+    """}
+    r2 = lint_fixture(tmp_path, v2, passes=["HYGIENE"], baseline_path=base)
+    assert not r2.findings and len(r2.grandfathered) == 1
+    assert r2.grandfathered[0].line == 3  # new line, same identity
+    v3 = {"pkg/mv.py": """\
+        \"\"\"now with a docstring: the finding moved down two lines.\"\"\"
+
+        import os  # schedlint: disable=HY001 -- kept for the doctest
+    """}
+    r3 = lint_fixture(tmp_path, v3, passes=["HYGIENE"], baseline_path=base)
+    assert not r3.findings and not r3.grandfathered
+    assert len(r3.suppressed) == 1
+
+
+# ---- SARIF + --fail-on-new driver surface --------------------------------
+
+
+def test_to_sarif_shapes():
+    from k8s_scheduler_tpu.analysis.core import (
+        Finding,
+        LintResult,
+        to_sarif,
+    )
+
+    res = LintResult(
+        findings=[Finding("a.py", 3, "XX001", "bad")],
+        suppressed=[Finding("b.py", 1, "XX002", "ok")],
+        grandfathered=[Finding("c.py", 2, "XX001", "old")],
+        files_scanned=3, passes_run=["X"],
+    )
+    doc = to_sarif(res, {"XX001": "d1", "XX002": "d2"})
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "schedlint"
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == [
+        "XX001", "XX002",
+    ]
+    rows = [
+        (r["ruleId"], r["level"], r.get("suppressions"))
+        for r in run["results"]
+    ]
+    assert rows == [
+        ("XX001", "error", None),
+        ("XX002", "note", [{"kind": "inSource"}]),
+        ("XX001", "note", [{"kind": "external"}]),
+    ]
+    fp = run["results"][0]["partialFingerprints"]["schedlintFingerprint/v1"]
+    assert fp == res.findings[0].fingerprint()
+    loc = run["results"][0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "a.py"
+    assert loc["region"]["startLine"] == 3
+
+
+def test_schedlint_fail_on_new_usage_errors(capsys):
+    import importlib.util
+
+    path = os.path.join(REPO, "scripts", "schedlint.py")
+    spec = importlib.util.spec_from_file_location("schedlint_cli4", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--fail-on-new", "--baseline", ""]) == 2
+    assert "needs --baseline" in capsys.readouterr().err
+    assert mod.main(["--fail-on-new", "--write-baseline"]) == 2
+    assert "mutually exclusive" in capsys.readouterr().err
+
+
+def test_lint_metrics_schedlint_summary_shape():
+    import importlib.util
+
+    path = os.path.join(REPO, "scripts", "lint_metrics.py")
+    spec = importlib.util.spec_from_file_location("lint_metrics_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    summary = mod.schedlint_summary()
+    assert set(summary["passes"]) == set(default_registry().names())
+    assert summary["total"]["findings"] == 0  # the tree is clean
+    row = summary["passes"]["JIT-PURITY"]
+    assert set(row) == {"findings", "suppressed", "grandfathered"}
