@@ -251,6 +251,7 @@ def main() -> None:
             "stall": r.get("stall_cycles", 0),
             "trt99": round(r.get("tunnel_rt_p99_ms", 0.0), 1),
             "anom": r.get("anomalies", {}),
+            "alerts": r.get("alerts_fired", 0),
             "sched": r.get("scheduled", 0),
             "unsched": r.get("unschedulable", 0),
             # multi-cycle K-sweep headline (BENCH_MULTI_K): amortization

@@ -741,6 +741,14 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
     from k8s_scheduler_tpu.core.observe import classify_latency_series
 
     anomalies = classify_latency_series(times)
+    # ...and once more through the WATCHTOWER rule pack (metrics/rules):
+    # the same series replayed against the built-in alert rules with a
+    # 1 s-per-cycle virtual clock, so a headline run that would have
+    # paged in production says so in the artifact (`alerts_fired`, a
+    # bench_diff count metric like stall_cycles)
+    from k8s_scheduler_tpu.metrics.rules import replay_alerts
+
+    alert_replay = replay_alerts(times)
     # multi-cycle K-sweep (BENCH_MULTI_K="1,4,8,16" or "1" to disable):
     # effective per-cycle RT of a K-cycle device batch vs the single
     # dispatch, surfaced as tunnel_amortization / effective_cycle_p50_ms
@@ -776,6 +784,11 @@ def run_config(cfg: int, snapshots: int = 50) -> dict:
         "p99_ms": round(p99 * 1e3, 3),
         "stall_cycles": stall_cycles,
         "anomalies": anomalies,
+        "alerts_fired": alert_replay["alerts_fired"],
+        **(
+            {"alert_rules_fired": alert_replay["fired_rules"]}
+            if alert_replay["fired_rules"] else {}
+        ),
         "device_ms": round(device_s * 1e3, 3),
         "diag_ms": round(diag_ms, 3),
         "fetch_bytes": fetch_bytes,

@@ -29,8 +29,10 @@ from .registry import PassBase
 # HERE in the same commit (HY003 fails otherwise), which is the review
 # hook that keeps dead one-off probes from accumulating silently again.
 SCRIPT_ALLOWLIST = frozenset({
+    "scripts/alerts_check.py",    # clean-soak alert-rule CI gate
     "scripts/audit_sharded.py",   # compile-only collective-budget gate
     "scripts/bench_diff.py",      # BENCH artifact CI tripwire
+    "scripts/blackbox_read.py",   # crash black-box bundle reader
     "scripts/fuzz_scheduler.py",  # scenario-fuzzer differential soak
     "scripts/lint_metrics.py",    # metric-inventory shim (tests)
     "scripts/loadgen.py",         # open-loop front-door load generator

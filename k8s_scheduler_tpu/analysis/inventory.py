@@ -101,6 +101,12 @@ REQUIRED_FAMILIES = {
     "scheduler_snapshot_last_restore_seconds",
     "scheduler_leader_state",
     "scheduler_leader_lease_age_seconds",
+    # watchtower + build-identity floor: the alert counter is what the
+    # rule engine fires into, build_info/uptime are what dashboards
+    # correlate restarts against — all three are operational contracts
+    "scheduler_build_info",
+    "scheduler_uptime_seconds",
+    "scheduler_alerts_total",
 }
 
 # dataclass fields that are structured sub-configs, not flat YAML keys
@@ -152,6 +158,9 @@ class InventoryDriftPass(PassBase):
                  "registry and the README Static-analysis table",
         "ID010": "span-name inventory drifted between spans.SPAN_NAMES, "
                  "the metrics docstring, and the README tracing table",
+        "ID011": "alert rule-pack inventory drifted between "
+                 "rules.BUILTIN_RULES, the README alert table, and the "
+                 "anomaly-class docs",
     }
 
     def run(self, ctx: LintContext) -> list[Finding]:
@@ -178,6 +187,7 @@ class InventoryDriftPass(PassBase):
         findings += self._check_rungs(ctx)
         findings += self._check_collective_budgets(ctx)
         findings += self._check_code_table(ctx)
+        findings += self._check_alert_rules(ctx)
         return findings
 
     @staticmethod
@@ -748,6 +758,112 @@ class InventoryDriftPass(PassBase):
                 f'the README "## Static analysis" table documents '
                 f"{code!r}, which no registered pass defines: stale row",
             ))
+        return findings
+
+    # ---- ID011: alert rule-pack inventory --------------------------------
+
+    @staticmethod
+    def _rule_pack_names(sf):
+        """Rule names out of the module-level `BUILTIN_RULES = (...)`
+        literal: a tuple/list of dict literals whose "name" values are
+        string constants. None when the literal is absent or not
+        statically extractable — the rule pack MUST stay a pure
+        literal, that is what makes it a machine-checked inventory."""
+        for node in sf.tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "BUILTIN_RULES"
+            ):
+                continue
+            v = node.value
+            if not isinstance(v, (ast.Tuple, ast.List)):
+                return None, node.lineno
+            names: set[str] = set()
+            for elt in v.elts:
+                if not isinstance(elt, ast.Dict):
+                    return None, node.lineno
+                for k, val in zip(elt.keys, elt.values):
+                    if (
+                        isinstance(k, ast.Constant) and k.value == "name"
+                        and isinstance(val, ast.Constant)
+                        and isinstance(val.value, str)
+                    ):
+                        names.add(val.value)
+            return names, node.lineno
+        return None, 0
+
+    # rule names the phantom-row scan recognizes: bare snake_case
+    # tokens in the alert table's first column (family names carry the
+    # scheduler_ prefix and belong to ID001's tables, not this one)
+    _RULE_ROW_RE = re.compile(r"^\| *`([a-z][a-z0-9_]*)` *\|", re.M)
+
+    def _check_alert_rules(self, ctx: LintContext) -> list[Finding]:
+        rules_sf = self._find(ctx, "metrics/rules.py")
+        if rules_sf is None:
+            return []
+        names, r_line = self._rule_pack_names(rules_sf)
+        if not names:
+            return [Finding(
+                rules_sf.rel, max(r_line, 1), "ID011",
+                "metrics/rules.py defines no statically-extractable "
+                "BUILTIN_RULES literal (tuple of dict literals with "
+                'string "name" values) — the committed rule pack the '
+                "README alert table is pinned to",
+            )]
+        findings: list[Finding] = []
+        # the anomaly-class leg: rule firings raise the `alert` class,
+        # so its removal from observe.ANOMALY_CLASSES would make every
+        # firing crash raise_anomaly's class validation
+        obs_sf = self._find(ctx, "core/observe.py")
+        if obs_sf is not None:
+            classes, obs_line = self._module_const(
+                obs_sf, "ANOMALY_CLASSES"
+            )
+            if classes is not None and "alert" not in classes:
+                findings.append(Finding(
+                    obs_sf.rel, max(obs_line, 1), "ID011",
+                    'anomaly class "alert" is missing from '
+                    "observe.ANOMALY_CLASSES — rule firings raise it, "
+                    "so every alert would crash class validation",
+                ))
+        path = os.path.join(ctx.root, "README.md")
+        if not os.path.exists(path):
+            return findings
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        m = re.search(
+            r"^### Metrics history, alert rules & the black box\b"
+            r"(.*?)(?=^#{2,3} |\Z)",
+            text, re.M | re.S,
+        )
+        if m is None:
+            findings.append(Finding(
+                rules_sf.rel, r_line, "ID011",
+                'README.md has no "### Metrics history, alert rules & '
+                'the black box" subsection documenting the built-in '
+                "rule table",
+            ))
+            return findings
+        section = m.group(1)
+        for name in sorted(names):
+            if not re.search(rf"\b{re.escape(name)}\b", section):
+                findings.append(Finding(
+                    rules_sf.rel, r_line, "ID011",
+                    f"rule {name!r} (rules.BUILTIN_RULES) is not "
+                    "documented in the README alert-rule table",
+                ))
+        for doc in sorted(set(self._RULE_ROW_RE.findall(section))):
+            if doc.startswith("scheduler_"):
+                continue  # family column rows belong to ID001
+            if doc not in names:
+                findings.append(Finding(
+                    rules_sf.rel, r_line, "ID011",
+                    f"the README alert-rule table documents {doc!r}, "
+                    "which rules.BUILTIN_RULES does not define: "
+                    "stale row",
+                ))
         return findings
 
     # ---- ID001: metric inventory (runtime) -------------------------------

@@ -21,14 +21,28 @@ paths. On top of them, the cycle flight recorder
   anomalies that overlapped its cycles;
 - `/debug/pods/<uid>` — the per-pod scheduling timeline
   (queued -> attempts -> bound/evicted, joined with the events ring);
-- `/debug/anomalies?last=N` — the cycle observer's typed anomaly ring
-  (tunnel_stall / fetch_stall / recompile / fold_miss /
-  wedge_precursor), each event carrying the cycle seq that links it to
-  `/debug/flightrecorder` and the matching `/debug/trace` window, plus
-  per-class counts, per-phase quantiles, and the SLO burn status;
+- `/debug/anomalies?last=N[&tenant=<id>]` — the cycle observer's typed
+  anomaly ring (tunnel_stall / fetch_stall / recompile / fold_miss /
+  wedge_precursor / ... / alert), each event carrying the cycle seq
+  that links it to `/debug/flightrecorder` and the matching
+  `/debug/trace` window, plus per-class counts, per-phase quantiles,
+  and the SLO burn status; `tenant=` filters to one tenant's events
+  (the `tenant_starved` detail join) and the payload always carries
+  per-tenant anomaly counts;
 - `/debug/state` — durable-state health (journal lag/segments, fsync
-  latency, last snapshot and last restore stats) when `--state-dir`
-  is configured.
+  latency, last snapshot and last restore stats) plus the degradation
+  ladder's wall-timestamped transition ring when `--state-dir` is
+  configured;
+- `/debug/metrics/history?family=&labels=k=v,...&window=&step=` — the
+  in-process TSDB (metrics/tsdb.py): raw points (step<1) or 1 s / 1 m
+  aggregate buckets (min/max/sum/count/last) per family/labelset over
+  the trailing window; without `family=` it returns the stored-series
+  inventory;
+- `/debug/alerts` — active + resolved alert-rule firings with wall
+  timestamps, plus every rule's current state and value
+  (metrics/rules.py RuleEngine);
+- `/debug/dashboard` — dependency-free HTML sparkline dashboard over
+  the history API (inline SVG, no external assets).
 
 Served with the stdlib http.server on a daemon thread — the payloads are
 small and low-rate (scrapes + probes + on-demand debugging), no
@@ -51,6 +65,82 @@ from ..metrics import SchedulerMetrics
 # limit): the front door's bounded-memory contract must hold on the
 # HTTP path too — a giant Content-Length is refused BEFORE any read
 _MAX_SUBMIT_BODY_BYTES = 4 << 20
+
+
+# /debug/dashboard: dependency-free sparkline page over the history
+# API. Inline SVG + fetch() only — no external assets, so it renders
+# from an airgapped box exactly like every other debug endpoint.
+_DASHBOARD_HTML = b"""<!doctype html>
+<html><head><meta charset="utf-8"><title>scheduler watchtower</title>
+<style>
+ body{font:13px monospace;background:#111;color:#ddd;margin:1em}
+ h1{font-size:15px} .fam{display:inline-block;width:340px;margin:4px;
+ padding:6px;background:#1b1b1b;border:1px solid #333;vertical-align:top}
+ .fam b{display:block;font-size:11px;overflow:hidden;white-space:nowrap}
+ .lbl{color:#8a8;font-size:10px} .val{color:#fc6;float:right}
+ svg{width:100%;height:42px;background:#161616}
+ polyline{fill:none;stroke:#6cf;stroke-width:1}
+ #alerts{padding:6px;margin:4px}
+ .firing{color:#f66;font-weight:bold} .quiet{color:#6a6}
+</style></head><body>
+<h1>scheduler watchtower &mdash; metrics history + alerts</h1>
+<div id="alerts">loading alerts&hellip;</div>
+<div id="grid">loading series&hellip;</div>
+<script>
+const W=330,H=42;
+function spark(pts){
+ if(pts.length<2)return'<svg></svg>';
+ const xs=pts.map(p=>p[0]),ys=pts.map(p=>p[p.length>2?5:1]);
+ const x0=Math.min(...xs),x1=Math.max(...xs);
+ const y0=Math.min(...ys),y1=Math.max(...ys);
+ const pl=pts.map((p,i)=>{
+  const x=(xs[i]-x0)/Math.max(x1-x0,1e-9)*W;
+  const y=H-2-(ys[i]-y0)/Math.max(y1-y0,1e-9)*(H-4);
+  return x.toFixed(1)+','+y.toFixed(1)}).join(' ');
+ return'<svg viewBox="0 0 '+W+' '+H+'"><polyline points="'+pl+
+  '"/></svg>';
+}
+async function drawAlerts(){
+ try{
+  const a=await(await fetch('/debug/alerts')).json();
+  const act=a.active||[];
+  document.getElementById('alerts').innerHTML=act.length
+   ?'<span class="firing">FIRING: '+act.map(x=>x.rule+' ['+x.severity+
+     '] value='+Number(x.value).toPrecision(4)).join(' &middot; ')+
+     '</span>'
+   :'<span class="quiet">no active alerts ('+
+     (a.fired_total||0)+' lifetime firings, '+
+     (a.resolved||[]).length+' resolved in window)</span>';
+ }catch(e){
+  document.getElementById('alerts').textContent=
+   'alerts endpoint unavailable';
+ }
+}
+async function draw(){
+ const inv=await(await fetch('/debug/metrics/history')).json();
+ const fams=(inv.families||[]).slice(0,48);
+ const out=[];
+ for(const f of fams){
+  const q=await(await fetch('/debug/metrics/history?family='+
+   encodeURIComponent(f.family)+'&window=900&step=1')).json();
+  for(const s of (q.series||[]).slice(0,4)){
+   const pts=s.points||[];if(!pts.length)continue;
+   const last=pts[pts.length-1];
+   const v=last[last.length>2?5:1];
+   const lbl=Object.entries(s.labels||{}).map(([k,x])=>k+'='+x)
+    .join(',');
+   out.push('<div class="fam"><b>'+f.family+
+    '<span class="val">'+Number(v).toPrecision(5)+'</span></b>'+
+    '<span class="lbl">'+(lbl||'&nbsp;')+'</span>'+spark(pts)+
+    '</div>');
+  }
+ }
+ document.getElementById('grid').innerHTML=
+  out.join('')||'no series stored yet';
+}
+drawAlerts();draw();setInterval(()=>{drawAlerts();draw()},15000);
+</script></body></html>
+"""
 
 
 def _parse_last(query: str, default: int = 128) -> int:
@@ -138,6 +228,9 @@ def start_http_server(
     observer=None,  # core/observe.CycleObserver | None
     admission=None,  # service/admission.AdmissionController | None
     spans_recorder=None,  # core/spans.SpanRecorder | None
+    tsdb=None,  # metrics/tsdb.MetricsTSDB | None
+    alerts=None,  # metrics/rules.RuleEngine | None
+    dashboard: bool = True,
 ) -> ThreadingHTTPServer:
     """Serve /healthz, /readyz, /metrics and the /debug endpoints;
     returns the running server (bound port at `.server_address[1]`;
@@ -155,7 +248,11 @@ def start_http_server(
     the same controller the gRPC Submit RPC uses (200 on accept, 429 +
     Retry-After on shed, 400 on invalid pods, 503 while draining),
     with a W3C `traceparent` request header joining the submission's
-    trace and the effective traceparent echoed as a response header."""
+    trace and the effective traceparent echoed as a response header;
+    `tsdb` (the armed metrics/tsdb store) enables
+    /debug/metrics/history and — unless `dashboard` is False — the
+    /debug/dashboard sparkline page; `alerts` (the rules engine)
+    enables /debug/alerts."""
     health_fn = healthz or (lambda: (True, {}))
 
     class Handler(BaseHTTPRequestHandler):
@@ -211,13 +308,53 @@ def start_http_server(
                 return self._explain_route(uid)
             if path == "/debug/anomalies" and observer is not None:
                 last = _parse_last(query)
+                tenant = (
+                    urllib.parse.parse_qs(query).get("tenant") or [""]
+                )[0]
+                events = observer.anomalies(last=last)
+                # per-tenant counts over the returned window: the
+                # tenant_starved detail carries the starved tenant id,
+                # and alert/arena events ride the same join
+                tenant_counts: dict[str, int] = {}
+                for ev in events:
+                    t = ev.get("detail", {}).get("tenant", "")
+                    if t:
+                        tenant_counts[t] = tenant_counts.get(t, 0) + 1
+                if tenant:
+                    events = [
+                        ev for ev in events
+                        if ev.get("detail", {}).get("tenant", "")
+                        == tenant
+                    ]
                 body = json.dumps(
                     {
-                        "anomalies": observer.anomalies(last=last),
+                        "anomalies": events,
+                        "tenant": tenant or None,
+                        "tenant_counts": tenant_counts,
                         **observer.status(),
                     }
                 ).encode()
                 return 200, "application/json", body, {}
+            if path == "/debug/metrics/history" and tsdb is not None:
+                return self._history_route(query)
+            if path == "/debug/alerts" and alerts is not None:
+                return (
+                    200,
+                    "application/json",
+                    json.dumps(alerts.status()).encode(),
+                    {},
+                )
+            if (
+                path == "/debug/dashboard"
+                and tsdb is not None
+                and dashboard
+            ):
+                return (
+                    200,
+                    "text/html; charset=utf-8",
+                    _DASHBOARD_HTML,
+                    {},
+                )
             if path == "/debug/state" and state is not None:
                 return (
                     200,
@@ -241,6 +378,46 @@ def start_http_server(
                     )
                 return 200, "application/json", json.dumps(tl).encode(), {}
             return 404, "text/plain", b"not found", {}
+
+        def _history_route(
+            self, query: str
+        ) -> tuple[int, str, bytes, dict[str, str]]:
+            """GET /debug/metrics/history: the TSDB query surface.
+            `family=` selects one family (absent: the stored-series
+            inventory), `labels=k=v,k2=v2` is a subset selector,
+            `window=` seconds back from now (default 300), `step=`
+            selects the tier (>=60 -> 1 m buckets, >=1 -> 1 s,
+            else raw points)."""
+            qs = urllib.parse.parse_qs(query)
+            family = (qs.get("family") or [""])[0]
+            if not family:
+                body = json.dumps(
+                    {"families": tsdb.families(), **tsdb.status()}
+                ).encode()
+                return 200, "application/json", body, {}
+            labels: dict[str, str] = {}
+            for pair in (qs.get("labels") or [""])[0].split(","):
+                if "=" in pair:
+                    k, _, v = pair.partition("=")
+                    labels[k.strip()] = v.strip()
+            try:
+                window = float((qs.get("window") or ["300"])[0])
+                step = float((qs.get("step") or ["0"])[0])
+            except ValueError:
+                return (
+                    400,
+                    "application/json",
+                    json.dumps(
+                        {"error": "window/step must be numbers"}
+                    ).encode(),
+                    {},
+                )
+            body = json.dumps(
+                tsdb.query(
+                    family, labels=labels, window_s=window, step_s=step
+                )
+            ).encode()
+            return 200, "application/json", body, {}
 
         def _trace_route(
             self, query: str

@@ -230,6 +230,29 @@ def new_scheduler_command() -> argparse.ArgumentParser:
         "directory is size-rotated (oldest dumps deleted past 64 MB). "
         "Empty = no OTLP export (spans still serve at /debug/traces)",
     )
+    ap.add_argument(
+        "--metrics-history-samples", type=int, default=-1,
+        help="watchtower: per-series raw ring capacity of the "
+        "in-process metrics history TSDB; arming it also evaluates "
+        "the built-in alert rule pack and serves "
+        "/debug/metrics/history, /debug/alerts and /debug/dashboard "
+        "(config metricsHistorySamples, default 512; 0 disables the "
+        "watchtower, -1 = keep config)",
+    )
+    ap.add_argument(
+        "--alert-rules-file", default="",
+        help="extra alert/recording rules (YAML/JSON list, the "
+        "metrics/rules.py shape) appended to the built-in pack "
+        "(config alertRulesFile; empty = built-ins only)",
+    )
+    ap.add_argument(
+        "--blackbox-retention", type=int, default=-1,
+        help="crash black box: post-mortem bundles kept under "
+        "<stateDir>/blackbox/ — dumped on SIGTERM, degrade-to-"
+        "stateless, watchdog aborts and serve-loop faults; read them "
+        "with scripts/blackbox_read.py (config blackboxRetention, "
+        "default 8; 0 disables, -1 = keep config; needs --state-dir)",
+    )
     return ap
 
 
@@ -280,6 +303,12 @@ def main(argv: list[str] | None = None) -> int:
         config.snapshot_interval_seconds = args.snapshot_interval
     if args.trace_sample_rate >= 0:
         config.trace_sample_rate = args.trace_sample_rate
+    if args.metrics_history_samples >= 0:
+        config.metrics_history_samples = args.metrics_history_samples
+    if args.alert_rules_file:
+        config.alert_rules_file = args.alert_rules_file
+    if args.blackbox_retention >= 0:
+        config.blackbox_retention = args.blackbox_retention
     if (
         config.health_max_cycle_age_seconds > 0
         and config.flight_recorder_size <= 0
@@ -466,6 +495,75 @@ def main(argv: list[str] | None = None) -> int:
         admission=service.admission,
     )
 
+    # the watchtower (metrics history + alert rules): armed only by
+    # the CLI, like tracing — library/test constructions pay one
+    # module-flag check at the flight-recorder hook and nothing else
+    tsdb_store = None
+    alert_engine = None
+    if config.metrics_history_samples > 0:
+        from ..metrics import tsdb as _tsdb
+        from ..metrics.rules import (
+            RuleEngine,
+            builtin_rules,
+            load_rules_file,
+        )
+
+        tsdb_store = _tsdb.arm(
+            raw_cap=config.metrics_history_samples
+        )
+        rules = builtin_rules()
+        if config.alert_rules_file:
+            rules += load_rules_file(config.alert_rules_file)
+        alert_engine = RuleEngine(
+            rules,
+            tsdb_store,
+            observer=observer,
+            events=service.scheduler.events,
+            metrics=gm,
+        )
+        tsdb_store.engine = alert_engine
+        if recorder is not None:
+            recorder.observers.append(tsdb_store.observe_record)
+        tsdb_store.start_ticker(
+            gm.registry, interval_s=config.metrics_ticker_seconds
+        )
+        print(
+            "watchtower armed: "
+            f"{len(rules)} rules, history {config.metrics_history_samples} "
+            f"raw samples/series, ticker {config.metrics_ticker_seconds:g}s "
+            "(/debug/metrics/history, /debug/alerts, /debug/dashboard)",
+            flush=True,
+        )
+
+    # crash black box: bundles dump at the moment of the trigger
+    # (degrade-to-stateless, watchdog abort, serve-loop fault), not at
+    # exit — a later kill -9 still finds the bundle on disk
+    blackbox_box = None
+    if config.state_dir and config.blackbox_retention > 0:
+        import os as _os
+
+        from ..core import blackbox as _bb
+        from ..config.types import to_dict as _config_to_dict
+
+        blackbox_box = _bb.arm(_bb.BlackBox(
+            _os.path.join(config.state_dir, "blackbox"),
+            retention=config.blackbox_retention,
+            config=_config_to_dict(config),
+            recorder=recorder,
+            observer=observer,
+            spans_recorder=spans_recorder,
+            tsdb=tsdb_store,
+            engine=alert_engine,
+            ladder=service.scheduler.ladder,
+            fault_plan=getattr(service.scheduler, "_fault_plan", None),
+            events=service.scheduler.events,
+        ))
+        print(
+            f"black box armed: {blackbox_box.directory} "
+            f"(retention {blackbox_box.retention})",
+            flush=True,
+        )
+
     http_server = None
     if args.http_port >= 0:
         http_server = start_http_server(
@@ -479,6 +577,9 @@ def main(argv: list[str] | None = None) -> int:
             observer=observer,
             admission=service.admission,
             spans_recorder=spans_recorder,
+            tsdb=tsdb_store,
+            alerts=alert_engine,
+            dashboard=config.debug_dashboard,
         )
         print(
             "serving /healthz /metrics on port "
@@ -496,6 +597,14 @@ def main(argv: list[str] | None = None) -> int:
     try:
         stop.wait()
     finally:
+        if blackbox_box is not None:
+            # FIRST in shutdown: the sigterm bundle captures the rings
+            # before the drains below start mutating them
+            from ..core import blackbox as _bb
+
+            bpath = _bb.trigger("sigterm", "clean shutdown")
+            if bpath:
+                print(f"black box dumped: {bpath}", flush=True)
         if front_door is not None:
             # graceful drain BEFORE anything seals: admission closes
             # (late submits answer UNAVAILABLE "draining"), buffered
@@ -579,6 +688,17 @@ def main(argv: list[str] | None = None) -> int:
                 except Exception as e:  # schedlint: disable=RB001 -- best-effort shutdown dump
                     print(f"OTLP span export FAILED: {e}", flush=True)
             _spans.disarm()
+        if tsdb_store is not None:
+            # stops the ticker thread and detaches the cycle hook's
+            # flag; the store object itself stays readable (the sigterm
+            # bundle above already captured it)
+            from ..metrics import tsdb as _tsdb
+
+            _tsdb.disarm()
+        if blackbox_box is not None:
+            from ..core import blackbox as _bb
+
+            _bb.disarm()
         if lease is not None:
             lease.release()
     return 0

@@ -243,6 +243,30 @@ class SchedulerConfiguration:
     # snapshot cadence: how often the journal is compacted into a full
     # snapshot (seconds; 0 = journal only, never compact)
     snapshot_interval_seconds: float = 60.0
+    # watchtower (metrics/tsdb.py + metrics/rules.py): per-series raw
+    # ring capacity of the in-process metrics history store. The CLI
+    # arms the TSDB + the built-in alert rule pack when > 0; 0 disables
+    # the whole watchtower (history, rules, dashboard) — the unarmed
+    # cost at the cycle hook is one module-flag check.
+    metrics_history_samples: int = 512
+    # wall-ticker cadence (seconds) for scrape-time gauges: the TSDB
+    # samples the full Prometheus registry — set_function gauges
+    # evaluate exactly as on a /metrics GET — every this-many seconds.
+    # 0 disables the ticker (cycle-driven samples only).
+    metrics_ticker_seconds: float = 2.0
+    # extra alert rules (YAML/JSON list of rule objects, the
+    # metrics/rules.py shape) appended to the built-in pack. "" = the
+    # built-in pack only.
+    alert_rules_file: str = ""
+    # crash black box (core/blackbox.py): how many post-mortem bundles
+    # to keep under <stateDir>/blackbox/ (oldest deleted first; also
+    # capped at 64 MB total). 0 disables black-box capture. Needs
+    # stateDir — the bundle directory lives next to the journal.
+    blackbox_retention: int = 8
+    # /debug/dashboard HTML sparkline page (needs the watchtower
+    # armed); False turns just the page off, the history/alerts JSON
+    # endpoints stay.
+    debug_dashboard: bool = True
 
     def profile(self, scheduler_name: str = "default-scheduler") -> Profile:
         for p in self.profiles:
@@ -391,6 +415,15 @@ def load_config(source: "str | dict") -> SchedulerConfiguration:
         snapshot_interval_seconds=_duration_seconds(
             data.get("snapshotInterval", 60.0)
         ),
+        metrics_history_samples=int(
+            data.get("metricsHistorySamples", 512)
+        ),
+        metrics_ticker_seconds=float(
+            data.get("metricsTickerSeconds", 2.0)
+        ),
+        alert_rules_file=str(data.get("alertRulesFile", "")),
+        blackbox_retention=int(data.get("blackboxRetention", 8)),
+        debug_dashboard=bool(data.get("debugDashboard", True)),
         extenders=[
             Extender(
                 url_prefix=e["urlPrefix"],

@@ -248,6 +248,15 @@ class DegradationLadder:
                 "transitions_buffered": len(self.transitions),
             }
 
+    def transition_log(self, last: "int | None" = None) -> list[dict]:
+        """Copy of the transition ring (oldest first), each entry with
+        its monotonic `t` and wall timestamp — the /debug/state MTTR
+        surface and the black box's ladder tail. `last` trims to the
+        most recent entries; the ring itself is bounded (512)."""
+        with self._lock:
+            out = [dict(e) for e in self.transitions]
+        return out if last is None else out[-last:]
+
     def recovery_episodes_ms(self) -> list[float]:
         """Wall milliseconds of each completed recovery episode (left
         rung 0 -> returned to rung 0) — the MTTR series bench config 7

@@ -35,6 +35,12 @@ ASSUME_EXPIRED = "AssumeExpired"
 FETCH_FAILED = "FetchFailed"
 DEGRADED = "Degraded"
 PROMOTED = "Promoted"
+# watchtower additions: alert-rule transitions (metrics/rules.py) ride
+# the events ring too — the literals live in rules.py so metrics/ stays
+# importable without core/, and these constants keep the reason
+# namespace discoverable in one place
+ALERT_FIRING = "AlertFiring"
+ALERT_RESOLVED = "AlertResolved"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -132,6 +132,12 @@ ANOMALY_CLASSES = (
     # weighted-fair shed is the intake-side guard). The detail carries
     # the tenant id, its pending depth, and the streak length.
     "tenant_starved",
+    # a declarative alert rule fired (metrics/rules.py RuleEngine):
+    # raised externally once per firing — not per evaluation — with the
+    # rule name, severity, observed value and threshold in the detail,
+    # so the anomaly ring carries the alert timeline next to the raw
+    # symptoms the rule aggregated over
+    "alert",
 )
 
 # Fixed log-ish bucket edges (seconds) for the streaming phase

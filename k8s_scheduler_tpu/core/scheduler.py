@@ -69,6 +69,7 @@ from .degrade import (
 from .events import EventRecorder, failed_scheduling_message
 from .flight_recorder import FlightRecorder
 from . import spans as _spans
+from . import blackbox as _blackbox
 
 # binder(pod, node_name) -> None; raise to signal bind failure
 Binder = Callable[[Pod, str], None]
@@ -2770,6 +2771,13 @@ class Scheduler:
                 rung=new_rung,
             )
             self.flight.commit(rec)
+        if _blackbox.ARMED and cls == "deadline":
+            # a watchdog-aborted dispatch is a black-box trigger: the
+            # tunnel just proved it can wedge, so capture the rings
+            # NOW — a later kill -9 must still find this bundle
+            _blackbox.trigger(
+                "watchdog", f"profile={profile} seq={seq} {e}"
+            )
 
     def _on_rung_transition(
         self, old: int, new: int, reason: str
@@ -2821,6 +2829,12 @@ class Scheduler:
             # "normal" while mutations go unjournaled — the standby
             # takeover is the recovery that clears this
             self.ladder.floor = RUNG_STATELESS
+        if new >= RUNG_STATELESS and old < RUNG_STATELESS:
+            # entering stateless is the "something is very wrong"
+            # boundary whether or not durable state was attached:
+            # dump the black box while the rings still hold the fault
+            if _blackbox.ARMED:
+                _blackbox.trigger("stateless", reason)
 
     def _apply_phase(
         self,

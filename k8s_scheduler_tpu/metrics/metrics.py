@@ -192,6 +192,15 @@ cmd/main.py startup stamp):
   set once at startup so dashboards can correlate latency shifts with
   binary or runtime changes; bench headline artifacts carry the same
   stamp (build_fingerprint())
+- scheduler_uptime_seconds — seconds since SchedulerMetrics
+  construction (process start for the CLI), evaluated at scrape time;
+  joins build_info so restart storms are visible without log access
+- scheduler_alerts_total{rule,severity} — declarative alert-rule
+  firings from the in-process watchtower (metrics/rules.py; one
+  increment per ok->firing transition, never per evaluation); the
+  rule inventory is rules.BUILTIN_RULES, machine-checked by schedlint
+  ID011 against the README alert table, and each firing also raises
+  an `alert` anomaly and an AlertFiring event
 
 Durable-state families (state/ package — write-ahead journal, snapshots,
 restore) and leader election:
@@ -228,6 +237,7 @@ multi-scheduler processes that need isolated registries pass their own
 from __future__ import annotations
 
 import threading
+import time as _time
 
 from prometheus_client import (
     CollectorRegistry,
@@ -606,6 +616,28 @@ class SchedulerMetrics:
             "as labels (python | jax | jaxlib | backend | git), set "
             "once at startup (build_fingerprint()).",
             ["python", "jax", "jaxlib", "backend", "git"],
+            registry=r,
+        )
+        self.uptime = Gauge(
+            "scheduler_uptime_seconds",
+            "Seconds since SchedulerMetrics construction (process "
+            "start for the CLI), evaluated at scrape time.",
+            registry=r,
+        )
+        _t0 = _time.monotonic()
+        # whole seconds: sub-second precision is useless for an uptime
+        # join, and a full-precision float would make the rendered
+        # /metrics payload length differ between back-to-back scrapes
+        # (GET vs HEAD Content-Length must agree)
+        self.uptime.set_function(
+            lambda: float(int(_time.monotonic() - _t0))
+        )
+        self.alerts = Counter(
+            "scheduler_alerts_total",
+            "Watchtower alert-rule firings by rule name and severity "
+            "(metrics/rules.py; one increment per ok->firing "
+            "transition).",
+            ["rule", "severity"],
             registry=r,
         )
         # ---- durable state (state/: journal + snapshots + restore) ----

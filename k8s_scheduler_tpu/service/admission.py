@@ -61,6 +61,7 @@ import logging
 import threading
 import time as _time
 
+from ..core import blackbox as _blackbox
 from ..core import spans as _spans
 
 log = logging.getLogger(__name__)
@@ -709,6 +710,13 @@ class FrontDoor:
                     "front-door cycle failed (%d so far) — backing "
                     "off %.1fs and continuing",
                     self.cycle_failures, self._failure_backoff,
+                )
+                # unhandled serve-loop exception = black-box trigger
+                # (throttled inside; the loop is about to keep running,
+                # so the bundle must capture the rings now)
+                _blackbox.trigger(
+                    "serve_loop",
+                    f"cycle_failures={self.cycle_failures}",
                 )
                 self._stop.wait(self._failure_backoff)
                 continue

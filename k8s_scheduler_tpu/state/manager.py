@@ -531,8 +531,11 @@ class DurableState:
         deg = getattr(self, "degradation", None)
         if deg is not None:
             # the Scheduler pins its DegradationLadder here: the current
-            # rung belongs next to the durability it can seal away
+            # rung belongs next to the durability it can seal away —
+            # plus the full transition ring (wall-timestamped), so MTTR
+            # is computable over HTTP instead of from logs
             out["degradation"] = deg.status()
+            out["degradation"]["transition_log"] = deg.transition_log()
         shard = getattr(self, "sharding", None)
         if shard is not None:
             # the Scheduler pins its mesh layout + per-profile

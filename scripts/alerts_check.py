@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""CI gate: a short CLEAN soak must fire zero watchtower alerts.
+
+Drives the real Scheduler (CPU backend, tiny shapes) for a few dozen
+cycles with healthy synthetic churn, the in-process TSDB armed and the
+built-in rule pack evaluated exactly as the CLI wires it — windows
+scaled down (--time-scale) so `for`-durations hold within the soak.
+Any firing means either the pack's thresholds drifted into the healthy
+envelope (a false-page waiting to happen) or the scheduler's healthy
+envelope drifted into the thresholds (a regression); both are CI
+failures. Prints ONE JSON line and exits nonzero on any firing.
+
+    JAX_PLATFORMS=cpu python scripts/alerts_check.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--pods", type=int, default=32)
+    ap.add_argument("--nodes", type=int, default=8)
+    ap.add_argument("--cycles", type=int, default=40)
+    ap.add_argument(
+        "--time-scale", type=float, default=0.05,
+        help="rule window/for-duration scale: production rules carry "
+        "10-60 s horizons, the soak runs seconds — 0.05 turns a 20 s "
+        "for-duration into 1 s so a sustained-bad condition WOULD fire "
+        "within the soak (and a clean one still must not)",
+    )
+    args = ap.parse_args()
+
+    from k8s_scheduler_tpu.core import Scheduler
+    from k8s_scheduler_tpu.metrics import tsdb as _tsdb
+    from k8s_scheduler_tpu.metrics.rules import (
+        RuleEngine,
+        builtin_rules,
+        scale_rules,
+    )
+    from k8s_scheduler_tpu.models import MakeNode, MakePod
+
+    bound: dict[str, str] = {}
+    sched = Scheduler(
+        binder=lambda pod, node: bound.setdefault(pod.name, node),
+    )
+    store = _tsdb.arm(_tsdb.MetricsTSDB(eval_interval_s=0.0))
+    try:
+        engine = RuleEngine(
+            scale_rules(builtin_rules(), args.time_scale),
+            store,
+            observer=sched.observer,
+            events=sched.events,
+            metrics=sched.metrics,
+        )
+        store.engine = engine
+        sched.flight.observers.append(store.observe_record)
+        store.start_ticker(sched.metrics.registry, interval_s=0.2)
+
+        for i in range(args.nodes):
+            sched.on_node_add(
+                MakeNode(f"n{i}").capacity({"cpu": "64"}).obj()
+            )
+        t0 = time.perf_counter()
+        for c in range(args.cycles):
+            # healthy churn: a fresh small batch each cycle, binding
+            # immediately — the clean envelope the pack must tolerate
+            for p in range(args.pods // 4):
+                sched.on_pod_add(
+                    MakePod(f"c{c}-p{p}").req({"cpu": "1"}).obj()
+                )
+            sched.schedule_cycle()
+        soak_s = time.perf_counter() - t0
+        # let the ticker land a few registry sweeps + evaluations
+        time.sleep(1.0)
+        store.stop_ticker()
+        status = engine.status()
+    finally:
+        _tsdb.disarm()
+
+    row = {
+        "cycles": args.cycles,
+        "soak_s": round(soak_s, 3),
+        "bound": len(bound),
+        "alerts_fired": status["fired_total"],
+        "active": [a["rule"] for a in status["active"]],
+        "resolved": [a["rule"] for a in status["resolved"]],
+        "evaluations": status["evaluations"],
+        "series": store.status()["series"],
+        "time_scale": args.time_scale,
+    }
+    print(json.dumps(row, sort_keys=True))
+    if status["fired_total"]:
+        print(
+            "alerts_check: FAILED — clean soak fired "
+            f"{status['fired_total']} alert(s): "
+            f"{sorted(set(row['active'] + row['resolved']))}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"alerts_check: ok ({args.cycles} cycles, "
+        f"{status['evaluations']} evaluations, 0 firings)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -150,7 +150,9 @@ _METRICS = {
     "arena_device_speedup": ("higher", "arena_device_speedup", "adspd"),
     "tenants_per_dispatch": ("higher", "tenants_per_dispatch", "tpd"),
 }
-_COUNT_METRICS = ("stall_cycles", "anomalies_total", "degraded_cycles")
+_COUNT_METRICS = (
+    "stall_cycles", "anomalies_total", "degraded_cycles", "alerts_fired",
+)
 
 
 def _scan_tail(text: str) -> list[dict]:
@@ -209,6 +211,11 @@ def _normalize(row: dict) -> dict | None:
     if anom is not None:
         out["anomalies"] = dict(anom)
         out["anomalies_total"] = int(sum(anom.values()))
+    # watchtower replay (ISSUE 20): rule-pack firings over the same
+    # latency series — absent on artifacts predating the pack
+    alerts = row.get("alerts_fired", row.get("alerts"))
+    if alerts is not None:
+        out["alerts_fired"] = int(alerts)
     # require at least one real metric besides the config id, so a torn
     # tail fragment can't masquerade as a record
     if not any(k in out for k in _METRICS):

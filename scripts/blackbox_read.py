@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Read a crash black-box bundle: summarize, dump, or extract the trace.
+
+A bundle is the single JSON file `core/blackbox.py` writes at fault
+time (watchdog abort, degrade-to-stateless, serve-loop exception,
+SIGTERM). This reader is the post-mortem side of that contract:
+
+    python scripts/blackbox_read.py <bundle.json | blackbox-dir>
+        # human summary: trigger, alert + anomaly tails, ladder moves
+    python scripts/blackbox_read.py <path> --json
+        # full bundle to stdout (pipe to jq)
+    python scripts/blackbox_read.py <path> --perfetto out.json
+        # extract the pre-rendered Chrome/Perfetto trace for ui.perfetto.dev
+
+Given a directory (e.g. <stateDir>/blackbox/), reads the NEWEST bundle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _resolve(path: str) -> str:
+    if os.path.isdir(path):
+        names = sorted(
+            n for n in os.listdir(path)
+            if n.startswith("blackbox-") and n.endswith(".json")
+        )
+        if not names:
+            raise SystemExit(f"no blackbox-*.json bundles under {path}")
+        return os.path.join(path, names[-1])
+    return path
+
+
+def _wall(w) -> str:
+    try:
+        return datetime.datetime.fromtimestamp(
+            float(w), tz=datetime.timezone.utc
+        ).isoformat(timespec="milliseconds")
+    except (TypeError, ValueError, OSError):
+        return repr(w)
+
+
+def _summary(path: str, b: dict) -> None:
+    print(f"bundle:   {path}")
+    print(f"trigger:  {b.get('trigger')}  ({b.get('detail') or '-'})")
+    print(f"wall:     {_wall(b.get('wall'))}  pid={b.get('pid')}")
+    build = b.get("build") or {}
+    if build:
+        print("build:    " + " ".join(
+            f"{k}={build[k]}" for k in sorted(build)
+        ))
+
+    alerts = b.get("alerts") or {}
+    active = alerts.get("active") or []
+    resolved = alerts.get("resolved") or []
+    print(f"\nalerts:   {len(active)} active, {len(resolved)} resolved "
+          f"(fired_total={alerts.get('fired_total', 0)})")
+    for a in active:
+        print(f"  FIRING  {a['rule']} [{a['severity']}] "
+              f"value={a.get('value')} {a.get('op')} {a.get('threshold')} "
+              f"since {_wall(a.get('fired_wall'))}")
+    for a in resolved[-5:]:
+        print(f"  resolved {a['rule']} [{a['severity']}] "
+              f"{_wall(a.get('fired_wall'))} -> "
+              f"{_wall(a.get('resolved_wall'))}")
+
+    anomalies = (b.get("anomalies") or {}).get("events") or []
+    print(f"\nanomalies: {len(anomalies)} in ring; tail:")
+    for ev in anomalies[-10:]:
+        det = ev.get("detail") or {}
+        det_s = " ".join(f"{k}={det[k]}" for k in sorted(det))
+        print(f"  {ev.get('class'):<16} seq={ev.get('seq'):>6} "
+              f"phase={ev.get('phase') or '-'} "
+              f"value_ms={ev.get('value_ms')} {det_s}")
+
+    ladder = b.get("ladder") or {}
+    moves = ladder.get("transitions") or []
+    print(f"\nladder:   {len(moves)} transitions; tail:")
+    for m in moves[-8:]:
+        print(f"  seq={m.get('seq'):>6} {m.get('from_name')} -> "
+              f"{m.get('to_name')}  ({m.get('reason')})")
+
+    faults = b.get("faults") or {}
+    fired = faults.get("fired") or []
+    if fired:
+        print(f"\nfaults:   {len(fired)} injection points fired")
+
+    hist = b.get("metrics_history") or {}
+    print(f"\nmetrics_history: {len(hist.get('series') or [])} series "
+          "captured")
+    flight = (b.get("flight") or {})
+    print(f"flight:   {len(flight.get('records') or [])} cycle records, "
+          f"cycles={flight.get('cycles')}")
+    print(f"events:   {len(b.get('events') or [])} in tail")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="bundle file, or directory of bundles")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the full bundle JSON to stdout")
+    ap.add_argument("--perfetto", metavar="OUT",
+                    help="write the bundle's chrome_trace to OUT")
+    args = ap.parse_args()
+
+    from k8s_scheduler_tpu.core.blackbox import load_bundle
+
+    path = _resolve(args.path)
+    bundle = load_bundle(path)
+
+    if args.perfetto:
+        trace = bundle.get("chrome_trace")
+        if trace is None:
+            raise SystemExit(
+                "bundle has no chrome_trace key (flight recorder was "
+                "not attached when the box was armed)"
+            )
+        with open(args.perfetto, "w") as f:
+            json.dump(trace, f)
+        n = len(trace.get("traceEvents", trace)) if isinstance(
+            trace, (dict, list)
+        ) else 0
+        print(f"wrote {args.perfetto} ({n} trace events) — open at "
+              "https://ui.perfetto.dev", file=sys.stderr)
+        return 0
+
+    if args.json:
+        json.dump(bundle, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+
+    _summary(path, bundle)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1657,6 +1657,93 @@ def test_inventory_drift_span_names_id010(tmp_path):
     )
 
 
+# ---- ID011: the alert rule-pack inventory pin ----------------------------
+
+
+def test_inventory_drift_alert_rules_id011(tmp_path):
+    """ID011: rules.BUILTIN_RULES, the README 'Metrics history, alert
+    rules & the black box' rule table, and the `alert` anomaly class
+    cannot drift — an undocumented rule pages an operator the runbook
+    never heard of, and a missing `alert` class crashes every firing."""
+    result = lint_fixture(tmp_path, {
+        # a NEW rule "mystery_burn" joined the pack, and the class list
+        # lost "alert"...
+        "metrics/rules.py": """\
+            BUILTIN_RULES = (
+                {"name": "slo_burn", "family": "scheduler_slo_burn_rate",
+                 "agg": "avg", "window_s": 30.0, "threshold": 6.0},
+                {"name": "mystery_burn", "family": "scheduler_x_total",
+                 "agg": "rate", "window_s": 60.0, "threshold": 1.0},
+            )
+        """,
+        "core/observe.py": """\
+            ANOMALY_CLASSES = (
+                "tunnel_stall",
+                "degraded",
+            )
+        """,
+        # ...and the README table documents a rule the pack deleted
+        "README.md": """\
+            # fixture
+
+            ### Metrics history, alert rules & the black box
+
+            | rule | condition |
+            |---|---|
+            | `slo_burn` | burn rate > 6 |
+            | `ghost_rule` | long gone |
+        """,
+    }, passes=["INVENTORY-DRIFT"])
+    msgs = [f.message for f in codes_at(result, "ID011")]
+    assert any("'mystery_burn'" in m and "not" in m for m in msgs)
+    assert any("'ghost_rule'" in m and "stale row" in m for m in msgs)
+    assert any('"alert" is missing' in m for m in msgs)
+    assert len(msgs) == 3
+
+    # a consistent tree lints clean; scheduler_-prefixed first-column
+    # rows (family names) belong to ID001 and are not phantom rules
+    clean = lint_fixture(tmp_path / "clean", {
+        "metrics/rules.py": """\
+            BUILTIN_RULES = (
+                {"name": "slo_burn", "family": "scheduler_slo_burn_rate",
+                 "agg": "avg", "window_s": 30.0, "threshold": 6.0},
+            )
+        """,
+        "core/observe.py": 'ANOMALY_CLASSES = ("alert",)\n',
+        "README.md": (
+            "### Metrics history, alert rules & the black box\n\n"
+            "| `slo_burn` | burn rate > 6 |\n"
+            "| `scheduler_slo_burn_rate` | the family itself |\n"
+        ),
+    }, passes=["INVENTORY-DRIFT"])
+    assert codes_at(clean, "ID011") == []
+
+    # the pack must stay a statically-extractable literal
+    anchorless = lint_fixture(tmp_path / "anchorless", {
+        "metrics/rules.py":
+            "BUILTIN_RULES = tuple(make_rule(n) for n in ())\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "no statically-extractable BUILTIN_RULES" in f.message
+        for f in codes_at(anchorless, "ID011")
+    )
+
+    # the README section itself missing is flagged
+    sectionless = lint_fixture(tmp_path / "sectionless", {
+        "metrics/rules.py": """\
+            BUILTIN_RULES = (
+                {"name": "slo_burn", "family": "f",
+                 "agg": "avg", "window_s": 30.0},
+            )
+        """,
+        "README.md": "# no watchtower section\n",
+    }, passes=["INVENTORY-DRIFT"])
+    assert any(
+        "Metrics history, alert rules" in f.message
+        for f in codes_at(sectionless, "ID011")
+    )
+
+
 # ---- wall-clock satellites: parse cache, fingerprints, --changed ---------
 
 
